@@ -1,0 +1,64 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on jax/XLA/pallas.
+
+Architecture (see SURVEY.md):
+  - models are jax pytrees (`nn.Layer`) — jit/grad/pjit work on them directly
+  - ops lower to XLA HLO; hot paths use pallas TPU kernels (`ops/`)
+  - distributed = `jax.sharding.Mesh` + GSPMD specs (`distributed/`),
+    replacing Fleet's NCCL process groups with ICI collectives
+"""
+from __future__ import annotations
+
+__version__ = '0.1.0'
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    finfo,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    iinfo,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import Tensor  # noqa: F401
+from . import tensor  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import grad, no_grad, value_and_grad  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import framework  # noqa: F401
+from . import device  # noqa: F401
+from .device import CPUPlace, TPUPlace, get_device, set_device  # noqa: F401
+from . import jit  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import linalg  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+
+import jax.numpy as _jnp
+
+# dtype checks on arrays
+def is_floating_point(x):
+    return _dtype_mod.is_floating_point(x.dtype if hasattr(x, 'dtype') else x)
+
+
+def is_complex(x):
+    import numpy as _np
+
+    return _np.issubdtype(x.dtype, _np.complexfloating)
+
+
+def is_integer(x):
+    return _dtype_mod.is_integer(x.dtype if hasattr(x, 'dtype') else x)

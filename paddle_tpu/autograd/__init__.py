@@ -1,0 +1,161 @@
+"""Autograd (ref: python/paddle/autograd).
+
+Paddle's dygraph autograd records a C++ tape and walks it on
+``Tensor.backward()``. TPU-native: differentiation is a program
+transform. ``value_and_grad``/``grad`` differentiate a loss function
+w.r.t. the *trainable* leaves of a model pytree (stop_gradient /
+trainable=False params and buffers are frozen out structurally), which
+is both the jax idiom and what XLA wants — one fused fwd+bwd program.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+from ..framework import tree as tree_util
+from ..framework.tree import global_norm, merge, split_trainable
+
+__all__ = [
+    'grad',
+    'value_and_grad',
+    'no_grad',
+    'enable_grad',
+    'is_grad_enabled',
+    'stop_gradient',
+    'PyLayer',
+    'jvp',
+    'vjp',
+    'jacobian',
+    'hessian',
+]
+
+
+def value_and_grad(fn, has_aux=False, model_arg=0):
+    """Differentiate ``fn(model, *args)`` w.r.t. the trainable leaves of
+    ``model``. Returns ``(value, grads)`` where ``grads`` is model-shaped
+    with ``None`` in frozen slots (so ``jax.tree.map`` over
+    ``(params, grads)`` aligns).
+
+    If the step mutates layer state (BatchNorm stats, RNG keys), return
+    the model from ``fn`` via ``has_aux`` to carry the updates out.
+    """
+
+    def wrapped(*args, **kwargs):
+        model = args[model_arg]
+        trainable, frozen = split_trainable(model)
+
+        def inner(t):
+            m = merge(t, frozen)
+            new_args = args[:model_arg] + (m,) + args[model_arg + 1 :]
+            return fn(*new_args, **kwargs)
+
+        return jax.value_and_grad(inner, has_aux=has_aux)(trainable)
+
+    return wrapped
+
+
+def grad(fn, has_aux=False, model_arg=0):
+    vg = value_and_grad(fn, has_aux=has_aux, model_arg=model_arg)
+
+    def wrapped(*args, **kwargs):
+        _, g = vg(*args, **kwargs)
+        return g
+
+    return wrapped
+
+
+_grad_enabled = [True]
+
+
+@contextlib.contextmanager
+def no_grad():
+    """API-parity context (ref: paddle.no_grad). In a functional framework
+    gradients only flow through explicit grad transforms; this context
+    flags intent and is consulted by Layer code paths that would
+    otherwise thread state for backward."""
+    _grad_enabled.append(False)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+@contextlib.contextmanager
+def enable_grad():
+    _grad_enabled.append(True)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+def is_grad_enabled():
+    return _grad_enabled[-1]
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+class PyLayer:
+    """Custom-VJP op (ref: paddle.autograd.PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and
+    ``backward(ctx, *grads)``; ``ctx.save_for_backward(*xs)`` stashes
+    residuals. Compiles to a jax.custom_vjp under the hood.
+    """
+
+    class _Ctx:
+        def __init__(self):
+            self.saved = ()
+
+        def save_for_backward(self, *xs):
+            self.saved = xs
+
+        def saved_tensor(self):
+            return self.saved
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+
+        @jax.custom_vjp
+        def op(*args):
+            return cls.forward(PyLayer._Ctx(), *args)
+
+        def fwd(*args):
+            ctx = PyLayer._Ctx()
+            out = cls.forward(ctx, *args)
+            return out, ctx.saved
+
+        def bwd(saved, g):
+            ctx = PyLayer._Ctx()
+            ctx.saved = saved
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return grads
+
+        op.defvjp(fwd, bwd)
+        cls._op = staticmethod(op)
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._op(*args)
+
+
+def jvp(fn, primals, tangents):
+    return jax.jvp(fn, primals, tangents)
+
+
+def vjp(fn, *primals):
+    return jax.vjp(fn, *primals)
+
+
+def jacobian(fn, x):
+    return jax.jacrev(fn)(x)
+
+
+def hessian(fn, x):
+    return jax.hessian(fn)(x)

@@ -1,0 +1,7 @@
+from . import dtype, random, tree  # noqa: F401
+from .dtype import (  # noqa: F401
+    convert_dtype,
+    get_default_dtype,
+    set_default_dtype,
+)
+from .random import get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,88 @@
+"""Dtype system.
+
+Paddle-style dtype names mapped onto jax/numpy dtypes
+(ref: python/paddle/framework/dtype.py). TPU-first: bfloat16 is the
+preferred low-precision compute dtype; float32 is the default.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+try:  # fp8 for quantized matmul paths (TPU v5+)
+    float8_e4m3 = jnp.float8_e4m3fn
+    float8_e5m2 = jnp.float8_e5m2
+except AttributeError:  # pragma: no cover
+    float8_e4m3 = None
+    float8_e5m2 = None
+
+_STR2DTYPE = {
+    'bool': bool_,
+    'uint8': uint8,
+    'int8': int8,
+    'int16': int16,
+    'int32': int32,
+    'int64': int64,
+    'float16': float16,
+    'fp16': float16,
+    'bfloat16': bfloat16,
+    'bf16': bfloat16,
+    'float32': float32,
+    'fp32': float32,
+    'float64': float64,
+    'fp64': float64,
+    'complex64': complex64,
+    'complex128': complex128,
+}
+
+_default_dtype = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalise a dtype-ish value (str | np.dtype | jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_STR2DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    """ref: paddle.set_default_dtype (python/paddle/framework/framework.py)."""
+    _default_dtype[0] = convert_dtype(dtype)
+
+
+def get_default_dtype():
+    return np.dtype(_default_dtype[0])
+
+
+def is_floating_point(dtype):
+    return np.issubdtype(convert_dtype(dtype), np.floating) or convert_dtype(
+        dtype
+    ) == np.dtype(bfloat16)
+
+
+def is_integer(dtype):
+    return np.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

@@ -1,0 +1,89 @@
+"""Serialization: paddle_tpu.save / load (ref: python/paddle/framework/io.py).
+
+State dicts (flat name->array) and nested pytrees are stored as .npz
+with a JSON treedef sidecar entry — no pickle, portable, atomic write.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_state(obj, prefix=''):
+    out = {}
+    if isinstance(obj, dict):
+        items = obj.items()
+    elif isinstance(obj, (list, tuple)):
+        items = ((str(i), v) for i, v in enumerate(obj))
+    else:
+        from ..nn.layer.base import Layer
+
+        if isinstance(obj, Layer):
+            return _flatten_state(obj.state_dict(), prefix)
+        out[prefix or 'value'] = np.asarray(obj)
+        return out
+    for k, v in items:
+        path = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, (dict, list, tuple)):
+            out.update(_flatten_state(v, path))
+        elif v is None:
+            out[path + '#none'] = np.zeros(0)
+        elif np.isscalar(v) or isinstance(v, (jax.Array, np.ndarray)):
+            out[path] = np.asarray(v)
+        else:
+            out[path + '#json'] = np.frombuffer(
+                json.dumps(v).encode(), dtype=np.uint8
+            ).copy()
+    return out
+
+
+def save(obj, path, protocol=None):
+    """ref: paddle.save. Atomic: writes tmp then renames."""
+    path = str(path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    flat = _flatten_state(obj)
+    structure = {
+        'format': 'paddle_tpu.v1',
+        'kind': type(obj).__name__,
+    }
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
+    os.close(fd)
+    try:
+        np.savez(tmp, __meta__=np.frombuffer(json.dumps(structure).encode(), dtype=np.uint8), **flat)
+        os.replace(tmp + '.npz' if os.path.exists(tmp + '.npz') else tmp, path)
+    finally:
+        for t in (tmp, tmp + '.npz'):
+            if os.path.exists(t):
+                os.remove(t)
+
+
+def load(path, return_numpy=False):
+    """ref: paddle.load. Returns nested dict of arrays."""
+    data = np.load(str(path), allow_pickle=False)
+    out = {}
+    for key in data.files:
+        if key == '__meta__':
+            continue
+        v = data[key]
+        if key.endswith('#none'):
+            key, v = key[:-5], None
+        elif key.endswith('#json'):
+            key, v = key[:-5], json.loads(v.tobytes().decode())
+        elif not return_numpy and isinstance(v, np.ndarray):
+            if v.dtype != object:
+                v = jnp.asarray(v)
+        _insert(out, key.split('/'), v)
+    if list(out.keys()) == ['value']:
+        return out['value']
+    return out
+
+
+def _insert(d, parts, v):
+    for p in parts[:-1]:
+        d = d.setdefault(p, {})
+    d[parts[-1]] = v

@@ -1,0 +1,44 @@
+"""Global RNG management.
+
+Paddle exposes a global seed (`paddle.seed`, ref:
+python/paddle/framework/random.py); jax is functional with explicit PRNG
+keys. Bridge: a process-global key that is split on every draw *in eager
+code* (module init, data pipeline). Inside jit-traced code, layers carry
+their own key leaves (see nn.Layer rng handling) so tracing stays pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, 'key'):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(s: int):
+    """Set the global seed (ref: paddle.seed)."""
+    _state.key = jax.random.PRNGKey(int(s))
+    return s
+
+
+def split_key(num: int = 1):
+    """Draw `num` fresh keys from the global stream (eager only)."""
+    keys = jax.random.split(_get_key(), num + 1)
+    _state.key = keys[0]
+    if num == 1:
+        return keys[1]
+    return list(keys[1:])
+
+
+def get_rng_state():
+    return _get_key()
+
+
+def set_rng_state(key):
+    _state.key = key

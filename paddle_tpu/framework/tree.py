@@ -1,0 +1,141 @@
+"""Model-pytree utilities: filtered partition/merge for autograd, and
+sharding-spec extraction for pjit.
+
+Paddle's autograd engine walks a C++ tape and only accumulates grads for
+``stop_gradient=False`` tensors (ref: paddle/fluid/eager/backward.cc).
+Here the equivalent is structural: split the model pytree into trainable
+and frozen halves, differentiate w.r.t. the trainable half, merge back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_layer(x):
+    from ..nn.layer.base import Layer
+
+    return isinstance(Layer, type) and isinstance(x, Layer)
+
+
+def _map_model(obj, fn, path=''):
+    """Recursively copy a model-ish pytree, applying ``fn(meta, path, leaf)``
+    to each array leaf. ``meta`` is the owning Layer's _Meta (or None for
+    arrays outside any Layer). Layers are shallow-copied via pytree
+    unflatten so originals are untouched."""
+    from ..nn.layer.base import Layer, _META_BUFFER
+
+    if isinstance(obj, Layer):
+        cls = type(obj)
+        new = object.__new__(cls)
+        new.__dict__.update(
+            {
+                k: v
+                for k, v in obj.__dict__.items()
+                if not (isinstance(v, (jax.Array,)) or isinstance(v, Layer) or _is_arr(v))
+            }
+        )
+        new.__dict__['_param_meta'] = dict(obj._param_meta)
+        for name, child in obj._children():
+            p = f"{path}.{name}" if path else name
+            if isinstance(child, Layer):
+                new.__dict__[name] = _map_model(child, fn, p)
+            else:
+                meta = obj._param_meta.get(name, _META_BUFFER)
+                new.__dict__[name] = fn(meta, p, child)
+        return new
+    if isinstance(obj, dict):
+        return {k: _map_model(v, fn, f"{path}.{k}" if path else str(k)) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_map_model(v, fn, f"{path}.{i}") for i, v in enumerate(obj)]
+        return type(obj)(t) if not hasattr(obj, '_fields') else type(obj)(*t)
+    if _is_arr(obj):
+        return fn(None, path, obj)
+    return obj
+
+
+def _is_arr(v):
+    import numpy as np
+
+    return isinstance(v, (jax.Array, np.ndarray))
+
+
+def _trainable(meta):
+    return meta is not None and meta.kind == 'param' and meta.trainable
+
+
+def split_trainable(model):
+    """Return (trainable, frozen): two model-shaped copies where the
+    complementary leaves are None (an empty pytree node for jax)."""
+    t = _map_model(model, lambda m, p, x: x if _trainable(m) else None)
+    f = _map_model(model, lambda m, p, x: None if _trainable(m) else x)
+    return t, f
+
+
+def merge(a, b):
+    """Merge two same-structure partitions (None leaves filled from the other)."""
+    from ..nn.layer.base import Layer
+
+    if isinstance(a, Layer) and isinstance(b, Layer):
+        cls = type(a)
+        new = object.__new__(cls)
+        new.__dict__.update({k: v for k, v in a.__dict__.items()})
+        new.__dict__['_param_meta'] = dict(a._param_meta)
+        names = {n for n, _ in a._children()} | {n for n, _ in b._children()}
+        for name in names:
+            va = a.__dict__.get(name)
+            vb = b.__dict__.get(name)
+            if isinstance(va, Layer) or isinstance(vb, Layer):
+                new.__dict__[name] = merge(va, vb)
+            else:
+                new.__dict__[name] = va if va is not None else vb
+        return new
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, dict):
+        return {k: merge(a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        t = [merge(x, y) for x, y in zip(a, b)]
+        return type(a)(t) if not hasattr(a, '_fields') else type(a)(*t)
+    return a
+
+
+def leaves_with_meta(model, path=''):
+    """Yield (path, meta, leaf) for every array leaf in the model."""
+    out = []
+
+    def fn(meta, p, x):
+        out.append((p, meta, x))
+        return x
+
+    _map_model(model, fn, path)
+    return out
+
+
+def spec_tree(model, default=None):
+    """Model-shaped pytree of PartitionSpecs from parameter metadata
+    (used by distributed.parallelize to build pjit shardings)."""
+    from jax.sharding import PartitionSpec as P
+
+    rep = P() if default is None else default
+    return _map_model(model, lambda m, p, x: (m.spec if (m and m.spec is not None) else rep))
+
+
+def tree_cast(tree, dtype, floating_only=True):
+    def cast(x):
+        if x is None:
+            return x
+        if floating_only and not (
+            jnp.issubdtype(x.dtype, jnp.floating) or x.dtype == jnp.bfloat16
+        ):
+            return x
+        return x.astype(dtype)
+
+    return jax.tree.map(cast, tree)
+
+
+def global_norm(tree):
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
+    return jnp.sqrt(sum(leaves)) if leaves else jnp.array(0.0)

@@ -1,0 +1,27 @@
+"""Data pipeline (ref: python/paddle/io/__init__.py).
+
+Paddle's DataLoader: C++ worker pool → pinned buffers → async H2D copy.
+TPU-native: Python/multiprocess workers producing numpy batches → a
+double-buffered `jax.device_put` prefetcher that overlaps host→HBM DMA
+with the running step (XLA's async dispatch gives the overlap for free).
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .dataloader import (  # noqa: F401
+    BatchSampler,
+    DataLoader,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+    default_collate_fn,
+)

@@ -1,0 +1,296 @@
+"""DataLoader (ref: python/paddle/io/dataloader/dataloader_iter.py).
+
+Multiprocess map-style loading with order-preserving prefetch, plus a
+device-prefetch wrapper that keeps `prefetch_depth` batches in flight to
+HBM so the accelerator never waits on the host (the TPU analogue of
+Paddle's pinned-memory + cudaMemcpyAsync pipeline).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+from ..framework import random as random_mod
+from .dataset import Dataset, IterableDataset
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+
+    def __iter__(self):
+        import jax
+
+        n = len(self.data_source)
+        key = random_mod.split_key()
+        if self.replacement:
+            idx = np.asarray(jax.random.randint(key, (self.num_samples,), 0, n))
+        else:
+            idx = np.asarray(jax.random.permutation(key, n))[: self.num_samples]
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = np.random.default_rng(int(np.asarray(random_mod.split_key())[0]))
+        idx = rng.choice(len(p), size=self.num_samples, replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1, drop_last=False):
+        self.sampler = sampler or (
+            RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        )
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards the index space across data-parallel workers
+    (ref: python/paddle/io/dataloader/batch_sampler.py)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        import jax
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else jax.process_count()
+        self.local_rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        self.num_samples = (n + self.nranks - 1) // self.nranks if not drop_last else n // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - len(indices)]
+        indices = indices[self.local_rank : self.total_size : self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+def default_collate_fn(batch):
+    item = batch[0]
+    if isinstance(item, (np.ndarray, np.generic)) or np.isscalar(item):
+        return np.stack([np.asarray(b) for b in batch])
+    if hasattr(item, 'shape'):
+        return np.stack([np.asarray(b) for b in batch])
+    if isinstance(item, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in item}
+    if isinstance(item, (list, tuple)):
+        return type(item)(default_collate_fn(list(col)) for col in zip(*batch))
+    return np.asarray(batch)
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        seq, idxs = task
+        try:
+            batch = collate_fn([dataset[i] for i in idxs])
+            data_queue.put((seq, batch, None))
+        except Exception as e:  # pragma: no cover
+            data_queue.put((seq, None, repr(e)))
+
+
+class DataLoader:
+    """ref: paddle.io.DataLoader."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=60,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.batch_sampler = None
+        else:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError('IterableDataset DataLoader has no len()')
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_inline()
+        return self._iter_workers()
+
+    def _iter_iterable(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_inline(self):
+        for idxs in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _iter_workers(self):
+        ctx = mp.get_context('fork')
+        index_queue = ctx.Queue()
+        data_queue = ctx.Queue()
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queue, data_queue, self.collate_fn),
+                daemon=True,
+            )
+            for _ in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            batches = list(self.batch_sampler)
+            inflight = 0
+            next_submit = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            reorder = {}
+            next_yield = 0
+            while next_submit < len(batches) and inflight < max_inflight:
+                index_queue.put((next_submit, batches[next_submit]))
+                next_submit += 1
+                inflight += 1
+            while next_yield < len(batches):
+                if next_yield in reorder:
+                    b = reorder.pop(next_yield)
+                else:
+                    seq, batch, err = data_queue.get(timeout=self.timeout)
+                    inflight -= 1
+                    if next_submit < len(batches):
+                        index_queue.put((next_submit, batches[next_submit]))
+                        next_submit += 1
+                        inflight += 1
+                    if err is not None:
+                        raise RuntimeError(f'DataLoader worker failed: {err}')
+                    if seq != next_yield:
+                        reorder[seq] = batch
+                        continue
+                    b = batch
+                yield b
+                next_yield += 1
+        finally:
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+
+def prefetch_to_device(iterator, size=2, sharding=None):
+    """Double-buffered device prefetch: keeps `size` batches resident in HBM
+    ahead of consumption. The host thread stays `size` steps ahead;
+    device_put is async so H2D DMA overlaps compute."""
+    import jax
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    buf = []
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.pop(0)
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,3 @@
+"""paddle_tpu.linalg namespace (ref: python/paddle/linalg.py)."""
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor.math import matmul  # noqa: F401

@@ -1,0 +1,71 @@
+"""Gradient clipping (ref: python/paddle/nn/clip.py).
+
+Clip objects transform a *gradient pytree* functionally — attached to an
+optimizer via ``grad_clip=`` exactly like Paddle.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+
+        return jax.tree.map(clip, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip (the Fleet default for LLM training)."""
+
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree.leaves(grads)
+        gn = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        )
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gn, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """Functional global-norm clip over a grad pytree; returns (grads, norm)."""
+    leaves = jax.tree.leaves(parameters)
+    if norm_type == float('inf'):
+        gn = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        gn = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type)) for g in leaves),
+            1.0 / norm_type,
+        )
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gn, 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), parameters), gn
+
+
+def clip_grad_value_(parameters, clip_value):
+    return jax.tree.map(lambda g: jnp.clip(g, -clip_value, clip_value), parameters)

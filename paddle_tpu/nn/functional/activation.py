@@ -1,0 +1,166 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py).
+
+All map to single XLA HLO ops or small fusable expressions — the VPU
+handles these; XLA fuses them into surrounding matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ...framework import random as random_mod
+
+    g = jax.random.gumbel(random_mod.split_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.take_along_axis(y_hard, idx, axis=axis) * 0 + 1
+        onehot = jax.nn.one_hot(
+            jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype
+        )
+        y = jax.lax.stop_gradient(onehot - y) + y
+    return y
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5):
+    return jnp.clip(x * slope + offset, 0, 1)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0))
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def prelu(x, weight, data_format='NCHW'):
+    if weight.size > 1:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format == 'NCHW' else x.ndim - 1
+        shape[ch_axis] = weight.size
+        weight = weight.reshape(shape)
+    return jnp.where(x > 0, x, weight * x)
+
+
+def rrelu(x, lower=1 / 8.0, upper=1 / 3.0, training=True):
+    from ...framework import random as random_mod
+
+    if training:
+        a = jax.random.uniform(
+            random_mod.split_key(), x.shape, dtype=x.dtype, minval=lower, maxval=upper
+        )
+    else:
+        a = (lower + upper) / 2
+    return jnp.where(x >= 0, x, a * x)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def swiglu(x, y=None):
+    """SwiGLU gate (used by Llama FFN); fuses on TPU into two matmuls + VPU."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y

@@ -1,0 +1,78 @@
+"""Attention functionals.
+
+`scaled_dot_product_attention` (ref: python/paddle/nn/functional/
+flash_attention.py) dispatches to the pallas flash-attention TPU kernel
+when available, else to a fused lax reference (same math, XLA-fused).
+Layout: (batch, seq, num_heads, head_dim) — Paddle's flash-attn layout.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, rng_key=None, training=True):
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = scale or (1.0 / math.sqrt(D))
+    # GQA: broadcast kv heads if fewer than q heads
+    Hk = k.shape[2]
+    if Hk != H:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum('bqhd,bkhd->bhqk', qf, k.astype(jnp.float32))
+    if is_causal:
+        causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(causal[None, None], logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        from ...framework import random as random_mod
+
+        key = rng_key if rng_key is not None else random_mod.split_key()
+        keep = jax.random.bernoulli(key, 1 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1 - dropout_p), 0.0)
+    out = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p=0.0,
+    is_causal=False,
+    scale=None,
+    training=True,
+    rng_key=None,
+):
+    """Flash attention on TPU; lax reference elsewhere/with masks it can't take."""
+    use_flash = (
+        dropout_p == 0.0
+        and attn_mask is None
+        and query.shape[-1] % 8 == 0
+        and query.shape[1] >= 128
+        and jax.default_backend() not in ('cpu',)
+    )
+    if use_flash:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(query, key, value, causal=is_causal, scale=scale)
+        except Exception:
+            pass
+    return _sdpa_reference(
+        query, key, value, attn_mask, dropout_p, is_causal, scale, rng_key, training
+    )
+
+
+flash_attention = scaled_dot_product_attention

@@ -1,0 +1,242 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+(ref: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as random_mod
+from ...tensor.manipulation import pad  # noqa: F401  (re-exported)
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W + b with W stored (in_features, out_features) as Paddle does
+    (ref: nn/functional/common.py::linear) — this is also the MXU-friendly
+    layout (no transpose needed)."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train', rng_key=None):
+    if not training or p == 0:
+        if mode == 'downscale_in_infer' and not training:
+            return x * (1 - p)
+        return x
+    key = rng_key if rng_key is not None else random_mod.split_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(key, 1 - p, tuple(shape))
+    if mode == 'upscale_in_train':
+        return jnp.where(keep, x / (1 - p), 0)
+    return jnp.where(keep, x, 0)
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', rng_key=None):
+    axis = [0, 1] if data_format == 'NCHW' else [0, 3]
+    return dropout(x, p, axis=axis, training=training, rng_key=rng_key)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', rng_key=None):
+    axis = [0, 1] if data_format == 'NCDHW' else [0, 4]
+    return dropout(x, p, axis=axis, training=training, rng_key=rng_key)
+
+
+def alpha_dropout(x, p=0.5, training=True, rng_key=None):
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = rng_key if rng_key is not None else random_mod.split_key()
+    keep = jax.random.bernoulli(key, 1 - p, x.shape)
+    a = (1 / jnp.sqrt((1 - p) * (1 + p * alpha_p**2))).astype(x.dtype)
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def feature_alpha_dropout(x, p=0.5, training=True):
+    return alpha_dropout(x, p, training)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    # weight: (out, in1, in2)
+    y = jnp.einsum('bi,oij,bj->bo', x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.clip(n1 * n2, eps, None)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    n = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.clip(n, epsilon, None)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode='nearest',
+    align_corners=False,
+    data_format='NCHW',
+):
+    """ref: nn/functional/common.py::interpolate. Implemented with
+    jax.image.resize (gather-based, TPU friendly)."""
+    chan_last = data_format in ('NHWC', 'NDHWC', 'NLC')
+    spatial = x.ndim - 2
+    if chan_last:
+        sp_shape = x.shape[1:-1]
+    else:
+        sp_shape = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        size = [int(s * f) for s, f in zip(sp_shape, scale_factor)]
+    size = [int(s) for s in size]
+    if chan_last:
+        new_shape = (x.shape[0], *size, x.shape[-1])
+    else:
+        new_shape = (x.shape[0], x.shape[1], *size)
+    method = {
+        'nearest': 'nearest',
+        'bilinear': 'bilinear',
+        'trilinear': 'trilinear',
+        'linear': 'linear',
+        'bicubic': 'bicubic',
+        'area': 'linear',
+    }[mode]
+    if mode == 'nearest' or not align_corners:
+        return jax.image.resize(x, new_shape, method=method)
+    # align_corners path via explicit coordinate map
+    return _resize_align_corners(x, new_shape, method, chan_last)
+
+
+def _resize_align_corners(x, new_shape, method, chan_last):
+    import numpy as np
+
+    sp_axes = list(range(1, x.ndim - 1)) if chan_last else list(range(2, x.ndim))
+    out = x
+    for ax in sp_axes:
+        n_in, n_out = x.shape[ax], new_shape[ax]
+        if n_in == n_out:
+            continue
+        if n_out == 1:
+            idx = jnp.zeros((1,))
+        else:
+            idx = jnp.linspace(0, n_in - 1, n_out)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, n_in - 1)
+        w = (idx - lo).astype(x.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode='nearest', align_corners=False, data_format='NCHW'):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format='NCHW'):
+    r = upscale_factor
+    if data_format == 'NCHW':
+        b, c, h, w = x.shape
+        x = x.reshape(b, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(b, c // (r * r), h * r, w * r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h * r, w * r, c // (r * r))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format='NCHW'):
+    r = downscale_factor
+    if data_format == 'NCHW':
+        b, c, h, w = x.shape
+        x = x.reshape(b, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(b, c * r * r, h // r, w // r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // r, w // r, c * r * r)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (ref: nn/functional/common.py::unfold). NCHW input."""
+    ks = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) else list(kernel_sizes)
+    st = [strides] * 2 if isinstance(strides, int) else list(strides)
+    pd = [paddings] * 2 if isinstance(paddings, int) else list(paddings)
+    dl = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    b, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=ks,
+        window_strides=st,
+        padding='VALID',
+        rhs_dilation=dl,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+    )
+    return patches.reshape(b, c * ks[0] * ks[1], -1)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) else list(kernel_sizes)
+    st = [strides] * 2 if isinstance(strides, int) else list(strides)
+    pd = [paddings] * 2 if isinstance(paddings, int) else list(paddings)
+    b, ckk, L = x.shape
+    c = ckk // (ks[0] * ks[1])
+    H, W = output_sizes
+    oh = (H + 2 * pd[0] - ks[0]) // st[0] + 1
+    ow = (W + 2 * pd[1] - ks[1]) // st[1] + 1
+    out = jnp.zeros((b, c, H + 2 * pd[0], W + 2 * pd[1]), x.dtype)
+    x = x.reshape(b, c, ks[0], ks[1], oh, ow)
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            out = out.at[:, :, i : i + oh * st[0] : st[0], j : j + ow * st[1] : st[1]].add(
+                x[:, :, i, j]
+            )
+    if pd[0] or pd[1]:
+        out = out[:, :, pd[0] : out.shape[2] - pd[0], pd[1] : out.shape[3] - pd[1]]
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is None:
+        return (1 - epsilon) * label + epsilon / k
+    return (1 - epsilon) * label + epsilon * prior_dist
+
+
+def class_center_sample(label, num_classes, num_samples):  # pragma: no cover
+    raise NotImplementedError('class_center_sample: PS-specific, out of TPU scope')

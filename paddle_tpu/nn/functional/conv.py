@@ -1,0 +1,147 @@
+"""Convolutions (ref: python/paddle/nn/functional/conv.py).
+
+All convs lower to `lax.conv_general_dilated` → XLA tiles them onto the
+MXU. Paddle's default layout is NCHW; TPUs prefer channels-last, so the
+functional API accepts both and the Layer classes default to NCHW for
+API parity while converting internally only when asked.
+Weight layout follows Paddle: (out_ch, in_ch/groups, *kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dn(n, data_format):
+    if data_format in ('NCHW', 'NCL', 'NCDHW'):
+        lhs = 'NC' + 'DHW'[3 - n :]
+        out = lhs
+    else:
+        lhs = 'N' + 'DHW'[3 - n :] + 'C'
+        out = lhs
+    rhs = 'OI' + 'DHW'[3 - n :]
+    return (lhs, rhs, out)
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _dn(n, data_format))
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=_tuple(stride, n),
+        padding=_padding(padding, n),
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(x.dtype)
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[1 if data_format.startswith('NC') else -1] = bias.size
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format='NCL'):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format='NCHW'):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format='NCDHW'):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format
+):
+    # Paddle stores transpose-conv weight as (in_ch, out_ch/groups, *k)
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1] * groups, weight.shape[0] // groups) + weight.shape[2:],
+        _dn(n, data_format),
+    )
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad_pairs = [(0, 0)] * n if pad == 'VALID' else None
+    else:
+        pad_pairs = pad
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    k = weight.shape[2:]
+    opad = _tuple(output_padding, n)
+    if pad_pairs is None:
+        trans_pad = 'SAME'
+    else:
+        trans_pad = []
+        for i in range(n):
+            eff_k = (k[i] - 1) * dil[i] + 1
+            lo = eff_k - 1 - pad_pairs[i][0]
+            hi = eff_k - 1 - pad_pairs[i][1] + opad[i]
+            trans_pad.append((lo, hi))
+    # grouped transpose: weight (I, O/g, *k) -> flip spatial, swap to (O, I/g, *k)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        w = w.reshape((groups, weight.shape[0] // groups) + weight.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((weight.shape[1] * groups, weight.shape[0] // groups) + k)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1,) * n,
+        padding=trans_pad,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[1 if data_format.startswith('NC') else -1] = bias.size
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1,
+    data_format='NCL',
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1,
+    data_format='NCHW',
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1,
+    data_format='NCDHW',
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)

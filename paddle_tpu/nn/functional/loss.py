@@ -1,0 +1,298 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py).
+
+Log-space formulations throughout; reductions in fp32 for bf16 inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x, reduction):
+    if reduction == 'mean':
+        return jnp.mean(x)
+    if reduction == 'sum':
+        return jnp.sum(x)
+    return x
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction='mean',
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+):
+    """ref: paddle.nn.functional.cross_entropy."""
+    logits = input.astype(jnp.float32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-30, None))
+    n_classes = input.shape[axis]
+    if soft_label or (hasattr(label, 'dtype') and jnp.issubdtype(label.dtype, jnp.floating) and label.ndim == input.ndim):
+        tgt = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(tgt * logp, axis=axis)
+        mask = None
+    else:
+        lbl = label
+        if lbl.ndim == input.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        mask = lbl != ignore_index
+        safe = jnp.where(mask, lbl, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis
+        )
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            mean_logp = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * mean_logp
+        loss = -jnp.where(mask, picked, 0.0)
+        if weight is not None:
+            w = jnp.take(weight.astype(jnp.float32), safe)
+            loss = loss * jnp.where(mask, w, 0.0)
+            if reduction == 'mean':
+                return jnp.sum(loss) / jnp.clip(jnp.sum(jnp.where(mask, w, 0.0)), 1e-12, None)
+        if reduction == 'mean':
+            return jnp.sum(loss) / jnp.clip(jnp.sum(mask.astype(jnp.float32)), 1.0, None)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction='none', axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean'):
+    """input is log-probabilities (ref: F.nll_loss)."""
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(logp, label, weight, ignore_index, reduction):
+    mask = label != ignore_index
+    safe = jnp.where(mask, label, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    loss = -jnp.where(mask, picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+        if reduction == 'mean':
+            return jnp.sum(loss) / jnp.sum(jnp.where(mask, w, 0.0))
+    if reduction == 'mean':
+        return jnp.sum(loss) / jnp.clip(jnp.sum(mask), 1, None)
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction='mean'):
+    return _reduce(jnp.square(input.astype(jnp.float32) - label.astype(jnp.float32)), reduction)
+
+
+def l1_loss(input, label, reduction='mean'):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0):
+    d = jnp.abs(input - label)
+    return _reduce(jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta)), reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction='mean'):
+    d = jnp.abs(input - label)
+    return _reduce(jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta)), reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean'):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction='mean', pos_weight=None):
+    z = logit.astype(jnp.float32)
+    y = label.astype(jnp.float32)
+    base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * y + 1
+        base = jnp.maximum(z, 0) * (1 - y) + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0)
+        ) - 0  # stable pos-weighted form
+        base = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0))
+    if weight is not None:
+        base = base * weight
+    return _reduce(base, reduction)
+
+
+def kl_div(input, label, reduction='mean', log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-30, None)) - input)
+    if reduction == 'batchmean':
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean'):
+    return _reduce(jnp.maximum(0, -label * (input - other) + margin), reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean'):
+    loss = jnp.where(label == 1, input, jnp.maximum(0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction='mean'):
+    from .common import cosine_similarity
+
+    cos = cosine_similarity(input1, input2, axis=-1)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction='mean'):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1), 1 / p)
+
+    dp = dist(anchor, positive)
+    dn = dist(anchor, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction='mean'):
+    loss = -(label * jax.nn.log_sigmoid(input) + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def soft_margin_loss(input, label, reduction='mean'):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction='mean'):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction='mean'):
+    var = jnp.clip(variance, epsilon, None)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+    return _reduce(loss, reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction='mean', norm_by_times=False):
+    """CTC via the standard dynamic program in log space (lax.scan over time).
+    ref: nn/functional/loss.py::ctc_loss. log_probs: (T, B, C) after
+    log_softmax."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    ninf = jnp.float32(-1e30)
+    lp = log_probs.astype(jnp.float32)
+
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+
+    same_as_prevprev = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+
+    alpha0 = jnp.full((B, S), ninf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2].astype(jnp.int32), axis=1)[:, 0])
+
+    def lse(*xs):
+        stacked = jnp.stack(xs)
+        m = jnp.max(stacked, axis=0)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        return jnp.where(
+            jnp.isfinite(m),
+            m_safe + jnp.log(jnp.sum(jnp.exp(stacked - m_safe), axis=0)),
+            ninf,
+        )
+
+    def step(alpha, lp_t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), ninf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), ninf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(same_as_prevprev, ninf, prev2)
+        emit = jnp.take_along_axis(lp_t, ext.astype(jnp.int32), axis=1)
+        new = lse(alpha, prev1, prev2) + emit
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    last = alphas[t_idx, jnp.arange(B)]  # (B, S)
+    s_last = 2 * label_lengths  # blank after last label
+    a1 = jnp.take_along_axis(last, s_last[:, None].astype(jnp.int32), axis=1)[:, 0]
+    a2 = jnp.take_along_axis(
+        last, jnp.clip(s_last - 1, 0, S - 1)[:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    m = jnp.maximum(a1, a2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    ll = m_safe + jnp.log(jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe))
+    loss = -ll
+    if reduction == 'mean':
+        return jnp.mean(loss / jnp.clip(label_lengths.astype(jnp.float32), 1, None))
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction='sum'):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction='none')
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    label_oh = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1], dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = 2 * jnp.sum(input * label_oh, axis=reduce_axes)
+    denom = jnp.sum(input, axis=reduce_axes) + jnp.sum(label_oh, axis=reduce_axes)
+    return jnp.mean(1 - (inter + epsilon) / (denom + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = anchor @ positive.T
+    labels = labels.reshape(-1)
+    tgt = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    ce = jnp.mean(-jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) + jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
+    return ce + reg

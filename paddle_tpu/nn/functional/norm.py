@@ -1,0 +1,134 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py).
+
+Stats in fp32 regardless of input dtype (bf16-safe), results cast back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    xf = _f32(x)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        out = out * _f32(weight)
+    if bias is not None:
+        out = out + _f32(bias)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (Llama-family). Pallas-fused variant in ops/pallas/rms_norm."""
+    xf = _f32(x)
+    var = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = xf * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    if weight is not None:
+        out = out * _f32(weight)
+    return out.astype(x.dtype)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format='NCHW',
+):
+    """Returns (out, new_mean, new_var) — state is explicit, the Layer
+    carries it (ref semantics: nn/functional/norm.py::batch_norm)."""
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    xf = _f32(x)
+    if training:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        n = x.size / x.shape[ch_axis]
+        unbiased = var * n / max(n - 1, 1)
+        new_mean = momentum * _f32(running_mean) + (1 - momentum) * mean
+        new_var = momentum * _f32(running_var) + (1 - momentum) * unbiased
+    else:
+        mean, var = _f32(running_mean), _f32(running_var)
+        new_mean, new_var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (xf - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * _f32(weight).reshape(shape)
+    if bias is not None:
+        out = out + _f32(bias).reshape(shape)
+    return (
+        out.astype(x.dtype),
+        new_mean.astype(running_mean.dtype),
+        new_var.astype(running_var.dtype),
+    )
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, data_format='NCHW'):
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(
+        i for i in range(1, x.ndim - 1)
+    )
+    xf = _f32(x)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out * _f32(weight).reshape(shape)
+        if bias is not None:
+            out = out + _f32(bias).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format='NCHW'):
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    c = x.shape[ch_axis]
+    xf = _f32(x)
+    if ch_axis == 1:
+        g_shape = (x.shape[0], num_groups, c // num_groups) + x.shape[2:]
+        axes = tuple(range(2, len(g_shape)))
+    else:
+        g_shape = x.shape[:-1] + (num_groups, c // num_groups)
+        axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
+    xg = xf.reshape(g_shape)
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) / jnp.sqrt(var + epsilon)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[ch_axis] = c
+    if weight is not None:
+        out = out * _f32(weight).reshape(shape)
+    if bias is not None:
+        out = out + _f32(bias).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format='NCHW'):
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    sq = jnp.square(_f32(x))
+    half = size // 2
+    c = x.shape[ch_axis]
+    pads = [(0, 0)] * x.ndim
+    pads[ch_axis] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pads)
+    acc = 0
+    for i in range(size):
+        sl = [slice(None)] * x.ndim
+        sl[ch_axis] = slice(i, i + c)
+        acc = acc + sq[tuple(sl)]
+    div = jnp.power(k + alpha * acc, beta)
+    return (x / div.astype(x.dtype))

@@ -1,0 +1,161 @@
+"""Pooling (ref: python/paddle/nn/functional/pooling.py) via lax.reduce_window."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if all(isinstance(v, int) for v in p):
+        return [(v, v) for v in p]
+    return [tuple(v) for v in p]
+
+
+def _window(x, n, kernel, stride, padding, data_format, init, op, ceil_mode=False):
+    nc_first = data_format.startswith('NC')
+    if nc_first:
+        dims = (1, 1) + _tuple(kernel, n)
+        strides = (1, 1) + _tuple(stride, n)
+        sp_off = 2
+    else:
+        dims = (1,) + _tuple(kernel, n) + (1,)
+        strides = (1,) + _tuple(stride, n) + (1,)
+        sp_off = 1
+    pad = _pads(padding, n)
+    if isinstance(pad, str):
+        full_pad = pad
+    else:
+        full_pad = [(0, 0)] * sp_off + pad + ([(0, 0)] if not nc_first else [])
+        if ceil_mode:
+            full_pad = [list(p) for p in full_pad]
+            for i in range(n):
+                ax = sp_off + i
+                size = x.shape[ax] + full_pad[ax][0] + full_pad[ax][1]
+                rem = (size - dims[ax]) % strides[ax]
+                if rem:
+                    full_pad[ax][1] += strides[ax] - rem
+            full_pad = [tuple(p) for p in full_pad]
+    return lax.reduce_window(x, init, op, dims, strides, full_pad), dims, strides
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCL'):
+    stride = stride or kernel_size
+    out, _, _ = _window(x, 1, kernel_size, stride, padding, data_format,
+                        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                        lax.max, ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCHW'):
+    stride = stride or kernel_size
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    out, _, _ = _window(x, 2, kernel_size, stride, padding, data_format, init, lax.max, ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCDHW'):
+    stride = stride or kernel_size
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    out, _, _ = _window(x, 3, kernel_size, stride, padding, data_format, init, lax.max, ceil_mode)
+    return out
+
+
+def _avg(x, n, kernel_size, stride, padding, ceil_mode, exclusive, data_format):
+    stride = stride or kernel_size
+    s, dims, strides = _window(
+        x.astype(jnp.float32), n, kernel_size, stride, padding, data_format, 0.0, lax.add, ceil_mode
+    )
+    import numpy as _np
+
+    nonzero_pad = (
+        (isinstance(padding, str) and padding.upper() == 'SAME')
+        or (not isinstance(padding, str) and _np.any(_np.asarray(padding) != 0))
+    )
+    if exclusive and (nonzero_pad or ceil_mode):
+        ones = jnp.ones_like(x, dtype=jnp.float32)
+        cnt, _, _ = _window(ones, n, kernel_size, stride, padding, data_format, 0.0, lax.add, ceil_mode)
+        return (s / cnt).astype(x.dtype)
+    import numpy as np
+
+    k = int(np.prod(_tuple(kernel_size, n)))
+    return (s / k).astype(x.dtype)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format='NCL'):
+    return _avg(x, 1, kernel_size, stride, padding, ceil_mode, exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format='NCHW'):
+    return _avg(x, 2, kernel_size, stride, padding, ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format='NCDHW'):
+    return _avg(x, 3, kernel_size, stride, padding, ceil_mode, exclusive, data_format)
+
+
+def _adaptive(x, n, output_size, data_format, reducer):
+    nc_first = data_format.startswith('NC')
+    out_size = _tuple(output_size, n)
+    sp_axes = list(range(2, 2 + n)) if nc_first else list(range(1, 1 + n))
+    out = x
+    for ax, osz in zip(sp_axes, out_size):
+        if osz is None:
+            continue
+        isz = out.shape[ax]
+        if isz % osz == 0:
+            k = isz // osz
+            shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1 :]
+            out = reducer(out.reshape(shape), ax + 1)
+        else:
+            pieces = []
+            for i in range(osz):
+                lo = (i * isz) // osz
+                hi = -(-((i + 1) * isz) // osz)
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(lo, hi)
+                pieces.append(reducer(out[tuple(sl)], ax, keepdims=True))
+            out = jnp.concatenate(pieces, axis=ax)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, data_format='NCL'):
+    return _adaptive(x, 1, output_size, data_format, lambda v, a, keepdims=False: jnp.mean(v, axis=a, keepdims=keepdims))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW'):
+    return _adaptive(x, 2, output_size, data_format, lambda v, a, keepdims=False: jnp.mean(v, axis=a, keepdims=keepdims))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW'):
+    return _adaptive(x, 3, output_size, data_format, lambda v, a, keepdims=False: jnp.mean(v, axis=a, keepdims=keepdims))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format='NCL'):
+    return _adaptive(x, 1, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format='NCHW'):
+    return _adaptive(x, 2, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format='NCDHW'):
+    return _adaptive(x, 3, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCHW'):
+    p = float(norm_type)
+    stride = stride or kernel_size
+    s, dims, _ = _window(
+        jnp.power(jnp.abs(x.astype(jnp.float32)), p), 2, kernel_size, stride, padding,
+        data_format, 0.0, lax.add, ceil_mode,
+    )
+    return jnp.power(s, 1.0 / p).astype(x.dtype)

@@ -1,0 +1,77 @@
+"""Activation layers (ref: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .base import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the functional's keyword order
+            fn = getattr(F, fn_name)
+            import inspect
+
+            sig = list(inspect.signature(fn).parameters)[1:]
+            for name, v in zip(sig, args):
+                self._kwargs[name] = v
+            for k, v in kwargs.items():
+                if k != 'name':
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple('relu')
+ReLU6 = _simple('relu6')
+GELU = _simple('gelu')
+SiLU = _simple('silu')
+Swish = _simple('swish')
+Sigmoid = _simple('sigmoid')
+LogSigmoid = _simple('log_sigmoid')
+Tanh = _simple('tanh')
+Tanhshrink = _simple('tanhshrink')
+Softmax = _simple('softmax')
+LogSoftmax = _simple('log_softmax')
+LeakyReLU = _simple('leaky_relu')
+ELU = _simple('elu')
+CELU = _simple('celu')
+SELU = _simple('selu')
+Hardswish = _simple('hardswish')
+Hardsigmoid = _simple('hardsigmoid')
+Hardtanh = _simple('hardtanh')
+Hardshrink = _simple('hardshrink')
+Softshrink = _simple('softshrink')
+Softplus = _simple('softplus')
+Softsign = _simple('softsign')
+Mish = _simple('mish')
+ThresholdedReLU = _simple('thresholded_relu')
+GLU = _simple('glu')
+Maxout = _simple('maxout')
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format='NCHW', name=None):
+        super().__init__()
+        from .. import initializer as I
+
+        self.data_format = data_format
+        self.weight = self.create_parameter((num_parameters,), initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1 / 8.0, upper=1 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

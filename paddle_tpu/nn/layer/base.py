@@ -1,0 +1,400 @@
+"""Pytree-native module system.
+
+The TPU-first replacement for Paddle's `nn.Layer` (ref:
+python/paddle/nn/layer/layers.py). Paddle layers are mutable Python
+objects driven by a C++ dygraph tracer; here a Layer *is a jax pytree*:
+array-valued attributes (parameters, buffers, sub-layers) are dynamic
+leaves, everything else is static structure. That makes a whole model a
+legal argument/return of `jax.jit`, `jax.grad`, `pjit`, `shard_map` —
+no tracer, no ProgramDesc; XLA sees one functional program.
+
+Imperative feel is preserved: layers may mutate their own attributes
+during forward (BatchNorm running stats, RNG key threading). Under a
+traced step the mutations land on the traced copy, and returning the
+model from the step function carries them out — the idiomatic jax
+"state in, state out" pattern with Paddle's surface syntax.
+"""
+from __future__ import annotations
+
+import typing
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.tree_util import GetAttrKey, register_pytree_with_keys
+
+from ...framework import dtype as dtype_mod
+from ...framework import random as random_mod
+
+_ARRAY_TYPES = (jax.Array, np.ndarray)
+
+
+class Parameter:
+    """A marker carrying an array plus parameter metadata.
+
+    Assigning a Parameter to a Layer attribute registers it: the array is
+    stored directly on the layer (so forward code uses it as a plain
+    ``jax.Array``) and the metadata (trainable flag, sharding
+    PartitionSpec) is recorded in the layer's ``_param_meta`` table.
+    ref: Paddle's EagerParamBase (python/paddle/base/framework.py).
+    """
+
+    __slots__ = ('value', 'trainable', 'spec')
+
+    def __init__(self, value, trainable: bool = True, spec=None):
+        self.value = jnp.asarray(value) if value is not None else None
+        self.trainable = trainable
+        self.spec = spec
+
+    def __repr__(self):
+        return f"Parameter(shape={getattr(self.value, 'shape', None)}, trainable={self.trainable}, spec={self.spec})"
+
+
+class Buffer:
+    """Marker for non-parameter state (running stats, RNG keys).
+
+    ``persistable=False`` buffers are excluded from ``state_dict``.
+    ref: Layer.register_buffer (python/paddle/nn/layer/layers.py).
+    """
+
+    __slots__ = ('value', 'persistable')
+
+    def __init__(self, value, persistable: bool = True):
+        self.value = value if value is None else jnp.asarray(value)
+        self.persistable = persistable
+
+
+class _Meta(typing.NamedTuple):
+    kind: str          # 'param' | 'buffer'
+    trainable: bool
+    persistable: bool
+    spec: typing.Any   # PartitionSpec or None
+
+
+def _hashable(v):
+    """Best-effort conversion of a static attribute to a hashable value."""
+    if isinstance(v, list):
+        return ('__list__', tuple(_hashable(x) for x in v))
+    if isinstance(v, tuple):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return ('__dict__', tuple(sorted((k, _hashable(x)) for k, x in v.items())))
+    if isinstance(v, set):
+        return ('__set__', frozenset(_hashable(x) for x in v))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return _ByEq(v)
+
+
+def _unhashable(v):
+    if isinstance(v, tuple):
+        if len(v) == 2 and v[0] == '__list__':
+            return [_unhashable(x) for x in v[1]]
+        if len(v) == 2 and v[0] == '__dict__':
+            return {k: _unhashable(x) for k, x in v[1]}
+        if len(v) == 2 and v[0] == '__set__':
+            return {_unhashable(x) for x in v[1]}
+        return tuple(_unhashable(x) for x in v)
+    if isinstance(v, _ByEq):
+        return v.obj
+    return v
+
+
+class _ByEq:
+    """Wraps an unhashable static value; compares by equality."""
+
+    __slots__ = ('obj',)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __eq__(self, other):
+        return isinstance(other, _ByEq) and self.obj == other.obj
+
+    def __hash__(self):
+        return 0
+
+
+# Attributes handled specially by flatten (never children, never plain static).
+_INTERNAL = ('_param_meta',)
+
+
+def _is_child(v):
+    return isinstance(v, _ARRAY_TYPES + (Layer,))
+
+
+def _flatten_layer(layer: 'Layer'):
+    # meta-registered attrs are ALWAYS children, even when None — so a
+    # filtered copy (split_trainable) keeps the same treedef as the model.
+    meta_names = layer._param_meta
+    children, keys, static = [], [], []
+    for name in sorted(layer.__dict__):
+        if name in _INTERNAL:
+            continue
+        v = layer.__dict__[name]
+        if _is_child(v) or name in meta_names:
+            keys.append(name)
+            children.append(v)
+        else:
+            static.append((name, _hashable(v)))
+    meta = tuple(sorted(layer._param_meta.items()))
+    aux = (type(layer), tuple(keys), tuple(static), meta)
+    return children, aux
+
+
+def _flatten_layer_with_keys(layer: 'Layer'):
+    children, aux = _flatten_layer(layer)
+    keys = aux[1]
+    return [(GetAttrKey(k), c) for k, c in zip(keys, children)], aux
+
+
+def _unflatten_layer(aux, children):
+    cls, keys, static, meta = aux
+    obj = object.__new__(cls)
+    d = obj.__dict__
+    for name, v in static:
+        d[name] = _unhashable(v)
+    for name, c in zip(keys, children):
+        d[name] = c
+    d['_param_meta'] = dict(meta)
+    return obj
+
+
+_registered: set = set()
+
+
+def _register(cls):
+    if cls in _registered:
+        return
+    _registered.add(cls)
+    register_pytree_with_keys(
+        cls,
+        _flatten_layer_with_keys,
+        lambda aux, children: _unflatten_layer(aux, children),
+        _flatten_layer,
+    )
+
+
+class Layer:
+    """Base class for all network modules (ref: paddle.nn.Layer)."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _register(cls)
+
+    def __init__(self, name_scope=None, dtype=None):
+        d = self.__dict__
+        d.setdefault('_param_meta', {})
+        d.setdefault('training', True)
+        d.setdefault('_dtype', dtype_mod.convert_dtype(dtype) if dtype else None)
+
+    # -- attribute registration ------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._ensure_init()
+            self._param_meta[name] = _Meta('param', value.trainable, True, value.spec)
+            object.__setattr__(self, name, value.value)
+        elif isinstance(value, Buffer):
+            self._ensure_init()
+            self._param_meta[name] = _Meta('buffer', False, value.persistable, None)
+            object.__setattr__(self, name, value.value)
+        else:
+            if isinstance(value, _ARRAY_TYPES):
+                self._ensure_init()
+                # plain array assignment: register as buffer on first set
+                if name not in self._param_meta:
+                    self._param_meta[name] = _Meta('buffer', False, True, None)
+            object.__setattr__(self, name, value)
+
+    def __delattr__(self, name):
+        self._param_meta.pop(name, None)
+        object.__delattr__(self, name)
+
+    def _ensure_init(self):
+        if '_param_meta' not in self.__dict__:
+            object.__setattr__(self, '_param_meta', {})
+        if 'training' not in self.__dict__:
+            object.__setattr__(self, 'training', True)
+
+    # -- parameter creation ----------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        dtype=None,
+        initializer=None,
+        is_bias: bool = False,
+        trainable: bool = True,
+        spec=None,
+    ) -> Parameter:
+        """Create (but not register) a Parameter; assign it to an attribute
+        to register. ref: Layer.create_parameter (nn/layer/layers.py)."""
+        from .. import initializer as I
+
+        dtype = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        if initializer is None:
+            initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = initializer(shape, dtype)
+        return Parameter(value, trainable=trainable, spec=spec)
+
+    def register_buffer(self, name, value, persistable=True):
+        setattr(self, name, Buffer(value, persistable=persistable))
+
+    def add_parameter(self, name, parameter: Parameter):
+        setattr(self, name, parameter)
+        return getattr(self, name)
+
+    def add_sublayer(self, name, sublayer: 'Layer'):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    # -- traversal --------------------------------------------------------
+    def _children(self):
+        meta_names = self._param_meta
+        for name in sorted(self.__dict__):
+            if name in _INTERNAL:
+                continue
+            v = self.__dict__[name]
+            if _is_child(v) or name in meta_names:
+                yield name, v
+
+    def named_sublayers(self, prefix='', include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, v in self._children():
+            if isinstance(v, Layer):
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from v.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix=''):
+        for name, v in self._children():
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(v, Layer):
+                yield from v.named_parameters(prefix=path)
+            elif self._param_meta.get(name, _META_BUFFER).kind == 'param':
+                yield path, v
+
+    def parameters(self):
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix='', persistable_only=False):
+        for name, v in self._children():
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(v, Layer):
+                yield from v.named_buffers(prefix=path, persistable_only=persistable_only)
+            else:
+                m = self._param_meta.get(name, _META_BUFFER)
+                if m.kind == 'buffer' and (m.persistable or not persistable_only):
+                    yield path, v
+
+    def buffers(self):
+        return [b for _, b in self.named_buffers()]
+
+    def meta_for(self, name) -> '_Meta':
+        return self._param_meta.get(name, _META_BUFFER)
+
+    def set_param_meta(self, name, **updates):
+        m = self._param_meta.get(name, _META_BUFFER)
+        self._param_meta[name] = m._replace(**updates)
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self, destination=None, prefix=''):
+        dest = destination if destination is not None else OrderedDict()
+        for name, v in self._children():
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(v, Layer):
+                v.state_dict(destination=dest, prefix=path)
+            else:
+                m = self._param_meta.get(name, _META_BUFFER)
+                if m.kind == 'param' or m.persistable:
+                    dest[path] = v
+        return dest
+
+    def set_state_dict(self, state_dict, strict=True):
+        missing, own = [], self.state_dict()
+        for path in own:
+            if path in state_dict:
+                self._set_by_path(path, jnp.asarray(state_dict[path]))
+            else:
+                missing.append(path)
+        unexpected = [k for k in state_dict if k not in own]
+        if strict and (missing or unexpected):
+            raise ValueError(
+                f"set_state_dict mismatch: missing={missing} unexpected={unexpected}"
+            )
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    load_state_dict = set_state_dict
+
+    def _set_by_path(self, path, value):
+        parts = path.split('.')
+        obj = self
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        object.__setattr__(obj, parts[-1], value)
+
+    # -- modes ------------------------------------------------------------
+    def train(self):
+        for l in self.named_sublayers(include_self=True):
+            object.__setattr__(l[1], 'training', True)
+        return self
+
+    def eval(self):
+        for l in self.named_sublayers(include_self=True):
+            object.__setattr__(l[1], 'training', False)
+        return self
+
+    def apply(self, fn):
+        for _, l in self.named_sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- dtype / device ---------------------------------------------------
+    def astype(self, dtype, floating_only=True):
+        """Cast parameters & buffers in place (ref: Layer.to / amp O2)."""
+        dtype = dtype_mod.convert_dtype(dtype)
+        for _, l in self.named_sublayers(include_self=True):
+            for name, v in list(l._children()):
+                if isinstance(v, Layer):
+                    continue
+                if floating_only and not (
+                    jnp.issubdtype(v.dtype, jnp.floating)
+                    or v.dtype == jnp.bfloat16
+                ):
+                    continue
+                object.__setattr__(l, name, v.astype(dtype))
+        return self
+
+    to = astype
+
+    # -- call -------------------------------------------------------------
+    def forward(self, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def __repr__(self):
+        n_params = sum(int(np.prod(p.shape)) for p in self.parameters())
+        return f"{type(self).__name__}(params={n_params})"
+
+    # -- rng --------------------------------------------------------------
+    def _init_rng(self):
+        """Give this layer a private PRNG key leaf (threaded functionally)."""
+        self.register_buffer('_rng_key', random_mod.split_key(), persistable=False)
+
+    def next_rng_key(self):
+        new, key = jax.random.split(self._rng_key)
+        object.__setattr__(self, '_rng_key', new)
+        return key
+
+
+_META_BUFFER = _Meta('buffer', False, True, None)
+
+_register(Layer)

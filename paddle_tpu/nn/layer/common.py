@@ -1,0 +1,249 @@
+"""Common layers (ref: python/paddle/nn/layer/common.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from .base import Layer, Parameter
+
+
+class Linear(Layer):
+    """ref: paddle.nn.Linear — weight stored (in_features, out_features)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), initializer=_init_of(weight_attr)
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True, initializer=_init_of(bias_attr, bias=True)
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+def _init_of(attr, bias=False):
+    if attr is None or attr is True:
+        return None
+    if isinstance(attr, I.Initializer):
+        return attr
+    if hasattr(attr, 'initializer'):  # ParamAttr-like
+        return attr.initializer
+    return None
+
+
+class Embedding(Layer):
+    """ref: paddle.nn.Embedding."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        init = _init_of(weight_attr) or I.Normal(0.0, 1.0)
+        self.weight = Parameter(init((num_embeddings, embedding_dim), jnp.float32))
+        if padding_idx is not None:
+            self.weight = Parameter(self.weight.value.at[padding_idx].set(0.0))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode='upscale_in_train', name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+        self._init_rng()
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return F.dropout(x, self.p, self.axis, False, self.mode)
+        return F.dropout(x, self.p, self.axis, True, self.mode, rng_key=self.next_rng_key())
+
+
+class Dropout2D(Dropout):
+    def __init__(self, p=0.5, data_format='NCHW', name=None):
+        super().__init__(p=p, axis=None)
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        return F.dropout2d(x, self.p, True, self.data_format, rng_key=self.next_rng_key())
+
+
+class Dropout3D(Dropout):
+    def __init__(self, p=0.5, data_format='NCDHW', name=None):
+        super().__init__(p=p, axis=None)
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        return F.dropout3d(x, self.p, True, self.data_format, rng_key=self.next_rng_key())
+
+
+class AlphaDropout(Dropout):
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        return F.alpha_dropout(x, self.p, True, rng_key=self.next_rng_key())
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from ...tensor.manipulation import flatten
+
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter((out_features, in1_features, in2_features))
+        self.bias = None if bias_attr is False else self.create_parameter((1, out_features), is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode='nearest', align_corners=False, data_format='NCHW', name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners, self.data_format = mode, align_corners, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode, self.align_corners, self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format='NCHW', name=None):
+        super().__init__(size, scale_factor, 'bilinear', True, data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format='NCHW', name=None):
+        super().__init__(size, scale_factor, 'nearest', False, data_format)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode='constant', value=0.0, data_format='NCHW'):
+        super().__init__()
+        self.padding = list(padding) if not isinstance(padding, int) else None
+        self._int_pad = padding if isinstance(padding, int) else None
+        self.mode, self.value, self.data_format = mode, value, data_format
+        self._n = {'NCL': 1, 'NLC': 1, 'NCHW': 2, 'NHWC': 2, 'NCDHW': 3, 'NDHWC': 3}[data_format]
+
+    def forward(self, x):
+        from ...tensor.manipulation import pad as pad_fn
+
+        p = self.padding if self.padding is not None else [self._int_pad] * (2 * self._n)
+        if self.data_format.startswith('NC'):
+            return pad_fn(x, p, self.mode, self.value)
+        # channels-last: pad spatial dims (1..n)
+        pairs = [(0, 0)] * x.ndim
+        it = list(zip(p[0::2], p[1::2]))
+        for i, pr in enumerate(reversed(it)):
+            pairs[1 + i] = pr
+        if self.mode == 'constant':
+            return jnp.pad(x, pairs, constant_values=self.value)
+        jmode = {'reflect': 'reflect', 'replicate': 'edge', 'circular': 'wrap'}[self.mode]
+        return jnp.pad(x, pairs, mode=jmode)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode='constant', value=0.0, data_format='NCL', name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad2D(_PadNd):
+    def __init__(self, padding, mode='constant', value=0.0, data_format='NCHW', name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode='constant', value=0.0, data_format='NCDHW', name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(Pad2D):
+    pass
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format='NCHW', name=None):
+        super().__init__()
+        self.upscale_factor, self.data_format = upscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format='NCHW', name=None):
+        super().__init__()
+        self.downscale_factor, self.data_format = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self.kernel_sizes, self.strides = kernel_sizes, strides
+        self.paddings, self.dilations = paddings, dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings, self.dilations)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings, self.dilations = strides, paddings, dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides, self.paddings, self.dilations)

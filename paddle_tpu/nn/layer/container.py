@@ -1,0 +1,142 @@
+"""Layer containers (ref: python/paddle/nn/layer/container.py).
+
+Children are stored as numbered/named attributes so they participate in
+pytree flattening like any other sub-layer.
+"""
+from __future__ import annotations
+
+from .base import Layer, Parameter
+
+
+class Sequential(Layer):
+    """ref: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and not isinstance(
+            layers[0], Layer
+        ):
+            layers = tuple(layers[0])
+        named = []
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                name, l = l
+            else:
+                name = str(i)
+            named.append(name)
+            self.add_sublayer(f"L{name}", l)
+        self._names = tuple(named)
+
+    def __len__(self):
+        return len(self._names)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            items = [getattr(self, f"L{n}") for n in self._names[idx]]
+            return Sequential(*items)
+        return getattr(self, f"L{self._names[idx]}")
+
+    def __iter__(self):
+        for n in self._names:
+            yield getattr(self, f"L{n}")
+
+    def forward(self, x):
+        for n in self._names:
+            x = getattr(self, f"L{n}")(x)
+        return x
+
+
+class LayerList(Layer):
+    """ref: paddle.nn.LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._n = 0
+        for l in sublayers or []:
+            self.append(l)
+
+    def append(self, layer):
+        self.add_sublayer(f"L{self._n}", layer)
+        self._n += 1
+        return self
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [getattr(self, f"L{i}") for i in range(self._n)][idx]
+        if idx < 0:
+            idx += self._n
+        return getattr(self, f"L{idx}")
+
+    def __setitem__(self, idx, layer):
+        if idx < 0:
+            idx += self._n
+        self.add_sublayer(f"L{idx}", layer)
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield getattr(self, f"L{i}")
+
+
+class ParameterList(Layer):
+    """ref: paddle.nn.ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._n = 0
+        for p in parameters or []:
+            self.append(p)
+
+    def append(self, parameter):
+        if not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter)
+        setattr(self, f"P{self._n}", parameter)
+        self._n += 1
+        return self
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += self._n
+        return getattr(self, f"P{idx}")
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield getattr(self, f"P{i}")
+
+
+class LayerDict(Layer):
+    """ref: paddle.nn.LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._keys = ()
+        for k, v in (sublayers or {}).items():
+            self[k] = v
+
+    def __setitem__(self, key, layer):
+        if key not in self._keys:
+            self._keys = self._keys + (key,)
+        self.add_sublayer(f"D{key}", layer)
+
+    def __getitem__(self, key):
+        return getattr(self, f"D{key}")
+
+    def __contains__(self, key):
+        return key in self._keys
+
+    def __len__(self):
+        return len(self._keys)
+
+    def keys(self):
+        return self._keys
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]

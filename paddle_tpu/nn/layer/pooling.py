@@ -1,0 +1,116 @@
+"""Pooling layers (ref: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .base import Layer
+
+
+class _Pool(Layer):
+    def __init__(self, kernel_size=None, stride=None, padding=0, ceil_mode=False,
+                 data_format=None, output_size=None, **kw):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+        self.output_size = output_size
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCL', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCHW', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCDHW', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format='NCL', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding, self.exclusive, self.ceil_mode, self.data_format)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format='NCHW', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.exclusive, None, self.data_format)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format='NCDHW', name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.exclusive, None, self.data_format)
+
+
+class AdaptiveAvgPool1D(_Pool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size=output_size, data_format='NCL')
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool2D(_Pool):
+    def __init__(self, output_size, data_format='NCHW', name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool3D(_Pool):
+    def __init__(self, output_size, data_format='NCDHW', name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool1D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, data_format='NCL')
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size, data_format=self.data_format)
+
+
+class AdaptiveMaxPool2D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, data_format='NCHW')
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size, data_format=self.data_format)
+
+
+class AdaptiveMaxPool3D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, data_format='NCDHW')
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, data_format=self.data_format)

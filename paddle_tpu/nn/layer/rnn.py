@@ -1,0 +1,209 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+Recurrences run under `lax.scan` — the XLA-native loop: compiled once,
+unrolled on-device, differentiable, static shapes. Gate matmuls are
+batched so each scan step is one MXU-friendly (B, 4H) matmul.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from .base import Layer
+from .container import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, dtype=jnp.float32):
+        shape = (batch_size, self.hidden_size)
+        if self._state_arity == 2:
+            return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        return jnp.zeros(shape, dtype)
+
+
+def _uniform_std(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    _state_arity = 1
+
+    def __init__(self, input_size, hidden_size, activation='tanh',
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter((input_size, hidden_size), initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size), initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter((hidden_size,), initializer=init, is_bias=True)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs.shape[0], inputs.dtype)
+        z = inputs @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh
+        act = jnp.tanh if self.activation == 'tanh' else F.relu
+        h = act(z)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    _state_arity = 2
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter((input_size, 4 * hidden_size), initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, 4 * hidden_size), initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), initializer=init, is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], inputs.dtype)
+        h, c = states
+        z = inputs @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    _state_arity = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter((input_size, 3 * hidden_size), initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, 3 * hidden_size), initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), initializer=init, is_bias=True)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs.shape[0], inputs.dtype)
+        zi = inputs @ self.weight_ih + self.bias_ih
+        zh = h @ self.weight_hh + self.bias_hh
+        ri, ui, ci = jnp.split(zi, 3, axis=-1)
+        rh, uh, ch = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        u = jax.nn.sigmoid(ui + uh)
+        c = jnp.tanh(ci + r * ch)
+        h = u * h + (1 - u) * c
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (ref: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not self.time_major:
+            inputs = jnp.swapaxes(inputs, 0, 1)  # (T, B, C)
+        if self.is_reverse:
+            inputs = jnp.flip(inputs, axis=0)
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(inputs.shape[1], inputs.dtype)
+
+        cell = self.cell
+
+        def step(state, x_t):
+            out, new_state = cell(x_t, state)
+            return new_state, out
+
+        final, outs = jax.lax.scan(step, initial_states, inputs)
+        if self.is_reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = initial_states if initial_states is not None else (None, None)
+        out_fw, f_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, f_bw = self.rnn_bw(inputs, s_bw)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (f_fw, f_bw)
+
+
+class _StackedRNN(Layer):
+    """Shared driver for SimpleRNN / LSTM / GRU (ref: nn/layer/rnn.py::RNNBase)."""
+
+    def __init__(self, cell_cls, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0, **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ('bidirect', 'bidirectional')
+        self._state_arity = cell_cls._state_arity
+        self.layers = LayerList()
+        for i in range(num_layers):
+            isz = input_size if i == 0 else hidden_size * (2 if self.bidirect else 1)
+            if self.bidirect:
+                self.layers.append(
+                    BiRNN(cell_cls(isz, hidden_size, **cell_kwargs),
+                          cell_cls(isz, hidden_size, **cell_kwargs), time_major)
+                )
+            else:
+                self.layers.append(RNN(cell_cls(isz, hidden_size, **cell_kwargs), False, time_major))
+        if dropout > 0:
+            self._init_rng()
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.layers):
+            state_i = None if initial_states is None else jax.tree.map(
+                lambda s: s[i], initial_states
+            )
+            out, final = rnn(out, state_i)
+            finals.append(final)
+            if self.dropout > 0 and self.training and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=True, rng_key=self.next_rng_key())
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *finals)
+        return out, stacked
+
+
+class SimpleRNN(_StackedRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction='forward',
+                 time_major=False, dropout=0.0, activation='tanh', **kw):
+        super().__init__(SimpleRNNCell, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation=activation)
+
+
+class LSTM(_StackedRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction='forward',
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__(LSTMCell, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_StackedRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction='forward',
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__(GRUCell, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

@@ -1,0 +1,22 @@
+"""nn.utils (ref: python/paddle/nn/utils)."""
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from .weight_norm import remove_weight_norm, weight_norm  # noqa: F401
+from .spectral_norm import spectral_norm  # noqa: F401
+
+
+def parameters_to_vector(parameters):
+    import jax.numpy as jnp
+
+    return jnp.concatenate([p.reshape(-1) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters):
+    import numpy as np
+
+    out = []
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        out.append(vec[offset : offset + n].reshape(p.shape))
+        offset += n
+    return out

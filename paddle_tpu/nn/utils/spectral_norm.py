@@ -1,0 +1,20 @@
+"""spectral_norm hook (ref: python/paddle/nn/utils/spectral_norm_hook.py)."""
+from __future__ import annotations
+
+from ..layer.norm import SpectralNorm
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12, dim=None):
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith('Transpose') else 0
+    sn = SpectralNorm(w.shape, axis=dim, power_iters=n_power_iterations, epsilon=eps)
+    layer._spectral_norm = sn
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        object.__setattr__(layer, name, layer._spectral_norm(getattr(layer, name)))
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = forward
+    return layer

@@ -1,0 +1,60 @@
+"""Weight normalization (ref: python/paddle/nn/utils/weight_norm_hook.py).
+
+Functional re-parameterisation: the layer stores (v, g) parameters and
+recomputes weight = g * v / ||v|| in a pre-forward wrapper.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..layer.base import Parameter
+
+
+def _norm_except(v, axis):
+    if axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != axis)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name='weight', dim=0):
+    w = getattr(layer, name)
+    g = _norm_except(w, dim)
+    setattr(layer, name + '_v', Parameter(w))
+    setattr(layer, name + '_g', Parameter(g.reshape(-1) if dim is not None else g))
+    delattr(layer, name)
+
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        v = getattr(layer, name + '_v')
+        gg = getattr(layer, name + '_g')
+        if dim is not None:
+            shape = [1] * v.ndim
+            shape[dim] = -1
+            gg = gg.reshape(shape)
+        n = _norm_except(v, dim)
+        object.__setattr__(layer, name, v / n * gg)
+        out = orig_forward(*args, **kwargs)
+        return out
+
+    layer.forward = forward
+    layer._weight_norm_name = name
+    layer._weight_norm_dim = dim
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    dim = getattr(layer, '_weight_norm_dim', 0)
+    v = getattr(layer, name + '_v')
+    g = getattr(layer, name + '_g')
+    if dim is not None:
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        g = g.reshape(shape)
+    n = _norm_except(v, dim)
+    setattr(layer, name, Parameter(v / n * g))
+    delattr(layer, name + '_v')
+    delattr(layer, name + '_g')
+    layer.forward = type(layer).forward.__get__(layer)
+    return layer

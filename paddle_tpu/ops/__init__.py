@@ -1,0 +1,27 @@
+"""Hand-written TPU kernels (pallas) with lax fallbacks.
+
+Dispatch policy: pallas kernels on TPU backends, pure-lax reference
+implementations elsewhere (CPU tests) — same math, verified against each
+other in tests/test_pallas.py.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _on_tpu():
+    return jax.default_backend() not in ('cpu',)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Fused RMSNorm; pallas kernel on TPU (ops/pallas/rms_norm.py)."""
+    if _on_tpu() and x.shape[-1] % 128 == 0 and x.dtype != jax.numpy.float64:
+        try:
+            from .pallas.rms_norm import rms_norm as _k
+
+            return _k(x, weight, epsilon)
+        except Exception:
+            pass
+    from ..nn.functional.norm import rms_norm as _ref
+
+    return _ref(x, weight, epsilon)

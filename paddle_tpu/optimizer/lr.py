@@ -1,0 +1,297 @@
+"""LR schedulers (ref: python/paddle/optimizer/lr.py).
+
+Each scheduler is a pure function of the (traced) step count —
+`sched(step) -> lr` — so the whole schedule lives inside the compiled
+train step (no host→device sync per step, unlike the reference's
+Python-side `lr_scheduler.step()`). A Paddle-style `.step()/.get_lr()`
+shim is provided for imperative code.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self._host_step = 0
+
+    def __call__(self, step):
+        return self.get_lr_at(step)
+
+    def get_lr_at(self, step):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # imperative shims
+    def step(self, epoch=None):
+        self._host_step = self._host_step + 1 if epoch is None else epoch
+
+    def get_lr(self):
+        return float(self.get_lr_at(jnp.asarray(self._host_step, jnp.float32)))
+
+    def state_dict(self):
+        return {'host_step': self._host_step}
+
+    def set_state_dict(self, state):
+        self._host_step = int(state.get('host_step', 0))
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+
+    def get_lr_at(self, step):
+        s = jnp.maximum(step.astype(jnp.float32) if hasattr(step, 'astype') else jnp.float32(step), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(s ** -0.5, s * self.warmup_steps ** -1.5)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.gamma = gamma
+
+    def get_lr_at(self, step):
+        return self.base_lr * jnp.power(self.gamma, _f(step))
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.gamma = gamma
+
+    def get_lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * _f(step))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.gamma = gamma
+
+    def get_lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * _f(step))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.decay_steps, self.end_lr, self.power, self.cycle = decay_steps, end_lr, power, cycle
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(s / self.decay_steps, 1.0))
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            s = jnp.minimum(s, decay_steps)
+        return (self.base_lr - self.end_lr) * jnp.power(1 - s / decay_steps, self.power) + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr, last_epoch=-1, verbose=False):
+        super().__init__(end_lr, last_epoch, verbose)
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.peak = learning_rate if not isinstance(learning_rate, LRScheduler) else None
+        self.warmup_steps, self.start_lr, self.end_lr = warmup_steps, start_lr, end_lr
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(s, self.warmup_steps) / self.warmup_steps
+        if self.inner is not None:
+            after = self.inner(jnp.maximum(s - self.warmup_steps, 0))
+        else:
+            after = self.peak
+        return jnp.where(s < self.warmup_steps, warm, after)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.step_size, self.gamma = step_size, gamma
+
+    def get_lr_at(self, step):
+        return self.base_lr * jnp.power(self.gamma, jnp.floor(_f(step) / self.step_size))
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.milestones = list(milestones)
+        self.gamma = gamma
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        count = sum((s >= m).astype(jnp.float32) for m in self.milestones)
+        return self.base_lr * jnp.power(self.gamma, count)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.lr_lambda = lr_lambda
+
+    def get_lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.lr_lambda = lr_lambda
+
+    def get_lr_at(self, step):
+        # product form λ(1)·…·λ(t); for traceability assume λ const-per-step
+        return self.base_lr * jnp.power(self.lr_lambda(1), _f(step))
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.T_max, self.eta_min = T_max, eta_min
+
+    def get_lr_at(self, step):
+        s = jnp.minimum(_f(step), self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * s / self.T_max))
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0, last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.T_0, self.T_mult, self.eta_min = T_0, T_mult, eta_min
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        if self.T_mult == 1:
+            t_cur = jnp.mod(s, self.T_0)
+            T_i = self.T_0
+        else:
+            n = jnp.floor(jnp.log(s / self.T_0 * (self.T_mult - 1) + 1) / math.log(self.T_mult))
+            sum_prev = self.T_0 * (jnp.power(float(self.T_mult), n) - 1) / (self.T_mult - 1)
+            t_cur = s - sum_prev
+            T_i = self.T_0 * jnp.power(float(self.T_mult), n)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t_cur / T_i))
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy='cos',
+                 three_phase=False, last_epoch=-1, verbose=False):
+        super().__init__(max_learning_rate, last_epoch, verbose)
+        self.max_lr = max_learning_rate
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.total_steps = total_steps
+        self.up_steps = int(phase_pct * total_steps)
+
+    def get_lr_at(self, step):
+        s = jnp.minimum(_f(step), self.total_steps)
+        up = self.initial_lr + (self.max_lr - self.initial_lr) * s / max(self.up_steps, 1)
+        down_frac = (s - self.up_steps) / max(self.total_steps - self.up_steps, 1)
+        down = self.end_lr + (self.max_lr - self.end_lr) * 0.5 * (1 + jnp.cos(jnp.pi * jnp.clip(down_frac, 0, 1)))
+        return jnp.where(s < self.up_steps, up, down)
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate, max_learning_rate, step_size_up=2000,
+                 step_size_down=None, mode='triangular', exp_gamma=1.0,
+                 scale_fn=None, scale_mode='cycle', last_epoch=-1, verbose=False):
+        super().__init__(base_learning_rate, last_epoch, verbose)
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        total = self.up + self.down
+        cycle = jnp.floor(1 + s / total)
+        pos = jnp.mod(s, total)
+        x = jnp.where(pos < self.up, pos / self.up, 1 - (pos - self.up) / self.down)
+        amp = self.max_lr - self.base_lr
+        if self.mode == 'triangular2':
+            amp = amp / jnp.power(2.0, cycle - 1)
+        elif self.mode == 'exp_range':
+            amp = amp * jnp.power(self.exp_gamma, s)
+        return self.base_lr + amp * x
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven scheduler — inherently host-side (ref: lr.py::ReduceOnPlateau).
+    Use imperatively: call .step(metric) each eval, read .last_lr."""
+
+    def __init__(self, learning_rate, mode='min', factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode='rel', cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        super().__init__(learning_rate)
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.threshold_mode = threshold, threshold_mode
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self.last_lr = learning_rate
+        self._best = None
+        self._bad = 0
+        self._cool = 0
+
+    def get_lr_at(self, step):
+        return jnp.asarray(self.last_lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (
+            self._best is None
+            or (self.mode == 'min' and m < self._best - self._eps())
+            or (self.mode == 'max' and m > self._best + self._eps())
+        )
+        if better:
+            self._best = m
+            self._bad = 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self.last_lr = max(self.last_lr * self.factor, self.min_lr)
+                self._bad = 0
+                self._cool = self.cooldown
+
+    def _eps(self):
+        if self.threshold_mode == 'rel':
+            return abs(self._best or 0) * self.threshold
+        return self.threshold
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        super().__init__(values[0], last_epoch, verbose)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def get_lr_at(self, step):
+        s = _f(step)
+        lr = jnp.asarray(self.values[-1], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            lr = jnp.where(s < b, v, lr)
+        return lr
+
+
+class LinearLR(LRScheduler):
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3, end_factor=1.0,
+                 last_epoch=-1, verbose=False):
+        super().__init__(learning_rate, last_epoch, verbose)
+        self.total_steps, self.start_factor, self.end_factor = total_steps, start_factor, end_factor
+
+    def get_lr_at(self, step):
+        frac = jnp.clip(_f(step) / self.total_steps, 0, 1)
+        return self.base_lr * (self.start_factor + (self.end_factor - self.start_factor) * frac)
+
+
+def _f(step):
+    return step.astype(jnp.float32) if hasattr(step, 'astype') else jnp.float32(step)

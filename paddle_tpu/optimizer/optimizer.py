@@ -1,0 +1,161 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py).
+
+Functional core, Paddle surface. The whole update is one fused jitted
+tree-map — the TPU-native equivalent of Paddle's multi_tensor/fused_adam
+paths (XLA fuses the per-parameter lambdas into a handful of kernels).
+
+Usage (inside a jitted train step):
+    opt = AdamW(learning_rate=3e-4, weight_decay=0.01)
+    state = opt.init(model)
+    ...
+    model, state = opt.apply_gradients(model, grads, state)
+
+`multi_precision=True` keeps fp32 master weights for bf16 params
+(ref: optimizer.py::_multi_precision logic).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tree import merge, split_trainable
+from .lr import LRScheduler
+
+
+def _tmap(fn, *trees):
+    return jax.tree.map(fn, *trees)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._decoupled_decay = False
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self._model_ref = parameters
+        self.state = None
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self, step=0):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr(step)
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def set_lr(self, lr):
+        self._lr = lr
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- functional API ---------------------------------------------------
+    def init(self, model):
+        """Build optimizer state for the trainable partition of `model`."""
+        t, _ = split_trainable(model)
+        state = {
+            'step': jnp.zeros((), jnp.int32),
+            'slots': self.init_slots(t),
+        }
+        if self.multi_precision:
+            state['master'] = _tmap(
+                lambda p: p.astype(jnp.float32) if p.dtype == jnp.bfloat16 else None, t
+            )
+        self.state = state
+        return state
+
+    def init_slots(self, trainable):  # per-optimizer moment slots
+        return {}
+
+    def update_param(self, p, g, slots, lr, step):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def apply_gradients(self, model, grads, state=None):
+        """Returns (new_model, new_state). `grads` is the tree returned by
+        autograd.value_and_grad (trainable-shaped)."""
+        state = state if state is not None else self.state
+        t, f = split_trainable(model)
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = state['step'] + 1
+        lr = self.get_lr(step)
+        master = state.get('master')
+
+        # coupled L2 (SGD/Momentum-style regularizer): g += wd * p
+        if self._weight_decay and not self._decoupled_decay:
+            wd = self._weight_decay
+            grads = _tmap(lambda g, p: g + wd * p.astype(g.dtype), grads, t)
+
+        def upd(p, g, *slot_leaves):
+            return None  # placeholder; real work below via packed trees
+
+        slots = state['slots']
+        new_t, new_slots, new_master = self._apply_tree(t, grads, slots, master, lr, step)
+        new_state = {'step': step, 'slots': new_slots}
+        if master is not None:
+            new_state['master'] = new_master
+        new_model = merge(new_t, f)
+        self.state = new_state
+        return new_model, new_state
+
+    def _apply_tree(self, t, grads, slots, master, lr, step):
+        # slots: dict name -> tree shaped like t
+        slot_names = list(slots.keys())
+        slot_trees = [slots[k] for k in slot_names]
+
+        def leaf_update(p, g, m, *slot_leaves):
+            if g is None:
+                return (p,) + tuple(slot_leaves) + (m,)
+            compute_p = m if m is not None else p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            if self._weight_decay and self._decoupled_decay:
+                compute_p = compute_p - lr * self._weight_decay * compute_p
+            new_p, new_slots_ = self.update_param(
+                compute_p, gf, dict(zip(slot_names, slot_leaves)), lr, step
+            )
+            out_slots = tuple(new_slots_[k] for k in slot_names)
+            if m is not None:
+                return (new_p.astype(p.dtype),) + out_slots + (new_p,)
+            return (new_p.astype(p.dtype),) + out_slots + (None,)
+
+        if master is None:
+            master = _tmap(lambda p: None, t)
+
+        # tree.map over multiple trees with identical structure; None leaves in
+        # grads align with None in t's frozen slots (both empty nodes).
+        packed = jax.tree.map(
+            lambda p, g, m, *sl: leaf_update(p, g, m, *sl),
+            t, grads, master, *slot_trees,
+            is_leaf=lambda x: x is None,
+        )
+
+        k = len(slot_names)
+
+        def pick(i):
+            return jax.tree.map(
+                lambda tup: tup[i], packed,
+                is_leaf=lambda x: isinstance(x, tuple) and len(x) == k + 2,
+            )
+
+        new_t = pick(0)
+        new_slots = {name: pick(1 + i) for i, name in enumerate(slot_names)}
+        new_master = pick(k + 1)
+        return new_t, new_slots, new_master
+
+    # -- paddle-style imperative conveniences ------------------------------
+    def step(self):  # pragma: no cover - dygraph-compat shim
+        raise RuntimeError(
+            'paddle_tpu optimizers are functional: use '
+            'model, state = opt.apply_gradients(model, grads, state) '
+            'inside your (jitted) train step.'
+        )
+
+    def clear_grad(self):
+        return None
+
+    def state_dict(self):
+        return self.state
+
+    def set_state_dict(self, state):
+        self.state = state

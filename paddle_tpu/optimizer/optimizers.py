@@ -1,0 +1,247 @@
+"""Concrete optimizers (ref: python/paddle/optimizer/{sgd,momentum,adam,adamw,
+adagrad,adadelta,adamax,rmsprop,lamb,nadam,radam}.py).
+
+Each defines moment slots + a per-leaf update in fp32; the base class
+fuses the whole pytree update into one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, _tmap
+
+
+def _zeros_like_tree(t):
+    return _tmap(lambda p: jnp.zeros_like(p, dtype=jnp.float32), t)
+
+
+class SGD(Optimizer):
+    def init_slots(self, t):
+        return {}
+
+    def update_param(self, p, g, slots, lr, step):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def init_slots(self, t):
+        return {'velocity': _zeros_like_tree(t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        v = self.momentum * slots['velocity'] + g
+        if self.use_nesterov:
+            p = p - lr * (g + self.momentum * v)
+        else:
+            p = p - lr * v
+        return p, {'velocity': v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, t):
+        return {'m': _zeros_like_tree(t), 'v': _zeros_like_tree(t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * slots['m'] + (1 - b1) * g
+        v = b2 * slots['v'] + (1 - b2) * g * g
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf))
+        vhat = v / (1 - jnp.power(b2, sf))
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return p, {'m': m, 'v': v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name=name)
+        self._decoupled_decay = True
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def init_slots(self, t):
+        iv = self.initial_accumulator_value
+        return {'moment': _tmap(lambda p: jnp.full(p.shape, iv, jnp.float32), t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        acc = slots['moment'] + g * g
+        p = p - lr * g / (jnp.sqrt(acc) + self.epsilon)
+        return p, {'moment': acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.epsilon, self.rho = epsilon, rho
+
+    def init_slots(self, t):
+        return {'avg_sq_grad': _zeros_like_tree(t), 'avg_sq_update': _zeros_like_tree(t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        asg = self.rho * slots['avg_sq_grad'] + (1 - self.rho) * g * g
+        upd = jnp.sqrt(slots['avg_sq_update'] + self.epsilon) / jnp.sqrt(asg + self.epsilon) * g
+        asu = self.rho * slots['avg_sq_update'] + (1 - self.rho) * upd * upd
+        return p - lr * upd, {'avg_sq_grad': asg, 'avg_sq_update': asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, t):
+        return {'m': _zeros_like_tree(t), 'inf': _zeros_like_tree(t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        m = self.beta1 * slots['m'] + (1 - self.beta1) * g
+        inf = jnp.maximum(self.beta2 * slots['inf'], jnp.abs(g))
+        sf = step.astype(jnp.float32)
+        p = p - lr / (1 - jnp.power(self.beta1, sf)) * m / (inf + self.epsilon)
+        return p, {'m': m, 'inf': inf}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self.rho, self.epsilon, self.momentum, self.centered = rho, epsilon, momentum, centered
+
+    def init_slots(self, t):
+        slots = {'mean_sq': _zeros_like_tree(t), 'velocity': _zeros_like_tree(t)}
+        if self.centered:
+            slots['mean_g'] = _zeros_like_tree(t)
+        return slots
+
+    def update_param(self, p, g, slots, lr, step):
+        ms = self.rho * slots['mean_sq'] + (1 - self.rho) * g * g
+        out = {'mean_sq': ms}
+        if self.centered:
+            mg = self.rho * slots['mean_g'] + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self.epsilon)
+            out['mean_g'] = mg
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        v = self.momentum * slots['velocity'] + lr * g / denom
+        out['velocity'] = v
+        return p - v, out
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py — layerwise-adaptive AdamW for
+    large-batch training."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self.lamb_weight_decay = lamb_weight_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, t):
+        return {'m': _zeros_like_tree(t), 'v': _zeros_like_tree(t)}
+
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * slots['m'] + (1 - b1) * g
+        v = b2 * slots['v'] + (1 - b2) * g * g
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf))
+        vhat = v / (1 - jnp.power(b2, sf))
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + self.lamb_weight_decay * p
+        p_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr * trust * r, {'m': m, 'v': v}
+
+
+class NAdam(Adam):
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * slots['m'] + (1 - b1) * g
+        v = b2 * slots['v'] + (1 - b2) * g * g
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf + 1))
+        vhat = v / (1 - jnp.power(b2, sf))
+        m_bar = b1 * mhat + (1 - b1) * g / (1 - jnp.power(b1, sf))
+        p = p - lr * m_bar / (jnp.sqrt(vhat) + self.epsilon)
+        return p, {'m': m, 'v': v}
+
+
+class RAdam(Adam):
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * slots['m'] + (1 - b1) * g
+        v = b2 * slots['v'] + (1 - b2) * g * g
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf))
+        rho_inf = 2.0 / (1 - b2) - 1
+        b2t = jnp.power(b2, sf)
+        rho_t = rho_inf - 2 * sf * b2t / (1 - b2t)
+        r = jnp.sqrt(
+            jnp.clip((rho_t - 4) * (rho_t - 2) * rho_inf /
+                     jnp.clip((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12, None), 0, None)
+        )
+        vhat = jnp.sqrt(v / (1 - b2t)) + self.epsilon
+        p = jnp.where(rho_t > 5, p - lr * r * mhat / vhat, p - lr * mhat)
+        return p, {'m': m, 'v': v}
+
+
+class ASGD(SGD):
+    pass
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self.lr_range = learning_rate_range
+        self.etas = etas
+
+    def init_slots(self, t):
+        init_lr = float(self._lr) if not callable(self._lr) else 0.001
+        return {
+            'prev_g': _zeros_like_tree(t),
+            'lrs': _tmap(lambda p: jnp.full(p.shape, init_lr, jnp.float32), t),
+        }
+
+    def update_param(self, p, g, slots, lr, step):
+        sign = jnp.sign(g * slots['prev_g'])
+        lrs = jnp.clip(
+            jnp.where(sign > 0, slots['lrs'] * self.etas[1],
+                      jnp.where(sign < 0, slots['lrs'] * self.etas[0], slots['lrs'])),
+            self.lr_range[0], self.lr_range[1],
+        )
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        return p - lrs * jnp.sign(g_eff), {'prev_g': g_eff, 'lrs': lrs}

@@ -1,0 +1,12 @@
+"""Tensor op namespace (ref: python/paddle/tensor/__init__.py)."""
+from .creation import *  # noqa: F401,F403
+from .creation import Tensor  # noqa: F401
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from . import random  # noqa: F401
+
+import jax.numpy as _jnp
+
+einsum = _jnp.einsum

@@ -1,0 +1,138 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """ref: paddle.to_tensor."""
+    if isinstance(data, jax.Array) and dtype is None:
+        return data
+    arr = jnp.asarray(data)
+    d = _dt(dtype)
+    if d is None and arr.dtype == jnp.float64:
+        d = dtype_mod.get_default_dtype()
+    return arr.astype(d) if d is not None else arr
+
+
+Tensor = jax.Array
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype, dtype_mod.get_default_dtype()))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype, dtype_mod.get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype, dtype_mod.get_default_dtype()))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dt(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype, dtype_mod.get_default_dtype()))
+
+
+def diag(x, offset=0, padding_value=0):
+    if jnp.ndim(x) == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, dtype=x.dtype)
+        idx = jnp.arange(x.shape[0])
+        r = idx + max(0, -offset)
+        c = idx + max(0, offset)
+        return out.at[r, c].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing='ij')
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0):
+    return jnp.stack(jnp.tril_indices(row, k=offset, m=col))
+
+
+def triu_indices(row, col, offset=0):
+    return jnp.stack(jnp.triu_indices(row, k=offset, m=col))
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def numel(x):
+    return int(np.prod(x.shape)) if not isinstance(x.shape[0] if x.shape else 0, jax.core.Tracer) else x.size
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)

@@ -1,0 +1,144 @@
+"""Linear algebra (ref: python/paddle/tensor/linalg.py, python/paddle/linalg.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = 'fro' if axis is None or isinstance(axis, (list, tuple)) else 2
+    if p == 'fro' and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    axis_t = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.linalg.norm(x, ord=p, axis=axis_t, keepdims=keepdim)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p='fro', axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def lstsq(x, y, rcond=None):
+    return jnp.linalg.lstsq(x, y, rcond=rcond)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv
+
+
+def qr(x, mode='reduced'):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO='L'):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO='L'):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
+        v = v.at[i].set(1.0)
+        h = eye - tau[..., i] * jnp.outer(v, v)
+        return q @ h
+
+    return jax.lax.fori_loop(0, n, body, eye)[..., :, :n]
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(x, full_matrices=False)
+    q = q or min(6, *x.shape[-2:])
+    return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]

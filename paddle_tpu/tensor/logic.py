@@ -1,0 +1,31 @@
+"""Logic/compare ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_xor = jnp.logical_xor
+logical_not = jnp.logical_not
+
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x):
+    import jax
+
+    return isinstance(x, jax.Array)

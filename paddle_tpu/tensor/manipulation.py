@@ -1,0 +1,425 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape_(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+t = swapaxes
+
+
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = builtins_sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    idx = np.cumsum(sections)[:-1]
+    return jnp.split(x, idx, axis=axis)
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(v, axis=axis) for v in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def expand(x, shape):
+    shape = [x.shape[i - len(shape) + len(x.shape)] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    if start_axis < 0:
+        start_axis += nd
+    if stop_axis < 0:
+        stop_axis += nd
+    shape = (
+        x.shape[:start_axis]
+        + (int(np.prod(x.shape[start_axis : stop_axis + 1])),)
+        + x.shape[stop_axis + 1 :]
+    )
+    return jnp.reshape(x, shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis if not isinstance(axis, list) else tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis if not isinstance(axis, list) else tuple(axis))
+
+
+def gather(x, index, axis=0):
+    index = index.reshape(-1)
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce='assign'):
+    if reduce == 'assign':
+        return _scatter_along(x, indices, values, axis, 'set')
+    if reduce == 'add':
+        return _scatter_along(x, indices, values, axis, 'add')
+    if reduce in ('mul', 'multiply'):
+        return _scatter_along(x, indices, values, axis, 'mul')
+    raise ValueError(reduce)
+
+
+def _scatter_along(x, indices, values, axis, mode):
+    values = jnp.broadcast_to(jnp.asarray(values, dtype=x.dtype), indices.shape)
+    dims = []
+    for i in range(x.ndim):
+        if i == axis:
+            dims.append(indices)
+        else:
+            shape = [1] * x.ndim
+            shape[i] = x.shape[i] if i < axis else indices.shape[i]
+            dims.append(jnp.broadcast_to(jnp.arange(indices.shape[i]).reshape(shape), indices.shape))
+    idx = tuple(dims)
+    at = x.at[idx]
+    return getattr(at, {'set': 'set', 'add': 'add', 'mul': 'multiply'}[mode])(values)
+
+
+def scatter(x, index, updates, overwrite=True):
+    """ref: paddle.scatter — row-wise scatter on axis 0."""
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, updates.dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def masked_scatter(x, mask, value):
+    flat_mask = mask.reshape(-1)
+    n = int(flat_mask.sum())
+    out = x.reshape(-1).at[jnp.nonzero(flat_mask)[0]].set(value.reshape(-1)[:n])
+    return out.reshape(x.shape)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return nz
+    return jnp.stack(nz, axis=1)
+
+
+def pad(x, pad, mode='constant', value=0.0, data_format=None):
+    """ref: paddle.nn.functional.pad — pad is [before_last, after_last, ...]
+    pairs from the LAST axis backwards when given flat ints (torch/paddle
+    convention), or a full per-axis list."""
+    if len(pad) == 2 * x.ndim:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        pairs = [(0, 0)] * (x.ndim - len(pad) // 2)
+        it = list(zip(pad[0::2], pad[1::2]))
+        pairs += [tuple(p) for p in reversed(it)]
+    if mode == 'constant':
+        return jnp.pad(x, pairs, mode='constant', constant_values=value)
+    jmode = {'reflect': 'reflect', 'replicate': 'edge', 'circular': 'wrap'}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    return jnp.unique(
+        x,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    x_flat = x if axis is not None else x.reshape(-1)
+    keep = jnp.concatenate([jnp.array([True]), x_flat[1:] != x_flat[:-1]])
+    return x_flat[keep]
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argmax(x, axis=None, keepdim=False, dtype='int64'):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64'):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = topk(xm, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        v, i = jax.lax.top_k(x, k)
+    else:
+        v, i = jax.lax.top_k(-x, k)
+        v = -v
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vk = jnp.take(v, k - 1, axis=axis)
+    ik = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vk = jnp.expand_dims(vk, axis)
+        ik = jnp.expand_dims(ik, axis)
+    return vk, ik
+
+
+def mode(x, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    # most frequent via run-length on sorted values (static-shape friendly)
+    eq = v == jnp.roll(v, 1, axis=axis)
+    runs = jnp.cumsum(eq, axis=axis)
+    idx = jnp.argmax(runs, axis=axis, keepdims=True)
+    out = jnp.take_along_axis(v, idx, axis=axis)
+    if not keepdim:
+        out = jnp.squeeze(out, axis=axis)
+    return out, idx if keepdim else jnp.squeeze(idx, axis=axis)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = 'right' if right else 'left'
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def as_strided(x, shape, stride, offset=0):
+    # XLA has no strided views; emulate via gather for the common cases.
+    idx = offset + np.sum(
+        np.stack(np.meshgrid(*[np.arange(s) for s in shape], indexing='ij'), 0)
+        * np.array(stride).reshape((-1,) + (1,) * len(shape)),
+        axis=0,
+    )
+    return x.reshape(-1)[idx]
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, shape_or_dtype)
+    return x.view(shape_or_dtype)
+
+
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    shape = [x.shape[i] - offsets[i] if s in (-1, None) else s for i, s in enumerate(shape)]
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def slice(x, axes, starts, ends):
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = builtins_slice(s, e)
+    return x[tuple(idx)]
+
+
+def builtins_slice(*a):
+    import builtins
+
+    return builtins.slice(*a)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins_slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def cdist(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == float('inf'):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def cast(x, dtype):
+    from ..framework import dtype as dtype_mod
+
+    return x.astype(dtype_mod.convert_dtype(dtype))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = index_num // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    inside = (input >= lo) & (input < hi)
+    return jnp.where(inside, input - lo, ignore_value)

@@ -1,0 +1,296 @@
+"""Math ops (ref: python/paddle/tensor/math.py, ops.py).
+
+Thin Paddle-signature fronts over jnp — jnp *is* the TPU kernel library
+here (every call lowers to XLA HLO and fuses)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# elementwise binary
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = remainder = jnp.remainder
+pow = jnp.power
+maximum = jnp.maximum
+minimum = jnp.minimum
+fmax = jnp.fmax
+fmin = jnp.fmin
+atan2 = jnp.arctan2
+hypot = jnp.hypot
+copysign = jnp.copysign
+nextafter = jnp.nextafter
+ldexp = jnp.ldexp
+gcd = jnp.gcd
+lcm = jnp.lcm
+heaviside = jnp.heaviside
+
+
+def divide_no_nan(x, y):
+    return jnp.where(y == 0, jnp.zeros_like(x), x / jnp.where(y == 0, 1, y))
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+# elementwise unary
+abs = jnp.abs
+neg = negative = jnp.negative
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sqrt = jnp.sqrt
+rsqrt = jax.lax.rsqrt
+square = jnp.square
+sign = jnp.sign
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = arcsin = jnp.arcsin
+acos = arccos = jnp.arccos
+atan = arctan = jnp.arctan
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+ceil = jnp.ceil
+floor = jnp.floor
+round = jnp.round
+trunc = jnp.trunc
+frac = lambda x: x - jnp.trunc(x)
+reciprocal = jnp.reciprocal
+erf = jax.scipy.special.erf
+erfinv = jax.scipy.special.erfinv
+lgamma = jax.scipy.special.gammaln
+digamma = jax.scipy.special.digamma
+i0 = jnp.i0
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+deg2rad = jnp.deg2rad
+rad2deg = jnp.rad2deg
+angle = jnp.angle
+conj = jnp.conj
+real = jnp.real
+imag = jnp.imag
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x / (1 - x))
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# reductions
+def _axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# cumulative
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    m = jnp.max(x, axis=axis, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    return jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis)) + m
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1) if x.ndim <= 2 else jnp.dot(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=rng)
+    return h
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale

@@ -1,0 +1,99 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py).
+
+Eager convenience front over jax.random using the process-global stream.
+Inside jit-traced code prefer explicit keys (`paddle_tpu.framework.random`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None):
+    return jax.random.uniform(random_mod.split_key(), tuple(shape), dtype=_dt(dtype))
+
+
+uniform_random = rand
+
+
+def randn(shape, dtype=None):
+    return jax.random.normal(random_mod.split_key(), tuple(shape), dtype=_dt(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, 'shape') else ()
+    return mean + std * jax.random.normal(
+        random_mod.split_key(), tuple(shape), dtype=dtype_mod.get_default_dtype()
+    )
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(
+        random_mod.split_key(), tuple(shape), dtype=_dt(dtype), minval=min, maxval=max
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype='int64'):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(
+        random_mod.split_key(), tuple(shape), low, high, dtype=dtype_mod.convert_dtype(dtype)
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype='int64'):
+    return jax.random.permutation(random_mod.split_key(), n).astype(
+        dtype_mod.convert_dtype(dtype)
+    )
+
+
+def shuffle(x, axis=0):
+    return jax.random.permutation(random_mod.split_key(), x, axis=axis)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    k = random_mod.split_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(k, logits, shape=(*x.shape[:-1], num_samples))
+    # Gumbel top-k trick for sampling without replacement
+    g = jax.random.gumbel(k, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(random_mod.split_key(), x).astype(
+        dtype_mod.get_default_dtype()
+    )
+
+
+def poisson(x):
+    return jax.random.poisson(random_mod.split_key(), x).astype(
+        dtype_mod.get_default_dtype()
+    )
+
+
+def exponential_(x, lam=1.0):
+    return jax.random.exponential(random_mod.split_key(), x.shape, dtype=x.dtype) / lam
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def standard_gamma(alpha, shape=None):
+    return jax.random.gamma(random_mod.split_key(), alpha, shape=shape)

@@ -1,0 +1,25 @@
+"""Test env: force a virtual 8-device CPU mesh BEFORE jax initialises.
+
+Mirrors SURVEY.md §4 — distributed tests validate dp/tp/pp/fsdp sharding
+semantics on host devices; the driver separately dry-runs multichip.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+prev = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in prev:
+    os.environ['XLA_FLAGS'] = (
+        prev + ' --xla_force_host_platform_device_count=8'
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+
+    pt.seed(1234)
+    np.random.seed(1234)
+    yield

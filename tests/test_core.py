@@ -1,0 +1,176 @@
+"""Core framework tests: pytree Layer system, autograd filtering, train step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import SGD, Adam, AdamW
+
+
+def test_layer_is_pytree():
+    m = nn.Linear(4, 8)
+    leaves, treedef = jax.tree.flatten(m)
+    assert len(leaves) == 2
+    m2 = jax.tree.unflatten(treedef, leaves)
+    x = jnp.ones((2, 4))
+    np.testing.assert_allclose(m(x), m2(x))
+
+
+def test_named_parameters_and_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = dict(m.named_parameters())
+    assert set(names) == {'L0.weight', 'L0.bias', 'L2.weight', 'L2.bias'}
+    sd = m.state_dict()
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    x = jnp.ones((3, 4))
+    np.testing.assert_allclose(m(x), m2(x))
+
+
+def test_state_dict_strict_mismatch():
+    m = nn.Linear(4, 8)
+    with pytest.raises(ValueError):
+        m.set_state_dict({'weight': np.zeros((4, 8))})  # missing bias
+
+
+def test_buffers_not_trainable():
+    bn = nn.BatchNorm1D(4, data_format='NLC')
+    pnames = {n for n, _ in bn.named_parameters()}
+    assert pnames == {'weight', 'bias'}
+    bnames = {n for n, _ in bn.named_buffers()}
+    assert '_mean' in bnames and '_variance' in bnames
+
+
+def test_grad_only_trainable():
+    m = nn.BatchNorm1D(3, data_format='NLC')
+
+    def loss(model, x):
+        return model(x).sum()
+
+    g = pt.autograd.grad(loss)(m, jnp.ones((2, 3)))
+    assert g.weight is not None and g.bias is not None
+    assert g._mean is None and g._variance is None
+
+
+def test_jit_train_step_converges():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    x = jnp.asarray(np.random.randn(32, 8), jnp.float32)
+    y = jnp.asarray(np.random.randint(0, 4, (32,)))
+    opt = Adam(learning_rate=1e-2)
+    state = opt.init(model)
+
+    @jax.jit
+    def step(model, state, x, y):
+        def loss_fn(m):
+            return F.cross_entropy(m(x), y)
+
+        loss, grads = pt.value_and_grad(loss_fn)(model)
+        model, state = opt.apply_gradients(model, grads, state)
+        return model, state, loss
+
+    first = None
+    for _ in range(40):
+        model, state, loss = step(model, state, x, y)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.3
+
+
+def test_batchnorm_stats_update_under_jit():
+    model = nn.BatchNorm1D(4, data_format='NLC')
+
+    @jax.jit
+    def fwd(m, x):
+        y = m(x)
+        return y, m
+
+    x = jnp.asarray(np.random.randn(64, 4) * 3 + 1, jnp.float32)
+    y, model = fwd(model, x)
+    assert float(jnp.abs(model._mean).sum()) > 0.1
+    model = model.eval()
+    y2 = model(x)
+    assert y2.shape == x.shape
+
+
+def test_dropout_rng_threading():
+    d = nn.Dropout(0.5)
+
+    @jax.jit
+    def fwd(m, x):
+        return m(x), m
+
+    x = jnp.ones((4, 100))
+    y1, d = fwd(d, x)
+    y2, d = fwd(d, x)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2)), 'rng must advance'
+    d = d.eval()
+    np.testing.assert_allclose(d(x), x)
+
+
+def test_train_eval_mode_recursive():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    m.eval()
+    assert all(not l.training for l in m.sublayers(include_self=True))
+    m.train()
+    assert all(l.training for l in m.sublayers(include_self=True))
+
+
+def test_optimizer_master_weights():
+    m = nn.Linear(4, 4)
+    m.astype('bfloat16')
+    assert m.weight.dtype == jnp.bfloat16
+    opt = AdamW(learning_rate=1e-3, multi_precision=True)
+    state = opt.init(m)
+    master = state['master']
+    assert master.weight.dtype == jnp.float32
+
+    def loss(model, x):
+        return model(x).astype(jnp.float32).sum()
+
+    g = pt.autograd.grad(loss)(m, jnp.ones((2, 4), jnp.bfloat16))
+    m2, state = opt.apply_gradients(m, g, state)
+    assert m2.weight.dtype == jnp.bfloat16
+    assert state['master'].weight.dtype == jnp.float32
+
+
+def test_sgd_matches_formula():
+    m = nn.Linear(2, 2, bias_attr=False)
+    w0 = np.asarray(m.weight)
+    opt = SGD(learning_rate=0.1)
+    state = opt.init(m)
+
+    def loss(model):
+        return jnp.sum(model.weight ** 2)
+
+    g = pt.autograd.grad(loss)(m)
+    m2, _ = opt.apply_gradients(m, g, state)
+    np.testing.assert_allclose(np.asarray(m2.weight), w0 - 0.1 * 2 * w0, rtol=1e-6)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    p = str(tmp_path / 'model.pdparams')
+    pt.save(m.state_dict(), p)
+    loaded = pt.load(p)
+    m2 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    m2.set_state_dict(loaded)
+    x = jnp.ones((2, 3))
+    np.testing.assert_allclose(m(x), m2(x))
+
+
+def test_no_copy_param_sharing_in_containers():
+    lin = nn.Linear(2, 2)
+    seq = nn.Sequential(lin)
+    assert seq[0] is lin
+
+
+def test_astype_roundtrip():
+    m = nn.Linear(4, 4)
+    m.astype(pt.bfloat16)
+    assert m.weight.dtype == jnp.bfloat16
+    m.astype(pt.float32)
+    y = m(jnp.ones((1, 4)))
+    assert y.dtype == jnp.float32
