@@ -1,0 +1,115 @@
+"""Flagship benchmark: Llama decoder-block train-step throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures tokens/sec/chip for a full train step (fwd+bwd+AdamW, bf16
+compute, flash attention, remat) on a Llama-2-7B-dimension decoder
+stack scaled in depth to fit one chip. `vs_baseline` = achieved MFU /
+0.50 — the reference's north-star is ">=50% MFU for Llama-2-7B under
+Fleet 3D hybrid parallel" (BASELINE.json), so 1.0 means parity with the
+reference's target efficiency on the same silicon.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    'TPU v2': 45e12, 'TPU v3': 123e12, 'TPU v4': 275e12,
+    'TPU v5 lite': 197e12, 'TPU v5e': 197e12, 'TPU v5': 459e12,
+    'TPU v5p': 459e12, 'TPU v6 lite': 918e12, 'TPU v6e': 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, 'device_kind', '')
+    for k, v in PEAK_BF16_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 275e12  # assume v4 if unknown
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    on_tpu = jax.default_backend() not in ('cpu',)
+    if on_tpu:
+        # 7B dims, depth scaled to single-chip HBM; trimmed vocab keeps the
+        # measurement on the decoder blocks (the headline unit).
+        cfg = LlamaConfig(
+            vocab_size=8192, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=4, num_attention_heads=32,
+            num_key_value_heads=32, max_position_embeddings=2048,
+            dtype='bfloat16', remat=True,
+        )
+        batch, seq, steps = 4, 2048, 10
+    else:  # smoke mode for CPU dev boxes
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=256,
+            dtype='float32', remat=False,
+        )
+        batch, seq, steps = 4, 128, 3
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
+    state = opt.init(model)
+
+    def train_step(model, state, batch):
+        loss, grads = pt.autograd.value_and_grad(lambda m: m.loss(batch))(model)
+        model, state = opt.apply_gradients(model, grads, state)
+        return model, state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq + 1)),
+        jnp.int32,
+    )
+
+    model, state, loss = step(model, state, ids)   # compile + warmup
+    float(loss)
+    model, state, loss = step(model, state, ids)   # steady-state warmup
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model, state, loss = step(model, state, ids)
+        float(loss)                                # hard sync every step
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch * seq
+    tok_per_sec = tokens / dt
+
+    # FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    attn = 6 * cfg.num_hidden_layers * cfg.hidden_size * seq  # 12*L*h*S * 0.5 causal
+    flops_per_token = 6 * n_params + attn
+    mfu = tok_per_sec * flops_per_token / peak_flops(jax.devices()[0])
+    vs_baseline = mfu / 0.50 if on_tpu else 0.0
+
+    print(json.dumps({
+        'metric': 'llama_decoder_train_tokens_per_sec_per_chip',
+        'value': round(tok_per_sec, 1),
+        'unit': 'tokens/s',
+        'vs_baseline': round(vs_baseline, 4),
+        'detail': {
+            'mfu': round(mfu, 4), 'loss': float(loss), 'step_ms': round(dt * 1e3, 2),
+            'params': n_params, 'batch': batch, 'seq': seq,
+            'backend': jax.default_backend(),
+            'device': getattr(jax.devices()[0], 'device_kind', '?'),
+        },
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -45,6 +45,9 @@ from . import jit  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import linalg  # noqa: F401
+from . import distributed  # noqa: F401
+from . import models  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 import jax.numpy as _jnp

@@ -1,0 +1,54 @@
+"""paddle_tpu.distributed — hybrid-parallel training on a device mesh.
+
+ref: python/paddle/distributed (Fleet, communication, auto_parallel).
+Design: ONE `jax.sharding.Mesh` with axes (dp, fsdp, pp, tp, sp)
+replaces Fleet's NCCL process-group topology; GSPMD + shard_map replace
+hand-written collective calls. See SURVEY.md §2.7.
+"""
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    ppermute,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send_recv,
+)
+from .mesh import (  # noqa: F401
+    MESH_AXES,
+    DistributedStrategy,
+    build_mesh,
+    get_mesh,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    set_mesh,
+)
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_cross_entropy,
+    sharding_constraint,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    apply_rules,
+    model_shardings,
+    parallelize,
+    shard_batch,
+    shard_model,
+    shard_tensor,
+)
+
+
+def get_world_size_safe():
+    import jax
+
+    return jax.device_count()

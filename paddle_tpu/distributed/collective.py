@@ -1,0 +1,136 @@
+"""Collective communication (ref: python/paddle/distributed/communication/*).
+
+Paddle: eager tensors + ProcessGroupNCCL streams. TPU-native: these are
+*traced* collectives — inside `shard_map` they lower to XLA ICI
+collectives (psum / all-gather / ppermute / all-to-all); outside any
+mapped context they're the single-participant identity, which matches
+Paddle's behaviour with world_size == 1.
+
+`group` is a mesh axis name (str) or tuple of names — the moral
+equivalent of Paddle's `Group` object.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    'ReduceOp', 'all_reduce', 'all_gather', 'reduce_scatter', 'broadcast',
+    'all_to_all', 'send_recv', 'ppermute', 'barrier', 'scatter', 'reduce',
+    'axis_size', 'axis_index',
+]
+
+
+class ReduceOp:
+    SUM = 'sum'
+    MAX = 'max'
+    MIN = 'min'
+    PROD = 'prod'
+    AVG = 'avg'
+
+
+def _in_mapped_context(axis):
+    try:
+        lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def axis_size(axis) -> int:
+    return lax.axis_size(axis)
+
+
+def axis_index(axis):
+    return lax.axis_index(axis)
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, group='dp'):
+    if not _in_mapped_context(group):
+        return x
+    if op == ReduceOp.SUM:
+        return lax.psum(x, group)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, group)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, group)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, group)
+    if op == ReduceOp.PROD:
+        # gather + prod handles zeros and negatives exactly (an
+        # exp-of-psum-of-logs trick would NaN on them)
+        return jnp.prod(lax.all_gather(x, group, axis=0, tiled=False), axis=0)
+    raise ValueError(f'unknown op {op}')
+
+
+def all_gather(x, group='dp', axis=0, tiled=True):
+    """Concatenate shards along `axis` (ref: communication/all_gather.py)."""
+    if not _in_mapped_context(group):
+        return x
+    return lax.all_gather(x, group, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, op: str = ReduceOp.SUM, group='dp', axis=0):
+    if not _in_mapped_context(group):
+        return x
+    assert op == ReduceOp.SUM, 'reduce_scatter supports SUM'
+    return lax.psum_scatter(x, group, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, src: int = 0, group='dp'):
+    """Every participant gets src's shard."""
+    if not _in_mapped_context(group):
+        return x
+    n = lax.axis_size(group)
+    full = lax.all_gather(x, group, axis=0, tiled=False)
+    return full[src]
+
+
+def all_to_all(x, group='ep', split_axis=0, concat_axis=0):
+    """ref: communication/all_to_all.py — the MoE dispatch primitive."""
+    if not _in_mapped_context(group):
+        return x
+    return lax.all_to_all(x, group, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, perm, group='pp'):
+    if not _in_mapped_context(group):
+        return x
+    return lax.ppermute(x, group, perm)
+
+
+def send_recv(x, group='pp', shift: int = 1):
+    """Neighbour exchange on a ring (ref: communication/send.py/recv.py —
+    p2p NCCL send/recv; on TPU a ppermute rides the ICI torus)."""
+    if not _in_mapped_context(group):
+        return x
+    n = lax.axis_size(group)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, group, perm)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, group='dp'):
+    if not _in_mapped_context(group):
+        return x
+    y = all_reduce(x, op, group)
+    idx = lax.axis_index(group)
+    return jnp.where(idx == dst, y, jnp.zeros_like(y))
+
+
+def scatter(x, src: int = 0, group='dp'):
+    """x holds the full array on all participants; return this rank's slice."""
+    if not _in_mapped_context(group):
+        return x
+    n = lax.axis_size(group)
+    idx = lax.axis_index(group)
+    chunk = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+
+
+def barrier(group=None):
+    """No-op under SPMD: every jitted program is already a global sync point."""
+    return None

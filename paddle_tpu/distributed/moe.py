@@ -1,0 +1,141 @@
+"""Mixture-of-Experts with expert parallelism.
+
+ref: python/paddle/incubate/distributed/models/moe (MoELayer, gate/
+top-k dispatch, NCCL all-to-all) — Paddle routes token tensors between
+expert ranks with `global_scatter`/`global_gather`.
+
+TPU-native: gating + capacity-bucketed dispatch is dense einsum algebra
+(one-hot combine/dispatch masks — the classic GShard formulation, which
+IS what XLA wants: static shapes, MXU-friendly), and the rank-to-rank
+exchange is `lax.all_to_all` over the 'ep' mesh axis when run under
+shard_map — or plain GSPMD sharding of the expert axis under pjit
+(experts sharded over 'ep'; XLA inserts the all-to-all pair itself).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+
+
+def top_k_gating(logits, k: int, capacity: int, jitter_key=None):
+    """GShard-style top-k gating with capacity.
+
+    logits: (tokens, E). Returns (dispatch (T, E, C) bool-ish float,
+    combine (T, E, C) float, aux_loss scalar).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)          # (T, k)
+    # normalise chosen gates
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # load-balancing aux loss (Switch/GShard): E * mean(frac_tokens * frac_probs)
+    me = probs.mean(axis=0)                                   # (E,)
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E)
+    ce = top1.mean(axis=0)
+    aux_loss = E * jnp.sum(me * ce)
+
+    # position of each (token, choice) within its expert's capacity buffer
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    fill = jnp.zeros((E,), jnp.int32)
+    for choice in range(k):
+        e = expert_idx[:, choice]                             # (T,)
+        onehot_e = jax.nn.one_hot(e, E, dtype=jnp.int32)      # (T, E)
+        # slot index = tokens already routed to e before me (this choice pass)
+        pos_in_e = jnp.cumsum(onehot_e, axis=0) - onehot_e    # (T, E)
+        slot = (pos_in_e * onehot_e).sum(-1) + fill[e]        # (T,)
+        keep = slot < capacity
+        slot_oh = jax.nn.one_hot(slot, capacity) * keep[:, None]
+        upd = onehot_e[:, :, None] * slot_oh[:, None, :]      # (T, E, C)
+        dispatch = dispatch + upd
+        combine = combine + upd * (gate_vals[:, choice] * keep)[:, None, None]
+        fill = fill + onehot_e.sum(0)
+    return dispatch, combine, aux_loss
+
+
+class ExpertMLP(Layer):
+    """E experts' weights batched on a leading axis sharded over 'ep' —
+    one einsum runs every expert (GSPMD splits it across ranks)."""
+
+    def __init__(self, num_experts, hidden, intermediate, activation=F.silu):
+        super().__init__()
+        init = I.Normal(0.0, 0.02)
+        self.w_up = Parameter(init((num_experts, hidden, intermediate), 'float32'),
+                              spec=P('ep', None, 'tp'))
+        self.w_gate = Parameter(init((num_experts, hidden, intermediate), 'float32'),
+                                spec=P('ep', None, 'tp'))
+        self.w_down = Parameter(init((num_experts, intermediate, hidden), 'float32'),
+                                spec=P('ep', 'tp', None))
+        self.act = activation
+
+    def forward(self, x):
+        """x: (E, C, H) expert-major buckets."""
+        h = self.act(jnp.einsum('ech,ehm->ecm', x, self.w_gate))
+        h = h * jnp.einsum('ech,ehm->ecm', x, self.w_up)
+        return jnp.einsum('ecm,emh->ech', h, self.w_down)
+
+
+class MoELayer(Layer):
+    """ref: incubate.distributed.models.moe.MoELayer.
+
+    Dense GShard dispatch: out = combine · expert(dispatchᵀ · x).
+    Shared experts (DeepSeek-style) run on every token additively.
+    """
+
+    def __init__(self, hidden, intermediate, num_experts=8, top_k=2,
+                 capacity_factor=1.25, num_shared_experts=0, gate_init=None,
+                 return_aux=False):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        init = gate_init or I.Normal(0.0, 0.02)
+        self.gate = Parameter(init((hidden, num_experts), 'float32'))
+        self.experts = ExpertMLP(num_experts, hidden, intermediate)
+        self.num_shared = num_shared_experts
+        self.shared = (
+            None if num_shared_experts == 0
+            else ExpertMLP(num_shared_experts, hidden,
+                           intermediate)
+        )
+        self.return_aux = return_aux
+        self.aux_loss = jnp.zeros(())   # registered buffer: last aux loss
+
+    def forward(self, x):
+        """x: (B, S, H) → (B, S, H), or (out, aux_loss) if return_aux.
+
+        `self.aux_loss` is also updated in place; being a registered
+        buffer it follows the framework's state-in/state-out rule — under
+        jit it carries out only if the (traced) model is returned from
+        the jitted fn, like BatchNorm stats. Use `return_aux=True` (or
+        read `m.aux_loss` on the traced model inside the step) when
+        adding it to the training loss."""
+        B, S, H = x.shape
+        tokens = x.reshape(B * S, H)
+        T = B * S
+        capacity = int(self.capacity_factor * self.top_k * T / self.num_experts)
+        capacity = max(capacity, 1)
+        logits = tokens @ self.gate
+        dispatch, combine, aux = top_k_gating(logits, self.top_k, capacity)
+        # (T,E,C)·(T,H) → (E,C,H): under GSPMD with 'ep'-sharded experts
+        # this einsum IS the all-to-all dispatch
+        expert_in = jnp.einsum('tec,th->ech', dispatch, tokens.astype(jnp.float32))
+        expert_out = self.experts(expert_in.astype(x.dtype))
+        out = jnp.einsum('tec,ech->th', combine, expert_out.astype(jnp.float32))
+        out = out.reshape(B, S, H).astype(x.dtype)
+        if self.shared is not None:
+            shared_in = jnp.broadcast_to(
+                tokens[None], (self.num_shared, T, H)).astype(x.dtype)
+            shared_out = self.shared(shared_in).sum(axis=0)
+            out = out + shared_out.reshape(B, S, H)
+        object.__setattr__(self, 'aux_loss', aux)
+        if self.return_aux:
+            return out, aux
+        return out

@@ -1,0 +1,131 @@
+"""Tensor-parallel layers (ref: python/paddle/distributed/fleet/layers/
+mpu/mp_layers.py, mp_ops.py).
+
+Paddle's mp layers split weights manually per rank and call NCCL
+(`c_identity` / `c_allreduce_sum` / `c_concat`) in forward/backward.
+TPU-native: the layer holds the FULL logical weight annotated with a
+`PartitionSpec`; GSPMD partitions it over the 'tp' mesh axis and inserts
+the matching ICI collectives (the allreduce after a row-parallel matmul,
+the allgather for `gather_output=True`) automatically — forward code is
+the plain matmul.
+
+`sharding_constraint` is applied to activations so the compiler keeps
+the intended layout at layer boundaries instead of re-deciding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+from .mesh import get_mesh
+
+
+def sharding_constraint(x, *spec_entries, mesh=None):
+    """`lax.with_sharding_constraint` that degrades to identity when no
+    mesh (single-device tests)."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    from .parallel import _valid_spec
+
+    spec = _valid_spec(P(*spec_entries), x.shape, mesh)
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+    except (ValueError, RuntimeError):
+        return x    # outside jit with incompatible placement
+
+
+class ColumnParallelLinear(Layer):
+    """Output-dim-sharded Linear (ref: mp_layers.py::ColumnParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else I.XavierNormal()
+        self.weight = Parameter(init((in_features, out_features), 'float32'), spec=P(None, 'tp'))
+        self.bias = Parameter(jnp.zeros((out_features,)), spec=P('tp')) if has_bias else None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = sharding_constraint(y, *([None] * (y.ndim - 1)), None)
+        else:
+            y = sharding_constraint(y, *([None] * (y.ndim - 1)), 'tp')
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Input-dim-sharded Linear; GSPMD adds the psum over 'tp'
+    (ref: mp_layers.py::RowParallelLinear — manual mp_allreduce there)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else I.XavierNormal()
+        self.weight = Parameter(init((in_features, out_features), 'float32'), spec=P('tp', None))
+        self.bias = Parameter(jnp.zeros((out_features,))) if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = sharding_constraint(x, *([None] * (x.ndim - 1)), 'tp')
+        y = F.linear(x, self.weight, self.bias)
+        return sharding_constraint(y, *([None] * (y.ndim - 1)), None)
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-sharded embedding (ref: mp_layers.py::VocabParallelEmbedding).
+
+    Paddle masks out-of-shard ids and allreduces partial lookups.
+    GSPMD handles the gather over a sharded table directly."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else I.Normal(0., 0.02)
+        self.weight = Parameter(init((num_embeddings, embedding_dim), 'float32'), spec=P('tp', None))
+
+    def forward(self, x):
+        return self.weight[x]
+
+
+def parallel_cross_entropy(logits, labels, axis='tp'):
+    """Vocab-parallel softmax cross entropy (ref: mp_ops.py::
+    _c_softmax_with_cross_entropy). Under GSPMD the log_softmax over a
+    'tp'-sharded vocab axis lowers to (local max/sum + psum) — the same
+    two-pass trick Paddle hand-codes — so we just write the math in fp32
+    and keep the logits sharded via constraint."""
+    logits = sharding_constraint(
+        logits, *([None] * (logits.ndim - 1)), axis).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll
+
+
+class ParallelCrossEntropy(Layer):
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        # clamp ignored labels to a valid index before the gather, then
+        # zero their contribution (negative ignore_index like the default
+        # -100 would otherwise wrap in take_along_axis)
+        mask = labels != self.ignore_index
+        safe_labels = jnp.where(mask, labels, 0)
+        nll = parallel_cross_entropy(logits, safe_labels)
+        return jnp.where(mask, nll, 0.0)

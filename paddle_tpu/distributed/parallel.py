@@ -1,0 +1,190 @@
+"""Model parallelization (ref: python/paddle/distributed/auto_parallel +
+fleet.distributed_model).
+
+Paddle: `fleet.distributed_model(model)` wraps the model in
+DataParallel / TensorParallel / PipelineParallel classes that rewire
+forward with NCCL calls. TPU-native: `parallelize(model, mesh, rules)`
+*annotates* — every parameter gets a `PartitionSpec`, arrays are
+device_put with `NamedSharding`, and GSPMD inserts the collectives when
+the jitted train step runs. The model code never changes.
+"""
+from __future__ import annotations
+
+import re
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..framework import tree as tree_util
+from .mesh import get_mesh
+
+Rules = typing.Sequence[typing.Tuple[str, typing.Any]]
+
+
+def match_spec(path: str, rules: Rules):
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return None
+
+
+def apply_rules(model, rules: Rules):
+    """Set Parameter PartitionSpec metadata by regex over param paths
+    (ref: auto_parallel shard_tensor annotations). Mutates metadata only."""
+    for layer_path, layer in model.named_sublayers(include_self=True):
+        for name, v in list(layer._children()):
+            from ..nn.layer.base import Layer
+
+            if isinstance(v, Layer):
+                continue
+            path = f'{layer_path}.{name}' if layer_path else name
+            spec = match_spec(path, rules)
+            if spec is not None and layer.meta_for(name).kind == 'param':
+                layer.set_param_meta(name, spec=spec)
+    return model
+
+
+def _valid_spec(spec, shape, mesh: Mesh):
+    """Clamp a PartitionSpec to divisible dims on this mesh; drop axes the
+    mesh doesn't have or that don't divide the dim."""
+    if spec is None:
+        return P()
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        keep = []
+        size = 1
+        for a in axes:
+            if a in mesh.axis_names:
+                keep.append(a)
+                size *= mesh.shape[a]
+        if keep and shape[i] % size == 0:
+            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def shard_model(model, mesh: Mesh | None = None, fsdp_axis=None):
+    """device_put every array leaf per its PartitionSpec (replicated if
+    none). `fsdp_axis`: additionally shard the largest unsharded dim of
+    each param over this axis (ZeRO-3 / GroupSharded stage 3 —
+    ref: fleet/meta_parallel/sharding/group_sharded_stage3.py)."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return model
+
+    def place(meta, path, x):
+        if x is None or not hasattr(x, 'shape'):
+            return x
+        spec = meta.spec if (meta is not None and meta.spec is not None) else P()
+        spec = _valid_spec(spec, x.shape, mesh)
+        if fsdp_axis and meta is not None and meta.kind == 'param':
+            spec = _add_fsdp(spec, x.shape, mesh, fsdp_axis)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return tree_util._map_model(model, place)
+
+
+def _add_fsdp(spec, shape, mesh, axis):
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a:
+                used.add(a)
+    if axis in used:
+        return spec
+    # shard the largest divisible unsharded dim
+    best, best_size = None, 0
+    for i, e in enumerate(entries):
+        if e is None and shape[i] % mesh.shape[axis] == 0 and shape[i] > best_size:
+            best, best_size = i, shape[i]
+    if best is None:
+        return spec
+    entries[best] = axis
+    return P(*entries)
+
+
+def model_shardings(model, mesh: Mesh | None = None):
+    """Model-shaped tree of NamedShardings (for pjit in/out_shardings)."""
+    mesh = mesh or get_mesh()
+    specs = tree_util.spec_tree(model)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s) if isinstance(s, P) else s, specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def parallelize(model, mesh: Mesh | None = None, rules: Rules | None = None,
+                fsdp_axis=None):
+    """Annotate + place: the one-call equivalent of
+    `fleet.distributed_model` (ref: python/paddle/distributed/parallel.py).
+    """
+    mesh = mesh or get_mesh()
+    if rules:
+        apply_rules(model, rules)
+    return shard_model(model, mesh, fsdp_axis=fsdp_axis)
+
+
+def shard_tensor(x, mesh: Mesh | None = None, *spec_entries, spec=None):
+    """ref: paddle.distributed.shard_tensor — place one array."""
+    mesh = mesh or get_mesh()
+    spec = spec if spec is not None else P(*spec_entries)
+    return jax.device_put(x, NamedSharding(mesh, _valid_spec(spec, x.shape, mesh)))
+
+
+def shard_batch(batch, mesh: Mesh | None = None, axes=('dp', 'fsdp')):
+    """Shard the leading (batch) dim of every leaf over the data axes."""
+    mesh = mesh or get_mesh()
+    present = tuple(a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1)
+
+    def place(x):
+        spec = P(present) if present and x.ndim and x.shape[0] % _prod(mesh, present) == 0 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, batch)
+
+
+def _prod(mesh, axes):
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
+class DataParallel:
+    """ref: paddle.DataParallel — wraps a model for dp training.
+
+    TPU-native: nothing to wrap. Holds the model with batch-sharding
+    helpers; gradients are averaged by GSPMD when the loss mean spans the
+    sharded batch axis. Provided for API parity."""
+
+    def __init__(self, layers, mesh=None, **kw):
+        self._layers = layers
+        self.mesh = mesh or get_mesh()
+        if self.mesh is not None:
+            self._layers = shard_model(layers, self.mesh)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def forward(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def scale_loss(self, loss):
+        return loss          # GSPMD mean already spans replicas
+
+    def apply_collective_grads(self):
+        return None          # grads are globally correct under GSPMD

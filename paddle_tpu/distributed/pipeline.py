@@ -1,0 +1,158 @@
+"""Pipeline parallelism (ref: python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py, pp_utils).
+
+Paddle: each pp rank owns a stage module; a Python scheduler
+(forward_backward_pipeline) drives 1F1B micro-batch phases with NCCL
+p2p send/recv between ranks.
+
+TPU-native: the stage loop is *data*: all stages' parameters are stacked
+on a leading 'pp'-sharded axis, and one `shard_map` program runs the
+GPipe schedule as a `lax.fori_loop` with `ppermute` rotations riding the
+ICI ring. XLA overlaps the collective permute with the stage compute —
+the same overlap Paddle gets from separate CUDA streams.
+
+The model side: `PipelineStage` wraps a list of per-stage step
+functions with identical signatures; `pipeline_apply` runs the
+schedule. For models built as a stack of identical blocks (the LLM
+case) use `stacked_pipeline` — stage weights are a stacked pytree and
+the per-stage fn is one block-stack forward.
+"""
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(stage_models: typing.Sequence, axis=0):
+    """Stack N same-structure stage pytrees into one pytree with a leading
+    stage axis (shard it over 'pp')."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=axis), *stage_models)
+
+
+def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
+    """Build the SPMD GPipe body to run under `shard_map`.
+
+    stage_fn(stage_params, x) -> y, applied by every pp rank to its
+    resident stage. Inside shard_map each rank holds: its stage's params
+    (leading axis stripped to size 1) and the full microbatch queue.
+
+    Schedule (GPipe, forward): T = n_micro + n_stages - 1 ticks; at tick
+    t, rank s computes microbatch (t - s) if 0 <= t-s < n_micro. After
+    each tick activations rotate +1 along the ring; outputs collect on
+    the last rank then broadcast.
+    """
+    if n_microbatches < 1:
+        raise ValueError(f'n_microbatches must be >= 1, got {n_microbatches}')
+
+    def body(stage_params, microbatches):
+        # microbatches: (n_micro, mb, ...) identical on every rank
+        rank = lax.axis_index(axis)
+        n_ticks = n_microbatches + n_stages - 1
+        mb_shape = microbatches.shape[1:]
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            buf, outputs = carry
+            # which microbatch this rank works on at tick t
+            mb_idx = t - rank
+            active = (mb_idx >= 0) & (mb_idx < n_microbatches)
+            # stage 0 pulls fresh input from the queue; others use the
+            # rotated buffer
+            fresh = lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(mb_idx, 0, n_microbatches - 1), 0,
+                keepdims=False)
+            x = jnp.where(rank == 0, fresh, buf)
+            y = stage_fn(stage_params, x)
+            y = jnp.where(active, y, buf)
+            # last stage: record finished microbatch
+            done_idx = t - (n_stages - 1)
+            is_done = (rank == n_stages - 1) & (done_idx >= 0) & (done_idx < n_microbatches)
+            outputs = lax.cond(
+                is_done,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(done_idx, 0, n_microbatches - 1), 0),
+                lambda o: o,
+                outputs,
+            )
+            buf = lax.ppermute(y, axis, perm)
+            return buf, outputs
+
+        buf0 = jnp.zeros(mb_shape, microbatches.dtype)
+        outs0 = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+        _, outputs = lax.fori_loop(0, n_ticks, tick, (buf0, outs0))
+        # outputs live on the last rank; psum broadcasts (others hold zeros)
+        return lax.psum(outputs, axis)
+
+    return body
+
+
+def pipeline_apply(stacked_params, microbatches, stage_fn, mesh: Mesh,
+                   n_microbatches: int, axis='pp'):
+    """Run the GPipe forward over a 'pp'-sharded stack of stage params.
+
+    stacked_params: pytree with leading stage axis == mesh.shape[axis].
+    microbatches: (n_micro, mb, ...) array (replicated).
+    """
+    n_stages = mesh.shape[axis]
+    body = pipeline_spmd(stage_fn, n_stages, n_microbatches, axis)
+
+    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    other_axes = [a for a in mesh.axis_names if a != axis]
+
+    def local_body(params, mbs):
+        # strip the local stage axis (size 1 per rank)
+        local = jax.tree.map(lambda p: p[0], params)
+        return body(local, mbs)
+
+    fn = jax.shard_map(
+        local_body, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stacked_params, microbatches)
+
+
+class PipelineLayer:
+    """ref: paddle.distributed.fleet.meta_parallel.PipelineLayer —
+    user-facing wrapper: partition a LayerList of blocks into pp stages.
+
+    For jit-ability all stages must be structurally identical (the usual
+    transformer case). `forward` runs GPipe over the mesh 'pp' axis.
+    """
+
+    def __init__(self, blocks, mesh: Mesh, n_microbatches: int = 4,
+                 block_fn=None, axis='pp'):
+        n_stages = mesh.shape[axis]
+        if len(blocks) % n_stages:
+            raise ValueError(
+                f'{len(blocks)} blocks not divisible into {n_stages} stages')
+        per = len(blocks) // n_stages
+        self.mesh, self.axis, self.n_microbatches = mesh, axis, n_microbatches
+        self.block_fn = block_fn or (lambda blk, x: blk(x))
+        # group blocks into stages, stack stages on leading axis
+        stages = []
+        for s in range(n_stages):
+            stage_blocks = blocks[s * per:(s + 1) * per]
+            stages.append(stage_blocks)
+        self.stacked = stack_stage_params(stages)
+        self.per_stage = per
+
+    def _stage_fn(self, stage_blocks, x):
+        # stage_blocks is the local stage's list of `per_stage` block
+        # pytrees (leaves already unstacked by pipeline_apply)
+        for i in range(self.per_stage):
+            x = self.block_fn(stage_blocks[i], x)
+        return x
+
+    def __call__(self, microbatches):
+        def stage_fn(params, x):
+            return self._stage_fn(params, x)
+
+        return pipeline_apply(self.stacked, microbatches, stage_fn, self.mesh,
+                              self.n_microbatches, self.axis)

@@ -1,0 +1,107 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+ref: the reference's sequence-parallel utilities
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py sequence-
+parallel paths) scatter activations over ranks and allgather before
+attention — O(S) memory per device for KV. Ring attention (Liu et al.;
+see PAPERS.md) goes further: KV blocks *rotate* around the 'sp' ring
+via `ppermute` while each device accumulates its queries' attention
+online (flash-style log-sum-exp merge), so no device ever materialises
+the full sequence. On TPU the ppermute rides the ICI torus and XLA
+overlaps it with the per-block matmuls — compute-communication overlap
+without CUDA streams.
+
+Use under `shard_map` with Q/K/V sharded (batch, seq→'sp', heads, dim).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """One (q-block, kv-block) partial attention.
+
+    Returns (out_unnormalised, row_max, row_sumexp) in fp32 —
+    the flash-attention accumulator triple.
+    q: (B, Sq, H, D), k/v: (B, Sk, Hkv, D).
+    """
+    H, Hk = q.shape[2], k.shape[2]
+    if Hk != H:
+        k = jnp.repeat(k, H // Hk, axis=2)
+        v = jnp.repeat(v, H // Hk, axis=2)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # (B, H, Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                      # (B, H, Sq)
+    o = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two flash accumulators (log-sum-exp algebra)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[..., None]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
+    """Full attention over a sequence sharded on `axis`; call under
+    shard_map with q,k,v local blocks (B, S_local, H, D)."""
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, Sq, H, D = q.shape
+    scale = scale or 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]   # kv moves to next rank
+
+    q32 = q.astype(jnp.float32)
+
+    def step(i, carry):
+        o, m, l, kb, vb = carry
+        # kv block currently held originated at rank (rank - i) mod n
+        src = (rank - i) % n
+        if causal:
+            qpos = rank * Sq + jnp.arange(Sq)
+            kpos = src * kb.shape[1] + jnp.arange(kb.shape[1])
+            mask = (kpos[None, :] <= qpos[:, None])[None, None]
+        else:
+            mask = None
+        ob, mb, lb = _block_attn(q32, kb, vb, scale, mask)
+        o, m, l = _merge(o, m, l, ob, mb, lb)
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return o, m, l, kb, vb
+
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis='sp', causal=False,
+                           scale=None):
+    """Convenience wrapper: q/k/v are global arrays; shards seq over
+    `axis`, runs the ring, returns the global output."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis=axis, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

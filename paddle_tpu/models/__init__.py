@@ -1,0 +1,16 @@
+"""Model zoo (ref: python/paddle/vision/models + PaddleNLP-style LMs).
+
+Flagship: Llama-2 family (`models/llama.py`) — the hybrid-parallel
+pretrain target. Vision: ResNet et al (`models/resnet.py`, NHWC,
+TPU-friendly layouts).
+"""
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)

@@ -1,0 +1,332 @@
+"""Llama-2 family — the flagship decoder LM.
+
+ref (architecture parity): PaddleNLP Llama / the reference's
+`python/paddle/incubate` transformer stacks; components: RMSNorm
+pre-norm, rotary position embedding, SwiGLU MLP, grouped-query
+attention, tied-or-untied LM head.
+
+TPU-native design notes:
+  - the whole model is a pytree `nn.Layer`; one `jax.jit` / `pjit`
+    train step covers fwd+bwd+update.
+  - attention goes through `F.scaled_dot_product_attention`, which
+    dispatches to the pallas flash-attention kernel on TPU.
+  - parameters carry default `PartitionSpec`s for tensor parallelism
+    (column-split QKV/gate/up, row-split o_proj/down) so
+    `distributed.parallelize` can shard with zero per-model rules;
+    the embedding is vocab-sharded ('tp' on vocab axis).
+  - generation decodes with a functional KV-cache under
+    `lax.while_loop` (static shapes: cache preallocated at max_len).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32          # < num_attention_heads → GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    dtype: str = 'float32'                 # param dtype; compute follows
+    remat: bool = False                    # jax.checkpoint each decoder layer
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_7b() -> LlamaConfig:
+    """Llama-2-7B pretrain config (headline benchmark shape)."""
+    return LlamaConfig()
+
+
+def llama_tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, kv_heads=2,
+               intermediate_size=128, max_pos=128) -> LlamaConfig:
+    """Tiny config for tests / dryruns."""
+    return LlamaConfig(
+        vocab_size=vocab_size, hidden_size=hidden_size,
+        intermediate_size=intermediate_size, num_hidden_layers=layers,
+        num_attention_heads=heads, num_key_value_heads=kv_heads,
+        max_position_embeddings=max_pos,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding
+# ---------------------------------------------------------------------------
+
+def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32):
+    """cos/sin tables for the given integer positions, shape (..., head_dim//2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., D/2)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x, cos, sin):
+    """x: (B, S, H, D); cos/sin: (B, S, D/2) or (S, D/2). Rotate-half form."""
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos = cos[:, :, None, :]  # (B, S, 1, D/2)
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+class LlamaAttention(Layer):
+    """GQA attention with RoPE. Column-parallel QKV, row-parallel output."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        self.rope_theta = config.rope_theta
+        init = I.Normal(0.0, config.initializer_range)
+        h, d = config.hidden_size, self.head_dim
+        self.q_proj = Parameter(init((h, self.num_heads * d), config.dtype), spec=P(None, 'tp'))
+        self.k_proj = Parameter(init((h, self.num_kv_heads * d), config.dtype), spec=P(None, 'tp'))
+        self.v_proj = Parameter(init((h, self.num_kv_heads * d), config.dtype), spec=P(None, 'tp'))
+        self.o_proj = Parameter(init((self.num_heads * d, h), config.dtype), spec=P('tp', None))
+
+    def forward(self, x, positions, attn_mask=None, cache=None, cache_index=None):
+        """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
+
+        Returns (out, new_cache). With a cache, writes the S new kv rows at
+        cache_index and attends over the full cache (masked by position).
+        """
+        B, S, _ = x.shape
+        q = (x @ self.q_proj).reshape(B, S, self.num_heads, self.head_dim)
+        k = (x @ self.k_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
+        v = (x @ self.v_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
+
+        cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+
+        if cache is None:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=attn_mask is None)
+            new_cache = None
+        else:
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+            max_len = ck.shape[1]
+            # valid keys: position <= current query position
+            kpos = jnp.arange(max_len)
+            qpos = cache_index + jnp.arange(S)
+            mask = kpos[None, :] <= qpos[:, None]          # (S, max_len)
+            mask = mask[None, None, :, :]                  # (B, H, S, max_len)
+            out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)
+            new_cache = (ck, cv)
+
+        out = out.reshape(B, S, self.num_heads * self.head_dim)
+        return out @ self.o_proj, new_cache
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x)). Column gate/up, row down."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = Parameter(init((h, m), config.dtype), spec=P(None, 'tp'))
+        self.up_proj = Parameter(init((h, m), config.dtype), spec=P(None, 'tp'))
+        self.down_proj = Parameter(init((m, h), config.dtype), spec=P('tp', None))
+
+    def forward(self, x):
+        return (F.silu(x @ self.gate_proj) * (x @ self.up_proj)) @ self.down_proj
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, positions, attn_mask=None, cache=None, cache_index=None):
+        attn_out, new_cache = self.self_attn(
+            self.input_layernorm(x), positions, attn_mask, cache, cache_index
+        )
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, new_cache
+
+
+class LlamaModel(Layer):
+    """Embedding + decoder stack + final norm."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = Parameter(
+            init((config.vocab_size, config.hidden_size), config.dtype), spec=P('tp', None)
+        )
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
+                cache_index=None):
+        B, S = input_ids.shape
+        if positions is None:
+            base = 0 if cache_index is None else cache_index
+            positions = base + jnp.arange(S)[None, :].astype(jnp.int32)
+            positions = jnp.broadcast_to(positions, (B, S))
+        x = self.embed_tokens[input_ids]
+        new_caches = [] if caches is not None else None
+        use_remat = self.config.remat and caches is None
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            if use_remat:
+                x = jax.checkpoint(
+                    lambda lyr, h: lyr(h, positions, attn_mask)[0]
+                )(layer, x)
+                nc = None
+            else:
+                x, nc = layer(x, positions, attn_mask, cache, cache_index)
+            if new_caches is not None:
+                new_caches.append(nc)
+        return self.norm(x), new_caches
+
+
+class LlamaForCausalLM(Layer):
+    """LM head on top; loss = causal cross-entropy (shifted)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            init = I.Normal(0.0, config.initializer_range)
+            self.lm_head = Parameter(
+                init((config.hidden_size, config.vocab_size), config.dtype),
+                spec=P(None, 'tp'),
+            )
+
+    def logits(self, hidden):
+        if self.lm_head is None:
+            return hidden @ self.model.embed_tokens.T
+        return hidden @ self.lm_head
+
+    def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
+                cache_index=None):
+        hidden, new_caches = self.model(input_ids, positions, attn_mask, caches,
+                                        cache_index)
+        logits = self.logits(hidden)
+        if caches is None:
+            return logits
+        return logits, new_caches
+
+    def loss(self, input_ids, labels=None):
+        """Next-token cross-entropy, fp32 logits for stability."""
+        if labels is None:
+            labels = input_ids[:, 1:]
+            input_ids = input_ids[:, :-1]
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    # -- generation --------------------------------------------------------
+    def init_cache(self, batch_size, max_len, dtype=None):
+        cfg = self.config
+        dtype = dtype or self.model.embed_tokens.dtype
+        shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
+                 top_p=1.0, rng_key=None, eos_token_id=None):
+        """Greedy / sampled decode with a preallocated KV-cache.
+
+        Functional loop (`lax.while_loop`-shaped via scan): prefill once,
+        then one-token steps; static shapes throughout so the whole decode
+        compiles to a single XLA program.
+        """
+        B, S = input_ids.shape
+        max_len = S + max_new_tokens
+        caches = self.init_cache(B, max_len)
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+
+        # prefill
+        logits, caches = self(input_ids, caches=caches, cache_index=0)
+        last_logits = logits[:, -1, :]
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(input_ids.dtype)
+            logits = logits / temperature
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(input_ids.dtype)
+
+        def step(carry, _):
+            last_logits, caches, idx, key = carry
+            key, sub = jax.random.split(key)
+            tok = sample(last_logits, sub)
+            logits, caches = self(tok[:, None], caches=caches, cache_index=idx)
+            return (logits[:, -1, :], caches, idx + 1, key), tok
+
+        (_, _, _, _), tokens = jax.lax.scan(
+            step, (last_logits, caches, jnp.asarray(S, jnp.int32), rng_key),
+            None, length=max_new_tokens,
+        )
+        return jnp.concatenate([input_ids, tokens.T], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# TP sharding rules (consumed by distributed.parallelize)
+# ---------------------------------------------------------------------------
+
+LLAMA_TP_RULES: typing.List[typing.Tuple[str, typing.Any]] = [
+    (r'.*embed_tokens$', P('tp', None)),
+    (r'.*(q|k|v)_proj$', P(None, 'tp')),
+    (r'.*o_proj$', P('tp', None)),
+    (r'.*(gate|up)_proj$', P(None, 'tp')),
+    (r'.*down_proj$', P('tp', None)),
+    (r'.*lm_head$', P(None, 'tp')),
+]

@@ -1,0 +1,146 @@
+"""ResNet family (ref: python/paddle/vision/models/resnet.py).
+
+Same architecture graph (BasicBlock / BottleneckBlock, stages
+[64,128,256,512], stride-2 downsample shortcuts), built on our pytree
+layers. Default data_format is NHWC — the TPU-native layout (XLA:TPU
+keeps channels minor for the MXU's convolution tiling); Paddle's NCHW
+is accepted and handled by the conv layers' `data_format` passthrough.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 norm_layer=None, data_format='NHWC'):
+        super().__init__()
+        norm_layer = norm_layer or nn.BatchNorm2D
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = norm_layer(planes, data_format=data_format)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
+        self.bn2 = norm_layer(planes, data_format=data_format)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 norm_layer=None, data_format='NHWC'):
+        super().__init__()
+        norm_layer = norm_layer or nn.BatchNorm2D
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False,
+                               data_format=data_format)
+        self.bn1 = norm_layer(planes, data_format=data_format)
+        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False, data_format=data_format)
+        self.bn2 = norm_layer(planes, data_format=data_format)
+        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
+                               bias_attr=False, data_format=data_format)
+        self.bn3 = norm_layer(planes * self.expansion, data_format=data_format)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """ref: paddle.vision.models.ResNet(Block, depth, num_classes)."""
+
+    def __init__(self, block, depth=50, width=64, num_classes=1000,
+                 with_pool=True, data_format='NHWC'):
+        super().__init__()
+        layer_cfg = {
+            18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+            101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
+        }
+        layers = layer_cfg[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.data_format = data_format
+        self.inplanes = width
+        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, data_format=data_format)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, data_format=data_format)
+        self.layer1 = self._make_layer(block, 64, layers[0], 1, data_format)
+        self.layer2 = self._make_layer(block, 128, layers[1], 2, data_format)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2, data_format)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2, data_format)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride, data_format):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
+                          stride=stride, bias_attr=False, data_format=data_format),
+                nn.BatchNorm2D(planes * block.expansion, data_format=data_format),
+            )
+        seq = [block(self.inplanes, planes, stride, downsample,
+                     data_format=data_format)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            seq.append(block(self.inplanes, planes, data_format=data_format))
+        return nn.Sequential(*seq)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = jnp.reshape(x, (x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+def _resnet(block, depth, **kw):
+    return ResNet(block, depth, **kw)
+
+
+def resnet18(**kw):
+    return _resnet(BasicBlock, 18, **kw)
+
+
+def resnet34(**kw):
+    return _resnet(BasicBlock, 34, **kw)
+
+
+def resnet50(**kw):
+    return _resnet(BottleneckBlock, 50, **kw)
+
+
+def resnet101(**kw):
+    return _resnet(BottleneckBlock, 101, **kw)
+
+
+def resnet152(**kw):
+    return _resnet(BottleneckBlock, 152, **kw)

@@ -68,8 +68,11 @@ def scaled_dot_product_attention(
             from ...ops.pallas.flash_attention import flash_attention
 
             return flash_attention(query, key, value, causal=is_causal, scale=scale)
-        except Exception:
-            pass
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f'pallas flash attention unavailable, using lax '
+                          f'reference: {e!r}', stacklevel=2)
     return _sdpa_reference(
         query, key, value, attn_mask, dropout_p, is_causal, scale, rng_key, training
     )

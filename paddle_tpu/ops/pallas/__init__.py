@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (SURVEY §2.12).
+
+Each kernel ships a lax reference implementation and is verified
+against it in tests (interpret mode on CPU).
+"""

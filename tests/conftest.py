@@ -5,12 +5,20 @@ semantics on host devices; the driver separately dry-runs multichip.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force CPU: the session environment presets JAX_PLATFORMS to the real
+# TPU tunnel and its sitecustomize re-forces it at interpreter start, so
+# the env var alone is not enough — update jax.config after import,
+# before any backend initialisation.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 prev = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in prev:
     os.environ['XLA_FLAGS'] = (
         prev + ' --xla_force_host_platform_device_count=8'
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,90 @@
+"""Pallas kernels vs lax reference (interpret mode on CPU) — SURVEY §2.12."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.rms_norm import rms_norm as pallas_rms_norm
+from paddle_tpu.nn.functional.norm import rms_norm as ref_rms_norm
+
+
+def _qkv(B=1, S=256, H=2, Hk=2, D=64, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, Hk, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, Hk, D)), jnp.float32)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_fwd_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal)
+        ref = _sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_fwd_gqa(self):
+        q, k, v = _qkv(H=4, Hk=2)
+        out = flash_attention(q, k, v, causal=True)
+        ref = _sdpa_reference(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(S=128)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_sdpa_reference(q, k, v, is_causal=causal) ** 2).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_grads_gqa(self):
+        q, k, v = _qkv(S=128, H=4, Hk=2)
+        g1 = jax.grad(lambda *a: (flash_attention(*a, causal=True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: (_sdpa_reference(*a, is_causal=True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+
+class TestRMSNorm:
+    def test_fwd(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 256)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(256,)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(pallas_rms_norm(x, w)), np.asarray(ref_rms_norm(x, w)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_bwd(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(32, 128)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+        g1 = jax.grad(lambda x, w: (pallas_rms_norm(x, w) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: (ref_rms_norm(x, w) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_3d_input(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 16, 128)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(pallas_rms_norm(x)), np.asarray(ref_rms_norm(x)),
+            rtol=1e-5, atol=1e-5)
