@@ -47,6 +47,10 @@ from . import io  # noqa: F401
 from . import linalg  # noqa: F401
 from . import distributed  # noqa: F401
 from . import models  # noqa: F401
+from . import metric  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 

@@ -1,0 +1,170 @@
+"""Training callbacks (ref: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import os
+import time
+
+
+class Callback:
+    """ref: paddle.callbacks.Callback."""
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model=None, params=None):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            if model is not None:
+                c.set_model(model)
+            c.set_params(params or {})
+
+    def __getattr__(self, name):
+        if name.startswith('on_'):
+            def call(*args, **kw):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kw)
+
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """ref: paddle.callbacks.ProgBarLogger — step/epoch console logging."""
+
+    def __init__(self, log_freq=10, verbose=1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = (self.params or {}).get('steps')
+        self._t0 = time.time()
+        if self.verbose:
+            print(f'Epoch {epoch + 1}/{self.params.get("epochs", "?")}')
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ' - '.join(f'{k}: {v:.4f}' if isinstance(v, float) else f'{k}: {v}'
+                               for k, v in (logs or {}).items())
+            print(f'step {step}/{self.steps or "?"} - {items}')
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = ' - '.join(f'{k}: {v:.4f}' if isinstance(v, float) else f'{k}: {v}'
+                               for k, v in (logs or {}).items())
+            print(f'epoch {epoch + 1} done in {dt:.1f}s - {items}')
+
+
+class ModelCheckpoint(Callback):
+    """ref: paddle.callbacks.ModelCheckpoint — periodic save."""
+
+    def __init__(self, save_freq=1, save_dir='checkpoint'):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model and epoch % self.save_freq == 0:
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class LRSchedulerCallback(Callback):
+    """ref: paddle.callbacks.LRScheduler — steps the lr schedule."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, '_optimizer', None)
+        lr = getattr(opt, '_lr', None)
+        return lr if hasattr(lr, 'step') else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+LRScheduler = LRSchedulerCallback
+
+
+class EarlyStopping(Callback):
+    """ref: paddle.callbacks.EarlyStopping."""
+
+    def __init__(self, monitor='loss', mode='auto', patience=0, min_delta=0,
+                 baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        if mode == 'auto':
+            mode = 'max' if 'acc' in monitor else 'min'
+        self.mode = mode
+        self.stopped = False
+        self.wait = 0
+        self.best = None
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == 'min':
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped = True
+                if self.model is not None:
+                    self.model.stop_training = True

@@ -1,0 +1,234 @@
+"""High-level Model API (ref: python/paddle/hapi/model.py).
+
+`Model(network).prepare(opt, loss, metrics)` then `fit/evaluate/
+predict/save/load` — Paddle's Keras-style trainer. TPU-native twist:
+the whole train step (fwd+bwd+update) is one jitted donated-state
+program, rebuilt only when shapes change.
+"""
+from __future__ import annotations
+
+import os
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..callbacks import CallbackList, ProgBarLogger
+from ..framework import io as io_mod
+from ..io.dataloader import DataLoader
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """ref: paddle.Model."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._opt_state = None
+        self._train_step = None
+        self._eval_step = None
+        self.stop_training = False
+
+    # -- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, **kw):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        if optimizer is not None:
+            self._opt_state = optimizer.init(self.network)
+        self._build_steps()
+        return self
+
+    def _build_steps(self):
+        opt = self._optimizer
+        loss_fn = self._loss
+
+        def train_step(network, opt_state, inputs, labels):
+            def compute(m):
+                preds = m(*inputs)
+                loss = loss_fn(preds, *labels)
+                return loss, (m, preds)
+
+            (loss, (m, preds)), grads = autograd.value_and_grad(
+                compute, has_aux=True)(network)
+            m, opt_state = opt.apply_gradients(m, grads, opt_state)
+            return m, opt_state, loss, preds
+
+        def eval_step(network, inputs, labels):
+            preds = network(*inputs)
+            loss = loss_fn(preds, *labels) if loss_fn is not None else 0.0
+            return loss, preds
+
+        self._train_step = jax.jit(train_step) if opt else None
+        self._eval_step = jax.jit(eval_step)
+        self._pred_step = jax.jit(lambda network, inputs: network(*inputs))
+
+    # -- single-batch API (ref: Model.train_batch / eval_batch) ----------
+    def train_batch(self, inputs, labels=None):
+        inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
+        labels = tuple(jnp.asarray(x) for x in _to_list(labels))
+        self.network.train()
+        net, self._opt_state, loss, preds = self._train_step(
+            self.network, self._opt_state, inputs, labels)
+        self.network = net
+        metrics = self._update_metrics(preds, labels)
+        return [float(loss)] + metrics
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
+        labels = tuple(jnp.asarray(x) for x in _to_list(labels))
+        self.network.eval()
+        loss, preds = self._eval_step(self.network, inputs, labels)
+        metrics = self._update_metrics(preds, labels)
+        return [float(loss)] + metrics
+
+    def predict_batch(self, inputs):
+        inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
+        self.network.eval()
+        return np.asarray(self._pred_step(self.network, inputs))
+
+    def _update_metrics(self, preds, labels):
+        out = []
+        for m in self._metrics:
+            args = m.compute(preds, *labels)
+            if not isinstance(args, tuple):
+                args = (args,)
+            m.update(*args)
+            acc = m.accumulate()
+            out.append(acc)
+        return out
+
+    # -- loops ------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            shuffle=True, callbacks=None, **kw):
+        train_loader = self._loader(train_data, batch_size, shuffle)
+        eval_loader = self._loader(eval_data, batch_size, False)
+        cbks = CallbackList(
+            _to_list(callbacks) or [ProgBarLogger(log_freq, verbose)],
+            model=self,
+            params={'epochs': epochs, 'steps': len(train_loader),
+                    'verbose': verbose},
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                vals = self.train_batch(inputs, labels)
+                logs = self._logs(vals)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, callbacks=cbks,
+                                          verbose=0)
+                cbks.on_eval_end(eval_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 callbacks=None, **kw):
+        loader = self._loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            vals = self.eval_batch(inputs, labels)
+            losses.append(vals[0])
+        logs = {'loss': float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name()
+            accs = m.accumulate()
+            if isinstance(names, list):
+                logs.update(dict(zip(names, accs)))
+            else:
+                logs[names] = accs
+        return logs
+
+    def predict(self, test_data, batch_size=1, **kw):
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outs.append(self.predict_batch(inputs))
+        return outs
+
+    def _split_batch(self, batch, has_labels=True):
+        """(inputs..., label) convention — the trailing element is the
+        label whenever the batch has >= 2 elements (ref: hapi/model.py
+        feeds inputs+labels in one list; predict ignores the label)."""
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return tuple(batch[:-1]), (tuple(batch[-1:]) if has_labels else ())
+            return tuple(batch), ()
+        return (batch,), ()
+
+    def _logs(self, vals):
+        logs = {'loss': vals[0]}
+        i = 1
+        for m in self._metrics:
+            names = m.name()
+            if isinstance(names, list):
+                for n in names:
+                    logs[n] = float(np.asarray(vals[i]).reshape(-1)[0])
+                    i += 1
+            else:
+                v = vals[i]
+                logs[names] = float(np.asarray(v).reshape(-1)[0])
+                i += 1
+        return logs
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path, training=True):
+        io_mod.save(self.network.state_dict(), path + '.pdparams')
+        if training and self._opt_state is not None:
+            # opt state slots are model-shaped pytrees (Layer nodes) —
+            # store leaves; load rebuilds via the optimizer's treedef
+            leaves = jax.tree.leaves(self._opt_state)
+            io_mod.save({str(i): leaf for i, leaf in enumerate(leaves)},
+                        path + '.pdopt')
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = io_mod.load(path + '.pdparams')
+        self.network.set_state_dict(state, strict=not skip_mismatch)
+        opt_path = path + '.pdopt'
+        if not reset_optimizer and os.path.exists(opt_path) and self._optimizer:
+            template = self._optimizer.init(self.network)
+            treedef = jax.tree.structure(template)
+            flat = io_mod.load(opt_path)
+            leaves = [jnp.asarray(flat[str(i)]) for i in range(len(flat))]
+            self._opt_state = jax.tree.unflatten(treedef, leaves)
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtype)

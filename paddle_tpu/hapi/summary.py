@@ -1,0 +1,42 @@
+"""Model summary (ref: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtype=None, print_fn=print):
+    """Param table per layer + totals. Returns {'total_params', 'trainable_params'}."""
+    rows = []
+    total = 0
+    trainable = 0
+    for path, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        layer_trainable = 0
+        for name, v in layer._children():
+            from ..nn.layer.base import Layer
+
+            if isinstance(v, Layer) or v is None:
+                continue
+            meta = layer.meta_for(name)
+            if meta.kind != 'param':
+                continue
+            n = int(np.prod(v.shape))
+            n_params += n
+            if meta.trainable:
+                layer_trainable += n
+        if n_params:
+            rows.append((path or type(layer).__name__,
+                         type(layer).__name__, n_params))
+            total += n_params
+            trainable += layer_trainable
+    if print_fn:
+        width = max([len(r[0]) for r in rows], default=10) + 2
+        print_fn(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
+        print_fn('-' * (width + 36))
+        for path, tname, n in rows:
+            print_fn(f'{path:<{width}}{tname:<24}{n:>12,}')
+        print_fn('-' * (width + 36))
+        print_fn(f'Total params: {total:,}')
+        print_fn(f'Trainable params: {trainable:,}')
+        print_fn(f'Non-trainable params: {total - trainable:,}')
+    return {'total_params': total, 'trainable_params': trainable}

@@ -1,0 +1,153 @@
+"""Metrics (ref: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    """ref: paddle.metric.Metric — accumulating metric base."""
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return type(self).__name__.lower()
+
+    # hapi hook: turn (pred, label) into update() args
+    def compute(self, pred, label, *args):
+        return pred, label
+
+
+class Accuracy(Metric):
+    """ref: paddle.metric.Accuracy (top-k)."""
+
+    def __init__(self, topk=(1,), name='acc'):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred)
+        label = np.asarray(label)
+        maxk = max(self.topk)
+        order = np.argsort(-pred, axis=-1)[..., :maxk]
+        if label.ndim == pred.ndim:       # one-hot / soft labels
+            label = label.argmax(-1)
+        correct = order == label[..., None]
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        n = correct[..., 0].size
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].any(-1).sum()
+            self.count[i] += n
+        return self.total / np.maximum(self.count, 1)
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else list(acc)
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f'{self._name}_top{k}' for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (ref: paddle.metric.Precision)."""
+
+    def __init__(self, name='precision'):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(int)
+        labels = np.asarray(labels).reshape(-1).astype(int)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (ref: paddle.metric.Recall)."""
+
+    def __init__(self, name='recall'):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(int)
+        labels = np.asarray(labels).reshape(-1).astype(int)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (ref: paddle.metric.Auc)."""
+
+    def __init__(self, num_thresholds=4095, name='auc'):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1)
+        self._neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(int), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx, labels == 1)
+        np.add.at(self._neg, idx, labels == 0)
+
+    def accumulate(self):
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # walk thresholds high→low accumulating TPR/FPR trapezoids
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

@@ -14,3 +14,38 @@ from .resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+from . import bert  # noqa: F401
+from . import gpt  # noqa: F401
+from . import moe_lm  # noqa: F401
+from . import vision  # noqa: F401
+from .bert import BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .moe_lm import MoEConfig, MoEForCausalLM  # noqa: F401
+from .vision import (  # noqa: F401
+    AlexNet,
+    DenseNet,
+    GoogLeNet,
+    InceptionV3,
+    LeNet,
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3,
+    ShuffleNetV2,
+    SqueezeNet,
+    VGG,
+    alexnet,
+    densenet121,
+    googlenet,
+    inception_v3,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+    shufflenet_v2_x1_0,
+    squeezenet1_0,
+    squeezenet1_1,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)

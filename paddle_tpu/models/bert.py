@@ -1,0 +1,144 @@
+"""BERT/ERNIE-base encoder (ref: PaddleNLP BERT/ERNIE; architecture
+parity with the reference's transformer encoder stacks): token/position/
+segment embeddings + post-LN encoder, MLM head and sequence-classifier
+heads for fine-tuning.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+
+
+def bert_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128, dropout=0.0)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.word_embeddings = Parameter(init((config.vocab_size, h), 'float32'))
+        self.position_embeddings = Parameter(
+            init((config.max_position_embeddings, h), 'float32'))
+        self.token_type_embeddings = Parameter(
+            init((config.type_vocab_size, h), 'float32'))
+        self.layer_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        B, S = input_ids.shape
+        pos = jnp.arange(S)[None, :]
+        x = self.word_embeddings[input_ids] + self.position_embeddings[pos]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + self.token_type_embeddings[token_type_ids]
+        return self.dropout(self.layer_norm(x))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.attn = nn.MultiHeadAttention(h, config.num_attention_heads,
+                                          dropout=config.dropout)
+        self.ln1 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.fc1 = nn.Linear(h, config.intermediate_size)
+        self.fc2 = nn.Linear(config.intermediate_size, h)
+        self.ln2 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, attn_mask=attn_mask)))
+        h = self.fc2(F.gelu(self.fc1(x)))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # (B, S) 1/0 → (B, 1, 1, S) additive-compatible bool
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for layer in self.encoder:
+            x = layer(x, attn_mask=mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.decoder_bias = Parameter(jnp.zeros((config.vocab_size,)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        hidden, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(hidden)))
+        return h @ self.bert.embeddings.word_embeddings.T + self.decoder_bias
+
+    def loss(self, input_ids, labels, ignore_index=-100):
+        """labels: -100 everywhere except masked positions."""
+        logits = self(input_ids).astype(jnp.float32)
+        mask = labels != ignore_index
+        safe = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(mask.sum(), 1)
+        return jnp.where(mask, nll, 0.0).sum() / denom
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+    def loss(self, input_ids, labels, **kw):
+        logits = self(input_ids, **kw).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

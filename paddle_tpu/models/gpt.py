@@ -1,0 +1,139 @@
+"""GPT-2 style decoder LM (ref: PaddleNLP GPT / the reference's
+incubate transformer stacks): learned position embeddings, pre-LN
+blocks, GELU MLP, tied LM head optional.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt2_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128, dropout=0.0)
+    defaults.update(kw)
+    return GPTConfig(**defaults)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        init = I.Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.qkv = Parameter(init((h, 3 * h), 'float32'), spec=P(None, 'tp'))
+        self.qkv_bias = Parameter(jnp.zeros((3 * h,)), spec=P('tp'))
+        self.out_proj = Parameter(init((h, h), 'float32'), spec=P('tp', None))
+        self.out_bias = Parameter(jnp.zeros((h,)))
+
+    def forward(self, x):
+        B, S, H = x.shape
+        qkv = x @ self.qkv + self.qkv_bias
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, S, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape), is_causal=True)
+        return out.reshape(B, S, H) @ self.out_proj + self.out_bias
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        init = I.Normal(0.0, config.initializer_range)
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.fc_in = Parameter(init((h, config.intermediate_size), 'float32'),
+                               spec=P(None, 'tp'))
+        self.fc_in_bias = Parameter(jnp.zeros((config.intermediate_size,)),
+                                    spec=P('tp'))
+        self.fc_out = Parameter(init((config.intermediate_size, h), 'float32'),
+                                spec=P('tp', None))
+        self.fc_out_bias = Parameter(jnp.zeros((h,)))
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        h = F.gelu(self.ln_2(x) @ self.fc_in + self.fc_in_bias)
+        return x + self.dropout(h @ self.fc_out + self.fc_out_bias)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Parameter(init((config.vocab_size, config.hidden_size),
+                                  'float32'), spec=P('tp', None))
+        self.wpe = Parameter(init((config.max_position_embeddings,
+                                   config.hidden_size), 'float32'))
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        B, S = input_ids.shape
+        pos = jnp.arange(S)[None, :]
+        x = self.drop(self.wte[input_ids] + self.wpe[pos])
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.transformer = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            init = I.Normal(0.0, config.initializer_range)
+            self.lm_head = Parameter(
+                init((config.hidden_size, config.vocab_size), 'float32'),
+                spec=P(None, 'tp'))
+
+    def forward(self, input_ids):
+        hidden = self.transformer(input_ids)
+        if self.lm_head is None:
+            return hidden @ self.transformer.wte.T
+        return hidden @ self.lm_head
+
+    def loss(self, input_ids, labels=None):
+        if labels is None:
+            labels = input_ids[:, 1:]
+            input_ids = input_ids[:, :-1]
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()

@@ -1,0 +1,116 @@
+"""MoE language model — DeepSeek/ERNIE-MoE style (ref:
+python/paddle/incubate/distributed/models/moe + DeepSeek-MoE shared+
+routed experts): a Llama-style decoder where MLPs are replaced by
+`distributed.moe.MoELayer` (top-k routed experts + shared experts),
+expert-parallel over the 'ep' mesh axis.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.moe import MoELayer
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+from .llama import LlamaAttention, LlamaConfig
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 1408      # per-expert
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 64
+    num_shared_experts: int = 2
+    top_k: int = 6
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+
+    def attn_config(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range,
+        )
+
+
+def moe_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, num_experts=4,
+                    num_shared_experts=1, top_k=2, max_position_embeddings=128)
+    defaults.update(kw)
+    return MoEConfig(**defaults)
+
+
+class MoEDecoderLayer(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        acfg = config.attn_config()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(acfg)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_norm_eps)
+        self.moe = MoELayer(
+            hidden=config.hidden_size, intermediate=config.intermediate_size,
+            num_experts=config.num_experts, top_k=config.top_k,
+            capacity_factor=config.capacity_factor,
+            num_shared_experts=config.num_shared_experts, return_aux=True,
+        )
+
+    def forward(self, x, positions):
+        attn_out, _ = self.self_attn(self.input_layernorm(x), positions)
+        x = x + attn_out
+        moe_out, aux = self.moe(self.post_attention_layernorm(x))
+        return x + moe_out, aux
+
+
+class MoEForCausalLM(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = Parameter(
+            init((config.vocab_size, config.hidden_size), 'float32'))
+        self.layers = nn.LayerList(
+            [MoEDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = Parameter(
+            init((config.hidden_size, config.vocab_size), 'float32'))
+
+    def forward(self, input_ids):
+        """Returns (logits, total_aux_loss)."""
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S)).astype(jnp.int32)
+        x = self.embed_tokens[input_ids]
+        aux_total = jnp.zeros((), jnp.float32)
+        for layer in self.layers:
+            x, aux = layer(x, positions)
+            aux_total = aux_total + aux
+        logits = self.norm(x) @ self.lm_head
+        return logits, aux_total
+
+    def loss(self, input_ids, labels=None):
+        if labels is None:
+            labels = input_ids[:, 1:]
+            input_ids = input_ids[:, :-1]
+        logits, aux = self(input_ids)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        return nll + self.config.aux_loss_weight * aux

@@ -1,0 +1,642 @@
+"""Vision model zoo (ref: python/paddle/vision/models/*).
+
+Same architecture graphs as the reference zoo (lenet.py, alexnet.py,
+vgg.py, mobilenetv1/v2/v3.py, squeezenet.py, shufflenetv2.py,
+densenet.py, googlenet.py, inceptionv3.py), rebuilt on pytree layers
+with NHWC-first layouts for the TPU MXU conv path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+
+
+def _flat(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1,
+                 act='relu', data_format='NHWC'):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False,
+                              data_format=data_format)
+        self.bn = nn.BatchNorm2D(cout, data_format=data_format)
+        acts = {'relu': nn.ReLU, 'relu6': nn.ReLU6, 'hardswish': nn.Hardswish,
+                'swish': nn.Swish, None: nn.Identity}
+        self.act = acts[act]()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+# ---------------------------------------------------------------------------
+# LeNet (ref: vision/models/lenet.py)
+# ---------------------------------------------------------------------------
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10, data_format='NHWC'):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1, data_format=data_format),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2, data_format=data_format),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0, data_format=data_format),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2, data_format=data_format),
+        )
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84), nn.Linear(84, num_classes)
+        ) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.fc is not None:
+            x = self.fc(_flat(x))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (ref: vision/models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        df = data_format
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2, data_format=df), nn.ReLU(),
+            nn.MaxPool2D(3, 2, data_format=df),
+            nn.Conv2D(64, 192, 5, padding=2, data_format=df), nn.ReLU(),
+            nn.MaxPool2D(3, 2, data_format=df),
+            nn.Conv2D(192, 384, 3, padding=1, data_format=df), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1, data_format=df), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1, data_format=df), nn.ReLU(),
+            nn.MaxPool2D(3, 2, data_format=df),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(6, data_format=df)
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(_flat(self.pool(self.features(x))))
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# VGG (ref: vision/models/vgg.py)
+# ---------------------------------------------------------------------------
+
+_VGG_CFGS = {
+    11: [64, 'M', 128, 'M', 256, 256, 'M', 512, 512, 'M', 512, 512, 'M'],
+    13: [64, 64, 'M', 128, 128, 'M', 256, 256, 'M', 512, 512, 'M', 512, 512, 'M'],
+    16: [64, 64, 'M', 128, 128, 'M', 256, 256, 256, 'M', 512, 512, 512, 'M',
+         512, 512, 512, 'M'],
+    19: [64, 64, 'M', 128, 128, 'M', 256, 256, 256, 256, 'M', 512, 512, 512,
+         512, 'M', 512, 512, 512, 512, 'M'],
+}
+
+
+class VGG(nn.Layer):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=False,
+                 data_format='NHWC'):
+        super().__init__()
+        layers, cin = [], 3
+        for v in _VGG_CFGS[depth]:
+            if v == 'M':
+                layers.append(nn.MaxPool2D(2, 2, data_format=data_format))
+            else:
+                layers.append(nn.Conv2D(cin, v, 3, padding=1,
+                                        data_format=data_format))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v, data_format=data_format))
+                layers.append(nn.ReLU())
+                cin = v
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(7, data_format=data_format)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 49, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(_flat(self.pool(self.features(x))))
+
+
+def vgg11(**kw):
+    return VGG(11, **kw)
+
+
+def vgg13(**kw):
+    return VGG(13, **kw)
+
+
+def vgg16(**kw):
+    return VGG(16, **kw)
+
+
+def vgg19(**kw):
+    return VGG(19, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1 (ref: vision/models/mobilenetv1.py)
+# ---------------------------------------------------------------------------
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [ConvBNAct(3, s(32), 3, 2, 1, data_format=data_format)]
+        for cin, cout, stride in cfg:
+            layers.append(ConvBNAct(s(cin), s(cin), 3, stride, 1,
+                                    groups=s(cin), data_format=data_format))
+            layers.append(ConvBNAct(s(cin), s(cout), 1, data_format=data_format))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        return self.fc(_flat(self.pool(self.features(x))))
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV2 (ref: vision/models/mobilenetv2.py)
+# ---------------------------------------------------------------------------
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand, data_format='NHWC'):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(ConvBNAct(cin, hidden, 1, act='relu6',
+                                    data_format=data_format))
+        layers += [
+            ConvBNAct(hidden, hidden, 3, stride, 1, groups=hidden, act='relu6',
+                      data_format=data_format),
+            ConvBNAct(hidden, cout, 1, act=None, data_format=data_format),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = max(8, int(32 * scale))
+        layers = [ConvBNAct(3, cin, 3, 2, 1, act='relu6', data_format=data_format)]
+        for t, c, n, stride in cfg:
+            cout = max(8, int(c * scale))
+            for i in range(n):
+                layers.append(InvertedResidual(cin, cout, stride if i == 0 else 1,
+                                               t, data_format))
+                cin = cout
+        last = max(1280, int(1280 * scale))
+        layers.append(ConvBNAct(cin, last, 1, act='relu6', data_format=data_format))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        self.classifier = nn.Sequential(nn.Dropout(0.2), nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        return self.classifier(_flat(self.pool(self.features(x))))
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (ref: vision/models/mobilenetv3.py)
+# ---------------------------------------------------------------------------
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, c, r=4, data_format='NHWC'):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        self.fc1 = nn.Conv2D(c, c // r, 1, data_format=data_format)
+        self.fc2 = nn.Conv2D(c // r, c, 1, data_format=data_format)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class MBV3Block(nn.Layer):
+    def __init__(self, cin, hidden, cout, k, stride, se, act, data_format='NHWC'):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if hidden != cin:
+            layers.append(ConvBNAct(cin, hidden, 1, act=act, data_format=data_format))
+        layers.append(ConvBNAct(hidden, hidden, k, stride, k // 2, groups=hidden,
+                                act=act, data_format=data_format))
+        if se:
+            layers.append(SqueezeExcite(hidden, data_format=data_format))
+        layers.append(ConvBNAct(hidden, cout, 1, act=None, data_format=data_format))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # k, hidden, cout, se, act, stride
+    (3, 16, 16, False, 'relu', 1), (3, 64, 24, False, 'relu', 2),
+    (3, 72, 24, False, 'relu', 1), (5, 72, 40, True, 'relu', 2),
+    (5, 120, 40, True, 'relu', 1), (5, 120, 40, True, 'relu', 1),
+    (3, 240, 80, False, 'hardswish', 2), (3, 200, 80, False, 'hardswish', 1),
+    (3, 184, 80, False, 'hardswish', 1), (3, 184, 80, False, 'hardswish', 1),
+    (3, 480, 112, True, 'hardswish', 1), (3, 672, 112, True, 'hardswish', 1),
+    (5, 672, 160, True, 'hardswish', 2), (5, 960, 160, True, 'hardswish', 1),
+    (5, 960, 160, True, 'hardswish', 1),
+]
+
+_MBV3_SMALL = [
+    (3, 16, 16, True, 'relu', 2), (3, 72, 24, False, 'relu', 2),
+    (3, 88, 24, False, 'relu', 1), (5, 96, 40, True, 'hardswish', 2),
+    (5, 240, 40, True, 'hardswish', 1), (5, 240, 40, True, 'hardswish', 1),
+    (5, 120, 48, True, 'hardswish', 1), (5, 144, 48, True, 'hardswish', 1),
+    (5, 288, 96, True, 'hardswish', 2), (5, 576, 96, True, 'hardswish', 1),
+    (5, 576, 96, True, 'hardswish', 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config='large', scale=1.0, num_classes=1000,
+                 data_format='NHWC'):
+        super().__init__()
+        cfg = _MBV3_LARGE if config == 'large' else _MBV3_SMALL
+        last_exp = 960 if config == 'large' else 576
+        s = lambda c: max(8, int(c * scale))
+        cin = s(16)
+        layers = [ConvBNAct(3, cin, 3, 2, 1, act='hardswish',
+                            data_format=data_format)]
+        for k, hidden, cout, se, act, stride in cfg:
+            layers.append(MBV3Block(cin, s(hidden), s(cout), k, stride, se, act,
+                                    data_format))
+            cin = s(cout)
+        layers.append(ConvBNAct(cin, s(last_exp), 1, act='hardswish',
+                                data_format=data_format))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        self.classifier = nn.Sequential(
+            nn.Linear(s(last_exp), 1280), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(1280, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(_flat(self.pool(self.features(x))))
+
+
+def mobilenet_v3_large(scale=1.0, **kw):
+    return MobileNetV3('large', scale, **kw)
+
+
+def mobilenet_v3_small(scale=1.0, **kw):
+    return MobileNetV3('small', scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (ref: vision/models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3, data_format='NHWC'):
+        super().__init__()
+        self.axis = -1 if data_format == 'NHWC' else 1
+        self.squeeze = nn.Conv2D(cin, squeeze, 1, data_format=data_format)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1, data_format=data_format)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1, data_format=data_format)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return jnp.concatenate(
+            [self.relu(self.expand1(x)), self.relu(self.expand3(x))],
+            axis=self.axis)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version='1.1', num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        df = data_format
+        if version == '1.0':
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2, data_format=df), nn.ReLU(),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(96, 16, 64, 64, df), Fire(128, 16, 64, 64, df),
+                Fire(128, 32, 128, 128, df),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(256, 32, 128, 128, df), Fire(256, 48, 192, 192, df),
+                Fire(384, 48, 192, 192, df), Fire(384, 64, 256, 256, df),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(512, 64, 256, 256, df),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2, data_format=df), nn.ReLU(),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(64, 16, 64, 64, df), Fire(128, 16, 64, 64, df),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(128, 32, 128, 128, df), Fire(256, 32, 128, 128, df),
+                nn.MaxPool2D(3, 2, data_format=df),
+                Fire(256, 48, 192, 192, df), Fire(384, 48, 192, 192, df),
+                Fire(384, 64, 256, 256, df), Fire(512, 64, 256, 256, df),
+            )
+        self.head = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1, data_format=df),
+            nn.ReLU(), nn.AdaptiveAvgPool2D(1, data_format=df),
+        )
+
+    def forward(self, x):
+        return _flat(self.head(self.features(x)))
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet('1.0', **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet('1.1', **kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (ref: vision/models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+def channel_shuffle(x, groups, data_format='NHWC'):
+    if data_format == 'NHWC':
+        B, H, W, C = x.shape
+        x = x.reshape(B, H, W, groups, C // groups)
+        x = jnp.swapaxes(x, 3, 4)
+        return x.reshape(B, H, W, C)
+    B, C, H, W = x.shape
+    x = x.reshape(B, groups, C // groups, H, W)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(B, C, H, W)
+
+
+class ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, data_format='NHWC'):
+        super().__init__()
+        self.stride = stride
+        self.data_format = data_format
+        branch = cout // 2
+        self.axis = -1 if data_format == 'NHWC' else 1
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                ConvBNAct(cin, cin, 3, stride, 1, groups=cin, act=None,
+                          data_format=data_format),
+                ConvBNAct(cin, branch, 1, data_format=data_format),
+            )
+            b2_in = cin
+        else:
+            self.branch1 = None
+            b2_in = cin // 2
+        self.branch2 = nn.Sequential(
+            ConvBNAct(b2_in, branch, 1, data_format=data_format),
+            ConvBNAct(branch, branch, 3, stride, 1, groups=branch, act=None,
+                      data_format=data_format),
+            ConvBNAct(branch, branch, 1, data_format=data_format),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = jnp.split(x, 2, axis=self.axis)
+            out = jnp.concatenate([x1, self.branch2(x2)], axis=self.axis)
+        else:
+            out = jnp.concatenate([self.branch1(x), self.branch2(x)],
+                                  axis=self.axis)
+        return channel_shuffle(out, 2, self.data_format)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}[scale]
+        repeats = [4, 8, 4]
+        self.conv1 = ConvBNAct(3, 24, 3, 2, 1, data_format=data_format)
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1, data_format=data_format)
+        cin = 24
+        stages = []
+        for i, r in enumerate(repeats):
+            units = [ShuffleUnit(cin, stage_out[i], 2, data_format)]
+            for _ in range(r - 1):
+                units.append(ShuffleUnit(stage_out[i], stage_out[i], 1, data_format))
+            stages.append(nn.Sequential(*units))
+            cin = stage_out[i]
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = ConvBNAct(cin, stage_out[3], 1, data_format=data_format)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
+        self.fc = nn.Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        return self.fc(_flat(self.pool(x)))
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (ref: vision/models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size, data_format='NHWC'):
+        super().__init__()
+        self.axis = -1 if data_format == 'NHWC' else 1
+        self.bn1 = nn.BatchNorm2D(cin, data_format=data_format)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False,
+                               data_format=data_format)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth, data_format=data_format)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False, data_format=data_format)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=self.axis)
+
+
+class Transition(nn.Layer):
+    def __init__(self, cin, cout, data_format='NHWC'):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(cin, data_format=data_format)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False,
+                              data_format=data_format)
+        self.pool = nn.AvgPool2D(2, 2, data_format=data_format)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth=32, bn_size=4, num_classes=1000,
+                 data_format='NHWC'):
+        super().__init__()
+        blocks = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
+                  169: [6, 12, 32, 32], 201: [6, 12, 48, 32]}[layers]
+        df = data_format
+        cin = 64
+        feats = [ConvBNAct(3, cin, 7, 2, 3, data_format=df),
+                 nn.MaxPool2D(3, 2, padding=1, data_format=df)]
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(DenseLayer(cin, growth, bn_size, df))
+                cin += growth
+            if i != len(blocks) - 1:
+                feats.append(Transition(cin, cin // 2, df))
+                cin //= 2
+        feats += [nn.BatchNorm2D(cin, data_format=df), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        return self.fc(_flat(self.pool(self.features(x))))
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (ref: vision/models/googlenet.py)
+# ---------------------------------------------------------------------------
+
+class Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pool_proj, data_format='NHWC'):
+        super().__init__()
+        df = data_format
+        self.axis = -1 if df == 'NHWC' else 1
+        self.b1 = ConvBNAct(cin, c1, 1, data_format=df)
+        self.b2 = nn.Sequential(ConvBNAct(cin, c3r, 1, data_format=df),
+                                ConvBNAct(c3r, c3, 3, 1, 1, data_format=df))
+        self.b3 = nn.Sequential(ConvBNAct(cin, c5r, 1, data_format=df),
+                                ConvBNAct(c5r, c5, 5, 1, 2, data_format=df))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1, data_format=df),
+                                ConvBNAct(cin, pool_proj, 1, data_format=df))
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=self.axis)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        df = data_format
+        self.stem = nn.Sequential(
+            ConvBNAct(3, 64, 7, 2, 3, data_format=df),
+            nn.MaxPool2D(3, 2, padding=1, data_format=df),
+            ConvBNAct(64, 64, 1, data_format=df),
+            ConvBNAct(64, 192, 3, 1, 1, data_format=df),
+            nn.MaxPool2D(3, 2, padding=1, data_format=df),
+        )
+        self.blocks = nn.Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32, df),
+            Inception(256, 128, 128, 192, 32, 96, 64, df),
+            nn.MaxPool2D(3, 2, padding=1, data_format=df),
+            Inception(480, 192, 96, 208, 16, 48, 64, df),
+            Inception(512, 160, 112, 224, 24, 64, 64, df),
+            Inception(512, 128, 128, 256, 24, 64, 64, df),
+            Inception(512, 112, 144, 288, 32, 64, 64, df),
+            Inception(528, 256, 160, 320, 32, 128, 128, df),
+            nn.MaxPool2D(3, 2, padding=1, data_format=df),
+            Inception(832, 256, 160, 320, 32, 128, 128, df),
+            Inception(832, 384, 192, 384, 48, 128, 128, df),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.blocks(self.stem(x)))
+        return self.fc(self.dropout(_flat(x)))
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (ref: vision/models/inceptionv3.py) — compact faithful variant
+# ---------------------------------------------------------------------------
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_feat, df='NHWC'):
+        super().__init__()
+        self.axis = -1 if df == 'NHWC' else 1
+        self.b1 = ConvBNAct(cin, 64, 1, data_format=df)
+        self.b5 = nn.Sequential(ConvBNAct(cin, 48, 1, data_format=df),
+                                ConvBNAct(48, 64, 5, 1, 2, data_format=df))
+        self.b3 = nn.Sequential(ConvBNAct(cin, 64, 1, data_format=df),
+                                ConvBNAct(64, 96, 3, 1, 1, data_format=df),
+                                ConvBNAct(96, 96, 3, 1, 1, data_format=df))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1, data_format=df),
+                                ConvBNAct(cin, pool_feat, 1, data_format=df))
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                               axis=self.axis)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, data_format='NHWC'):
+        super().__init__()
+        df = data_format
+        self.stem = nn.Sequential(
+            ConvBNAct(3, 32, 3, 2, data_format=df),
+            ConvBNAct(32, 32, 3, data_format=df),
+            ConvBNAct(32, 64, 3, 1, 1, data_format=df),
+            nn.MaxPool2D(3, 2, data_format=df),
+            ConvBNAct(64, 80, 1, data_format=df),
+            ConvBNAct(80, 192, 3, data_format=df),
+            nn.MaxPool2D(3, 2, data_format=df),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32, df), InceptionA(256, 64, df),
+            InceptionA(288, 64, df),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(288, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.blocks(self.stem(x)))
+        return self.fc(self.dropout(_flat(x)))
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

@@ -1,0 +1,89 @@
+"""hapi Model / callbacks / metrics / summary (SURVEY §2.10)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.callbacks import EarlyStopping
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.optimizer import Adam
+
+
+def _cls_data(n=64, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes))
+    y = (x @ w).argmax(-1).astype(np.int64)
+    return TensorDataset([jnp.asarray(x), jnp.asarray(y)])
+
+
+class TestModel:
+    def _model(self):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+        model = pt.Model(net)
+        model.prepare(Adam(learning_rate=1e-2), nn.CrossEntropyLoss(),
+                      Accuracy())
+        return model
+
+    def test_fit_evaluate_predict(self):
+        model = self._model()
+        ds = _cls_data()
+        model.fit(ds, epochs=3, batch_size=16, verbose=0)
+        logs = model.evaluate(ds, batch_size=16, verbose=0)
+        assert logs['acc'] > 0.5
+        preds = model.predict(ds, batch_size=16)
+        assert preds[0].shape == (16, 3)
+
+    def test_save_load(self, tmp_path):
+        model = self._model()
+        ds = _cls_data()
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / 'ckpt')
+        model.save(path)
+        model2 = self._model()
+        model2.load(path)
+        a = model.predict_batch([np.ones((2, 8), np.float32)])
+        b = model2.predict_batch([np.ones((2, 8), np.float32)])
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_early_stopping(self):
+        model = self._model()
+        ds = _cls_data()
+        es = EarlyStopping(monitor='loss', patience=0, min_delta=1e9)
+        model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+                  callbacks=[es])
+        assert es.stopped
+
+    def test_summary(self):
+        model = self._model()
+        info = model.summary()
+        assert info['total_params'] == 8 * 32 + 32 + 32 * 3 + 3
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.asarray([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+        label = np.asarray([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5 and top2 == 0.5
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.asarray([0.9, 0.8, 0.2, 0.6])
+        labels = np.asarray([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        auc = Auc()
+        preds = np.asarray([0.9, 0.8, 0.1, 0.2])
+        labels = np.asarray([1, 1, 0, 0])
+        auc.update(preds, labels)
+        assert auc.accumulate() > 0.99

@@ -1,0 +1,171 @@
+"""Vision zoo + NLP model tests (SURVEY §2.9: tiny-config forward
+shapes, one train step decreases loss)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import vision as V
+from paddle_tpu.models.bert import (
+    BertForMaskedLM, BertForSequenceClassification, bert_tiny)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+from paddle_tpu.models.moe_lm import MoEForCausalLM, moe_tiny
+from paddle_tpu.optimizer import AdamW
+
+
+def _img(b, s, c=3, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=(b, s, s, c)),
+                       jnp.float32)
+
+
+def _ids(shape, vocab=256, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, vocab, shape),
+                       jnp.int32)
+
+
+class TestVisionZoo:
+    def test_lenet(self):
+        m = V.LeNet(num_classes=10).eval()
+        assert m(jnp.ones((2, 28, 28, 1))).shape == (2, 10)
+
+    def test_alexnet(self):
+        m = V.alexnet(num_classes=5).eval()
+        assert m(_img(1, 224)).shape == (1, 5)
+
+    def test_vgg16(self):
+        m = V.vgg16(num_classes=4).eval()
+        assert m(_img(1, 224)).shape == (1, 4)
+
+    def test_mobilenet_v1(self):
+        m = V.mobilenet_v1(scale=0.25, num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+
+    def test_mobilenet_v2(self):
+        m = V.mobilenet_v2(scale=0.25, num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+
+    def test_mobilenet_v3(self):
+        m = V.mobilenet_v3_small(scale=0.5, num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+        m = V.mobilenet_v3_large(scale=0.35, num_classes=3).eval()
+        assert m(_img(1, 64)).shape == (1, 3)
+
+    def test_squeezenet(self):
+        m = V.squeezenet1_1(num_classes=6).eval()
+        assert m(_img(1, 96)).shape == (1, 6)
+
+    def test_shufflenet(self):
+        m = V.shufflenet_v2_x1_0(num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+
+    def test_densenet(self):
+        m = V.densenet121(num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+
+    def test_googlenet(self):
+        m = V.googlenet(num_classes=6).eval()
+        assert m(_img(1, 64)).shape == (1, 6)
+
+    def test_inception_v3(self):
+        m = V.inception_v3(num_classes=6).eval()
+        assert m(_img(1, 96)).shape == (1, 6)
+
+
+class TestGPT:
+    def test_forward_and_train(self):
+        pt.seed(0)
+        cfg = gpt2_tiny(vocab_size=128, hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, intermediate_size=64)
+        model = GPTForCausalLM(cfg)
+        ids = _ids((2, 16), vocab=128)
+        assert model(ids).shape == (2, 16, 128)
+        opt = AdamW(learning_rate=1e-2)
+        state = opt.init(model)
+
+        @jax.jit
+        def step(model, state, batch):
+            loss, grads = pt.autograd.value_and_grad(lambda m: m.loss(batch))(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        batch = _ids((4, 17), vocab=128)
+        model, state, l0 = step(model, state, batch)
+        for _ in range(10):
+            model, state, loss = step(model, state, batch)
+        assert float(loss) < float(l0)
+
+    def test_tied_embeddings(self):
+        cfg = gpt2_tiny(tie_word_embeddings=True)
+        model = GPTForCausalLM(cfg)
+        assert model.lm_head is None
+        assert model(_ids((1, 8))).shape == (1, 8, cfg.vocab_size)
+
+
+class TestBert:
+    def test_mlm(self):
+        pt.seed(1)
+        cfg = bert_tiny()
+        model = BertForMaskedLM(cfg)
+        ids = _ids((2, 16))
+        logits = model(ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        labels = jnp.full((2, 16), -100, jnp.int32).at[:, 3].set(ids[:, 3])
+        loss = model.loss(ids, labels)
+        assert np.isfinite(float(loss))
+
+    def test_classifier_finetune(self):
+        pt.seed(2)
+        cfg = bert_tiny(hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, intermediate_size=64)
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        ids = _ids((4, 12))
+        labels = jnp.asarray([0, 1, 2, 1], jnp.int32)
+        assert model(ids).shape == (4, 3)
+        opt = AdamW(learning_rate=1e-2)
+        state = opt.init(model)
+
+        @jax.jit
+        def step(model, state):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: m.loss(ids, labels))(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        model, state, l0 = step(model, state)
+        for _ in range(10):
+            model, state, loss = step(model, state)
+        assert float(loss) < float(l0)
+
+    def test_attention_mask(self):
+        cfg = bert_tiny()
+        model = BertForMaskedLM(cfg).eval()
+        ids = _ids((1, 8))
+        mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+        out = model(ids, attention_mask=mask)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestMoELM:
+    def test_forward_and_train(self):
+        pt.seed(3)
+        cfg = moe_tiny()
+        model = MoEForCausalLM(cfg)
+        ids = _ids((2, 16))
+        logits, aux = model(ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert float(aux) > 0
+        opt = AdamW(learning_rate=1e-2)
+        state = opt.init(model)
+        batch = _ids((4, 17))
+
+        @jax.jit
+        def step(model, state, batch):
+            loss, grads = pt.autograd.value_and_grad(lambda m: m.loss(batch))(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        model, state, l0 = step(model, state, batch)
+        for _ in range(10):
+            model, state, loss = step(model, state, batch)
+        assert float(loss) < float(l0)
