@@ -51,6 +51,14 @@ from . import metric  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+from . import profiler  # noqa: F401
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .utils.flops import flops  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 

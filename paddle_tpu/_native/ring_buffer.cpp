@@ -1,0 +1,111 @@
+// Shared-memory SPSC ring buffer — the worker→main fast path of the
+// DataLoader (ref capability: the reference's C++ DataLoader workers +
+// shared-memory tensor transport in paddle/fluid/operators/reader and
+// python/paddle/io/dataloader/worker.py's shared-memory path).
+//
+// Layout of the shared region (Python allocates it, C++ operates on it):
+//   [0]  u64 head   — consumer cursor (bytes consumed, monotonically grows)
+//   [8]  u64 tail   — producer cursor (bytes written, monotonically grows)
+//   [16] u64 capacity of the data area
+//   [24] data[capacity]
+//
+// Records are length-prefixed (u64 le) byte blobs, written contiguously
+// with wrap-around. One producer (worker process), one consumer (main).
+// Lock-free: release/acquire on the cursors.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t HDR = 24;
+
+struct Ctrl {
+    std::atomic<uint64_t> head;
+    std::atomic<uint64_t> tail;
+    uint64_t capacity;
+};
+
+static_assert(sizeof(std::atomic<uint64_t>) == 8, "atomic u64 must be 8 bytes");
+
+inline Ctrl* ctrl(uint8_t* base) { return reinterpret_cast<Ctrl*>(base); }
+inline uint8_t* data(uint8_t* base) { return base + HDR; }
+
+// copy len bytes into the ring at logical offset `pos` (wraps)
+void ring_write(uint8_t* d, uint64_t cap, uint64_t pos, const uint8_t* src,
+                uint64_t len) {
+    uint64_t off = pos % cap;
+    uint64_t first = (off + len <= cap) ? len : cap - off;
+    std::memcpy(d + off, src, first);
+    if (first < len) std::memcpy(d, src + first, len - first);
+}
+
+void ring_read(const uint8_t* d, uint64_t cap, uint64_t pos, uint8_t* dst,
+               uint64_t len) {
+    uint64_t off = pos % cap;
+    uint64_t first = (off + len <= cap) ? len : cap - off;
+    std::memcpy(dst, d + off, first);
+    if (first < len) std::memcpy(dst + first, d, len - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+void rb_init(uint8_t* base, uint64_t total_size) {
+    Ctrl* c = ctrl(base);
+    c->head.store(0, std::memory_order_relaxed);
+    c->tail.store(0, std::memory_order_relaxed);
+    c->capacity = total_size - HDR;
+}
+
+// Returns 1 on success, 0 if the record does not fit in current free space.
+int rb_push(uint8_t* base, const uint8_t* src, uint64_t len) {
+    Ctrl* c = ctrl(base);
+    uint64_t cap = c->capacity;
+    uint64_t need = len + 8;
+    if (need > cap) return 0;  // can never fit
+    uint64_t head = c->head.load(std::memory_order_acquire);
+    uint64_t tail = c->tail.load(std::memory_order_relaxed);
+    if (tail - head + need > cap) return 0;  // full — caller retries
+    uint64_t le_len = len;
+    ring_write(data(base), cap, tail, reinterpret_cast<uint8_t*>(&le_len), 8);
+    ring_write(data(base), cap, tail + 8, src, len);
+    c->tail.store(tail + need, std::memory_order_release);
+    return 1;
+}
+
+// Returns the record size if one is pending (without consuming), 0 if empty.
+uint64_t rb_peek(uint8_t* base) {
+    Ctrl* c = ctrl(base);
+    uint64_t head = c->head.load(std::memory_order_relaxed);
+    uint64_t tail = c->tail.load(std::memory_order_acquire);
+    if (tail == head) return 0;
+    uint64_t len;
+    ring_read(data(base), c->capacity, head, reinterpret_cast<uint8_t*>(&len), 8);
+    return len;
+}
+
+// Pops one record into dst (must hold >= rb_peek() bytes).
+// Returns bytes written, 0 if empty, -1 if dst_cap too small.
+int64_t rb_pop(uint8_t* base, uint8_t* dst, uint64_t dst_cap) {
+    Ctrl* c = ctrl(base);
+    uint64_t head = c->head.load(std::memory_order_relaxed);
+    uint64_t tail = c->tail.load(std::memory_order_acquire);
+    if (tail == head) return 0;
+    uint64_t len;
+    ring_read(data(base), c->capacity, head, reinterpret_cast<uint8_t*>(&len), 8);
+    if (len > dst_cap) return -1;
+    ring_read(data(base), c->capacity, head + 8, dst, len);
+    c->head.store(head + 8 + len, std::memory_order_release);
+    return static_cast<int64_t>(len);
+}
+
+uint64_t rb_used(uint8_t* base) {
+    Ctrl* c = ctrl(base);
+    return c->tail.load(std::memory_order_acquire) -
+           c->head.load(std::memory_order_acquire);
+}
+
+}  // extern "C"

@@ -1,0 +1,31 @@
+"""FFT namespace (ref: python/paddle/fft.py) — jnp.fft lowered to XLA."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_f = jnp.fft
+
+fft = _f.fft
+ifft = _f.ifft
+fft2 = _f.fft2
+ifft2 = _f.ifft2
+fftn = _f.fftn
+ifftn = _f.ifftn
+rfft = _f.rfft
+irfft = _f.irfft
+rfft2 = _f.rfft2
+irfft2 = _f.irfft2
+rfftn = _f.rfftn
+irfftn = _f.irfftn
+hfft = _f.hfft
+ihfft = _f.ihfft
+fftfreq = _f.fftfreq
+rfftfreq = _f.rfftfreq
+fftshift = _f.fftshift
+ifftshift = _f.ifftshift
+
+__all__ = [
+    'fft', 'ifft', 'fft2', 'ifft2', 'fftn', 'ifftn', 'rfft', 'irfft',
+    'rfft2', 'irfft2', 'rfftn', 'irfftn', 'hfft', 'ihfft', 'fftfreq',
+    'rfftfreq', 'fftshift', 'ifftshift',
+]

@@ -168,6 +168,73 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn):
             data_queue.put((seq, None, repr(e)))
 
 
+def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn):
+    """Worker for the native shared-memory fast path: batches go through
+    the C++ SPSC ring (one memcpy into shm) instead of a pickled pipe
+    (ref: the reference's C++ DataLoader + shared-memory transport)."""
+    import struct
+    import time as time_mod
+
+    from .. import _native
+
+    ring = _native.ShmRing(name=ring_name, create=False)
+    try:
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            seq, idxs = task
+            try:
+                batch = collate_fn([dataset[i] for i in idxs])
+                flat, spec = _flatten_batch(batch)
+                payload = (struct.pack('<QB', seq, 0)
+                           + struct.pack('<I', len(spec)) + spec
+                           + _native.encode_batch(flat))
+            except Exception as e:  # pragma: no cover
+                msg = repr(e).encode()
+                payload = struct.pack('<QB', seq, 1) + msg
+            while not ring.push(payload):
+                time_mod.sleep(0.001)       # ring full — consumer catching up
+    finally:
+        ring.close(unlink=False)
+
+
+def _flatten_batch(batch):
+    """Flatten nested (list/tuple/dict of) arrays → (arrays, json spec)."""
+    import json
+
+    flat = []
+
+    def walk(x):
+        if isinstance(x, dict):
+            return {'__d__': {k: walk(v) for k, v in sorted(x.items())}}
+        if isinstance(x, (list, tuple)):
+            return {'__l__' if isinstance(x, list) else '__t__':
+                    [walk(v) for v in x]}
+        flat.append(np.asarray(x))
+        return len(flat) - 1
+
+    spec = walk(batch)
+    return flat, json.dumps(spec).encode()
+
+
+def _unflatten_batch(spec_bytes, flat):
+    import json
+
+    spec = json.loads(spec_bytes.decode())
+
+    def walk(s):
+        if isinstance(s, int):
+            return flat[s]
+        if '__d__' in s:
+            return {k: walk(v) for k, v in s['__d__'].items()}
+        if '__l__' in s:
+            return [walk(v) for v in s['__l__']]
+        return tuple(walk(v) for v in s['__t__'])
+
+    return walk(spec)
+
+
 class DataLoader:
     """ref: paddle.io.DataLoader."""
 
@@ -181,6 +248,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -201,6 +269,11 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_inline()
+        if self.use_shared_memory:
+            from .. import _native
+
+            if _native.AVAILABLE:
+                return self._iter_workers_shm()
         return self._iter_workers()
 
     def _iter_iterable(self):
@@ -267,6 +340,79 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+
+    def _iter_workers_shm(self):
+        """Native fast path: per-worker C++ shm ring carries the batches."""
+        import struct
+        import time as time_mod
+
+        from .. import _native
+
+        ctx = mp.get_context('fork')
+        index_queue = ctx.Queue()
+        rings = [_native.ShmRing(capacity=64 * 1024 * 1024, create=True)
+                 for _ in range(self.num_workers)]
+        workers = [
+            ctx.Process(
+                target=_worker_loop_shm,
+                args=(self.dataset, index_queue, rings[i].name, self.collate_fn),
+                daemon=True,
+            )
+            for i in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            batches = list(self.batch_sampler)
+            inflight = 0
+            next_submit = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            reorder = {}
+            next_yield = 0
+            deadline_base = time_mod.time()
+            while next_submit < len(batches) and inflight < max_inflight:
+                index_queue.put((next_submit, batches[next_submit]))
+                next_submit += 1
+                inflight += 1
+            while next_yield < len(batches):
+                if next_yield in reorder:
+                    b = reorder.pop(next_yield)
+                    yield b
+                    next_yield += 1
+                    continue
+                got_any = False
+                for ring in rings:
+                    payload = ring.pop()
+                    if payload is None:
+                        continue
+                    got_any = True
+                    deadline_base = time_mod.time()
+                    seq, status = struct.unpack_from('<QB', payload, 0)
+                    inflight -= 1
+                    if next_submit < len(batches):
+                        index_queue.put((next_submit, batches[next_submit]))
+                        next_submit += 1
+                        inflight += 1
+                    if status == 1:
+                        raise RuntimeError(
+                            f'DataLoader worker failed: {payload[9:].decode()}')
+                    (spec_len,) = struct.unpack_from('<I', payload, 9)
+                    spec = payload[13:13 + spec_len]
+                    flat = _native.decode_batch(payload[13 + spec_len:])
+                    reorder[seq] = _unflatten_batch(spec, flat)
+                if not got_any:
+                    if time_mod.time() - deadline_base > self.timeout:
+                        raise RuntimeError('DataLoader shm timeout')
+                    time_mod.sleep(0.0005)
+        finally:
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+            for ring in rings:
+                ring.close(unlink=True)
 
 
 def prefetch_to_device(iterator, size=2, sharding=None):

@@ -1,0 +1,159 @@
+"""Profiler (ref: python/paddle/profiler/profiler.py).
+
+Wraps `jax.profiler`: traces go to TensorBoard-compatible files; the
+same RecordEvent/Profiler surface as the reference, with XLA's own
+per-op timeline replacing Paddle's host/device event collation.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+__all__ = ['Profiler', 'RecordEvent', 'ProfilerTarget', 'profile',
+           'start_profiler', 'stop_profiler', 'StepTimer']
+
+
+class ProfilerTarget:
+    CPU = 'cpu'
+    GPU = 'gpu'
+    TPU = 'tpu'
+    CUSTOM_DEVICE = 'custom'
+
+
+class RecordEvent:
+    """ref: paddle.profiler.RecordEvent — named trace annotation.
+
+    Also usable as a decorator. Lowers to jax.profiler.TraceAnnotation,
+    which shows up on the XLA timeline.
+    """
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with jax.profiler.TraceAnnotation(self.name):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler.
+
+    with Profiler(on_trace_ready=...) as p:
+        for batch in loader:
+            train_step(...)
+            p.step()
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 log_dir='./profiler_log', timer_only=False, **kw):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.on_trace_ready = on_trace_ready
+        self._running = False
+        self._step_times = []
+        self._t_last = None
+
+    def start(self):
+        if not self.timer_only:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+        self._running = True
+        self._t_last = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._running and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._running = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return 'no steps recorded'
+        import numpy as np
+
+        t = np.asarray(self._step_times)
+        return (f'steps={len(t)} avg={t.mean() * 1e3:.2f}ms '
+                f'p50={np.percentile(t, 50) * 1e3:.2f}ms '
+                f'p99={np.percentile(t, 99) * 1e3:.2f}ms')
+
+    def summary(self, **kw):
+        print(self.step_info())
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profile(log_dir='./profiler_log'):
+    p = Profiler(log_dir=log_dir).start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+_global_profiler = None
+
+
+def start_profiler(log_dir='./profiler_log', **kw):
+    global _global_profiler
+    _global_profiler = Profiler(log_dir=log_dir, **kw).start()
+
+
+def stop_profiler():
+    global _global_profiler
+    if _global_profiler is not None:
+        _global_profiler.stop()
+        _global_profiler = None
+
+
+class StepTimer:
+    """Lightweight step timing (timer_only Profiler convenience)."""
+
+    def __init__(self):
+        self._p = Profiler(timer_only=True).start()
+
+    def step(self):
+        self._p.step()
+
+    def info(self):
+        return self._p.step_info()

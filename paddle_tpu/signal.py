@@ -1,0 +1,84 @@
+"""Signal ops (ref: python/paddle/signal.py): frame, overlap_add, stft, istft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames (ref: paddle.signal.frame)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    out = x[..., idx]                     # (..., num_frames, frame_length)
+    out = jnp.swapaxes(out, -1, -2)       # (..., frame_length, num_frames)
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame (ref: paddle.signal.overlap_add).
+    x: (..., frame_length, num_frames)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    n = frame_length + hop_length * (num_frames - 1)
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    for i in range(num_frames):          # static unroll — num_frames is static
+        out = out.at[..., i * hop_length:i * hop_length + frame_length].add(
+            x[..., i])
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode='reflect', normalized=False, onesided=True, name=None):
+    """ref: paddle.signal.stft. x: (..., T) real → (..., F, num_frames) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)              # (..., n_fft, num_frames)
+    frames = frames * window[:, None]
+    spec = (jnp.fft.rfft(frames, axis=-2) if onesided
+            else jnp.fft.fft(frames, axis=-2))
+    if normalized:
+        spec = spec / jnp.sqrt(n_fft)
+    return spec
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """ref: paddle.signal.istft."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if normalized:
+        x = x * jnp.sqrt(n_fft)
+    frames = (jnp.fft.irfft(x, n=n_fft, axis=-2) if onesided
+              else jnp.fft.ifft(x, axis=-2).real)
+    frames = frames * window[:, None]
+    out = overlap_add(frames, hop_length)
+    # window envelope normalisation
+    wsq = jnp.tile((window ** 2)[:, None], (1, x.shape[-1]))
+    env = overlap_add(wsq, hop_length)
+    out = out / jnp.maximum(env, 1e-10)
+    if center:
+        out = out[..., n_fft // 2:-(n_fft // 2) or None]
+    if length is not None:
+        out = out[..., :length]
+    return out

@@ -1,0 +1,115 @@
+"""Sparse namespace — COO basics (ref: python/paddle/sparse).
+
+TPU-native: COO is (indices, values, shape); matmul/reductions lower to
+dense segment ops (`.at[].add`), which XLA scatters efficiently. Dense
+fallbacks are correct at any sparsity; the TPU win is memory, not
+FLOPs, since the MXU wants dense tiles.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseCooTensor:
+    """ref: paddle.sparse.sparse_coo_tensor return type."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices = jnp.asarray(indices)      # (ndim, nnz)
+        self.values = jnp.asarray(values)        # (nnz, ...)
+        self.shape = tuple(shape)
+        self._coalesced = coalesced
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape + self.values.shape[1:], self.values.dtype)
+        return dense.at[tuple(self.indices)].add(self.values)
+
+    def coalesce(self):
+        flat = jnp.ravel_multi_index(tuple(self.indices),
+                                     self.shape[:self.indices.shape[0]],
+                                     mode='clip')
+        order = jnp.argsort(flat)
+        sorted_flat = flat[order]
+        sorted_vals = self.values[order]
+        unique, inv = jnp.unique(sorted_flat, return_inverse=True,
+                                 size=flat.shape[0], fill_value=-1)
+        summed = jnp.zeros_like(sorted_vals).at[inv].add(sorted_vals)
+        keep = unique >= 0
+        idx = jnp.stack(jnp.unravel_index(jnp.maximum(unique, 0), self.shape))
+        return SparseCooTensor(idx, jnp.where(keep[..., None] if summed.ndim > 1
+                                              else keep, summed, 0),
+                               self.shape, coalesced=True)
+
+    def __repr__(self):
+        return (f'SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, '
+                f'dtype={self.dtype})')
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref: paddle.sparse.sparse_coo_tensor."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values, dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(indices.max(axis=1)))
+    return SparseCooTensor(indices, values, shape)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def matmul(a, b):
+    """Sparse @ dense (ref: paddle.sparse.matmul) via gather+segment-add."""
+    if isinstance(a, SparseCooTensor):
+        assert a.ndim == 2, '2-D sparse matmul'
+        b = jnp.asarray(b)
+        rows, cols = a.indices
+        contrib = a.values[:, None] * b[cols]        # (nnz, N)
+        out = jnp.zeros((a.shape[0], b.shape[1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+    if isinstance(b, SparseCooTensor):
+        return matmul(b.transpose(), jnp.asarray(a).T).T
+    return jnp.asarray(a) @ jnp.asarray(b)
+
+
+def add(a, b):
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        assert a.shape == b.shape
+        return SparseCooTensor(
+            jnp.concatenate([a.indices, b.indices], axis=1),
+            jnp.concatenate([a.values, b.values]), a.shape)
+    return to_dense(a) + to_dense(b)
+
+
+def relu(x):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, jnp.maximum(x.values, 0), x.shape)
+    return jnp.maximum(x, 0)
+
+
+def transpose(x, perm=(1, 0)):
+    if isinstance(x, SparseCooTensor):
+        new_idx = x.indices[jnp.asarray(perm)]
+        new_shape = tuple(x.shape[p] for p in perm)
+        return SparseCooTensor(new_idx, x.values, new_shape)
+    return jnp.transpose(x, perm)
+
+
+SparseCooTensor.transpose = lambda self, perm=(1, 0): transpose(self, perm)

@@ -1,0 +1,31 @@
+"""Utilities (ref: python/paddle/utils)."""
+from .unique_name import generate, guard, switch  # noqa: F401
+from .flops import flops  # noqa: F401
+
+try:  # optional alias namespace
+    from . import download  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+
+def run_check():
+    """ref: paddle.utils.run_check — sanity-check the install + device."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    dev = jax.devices()[0]
+    print(f'paddle_tpu is installed successfully! '
+          f'backend={jax.default_backend()} device={dev.device_kind} '
+          f'check={float(y[0, 0])}')
+    return True
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,31 @@
+"""FLOPs estimation (ref: python/paddle/hapi/dynamic_flops.py).
+
+TPU-native: instead of per-layer hooks, trace the model with jax and
+read XLA's own cost analysis — exact for whatever fuses to the device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None, print_detail=False):
+    """Returns total FLOPs of one forward pass (XLA cost analysis)."""
+    import jax
+    import jax.numpy as jnp
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError('provide input_size or inputs')
+        inputs = (jnp.zeros(tuple(input_size), jnp.float32),)
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = (inputs,)
+
+    lowered = jax.jit(lambda m, *xs: m(*xs)).lower(net, *inputs)
+    try:
+        cost = lowered.compile().cost_analysis()
+        total = int(cost.get('flops', 0)) if cost else 0
+    except Exception:
+        total = 0
+    if print_detail:
+        print(f'Total FLOPs: {total:,}')
+    return total

@@ -1,0 +1,43 @@
+"""Unique name generator (ref: python/paddle/utils/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+        self.prefix = ''
+
+    def __call__(self, key):
+        self.ids.setdefault(key, 0)
+        name = f'{self.prefix}{key}_{self.ids[key]}'
+        self.ids[key] += 1
+        return name
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        g = _Generator()
+        g.prefix = new_generator
+        new_generator = g
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,4 @@
+"""paddle_tpu.vision (ref: python/paddle/vision)."""
+from . import datasets  # noqa: F401
+from . import transforms  # noqa: F401
+from .. import models  # noqa: F401  (paddle.vision.models alias)

@@ -1,0 +1,132 @@
+"""Built-in datasets (ref: python/paddle/vision/datasets).
+
+Download-free: MNIST/CIFAR read standard local archive files when
+`image_path`/`data_file` is given; otherwise deterministic synthetic
+data with the right shapes/classes (for tests and smoke training —
+this environment has no network egress).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic classification images (ref: paddle.vision.datasets.FakeData
+    has no direct analogue; used as the offline fallback)."""
+
+    def __init__(self, size=256, image_shape=(32, 32, 3), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._images = self._rng.integers(
+            0, 256, (size,) + self.image_shape).astype(np.uint8)
+        self._labels = self._rng.integers(0, num_classes, (size,)).astype(np.int64)
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, i):
+        img = self._images[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, self._labels[i]
+
+
+class MNIST(Dataset):
+    """ref: paddle.vision.datasets.MNIST — reads idx-ubyte(.gz) files from
+    `image_path`/`label_path`; synthetic fallback when absent."""
+
+    def __init__(self, image_path=None, label_path=None, mode='train',
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            fake = FakeData(size=512 if mode == 'train' else 128,
+                            image_shape=(28, 28, 1), num_classes=10,
+                            seed=0 if mode == 'train' else 1)
+            self.images = fake._images
+            self.labels = fake._labels
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, 'rb') if path.endswith('.gz') else open(path, 'rb')
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], 'big')
+        rows = int.from_bytes(data[8:12], 'big')
+        cols = int.from_bytes(data[12:16], 'big')
+        return np.frombuffer(data, np.uint8, offset=16).reshape(n, rows, cols, 1)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            data = f.read()
+        return np.frombuffer(data, np.uint8, offset=8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class Cifar10(Dataset):
+    """ref: paddle.vision.datasets.Cifar10 — reads the python-pickle tar;
+    synthetic fallback when `data_file` is absent."""
+
+    n_classes = 10
+
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self.images, self.labels = self._read_tar(data_file, mode)
+        else:
+            fake = FakeData(size=512 if mode == 'train' else 128,
+                            image_shape=(32, 32, 3),
+                            num_classes=self.n_classes,
+                            seed=2 if mode == 'train' else 3)
+            self.images = fake._images
+            self.labels = fake._labels
+
+    def _read_tar(self, path, mode):
+        images, labels = [], []
+        want = 'data_batch' if mode == 'train' else 'test_batch'
+        label_key = b'labels' if self.n_classes == 10 else b'fine_labels'
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                if want in member.name:
+                    d = pickle.load(tar.extractfile(member), encoding='bytes')
+                    images.append(d[b'data'])
+                    labels.extend(d[label_key])
+        images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        return images.transpose(0, 2, 3, 1).copy(), np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class Cifar100(Cifar10):
+    n_classes = 100

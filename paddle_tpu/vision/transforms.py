@@ -1,0 +1,291 @@
+"""Image transforms (ref: python/paddle/vision/transforms/transforms.py).
+
+Operate on numpy HWC uint8/float arrays (the dataloader-worker side —
+host CPU, not traced), matching the reference's numpy/PIL backend.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    """ref: transforms.Compose."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def _resize_np(img, h, w):
+    """Bilinear resize, pure numpy (no PIL dependency)."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation='bilinear', keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if isinstance(self.size, numbers.Number):
+            ih, iw = img.shape[:2]
+            scale = self.size / min(ih, iw)
+            h, w = int(round(ih * scale)), int(round(iw * scale))
+        else:
+            h, w = _size_pair(self.size)
+        return _resize_np(img, h, w)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode='constant', keys=None):
+        self.size = _size_pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = self.size
+        if self.padding:
+            p = self.padding if not isinstance(self.padding, int) else (
+                self.padding,) * 4
+            img = np.pad(img, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (img.ndim - 2),
+                         constant_values=self.fill)
+        ih, iw = img.shape[:2]
+        if self.pad_if_needed and (ih < h or iw < w):
+            ph, pw = max(h - ih, 0), max(w - iw, 0)
+            img = np.pad(img, ((0, ph), (0, pw)) + ((0, 0),) * (img.ndim - 2),
+                         constant_values=self.fill)
+            ih, iw = img.shape[:2]
+        top = random.randint(0, ih - h)
+        left = random.randint(0, iw - w)
+        return img[top:top + h, left:left + w]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = _size_pair(size)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = max((ih - h) // 2, 0)
+        left = max((iw - w) // 2, 0)
+        return img[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+RandomFlip = RandomHorizontalFlip
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Normalize(BaseTransform):
+    """ref: transforms.Normalize — (x - mean) / std, channel-last."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format='HWC', to_rgb=False,
+                 keys=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == 'CHW':
+            return (img - self.mean.reshape(-1, 1, 1)) / self.std.reshape(-1, 1, 1)
+        return (img - self.mean) / self.std
+
+
+class Transpose(BaseTransform):
+    """ref: transforms.Transpose — default HWC→CHW (for NCHW nets)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class ToTensor(BaseTransform):
+    """ref: transforms.ToTensor — uint8 HWC → float CHW in [0,1].
+
+    TPU note: keep `data_format='HWC'` for NHWC models (the default zoo
+    layout here); CHW matches the reference default.
+    """
+
+    def __init__(self, data_format='CHW', keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.data_format == 'CHW':
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * alpha, 0,
+                       255 if np.asarray(img).dtype == np.uint8 else np.inf)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        img = np.asarray(img, np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        mean = img.mean()
+        return np.clip(mean + alpha * (img - mean), 0, 255)
+
+
+class ColorJitter(BaseTransform):
+    """Brightness/contrast jitter (saturation/hue: grayscale-safe stub)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.t = Compose([BrightnessTransform(brightness),
+                          ContrastTransform(contrast)])
+
+    def _apply_image(self, img):
+        return self.t(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode='constant', keys=None):
+        self.padding = (padding,) * 4 if isinstance(padding, int) else padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        p = self.padding
+        return np.pad(img, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (img.ndim - 2),
+                      constant_values=self.fill)
+
+
+class RandomRotation(BaseTransform):
+    """90-degree-step random rotation (arbitrary-angle needs scipy; the
+    dataloader path keeps to numpy)."""
+
+    def __init__(self, degrees, keys=None):
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        k = random.randint(0, 3)
+        return np.rot90(np.asarray(img), k).copy()
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if img.ndim == 3 and img.shape[-1] == 3:
+            g = img @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        else:
+            g = img.reshape(img.shape[:2])
+        g = g[:, :, None]
+        return np.repeat(g, self.n, axis=-1) if self.n > 1 else g
+
+
+# functional aliases (ref: paddle.vision.transforms.functional)
+def to_tensor(img, data_format='CHW'):
+    return ToTensor(data_format)(img)
+
+
+def resize(img, size, interpolation='bilinear'):
+    return Resize(size, interpolation)(img)
+
+
+def normalize(img, mean, std, data_format='HWC', to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode='constant'):
+    return Pad(padding, fill, padding_mode)(img)

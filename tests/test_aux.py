@@ -1,0 +1,153 @@
+"""Aux namespaces: vision transforms/datasets, fft, signal, sparse,
+utils, profiler, flags (SURVEY §2.8, §2.11)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import Cifar10, FakeData, MNIST
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        t = T.Compose([
+            T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(0.5),
+            T.Normalize(mean=127.5, std=127.5), T.ToTensor(data_format='HWC'),
+        ])
+        img = np.random.default_rng(0).integers(0, 256, (48, 64, 3)).astype(np.uint8)
+        out = t(img)
+        assert out.shape == (32, 32, 3)
+        assert out.dtype == np.float32
+
+    def test_to_tensor_chw(self):
+        img = np.zeros((8, 10, 3), np.uint8)
+        out = T.ToTensor()(img)
+        assert out.shape == (3, 8, 10)
+        assert out.max() <= 1.0
+
+    def test_resize_shapes(self):
+        img = np.zeros((20, 30, 3), np.float32)
+        assert T.Resize((10, 15))(img).shape == (10, 15, 3)
+        assert T.Resize(10)(img).shape[0] == 10   # short side
+
+    def test_random_crop_with_padding(self):
+        img = np.ones((8, 8, 1), np.float32)
+        out = T.RandomCrop(8, padding=2)(img)
+        assert out.shape == (8, 8, 1)
+
+    def test_grayscale(self):
+        img = np.random.default_rng(1).normal(size=(6, 6, 3)).astype(np.float32)
+        assert T.Grayscale()(img).shape == (6, 6, 1)
+        assert T.Grayscale(3)(img).shape == (6, 6, 3)
+
+
+class TestDatasets:
+    def test_fake_data_deterministic(self):
+        a, b = FakeData(size=8, seed=5), FakeData(size=8, seed=5)
+        np.testing.assert_array_equal(a[3][0], b[3][0])
+
+    def test_mnist_synthetic_fallback(self):
+        ds = MNIST(mode='train')
+        img, label = ds[0]
+        assert img.shape == (28, 28, 1)
+        assert 0 <= int(label) < 10
+
+    def test_cifar_with_transform(self):
+        ds = Cifar10(mode='test', transform=T.ToTensor(data_format='HWC'))
+        img, label = ds[0]
+        assert img.shape == (32, 32, 3)
+        assert img.dtype == np.float32
+
+
+class TestFFT:
+    def test_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(16,)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(pt.fft.ifft(pt.fft.fft(x)).real), np.asarray(x),
+            rtol=1e-5, atol=1e-5)
+
+    def test_rfft_shape(self):
+        x = jnp.zeros((4, 16))
+        assert pt.fft.rfft(x).shape == (4, 9)
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 64)), jnp.float32)
+        frames = pt.signal.frame(x, 16, 16)      # non-overlapping
+        back = pt.signal.overlap_add(frames, 16)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        x = jnp.asarray(np.sin(np.linspace(0, 20 * np.pi, 256)), jnp.float32)[None]
+        window = jnp.asarray(np.hanning(64), jnp.float32)
+        spec = pt.signal.stft(x, n_fft=64, hop_length=16, window=window)
+        assert spec.shape[-2] == 33
+        back = pt.signal.istft(spec, n_fft=64, hop_length=16, window=window,
+                               length=256)
+        np.testing.assert_allclose(np.asarray(back[0, 32:-32]),
+                                   np.asarray(x[0, 32:-32]), atol=1e-3)
+
+
+class TestSparse:
+    def test_coo_to_dense(self):
+        idx = jnp.asarray([[0, 1, 2], [1, 0, 2]])
+        vals = jnp.asarray([1.0, 2.0, 3.0])
+        sp = pt.sparse.sparse_coo_tensor(idx, vals, (3, 3))
+        dense = np.zeros((3, 3))
+        dense[0, 1], dense[1, 0], dense[2, 2] = 1, 2, 3
+        np.testing.assert_allclose(np.asarray(sp.to_dense()), dense)
+
+    def test_spmm(self):
+        idx = jnp.asarray([[0, 1], [1, 0]])
+        sp = pt.sparse.sparse_coo_tensor(idx, jnp.asarray([2.0, 3.0]), (2, 2))
+        b = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        ref = np.asarray(sp.to_dense()) @ np.asarray(b)
+        np.testing.assert_allclose(np.asarray(pt.sparse.matmul(sp, b)), ref)
+
+    def test_relu_and_transpose(self):
+        idx = jnp.asarray([[0, 1], [1, 0]])
+        sp = pt.sparse.sparse_coo_tensor(idx, jnp.asarray([-2.0, 3.0]), (2, 2))
+        assert float(pt.sparse.relu(sp).values[0]) == 0.0
+        t = sp.transpose()
+        np.testing.assert_allclose(np.asarray(t.to_dense()),
+                                   np.asarray(sp.to_dense()).T)
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate('fc')
+        b = unique_name.generate('fc')
+        assert a != b
+        with unique_name.guard():
+            c = unique_name.generate('fc')
+        assert c.endswith('fc_0')
+
+    def test_flops(self):
+        net = pt.nn.Linear(8, 4)
+        n = pt.flops(net, input_size=(1, 8))
+        assert n >= 2 * 8 * 4   # at least the matmul
+
+    def test_flags(self):
+        pt.set_flags({'FLAGS_use_pallas_kernels': False})
+        assert pt.get_flags('FLAGS_use_pallas_kernels') == {
+            'FLAGS_use_pallas_kernels': False}
+        pt.set_flags({'FLAGS_use_pallas_kernels': True})
+
+    def test_run_check(self, capsys):
+        assert pt.utils.run_check()
+
+
+class TestProfiler:
+    def test_step_timer_and_record_event(self):
+        p = pt.profiler.Profiler(timer_only=True).start()
+        with pt.profiler.RecordEvent('step'):
+            x = (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        p.step()
+        p.step()
+        p.stop()
+        assert 'steps=2' in p.step_info()

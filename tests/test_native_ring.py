@@ -1,0 +1,103 @@
+"""Native C++ ring buffer + DataLoader shared-memory fast path
+(SURVEY §2.8: C++ worker→main transport)."""
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.io.dataloader import DataLoader
+
+pytestmark = pytest.mark.skipif(not _native.AVAILABLE,
+                                reason='native lib unavailable')
+
+
+class TestRing:
+    def test_push_pop_roundtrip(self):
+        ring = _native.ShmRing(capacity=1 << 16)
+        try:
+            assert ring.pop() is None
+            assert ring.push(b'hello')
+            assert ring.push(b'world!')
+            assert ring.pop() == b'hello'
+            assert ring.pop() == b'world!'
+            assert ring.pop() is None
+        finally:
+            ring.close()
+
+    def test_wraparound(self):
+        ring = _native.ShmRing(capacity=1 << 10)
+        try:
+            payload = bytes(range(256)) * 2   # 512B records in a 1KB ring
+            for _ in range(10):               # cursor passes the end repeatedly
+                assert ring.push(payload)
+                assert ring.pop() == payload
+        finally:
+            ring.close()
+
+    def test_full_ring_rejects(self):
+        ring = _native.ShmRing(capacity=1 << 10)
+        try:
+            big = b'x' * 2000
+            assert not ring.push(big)         # never fits
+            small = b'y' * 400
+            assert ring.push(small)
+            assert ring.push(small)           # 2*(400+8) = 816 <= 1024
+            assert not ring.push(small)       # full now
+            assert ring.pop() == small
+            assert ring.push(small)           # space reclaimed
+        finally:
+            ring.close()
+
+    def test_cross_process(self):
+        import multiprocessing as mp
+
+        ring = _native.ShmRing(capacity=1 << 20)
+
+        def producer(name):
+            r = _native.ShmRing(name=name, create=False)
+            for i in range(50):
+                while not r.push(f'msg-{i}'.encode()):
+                    pass
+            r.close(unlink=False)
+
+        try:
+            p = mp.get_context('fork').Process(target=producer,
+                                               args=(ring.name,))
+            p.start()
+            got = []
+            while len(got) < 50:
+                m = ring.pop()
+                if m is not None:
+                    got.append(m)
+            p.join()
+            assert got == [f'msg-{i}'.encode() for i in range(50)]
+        finally:
+            ring.close()
+
+
+class TestCodec:
+    def test_encode_decode(self):
+        arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.asarray([1, 2, 3], np.int64),
+                  np.asarray(5.0)]
+        out = _native.decode_batch(_native.encode_batch(arrays))
+        for a, b in zip(arrays, out):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype
+
+
+class TestDataLoaderShm:
+    def test_matches_inline_loader(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, 64)
+        import jax.numpy as jnp
+
+        ds = TensorDataset([jnp.asarray(x), jnp.asarray(y)])
+        inline = list(DataLoader(ds, batch_size=16, num_workers=0))
+        shm = list(DataLoader(ds, batch_size=16, num_workers=2,
+                              use_shared_memory=True))
+        assert len(inline) == len(shm)
+        for (ax, ay), (bx, by) in zip(inline, shm):
+            np.testing.assert_allclose(np.asarray(ax), np.asarray(bx))
+            np.testing.assert_array_equal(np.asarray(ay), np.asarray(by))
