@@ -46,6 +46,15 @@ from .parallel import (  # noqa: F401
     shard_model,
     shard_tensor,
 )
+from . import fleet  # noqa: F401
+from . import moe  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import ring_attention  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def get_world_size_safe():

@@ -1,0 +1,99 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/fleet.py).
+
+Paddle's `fleet.init(is_collective=True, strategy=...)` builds NCCL
+groups; `fleet.distributed_model/distributed_optimizer` wrap model and
+optimizer with hybrid-parallel machinery. TPU-native: init builds the
+global Mesh from the strategy's hybrid_configs; distributed_model is
+`parallelize` (annotate + place); distributed_optimizer is a no-op
+passthrough — sharded optimizer states fall out of GSPMD when
+`opt.init` runs on sharded params.
+"""
+from __future__ import annotations
+
+import typing
+
+from .mesh import DistributedStrategy, get_mesh, init_parallel_env
+from .parallel import parallelize
+
+_strategy: typing.Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level='INFO'):
+    """ref: fleet.init. Accepts a DistributedStrategy or a dict-style
+    hybrid_configs ({'dp_degree':..,'mp_degree':..,'pp_degree':..,
+    'sharding_degree':..})."""
+    global _strategy
+    if isinstance(strategy, dict):
+        strategy = _from_hybrid_configs(strategy)
+    elif strategy is not None and hasattr(strategy, 'hybrid_configs') \
+            and isinstance(strategy.hybrid_configs, dict):
+        strategy = _from_hybrid_configs(strategy.hybrid_configs, strategy)
+    _strategy = strategy or DistributedStrategy()
+    init_parallel_env(_strategy)
+    return _strategy
+
+
+def _from_hybrid_configs(cfg: dict, base=None) -> DistributedStrategy:
+    s = base if isinstance(base, DistributedStrategy) else DistributedStrategy()
+    mapping = {
+        'dp_degree': 'dp_degree', 'mp_degree': 'tp_degree',
+        'pp_degree': 'pp_degree', 'sharding_degree': 'fsdp_degree',
+        'sep_degree': 'sp_degree', 'ep_degree': 'ep_degree',
+    }
+    for k, attr in mapping.items():
+        if k in cfg:
+            setattr(s, attr, cfg[k])
+    return s
+
+
+def distributed_model(model, rules=None, fsdp=None):
+    """ref: fleet.distributed_model — here: annotate + shard over the mesh."""
+    strategy = _strategy or DistributedStrategy()
+    fsdp_axis = 'fsdp' if (
+        fsdp if fsdp is not None else strategy.sharding_stage >= 3
+        or strategy.fsdp_degree not in (1,)) else None
+    return parallelize(model, get_mesh(), rules=rules, fsdp_axis=fsdp_axis)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """ref: fleet.distributed_optimizer. GSPMD shards optimizer slots
+    automatically (they inherit param shardings at opt.init), so the
+    optimizer passes through unchanged — ZeRO-1/2 come free."""
+    return optimizer
+
+
+def get_hybrid_communicate_group():
+    """Minimal HCG parity: exposes the mesh + axis sizes."""
+    mesh = get_mesh()
+
+    class _HCG:
+        def __init__(self, mesh):
+            self.mesh = mesh
+
+        def get_data_parallel_world_size(self):
+            return (self.mesh.shape['dp'] * self.mesh.shape['fsdp']
+                    if self.mesh else 1)
+
+        def get_model_parallel_world_size(self):
+            return self.mesh.shape['tp'] if self.mesh else 1
+
+        def get_pipe_parallel_world_size(self):
+            return self.mesh.shape['pp'] if self.mesh else 1
+
+    return _HCG(mesh)
+
+
+def worker_num():
+    import jax
+
+    return jax.process_count()
+
+
+def worker_index():
+    import jax
+
+    return jax.process_index()
+
+
+def barrier_worker():
+    return None

@@ -1,0 +1,57 @@
+"""Multi-host bring-up (ref: python/paddle/distributed/launch — the
+`python -m paddle.distributed.launch` elastic launcher).
+
+On TPU pods there is no mother process spawning ranks: each host runs
+the same script and `jax.distributed.initialize()` wires the cluster
+from the TPU metadata (or explicit coordinator args elsewhere). This
+module is that entry point plus a tiny CLI for parity:
+
+    python -m paddle_tpu.distributed.launch train.py --args...
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def init_on_cluster(coordinator_address=None, num_processes=None,
+                    process_id=None, local_device_ids=None):
+    """ref capability: launch's rank bring-up. On TPU hosts all args are
+    auto-detected; set them explicitly for CPU/GPU clusters."""
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    if local_device_ids is not None:
+        kwargs.update(local_device_ids=local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    return {
+        'rank': jax.process_index(),
+        'world_size': jax.process_count(),
+        'local_devices': len(jax.local_devices()),
+        'global_devices': jax.device_count(),
+    }
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print('usage: python -m paddle_tpu.distributed.launch SCRIPT [args...]')
+        return 1
+    # initialize the cluster unless the script opts out
+    if os.environ.get('PADDLE_TPU_NO_AUTO_INIT') != '1':
+        try:
+            init_on_cluster()
+        except Exception as e:    # single-host dev boxes
+            print(f'launch: single-process mode ({e})', file=sys.stderr)
+    script, *rest = argv
+    sys.argv = [script] + rest
+    runpy.run_path(script, run_name='__main__')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -251,14 +251,14 @@ class LlamaForCausalLM(Layer):
         return logits, new_caches
 
     def loss(self, input_ids, labels=None):
-        """Next-token cross-entropy, fp32 logits for stability."""
+        """Next-token cross-entropy (fused pallas softmax-xent on TPU)."""
+        from ..ops import softmax_cross_entropy
+
         if labels is None:
             labels = input_ids[:, 1:]
             input_ids = input_ids[:, :-1]
-        logits = self(input_ids).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        logits = self(input_ids)
+        return softmax_cross_entropy(logits, labels).mean()
 
     # -- generation --------------------------------------------------------
     def init_cache(self, batch_size, max_len, dtype=None):

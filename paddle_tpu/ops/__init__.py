@@ -25,3 +25,20 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     from ..nn.functional.norm import rms_norm as _ref
 
     return _ref(x, weight, epsilon)
+
+
+def softmax_cross_entropy(logits, labels):
+    """Fused softmax-xent; pallas on TPU (ops/pallas/softmax_xent.py),
+    lax reference elsewhere. Per-example nll, fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    if _on_tpu() and logits.shape[-1] % 128 == 0:
+        try:
+            from .pallas.softmax_xent import softmax_cross_entropy_with_logits
+
+            return softmax_cross_entropy_with_logits(logits, labels)
+        except Exception:
+            pass
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

@@ -1,0 +1,143 @@
+"""fleet facade, group_sharded, orbax checkpoint, fused softmax-xent
+(SURVEY §2.7 remainder, §2.12)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.llama import LLAMA_TP_RULES, LlamaForCausalLM, llama_tiny
+from paddle_tpu.optimizer import AdamW
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    dist.set_mesh(None)
+
+
+class TestFleet:
+    def test_init_with_hybrid_configs(self):
+        strategy = fleet.init(strategy={'dp_degree': 2, 'mp_degree': 2,
+                                        'sharding_degree': 2})
+        assert strategy.tp_degree == 2 and strategy.fsdp_degree == 2
+        mesh = dist.get_mesh()
+        assert mesh.shape['tp'] == 2 and mesh.shape['fsdp'] == 2
+
+    def test_distributed_model_and_hcg(self):
+        fleet.init(strategy={'mp_degree': 2})
+        model = LlamaForCausalLM(llama_tiny())
+        model = fleet.distributed_model(model, rules=LLAMA_TP_RULES)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 2
+        opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3))
+        state = opt.init(model)
+        assert state is not None
+
+
+class TestGroupSharded:
+    def test_stage3_shards_params(self):
+        mesh = dist.init_parallel_env(fsdp=4, dp=-1)
+        model = LlamaForCausalLM(llama_tiny(hidden_size=64))
+        opt = AdamW(learning_rate=1e-3)
+        model, opt, scaler = dist.group_sharded_parallel(model, opt,
+                                                         level='p_g_os')
+        gate = model.model.layers[0].mlp.gate_proj
+        axes = {a for s in gate.sharding.spec if s
+                for a in (s if isinstance(s, tuple) else (s,))}
+        assert 'fsdp' in axes
+
+    def test_bad_level(self):
+        with pytest.raises(ValueError):
+            dist.group_sharded_parallel(None, None, level='zz')
+
+
+class TestCheckpoint:
+    def test_manager_save_restore(self, tmp_path):
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny(hidden_size=32, layers=1))
+        opt = AdamW(learning_rate=1e-3)
+        state = opt.init(model)
+        mgr = dist.checkpoint.CheckpointManager(str(tmp_path / 'ckpt'),
+                                                async_save=False)
+        mgr.save(0, {'model': model, 'opt': state})
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 0
+
+        pt.seed(1)
+        template = {'model': LlamaForCausalLM(llama_tiny(hidden_size=32,
+                                                         layers=1)),
+                    'opt': opt.init(model)}
+        restored = mgr.restore(0, template)
+        mgr.close()
+        a = model.model.embed_tokens
+        b = restored['model'].model.embed_tokens
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_one_shot_save_load(self, tmp_path):
+        pt.seed(2)
+        model = LlamaForCausalLM(llama_tiny(hidden_size=32, layers=1))
+        dist.save_state_dict(model, str(tmp_path / 'one'))
+        pt.seed(3)
+        template = LlamaForCausalLM(llama_tiny(hidden_size=32, layers=1))
+        restored = dist.load_state_dict(template, str(tmp_path / 'one'))
+        ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+        np.testing.assert_allclose(np.asarray(model(ids)),
+                                   np.asarray(restored(ids)), rtol=1e-6)
+
+    def test_restore_with_resharding(self, tmp_path):
+        """Save replicated, restore onto a tp-sharded template."""
+        pt.seed(4)
+        model = LlamaForCausalLM(llama_tiny(hidden_size=64, layers=1))
+        dist.save_state_dict(model, str(tmp_path / 'rs'))
+        mesh = dist.init_parallel_env(tp=2, dp=-1)
+        template = dist.parallelize(
+            LlamaForCausalLM(llama_tiny(hidden_size=64, layers=1)), mesh,
+            rules=LLAMA_TP_RULES)
+        restored = dist.load_state_dict(template, str(tmp_path / 'rs'))
+        q = restored.model.layers[0].self_attn.q_proj
+        axes = {a for s in q.sharding.spec if s
+                for a in (s if isinstance(s, tuple) else (s,))}
+        assert 'tp' in axes
+        np.testing.assert_allclose(
+            np.asarray(q), np.asarray(model.model.layers[0].self_attn.q_proj))
+
+
+class TestFusedXent:
+    def test_matches_reference(self):
+        from paddle_tpu.ops.pallas.softmax_xent import (
+            softmax_cross_entropy_with_logits)
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(16, 256)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 256, (16,)), jnp.int32)
+        out = softmax_cross_entropy_with_logits(logits, labels)
+        ref = -np.take_along_axis(
+            np.asarray(jax.nn.log_softmax(logits)), np.asarray(labels)[:, None],
+            1)[:, 0]
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_reference(self):
+        from paddle_tpu.ops.pallas.softmax_xent import (
+            softmax_cross_entropy_with_logits)
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(8, 128)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 128, (8,)), jnp.int32)
+        g1 = jax.grad(lambda x: softmax_cross_entropy_with_logits(x, labels)
+                      .mean())(logits)
+        g2 = jax.grad(lambda x: -jnp.take_along_axis(
+            jax.nn.log_softmax(x), labels[:, None], 1).mean())(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_3d_batch(self):
+        from paddle_tpu.ops.pallas.softmax_xent import (
+            softmax_cross_entropy_with_logits)
+
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 128, (2, 8)), jnp.int32)
+        assert softmax_cross_entropy_with_logits(logits, labels).shape == (2, 8)
