@@ -148,3 +148,42 @@ def load(path, **config):
 
 def enable_to_static(flag=True):
     return None
+
+
+def enable_compilation_cache(cache_dir='~/.cache/paddle_tpu/xla_cache',
+                             min_compile_time_secs=1.0):
+    """AOT compile cache (ref capability: CINN compile cache + Paddle's
+    program cache). Wires jax's persistent compilation cache so repeat
+    runs skip XLA compilation entirely."""
+    import jax
+
+    path = os.path.expanduser(cache_dir)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', path)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                      min_compile_time_secs)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    return path
+
+
+def compilation_report(fn, *example_args, **kw):
+    """Compile-time reporting (ref: @to_static build reporting): returns
+    {compile_time_s, flops, bytes, hlo_text_head}."""
+    import time
+
+    jitted = jax.jit(fn, **kw)
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*example_args)
+    compiled = lowered.compile()
+    dt = time.perf_counter() - t0
+    cost = {}
+    try:
+        cost = compiled.cost_analysis() or {}
+    except Exception:
+        pass
+    return {
+        'compile_time_s': dt,
+        'flops': cost.get('flops', 0),
+        'bytes_accessed': cost.get('bytes accessed', 0),
+        'hlo_head': compiled.as_text()[:2000] if hasattr(compiled, 'as_text') else '',
+    }

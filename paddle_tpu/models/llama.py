@@ -48,6 +48,7 @@ class LlamaConfig:
     initializer_range: float = 0.02
     dtype: str = 'float32'                 # param dtype; compute follows
     remat: bool = False                    # jax.checkpoint each decoder layer
+    remat_policy: str = 'dots'             # 'full' | 'dots' (save matmul outs)
 
     @property
     def head_dim(self) -> int:
@@ -209,8 +210,13 @@ class LlamaModel(Layer):
         for i, layer in enumerate(self.layers):
             cache = caches[i] if caches is not None else None
             if use_remat:
+                # 'dots': keep matmul outputs, recompute elementwise — far
+                # cheaper recompute than full remat at slightly more HBM
+                policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                          if self.config.remat_policy == 'dots' else None)
                 x = jax.checkpoint(
-                    lambda lyr, h: lyr(h, positions, attn_mask)[0]
+                    lambda lyr, h: lyr(h, positions, attn_mask)[0],
+                    policy=policy,
                 )(layer, x)
                 nc = None
             else:

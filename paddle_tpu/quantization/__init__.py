@@ -1,0 +1,62 @@
+"""Quantization (ref: python/paddle/quantization + paddle.nn.quant).
+
+PTQ int8 weight-only: `quantize_model` walks a model's Linear layers,
+replacing fp weights with (int8, scale) pairs served by the pallas
+quantized matmul. Absmax observer; per-output-channel scales.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.base import Layer, Parameter
+from ..ops.pallas.quant_matmul import (  # noqa: F401
+    quant_matmul,
+    quantize_weight,
+    weight_only_linear,
+)
+
+
+class QuantizedLinear(Layer):
+    """Weight-only int8 Linear (ref: paddle.nn.quant.weight_only_linear)."""
+
+    def __init__(self, linear=None, weight_quantize_type='abs_max'):
+        super().__init__()
+        if linear is not None:
+            wq, scale = quantize_weight(linear.weight)
+            self.weight_q = Parameter(wq, trainable=False)
+            self.scale = Parameter(scale, trainable=False)
+            self.bias = linear.bias
+            self.in_features = linear.in_features
+            self.out_features = linear.out_features
+
+    def forward(self, x):
+        return weight_only_linear(x, self.weight_q, self.scale, self.bias)
+
+
+def quantize_model(model, quantizable=('Linear',), inplace=False):
+    """PTQ pass: swap matching sublayers for QuantizedLinear.
+
+    Returns the (new) model; original untouched unless inplace.
+    """
+    from ..nn.layer.common import Linear
+
+    if not inplace:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(model)
+        model = jax.tree.unflatten(treedef, leaves)   # structural copy
+    for _, layer in model.named_sublayers(include_self=True):
+        for name, child in list(layer._children()):
+            if isinstance(child, Linear) and 'Linear' in quantizable:
+                object.__setattr__(layer, name, QuantizedLinear(child))
+    return model
+
+
+class PTQ:
+    """ref: paddle.quantization.PTQ facade."""
+
+    def __init__(self, config=None):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        return quantize_model(model, inplace=inplace)

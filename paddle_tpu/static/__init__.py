@@ -1,0 +1,77 @@
+"""Static-graph compatibility namespace (ref: python/paddle/static).
+
+Paddle's static graph is replaced wholesale by jax tracing; this module
+keeps the API names that still make sense: `InputSpec` for shape/dtype
+declarations and the control-flow primitives (`cond`, `while_loop`,
+`case`, `switch_case`) that lower to XLA's structured control flow
+(ref: python/paddle/static/nn/control_flow.py).
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..jit import InputSpec  # noqa: F401
+
+
+def cond(pred, true_fn, false_fn, *operands):
+    """ref: paddle.static.nn.cond → lax.cond (both branches traced)."""
+    return lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """ref: paddle.static.nn.while_loop. loop_vars is a pytree carried
+    through `body_fn`; XLA compiles one rolled loop."""
+    if isinstance(loop_vars, (list, tuple)):
+        out = lax.while_loop(lambda v: cond_fn(*v), lambda v: tuple(body_fn(*v)),
+                             tuple(loop_vars))
+        return list(out) if isinstance(loop_vars, list) else out
+    return lax.while_loop(cond_fn, body_fn, loop_vars)
+
+
+def scan(fn, init, xs, length=None, reverse=False, unroll=1):
+    """lax.scan re-export (the graph-mode RNN/decode primitive)."""
+    return lax.scan(fn, init, xs, length=length, reverse=reverse,
+                    unroll=unroll)
+
+
+def case(pred_fn_pairs, default=None):
+    """ref: paddle.static.nn.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError('pred_fn_pairs must be non-empty')
+
+    def build(pairs):
+        (pred, fn), *rest = pairs
+        if not rest:
+            if default is None:
+                return fn()
+            return lax.cond(pred, fn, default)
+        return lax.cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """ref: paddle.static.nn.switch_case → lax.switch."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map arbitrary keys to dense switch via searchsorted-style select
+        import jax.numpy as jnp
+
+        idx = jnp.sum(jnp.asarray([branch_index == k for k in keys])
+                      * jnp.arange(1, len(keys) + 1)) - 1
+        if default is not None:
+            fns = fns + [default]
+            idx = jnp.where(idx < 0, len(fns) - 1, idx)
+        return lax.switch(jnp.clip(idx, 0, len(fns) - 1), fns)
+    fns = list(branch_fns)
+    if default is not None:
+        fns = fns + [default]
+    return lax.switch(branch_index, fns)
+
+
+# data/name parity shims
+def data(name, shape, dtype='float32', lod_level=0):
+    """ref: paddle.static.data — returns an InputSpec (tracing world)."""
+    return InputSpec(shape, dtype, name)

@@ -1,0 +1,92 @@
+"""static namespace + quantization (SURVEY §2.5 control flow, §2.11 PTQ)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu import static
+from paddle_tpu.quantization import (PTQ, quantize_model, quantize_weight,
+                                     weight_only_linear)
+
+
+class TestStatic:
+    def test_cond(self):
+        out = static.cond(jnp.asarray(True), lambda: 1.0, lambda: 2.0)
+        assert float(out) == 1.0
+
+    def test_while_loop(self):
+        i, s = static.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + i),
+            (jnp.asarray(0), jnp.asarray(0)),
+        )
+        assert int(i) == 5 and int(s) == 10
+
+    def test_scan(self):
+        def body(carry, x):
+            return carry + x, carry + x
+
+        final, outs = static.scan(body, jnp.asarray(0.0), jnp.arange(4.0))
+        assert float(final) == 6.0
+        np.testing.assert_allclose(np.asarray(outs), [0, 1, 3, 6])
+
+    def test_switch_case(self):
+        out = static.switch_case(jnp.asarray(1),
+                                 [lambda: 10.0, lambda: 20.0, lambda: 30.0])
+        assert float(out) == 20.0
+
+    def test_case_default(self):
+        out = static.case([(jnp.asarray(False), lambda: 1.0)],
+                          default=lambda: 9.0)
+        assert float(out) == 9.0
+
+    def test_under_jit(self):
+        @jax.jit
+        def f(n):
+            return static.while_loop(lambda i: i < n, lambda i: i + 2,
+                                     jnp.asarray(0))
+
+        assert int(f(jnp.asarray(7))) == 8
+
+    def test_input_spec_data(self):
+        spec = static.data('x', [None, 8], 'float32')
+        assert spec.shape == (None, 8)
+
+
+class TestQuantization:
+    def test_quantize_weight_roundtrip(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        wq, scale = quantize_weight(w)
+        assert wq.dtype == jnp.int8
+        deq = wq.astype(jnp.float32) * scale[None, :]
+        rel = np.abs(np.asarray(deq - w)).max() / np.abs(np.asarray(w)).max()
+        assert rel < 0.02    # 1/127 quantisation grid
+
+    def test_weight_only_linear_matches_dense(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+        wq, scale = quantize_weight(w)
+        out = weight_only_linear(x, wq, scale, b)
+        ref = x @ w + b
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0.05, atol=0.15)
+
+    def test_quantize_model_swaps_linears(self):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 16)),
+                        jnp.float32)
+        ref = net(x)
+        qnet = PTQ().quantize(net)
+        out = qnet(x)
+        # original untouched
+        from paddle_tpu.nn.layer.common import Linear
+
+        assert isinstance(net.sublayers()[0], Linear)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0.1, atol=0.3)
