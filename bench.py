@@ -45,13 +45,15 @@ def main():
     if on_tpu:
         # 7B dims, depth scaled to single-chip HBM; trimmed vocab keeps the
         # measurement on the decoder blocks (the headline unit).
+        # batch 6, no remat measured best on v5e (14.5k tok/s vs 11.1k with
+        # full remat at batch 4); remat only pays when HBM forces it
         cfg = LlamaConfig(
             vocab_size=8192, hidden_size=4096, intermediate_size=11008,
             num_hidden_layers=4, num_attention_heads=32,
             num_key_value_heads=32, max_position_embeddings=2048,
-            dtype='bfloat16', remat=True,
+            dtype='bfloat16', remat=False,
         )
-        batch, seq, steps = 4, 2048, 10
+        batch, seq, steps = 6, 2048, 10
     else:  # smoke mode for CPU dev boxes
         cfg = LlamaConfig(
             vocab_size=512, hidden_size=256, intermediate_size=512,

@@ -70,7 +70,7 @@ def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
 
     q32 = q.astype(jnp.float32)
 
-    def step(i, carry):
+    def step(carry, i):
         o, m, l, kb, vb = carry
         # kv block currently held originated at rank (rank - i) mod n
         src = (rank - i) % n
@@ -84,12 +84,14 @@ def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
         o, m, l = _merge(o, m, l, ob, mb, lb)
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
-        return o, m, l, kb, vb
+        return (o, m, l, kb, vb), None
 
     o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
-    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    # scan (not fori_loop): reverse-differentiable, so ring attention
+    # trains — the bwd pass rings the gradients back around
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

@@ -49,6 +49,7 @@ class LlamaConfig:
     dtype: str = 'float32'                 # param dtype; compute follows
     remat: bool = False                    # jax.checkpoint each decoder layer
     remat_policy: str = 'dots'             # 'full' | 'dots' (save matmul outs)
+    sequence_parallel: bool = False        # ring attention over the 'sp' axis
 
     @property
     def head_dim(self) -> int:
@@ -107,6 +108,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = config.head_dim
         self.rope_theta = config.rope_theta
+        self.sequence_parallel = config.sequence_parallel
         init = I.Normal(0.0, config.initializer_range)
         h, d = config.hidden_size, self.head_dim
         self.q_proj = Parameter(init((h, self.num_heads * d), config.dtype), spec=P(None, 'tp'))
@@ -130,8 +132,22 @@ class LlamaAttention(Layer):
         k = apply_rotary(k, cos, sin)
 
         if cache is None:
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                                 is_causal=attn_mask is None)
+            out = None
+            if self.sequence_parallel and attn_mask is None:
+                # long-context path: seq sharded over 'sp', KV blocks ring
+                # around the ICI via ppermute — no device holds full KV
+                from ..distributed.mesh import get_mesh
+                from ..distributed.ring_attention import ring_attention_sharded
+
+                mesh = get_mesh()
+                if (mesh is not None and 'sp' in mesh.axis_names
+                        and mesh.shape['sp'] > 1
+                        and S % mesh.shape['sp'] == 0):
+                    out = ring_attention_sharded(q, k, v, mesh, axis='sp',
+                                                 causal=True)
+            if out is None:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
             new_cache = None
         else:
             ck, cv = cache
