@@ -1,0 +1,41 @@
+"""ResNet-50 image classification through the hapi Model API.
+
+    python examples/train_resnet.py
+
+ref workflow parity: paddle.vision tutorial (Model.prepare/fit) with
+the DataLoader's native shared-memory worker path.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.models.resnet import resnet50
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import Cifar10
+
+
+def main():
+    pt.seed(0)
+    transform = T.Compose([
+        T.RandomHorizontalFlip(),
+        T.Normalize(mean=127.5, std=127.5),
+        T.ToTensor(data_format='HWC'),          # NHWC for the TPU conv path
+    ])
+    train_ds = Cifar10(mode='train', transform=transform)
+    test_ds = Cifar10(mode='test', transform=T.Compose([
+        T.Normalize(mean=127.5, std=127.5), T.ToTensor(data_format='HWC')]))
+
+    model = pt.Model(resnet50(num_classes=10))
+    sched = CosineAnnealingDecay(0.1, T_max=10)
+    model.prepare(Momentum(learning_rate=sched, momentum=0.9,
+                           weight_decay=5e-4),
+                  nn.CrossEntropyLoss(), Accuracy(topk=(1, 5)))
+    model.fit(train_ds, test_ds, epochs=2, batch_size=64, verbose=1)
+    print(model.evaluate(test_ds, batch_size=64, verbose=0))
+
+
+if __name__ == '__main__':
+    main()

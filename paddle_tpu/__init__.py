@@ -61,6 +61,7 @@ from .framework.flags import get_flags, set_flags  # noqa: F401
 from .utils.flops import flops  # noqa: F401
 from . import static  # noqa: F401
 from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 

@@ -57,7 +57,7 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
         mb_shape = microbatches.shape[1:]
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-        def tick(t, carry):
+        def tick(carry, t):
             buf, outputs = carry
             # which microbatch this rank works on at tick t
             mb_idx = t - rank
@@ -81,11 +81,13 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
                 outputs,
             )
             buf = lax.ppermute(y, axis, perm)
-            return buf, outputs
+            return (buf, outputs), None
 
         buf0 = jnp.zeros(mb_shape, microbatches.dtype)
         outs0 = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
-        _, outputs = lax.fori_loop(0, n_ticks, tick, (buf0, outs0))
+        # scan (not fori_loop): reverse-differentiable, so the 1F1B/GPipe
+        # backward falls out of jax.grad through the schedule
+        (_, outputs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
         # outputs live on the last rank; psum broadcasts (others hold zeros)
         return lax.psum(outputs, axis)
 

@@ -16,8 +16,10 @@ from .resnet import (  # noqa: F401
 )
 from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
+from . import llama_pp  # noqa: F401
 from . import moe_lm  # noqa: F401
 from . import vision  # noqa: F401
+from .llama_pp import LlamaForCausalLMPipelined  # noqa: F401
 from .bert import BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .moe_lm import MoEConfig, MoEForCausalLM  # noqa: F401

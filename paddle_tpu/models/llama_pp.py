@@ -1,0 +1,87 @@
+"""Pipeline-parallel Llama (ref: fleet/meta_parallel/pipeline_parallel.py
+applied to PaddleNLP Llama: PipelineLayer partitions the decoder stack).
+
+Composition story (SURVEY §2.7 hybrid): embedding + head are
+tp/replicated as usual; the decoder stack runs under the GPipe
+`shard_map` schedule over the 'pp' mesh axis, with tp sharding *inside*
+each stage handled by GSPMD — dp×tp×pp in one jitted train step.
+
+Stage parameters live in a `nn.LayerList` whose leaves carry a leading
+stage axis (sharded over 'pp'), so they are ordinary trainable pytree
+state: `value_and_grad` + optimizer updates see them like any weight.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.pipeline import pipeline_apply, stack_stage_params
+from ..nn import initializer as I
+from ..nn.layer.base import Layer, Parameter
+from .llama import LlamaConfig, LlamaDecoderLayer
+
+
+class LlamaForCausalLMPipelined(Layer):
+    """Llama with its decoder stack partitioned into pp stages.
+
+    Requires config.num_hidden_layers % mesh.shape['pp'] == 0 and
+    batch % n_microbatches == 0.
+    """
+
+    def __init__(self, config: LlamaConfig, mesh, n_microbatches=2):
+        super().__init__()
+        self.config = config
+        n_stages = mesh.shape['pp']
+        if config.num_hidden_layers % n_stages:
+            raise ValueError(
+                f'{config.num_hidden_layers} layers not divisible into '
+                f'{n_stages} pp stages')
+        self.per_stage = config.num_hidden_layers // n_stages
+        self.n_stages = n_stages
+        self._mesh = mesh
+        self._n_micro = n_microbatches
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = Parameter(
+            init((config.vocab_size, config.hidden_size), config.dtype))
+        blocks = [LlamaDecoderLayer(config)
+                  for _ in range(config.num_hidden_layers)]
+        stages = [blocks[s * self.per_stage:(s + 1) * self.per_stage]
+                  for s in range(n_stages)]
+        # list of `per_stage` block-pytrees, leaves stacked (n_stages, ...)
+        self.stage_blocks = nn.LayerList(stack_stage_params(stages))
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = Parameter(
+            init((config.hidden_size, config.vocab_size), config.dtype))
+
+    def forward(self, input_ids):
+        """input_ids: (batch, S); batch % n_microbatches == 0."""
+        B, S = input_ids.shape
+        n = self._n_micro
+        assert B % n == 0, f'batch {B} % microbatches {n} != 0'
+        x = self.embed_tokens[input_ids]                     # (B, S, H)
+        mbs = x.reshape(n, B // n, S, -1)
+
+        per = self.per_stage
+
+        def stage_fn(stage_params, h):
+            mb, s, _ = h.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(s)[None], (mb, s)).astype(jnp.int32)
+            for i in range(per):
+                h, _ = stage_params[i](h, positions)
+            return h
+
+        out = pipeline_apply(list(self.stage_blocks), mbs, stage_fn,
+                             self._mesh, n, axis='pp')
+        hidden = self.norm(out.reshape(B, S, -1))
+        return hidden @ self.lm_head
+
+    def loss(self, input_ids, labels=None):
+        from ..ops import softmax_cross_entropy
+
+        if labels is None:
+            labels = input_ids[:, 1:]
+            input_ids = input_ids[:, :-1]
+        logits = self(input_ids)
+        return softmax_cross_entropy(logits, labels).mean()

@@ -30,6 +30,12 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         self._lr = learning_rate
+        # weight_decay: float (coupled L2, or decoupled in AdamW) or a
+        # paddle_tpu.regularizer.L1Decay/L2Decay object
+        self._wd_regularizer = None
+        if weight_decay is not None and not isinstance(weight_decay, (int, float)):
+            self._wd_regularizer = weight_decay
+            weight_decay = 0.0
         self._weight_decay = 0.0 if weight_decay is None else weight_decay
         self._decoupled_decay = False
         self.grad_clip = grad_clip
@@ -86,6 +92,10 @@ class Optimizer:
         if self._weight_decay and not self._decoupled_decay:
             wd = self._weight_decay
             grads = _tmap(lambda g, p: g + wd * p.astype(g.dtype), grads, t)
+        if self._wd_regularizer is not None:
+            reg = self._wd_regularizer
+            grads = _tmap(
+                lambda g, p: g + reg.grad_term(p).astype(g.dtype), grads, t)
 
         def upd(p, g, *slot_leaves):
             return None  # placeholder; real work below via packed trees

@@ -1,0 +1,43 @@
+"""Regularizers (ref: python/paddle/regularizer.py).
+
+Paddle attaches L1Decay/L2Decay to params or optimizers; here they are
+consumed by `Optimizer` (weight_decay accepts a float — coupled L2 — or
+one of these objects; AdamW applies decoupled decay).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    coeff: float = 0.0
+
+    def grad_term(self, p):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, grads, params):
+        """Add the regularisation gradient term (coupled style)."""
+        return jax.tree.map(
+            lambda g, p: g + self.grad_term(p) if g is not None else None,
+            grads, params)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """ref: paddle.regularizer.L2Decay — grad += coeff * p."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, p):
+        return self.coeff * p
+
+
+class L1Decay(WeightDecayRegularizer):
+    """ref: paddle.regularizer.L1Decay — grad += coeff * sign(p)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, p):
+        return self.coeff * jnp.sign(p)
