@@ -83,11 +83,21 @@ def main():
     float(loss)
     model, state, loss = step(model, state, ids)   # steady-state warmup
     float(loss)
+    # measure host↔device sync latency (the axon tunnel adds ~60ms per
+    # round trip; block_until_ready does NOT block through it, only a
+    # host transfer does) and amortise it over a chained run
+    zero = jnp.zeros(())
+    float(zero + 1)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(zero + 1)
+    sync_latency = (time.perf_counter() - t0) / 5
+
     t0 = time.perf_counter()
     for _ in range(steps):
-        model, state, loss = step(model, state, ids)
-        float(loss)                                # hard sync every step
-    dt = (time.perf_counter() - t0) / steps
+        model, state, loss = step(model, state, ids)   # chained (donated)
+    float(loss)                                        # one hard sync
+    dt = (time.perf_counter() - t0 - sync_latency) / steps
 
     tokens = batch * seq
     tok_per_sec = tokens / dt

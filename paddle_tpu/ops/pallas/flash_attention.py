@@ -19,8 +19,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 1024x1024 measured 8.5x faster than 128x128 on v5e (59.9 vs 7.0 TF/s
+# effective): the grid collapses from ~49k tiny steps to ~770, amortising
+# per-step overhead; VMEM use stays ~6.5MB
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 

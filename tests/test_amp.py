@@ -1,0 +1,114 @@
+"""AMP: auto_cast, decorate O2, GradScaler, master weights, check_numerics
+(SURVEY §2.6)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import amp, nn
+from paddle_tpu.optimizer import AdamW
+
+
+class TestAutoCast:
+    def test_context_dtype(self):
+        assert not amp.is_auto_cast_enabled()
+        with amp.auto_cast(dtype='bfloat16'):
+            assert amp.is_auto_cast_enabled()
+            assert amp.get_amp_dtype() == jnp.bfloat16
+            x = amp.cast_inputs(jnp.ones((4,), jnp.float32))
+            assert x.dtype == jnp.bfloat16
+        assert not amp.is_auto_cast_enabled()
+
+    def test_disabled_passthrough(self):
+        with amp.auto_cast(enable=False):
+            x = amp.cast_inputs(jnp.ones((4,), jnp.float32))
+            assert x.dtype == jnp.float32
+
+
+class TestDecorate:
+    def test_o2_casts_params_and_sets_master(self):
+        pt.seed(0)
+        net = nn.Linear(8, 8)
+        opt = AdamW(learning_rate=1e-3)
+        net, opt = amp.decorate(net, opt, level='O2', dtype='bfloat16')
+        assert net.weight.dtype == jnp.bfloat16
+        assert opt.multi_precision
+
+    def test_master_weights_in_opt_state(self):
+        pt.seed(1)
+        net = nn.Linear(4, 4)
+        opt = AdamW(learning_rate=1e-2)
+        net, opt = amp.decorate(net, opt, level='O2', dtype='bfloat16')
+        state = opt.init(net)
+        masters = [m for m in jax.tree.leaves(state['master'])]
+        assert all(m.dtype == jnp.float32 for m in masters)
+
+        # master weights accumulate small updates bf16 params would lose
+        x = jnp.ones((2, 4), jnp.bfloat16)
+
+        @jax.jit
+        def step(net, state):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: (m(x).astype(jnp.float32) ** 2).mean())(net)
+            return opt.apply_gradients(net, grads, state) + (loss,)
+
+        net2, state2, _ = step(net, state)
+        assert net2.weight.dtype == jnp.bfloat16
+        m2 = jax.tree.leaves(state2['master'])[0]
+        assert m2.dtype == jnp.float32
+
+
+class TestGradScaler:
+    def test_bf16_noop_scale(self):
+        s = amp.GradScaler(enable=False)
+        loss = jnp.asarray(2.0)
+        assert float(s.scale(loss)) == 2.0
+
+    def test_fp16_dynamic_scaling(self):
+        s = amp.GradScaler(init_loss_scaling=16.0, incr_every_n_steps=2)
+        assert float(s.scale(jnp.asarray(1.0))) == 16.0
+        grads = {'g': jnp.asarray([1.0, jnp.inf])}
+        assert s.found_inf(grads)
+        s.update(found_inf=True)
+        assert s.get_loss_scaling() == 8.0
+        s.update(found_inf=False)
+        s.update(found_inf=False)
+        assert s.get_loss_scaling() == 16.0
+
+    def test_unscale(self):
+        s = amp.GradScaler(init_loss_scaling=4.0)
+        g = s.unscale_({'g': jnp.asarray([4.0])})
+        np.testing.assert_allclose(np.asarray(g['g']), [1.0])
+
+
+class TestCheckNumerics:
+    def test_finite_passes(self):
+        out = amp.check_numerics(jnp.ones((4,)), 'op', 'x')
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestIndexing:
+    """Basic/advanced __getitem__ + functional __setitem__ (SURVEY §2.1)."""
+
+    def test_basic_slicing(self):
+        x = pt.arange(24).reshape(2, 3, 4)
+        assert x[0].shape == (3, 4)
+        assert x[:, 1].shape == (2, 4)
+        assert x[..., -1].shape == (2, 3)
+        assert x[0, 1, 2] == 6
+
+    def test_advanced_indexing(self):
+        x = pt.arange(12).reshape(3, 4)
+        idx = jnp.asarray([0, 2])
+        np.testing.assert_array_equal(np.asarray(x[idx]),
+                                      np.arange(12).reshape(3, 4)[[0, 2]])
+        mask = x > 5
+        assert int(x[mask].sum()) == sum(range(6, 12))
+
+    def test_functional_setitem(self):
+        x = pt.zeros((3, 3))
+        y = x.at[1, 1].set(5.0)
+        assert float(y[1, 1]) == 5.0 and float(x[1, 1]) == 0.0
+        z = x.at[:, 0].add(1.0)
+        np.testing.assert_allclose(np.asarray(z[:, 0]), np.ones(3))
