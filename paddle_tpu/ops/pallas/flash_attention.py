@@ -46,27 +46,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)                 # (bq, D)
-    k = k_ref[0, 0].astype(jnp.float32)                 # (bk, D)
-    v = v_ref[0, 0].astype(jnp.float32)
+    # causal block skip: whole q block above the diagonal → contributes 0
+    live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
 
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
-    if causal:
-        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-        s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)             # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)             # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
 
-    m_prev = m_scr[:, 0]                                 # (bq,)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    p = jnp.exp(s - m_new[:, None])
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
-    acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
+            s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+
+        m_prev = m_scr[:, 0]                             # (bq,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     @pl.when(ik == nk - 1)
     def _():
