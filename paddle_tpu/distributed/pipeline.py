@@ -24,6 +24,7 @@ import typing
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -120,6 +121,288 @@ def pipeline_apply(stacked_params, microbatches, stage_fn, mesh: Mesh,
     return fn(stacked_params, microbatches)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B schedule (ref: fleet/meta_parallel/pipeline_parallel.py::
+# forward_backward_pipeline — steady-state one-forward-one-backward)
+# ---------------------------------------------------------------------------
+
+def build_1f1b_schedule(n_stages: int, n_micro: int):
+    """Static 1F1B timetable via greedy simulation with in-flight caps.
+
+    Stage s keeps at most (n_stages - s) microbatches in flight (the
+    classic 1F1B warmup depth), prefers backward when one is ready
+    (drains activation memory ASAP), and respects the 1-tick ppermute
+    communication latency between neighbouring stages.
+
+    Returns dict of numpy int32 tables, each (T, n_stages), entry = the
+    microbatch index the stage handles at that tick (-1 = none):
+      fwd / bwd          — compute
+      recv_act / recv_grad — message arriving at tick start (stored into
+                             the act/grad queues before compute)
+    plus queue depths (act_q, grad_q, stash) validated collision-free.
+    """
+    p, M = n_stages, n_micro
+    INF = 1 << 30
+    fwd_done = [[INF] * M for _ in range(p)]
+    bwd_done = [[INF] * M for _ in range(p)]
+    next_f, next_b = [0] * p, [0] * p
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        frow, brow = [-1] * p, [-1] * p
+        for s in range(p):
+            mb, mf = next_b[s], next_f[s]
+            bwd_ready = mb < M and fwd_done[s][mb] < t and (
+                s == p - 1 or bwd_done[s + 1][mb] < t)
+            fwd_ready = mf < M and (mf - mb) < (p - s) and (
+                s == 0 or fwd_done[s - 1][mf] < t)
+            if bwd_ready:
+                brow[s] = mb
+                bwd_done[s][mb] = t
+                next_b[s] += 1
+            elif fwd_ready:
+                frow[s] = mf
+                fwd_done[s][mf] = t
+                next_f[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > 4 * (M + p) + 16:       # safety: schedule must converge
+            raise RuntimeError('1f1b schedule did not converge')
+    T = t
+    fwd_tab = np.asarray(fwd_rows, np.int32)
+    bwd_tab = np.asarray(bwd_rows, np.int32)
+
+    # message-arrival tables: sent at end of tick t-1, usable at tick t
+    recv_act = np.full((T, p), -1, np.int32)
+    recv_grad = np.full((T, p), -1, np.int32)
+    recv_act[1:, 1:] = fwd_tab[:-1, :-1]
+    recv_grad[1:, :-1] = bwd_tab[:-1, 1:]
+
+    def _min_depth(store_tick, consume_tick, pairs):
+        # smallest Q such that no slot (m % Q) is overwritten while the
+        # previous occupant is still unread (store precedes consume
+        # within a tick, so a same-tick store/consume of different mbs
+        # collides)
+        for Q in range(1, M + 1):
+            ok = True
+            for (s, m) in pairs:
+                m2 = m + Q
+                if m2 < M:
+                    st2 = store_tick(s, m2)
+                    if st2 is not None and st2 <= consume_tick(s, m):
+                        ok = False
+                        break
+            if ok:
+                return Q
+        return M
+
+    pairs = [(s, m) for s in range(p) for m in range(M)]
+    act_depth = _min_depth(
+        lambda s, m: fwd_done[s - 1][m] + 1 if s >= 1 else None,
+        lambda s, m: fwd_done[s][m], pairs)
+    grad_depth = _min_depth(
+        lambda s, m: bwd_done[s + 1][m] + 1 if s < p - 1 else None,
+        lambda s, m: bwd_done[s][m], pairs)
+    stash_depth = _min_depth(
+        lambda s, m: fwd_done[s][m],
+        lambda s, m: bwd_done[s][m], pairs)
+    return {
+        'fwd': fwd_tab, 'bwd': bwd_tab,
+        'recv_act': recv_act, 'recv_grad': recv_grad,
+        'act_q': act_depth, 'grad_q': grad_depth, 'stash': stash_depth,
+        'ticks': T,
+    }
+
+
+def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
+                  stage_fn, loss_fn, mesh: Mesh, n_microbatches: int,
+                  axis='pp'):
+    """1F1B fused forward+backward (ref: pipeline_parallel.py 1F1B).
+
+    Hand-scheduled fwd/bwd interleave: each stage stashes only the
+    *inputs* of its in-flight microbatches (≤ n_stages - s of them, vs
+    GPipe's O(n_microbatches) scan residuals) and recomputes the stage
+    forward inside `jax.vjp` when the microbatch's backward tick fires —
+    the remat-style 1F1B every production pipeline uses.
+
+    stage_fn(stage_params, x) -> y        (y.shape == x.shape)
+    loss_fn(extra_params, y, target) -> scalar  (runs on the LAST stage)
+
+    Returns (loss, d_stacked, d_extra, d_microbatches): mean loss over
+    microbatches and the matching parameter/input cotangents.
+    """
+    p = mesh.shape[axis]
+    M = n_microbatches
+    if microbatches.shape[0] != M or targets.shape[0] != M:
+        raise ValueError(
+            f'microbatches/targets leading dim ({microbatches.shape[0]}/'
+            f'{targets.shape[0]}) must equal n_microbatches ({M})')
+    sched = build_1f1b_schedule(p, M)
+    fwd_tab = jnp.asarray(sched['fwd'])
+    bwd_tab = jnp.asarray(sched['bwd'])
+    ra_tab = jnp.asarray(sched['recv_act'])
+    rg_tab = jnp.asarray(sched['recv_grad'])
+    Qa, Qg, S = sched['act_q'], sched['grad_q'], sched['stash']
+    T = sched['ticks']
+    perm_f = [(i, (i + 1) % p) for i in range(p)]
+    perm_b = [(i, (i - 1) % p) for i in range(p)]
+
+    mb_shape = microbatches.shape[1:]
+    mb_dtype = microbatches.dtype
+
+    def body(params, extra, mbs, tgts):
+        rank = lax.axis_index(axis)
+        local = jax.tree.map(lambda x: x[0], params)   # strip stage axis
+
+        zeros_mb = jnp.zeros(mb_shape, mb_dtype)
+        zeros_p = jax.tree.map(jnp.zeros_like, local)
+        zeros_e = jax.tree.map(jnp.zeros_like, extra)
+
+        def tick(carry, t):
+            (act_q, grad_q, stash, act_msg, grad_msg,
+             pgrad, egrad, dmbs, loss_acc) = carry
+            fm = fwd_tab[t, rank]
+            bm = bwd_tab[t, rank]
+            ram = ra_tab[t, rank]
+            rgm = rg_tab[t, rank]
+
+            # 1. receive (store precedes compute: warmup consumes the
+            #    act that arrived this very tick)
+            act_q = lax.cond(
+                ram >= 0,
+                lambda aq: lax.dynamic_update_index_in_dim(
+                    aq, act_msg, jnp.clip(ram, 0) % Qa, 0),
+                lambda aq: aq, act_q)
+            grad_q = lax.cond(
+                rgm >= 0,
+                lambda gq: lax.dynamic_update_index_in_dim(
+                    gq, grad_msg, jnp.clip(rgm, 0) % Qg, 0),
+                lambda gq: gq, grad_q)
+
+            # 2. forward (cond: ranks with no fwd this tick skip compute)
+            def do_fwd(stash):
+                fresh = lax.dynamic_index_in_dim(
+                    mbs, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+                queued = lax.dynamic_index_in_dim(
+                    act_q, jnp.clip(fm, 0) % Qa, 0, keepdims=False)
+                x = jnp.where(rank == 0, fresh, queued)
+                y = stage_fn(local, x)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, x, jnp.clip(fm, 0) % S, 0)
+                return stash, y
+
+            stash, act_out = lax.cond(
+                fm >= 0, do_fwd, lambda st: (st, zeros_mb), stash)
+
+            # 3. backward (recompute-vjp on the stashed input)
+            def do_bwd(args):
+                pgrad, egrad, dmbs, loss_acc = args
+                x = lax.dynamic_index_in_dim(
+                    stash, jnp.clip(bm, 0) % S, 0, keepdims=False)
+                g_in = lax.dynamic_index_in_dim(
+                    grad_q, jnp.clip(bm, 0) % Qg, 0, keepdims=False)
+                tgt = lax.dynamic_index_in_dim(
+                    tgts, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+
+                def last_stage(_):
+                    def f(par, ex, xx):
+                        return loss_fn(ex, stage_fn(par, xx), tgt)
+
+                    lval, vjp = jax.vjp(f, local, extra, x)
+                    dpar, dex, dx = vjp(jnp.ones((), lval.dtype))
+                    return dpar, dex, dx, lval
+
+                def mid_stage(_):
+                    _, vjp = jax.vjp(lambda par, xx: stage_fn(par, xx),
+                                     local, x)
+                    dpar, dx = vjp(g_in)
+                    return dpar, zeros_e, dx, jnp.zeros((), jnp.float32)
+
+                dpar, dex, dx, lval = lax.cond(
+                    rank == p - 1, last_stage, mid_stage, None)
+                pgrad = jax.tree.map(jnp.add, pgrad, dpar)
+                egrad = jax.tree.map(jnp.add, egrad, dex)
+                # stage 0's input-cotangent feeds the outer embedding vjp
+                dmbs = lax.cond(
+                    rank == 0,
+                    lambda d: lax.dynamic_update_index_in_dim(
+                        d, dx.astype(d.dtype), jnp.clip(bm, 0, M - 1), 0),
+                    lambda d: d, dmbs)
+                return (pgrad, egrad, dmbs, loss_acc + lval), dx
+
+            (pgrad, egrad, dmbs, loss_acc), grad_out = lax.cond(
+                bm >= 0, do_bwd,
+                lambda args: (args, zeros_mb),
+                (pgrad, egrad, dmbs, loss_acc))
+
+            # 4. rotate: activations ride +1, gradients ride -1
+            act_msg = lax.ppermute(act_out, axis, perm_f)
+            grad_msg = lax.ppermute(grad_out, axis, perm_b)
+            return (act_q, grad_q, stash, act_msg, grad_msg,
+                    pgrad, egrad, dmbs, loss_acc), None
+
+        init = (
+            jnp.zeros((Qa,) + mb_shape, mb_dtype),
+            jnp.zeros((Qg,) + mb_shape, mb_dtype),
+            jnp.zeros((S,) + mb_shape, mb_dtype),
+            zeros_mb, zeros_mb,
+            zeros_p, zeros_e,
+            jnp.zeros((M,) + mb_shape, mb_dtype),
+            jnp.zeros((), jnp.float32),
+        )
+        carry, _ = lax.scan(tick, init, jnp.arange(T))
+        (_, _, _, _, _, pgrad, egrad, dmbs, loss_acc) = carry
+        # loss/extra-grads/input-grads live on single ranks; psum shares
+        loss = lax.psum(loss_acc, axis) / M
+        egrad = jax.tree.map(lambda g: lax.psum(g, axis) / M, egrad)
+        dmbs = lax.psum(dmbs, axis) / M
+        pgrad = jax.tree.map(lambda g: g[None] / M, pgrad)  # re-add stage axis
+        return loss, pgrad, egrad, dmbs
+
+    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P(), P(), P()),
+        out_specs=(P(), param_specs, P(), P()),
+        check_vma=False,
+    )
+    return fn(stacked_params, extra_params, microbatches, targets)
+
+
+def pipeline_1f1b_loss(stacked_params, extra_params, microbatches, targets,
+                       stage_fn, loss_fn, mesh: Mesh, n_microbatches: int,
+                       axis='pp'):
+    """Differentiable scalar 1F1B loss: composes with outer `jax.grad`.
+
+    custom_vjp wrapper — the forward pass runs the fused 1F1B schedule
+    (which produces the parameter/input grads as a by-product) and
+    caches them; the backward just scales by the incoming cotangent. An
+    outer `value_and_grad` therefore drives the whole pipelined train
+    step while activation residency stays O(n_stages).
+    """
+    def run(stacked, extra, mbs, tgts):
+        return pipeline_1f1b(stacked, extra, mbs, tgts, stage_fn, loss_fn,
+                             mesh, n_microbatches, axis)
+
+    @jax.custom_vjp
+    def f(stacked, extra, mbs, tgts):
+        loss, _, _, _ = run(stacked, extra, mbs, tgts)
+        return loss
+
+    def f_fwd(stacked, extra, mbs, tgts):
+        loss, dp, de, dm = run(stacked, extra, mbs, tgts)
+        return loss, (dp, de, dm)
+
+    def f_bwd(res, g):
+        dp, de, dm = res
+        scale = lambda t: jax.tree.map(lambda x: x * g, t)
+        return scale(dp), scale(de), scale(dm), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(stacked_params, extra_params, microbatches, targets)
+
+
 class PipelineLayer:
     """ref: paddle.distributed.fleet.meta_parallel.PipelineLayer —
     user-facing wrapper: partition a LayerList of blocks into pp stages.
@@ -129,7 +412,10 @@ class PipelineLayer:
     """
 
     def __init__(self, blocks, mesh: Mesh, n_microbatches: int = 4,
-                 block_fn=None, axis='pp'):
+                 block_fn=None, axis='pp', schedule='gpipe'):
+        if schedule not in ('gpipe', '1f1b'):
+            raise ValueError(f"schedule must be 'gpipe'|'1f1b', got {schedule}")
+        self.schedule = schedule
         n_stages = mesh.shape[axis]
         if len(blocks) % n_stages:
             raise ValueError(
@@ -158,3 +444,27 @@ class PipelineLayer:
 
         return pipeline_apply(self.stacked, microbatches, stage_fn, self.mesh,
                               self.n_microbatches, self.axis)
+
+    def loss(self, microbatches, targets, loss_fn, extra_params=None):
+        """Differentiable pipelined loss under the configured schedule.
+
+        loss_fn(extra_params, y, target) -> scalar, applied per
+        microbatch on the last stage. Under '1f1b' the fused
+        forward/backward schedule runs (live activations O(n_stages));
+        under 'gpipe' the loss is computed on the forward outputs and
+        the backward falls out of jax.grad through the scan.
+        """
+        extra = extra_params if extra_params is not None else {}
+
+        def stage_fn(params, x):
+            return self._stage_fn(params, x)
+
+        if self.schedule == '1f1b':
+            return pipeline_1f1b_loss(
+                self.stacked, extra, microbatches, targets, stage_fn,
+                loss_fn, self.mesh, self.n_microbatches, self.axis)
+        outs = pipeline_apply(self.stacked, microbatches, stage_fn,
+                              self.mesh, self.n_microbatches, self.axis)
+        losses = [loss_fn(extra, outs[m], targets[m])
+                  for m in range(self.n_microbatches)]
+        return jnp.mean(jnp.stack(losses))

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
-from ..distributed.pipeline import pipeline_apply, stack_stage_params
+from ..distributed.pipeline import (pipeline_1f1b_loss, pipeline_apply,
+                                    stack_stage_params)
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
 from .llama import LlamaConfig, LlamaDecoderLayer
@@ -29,8 +30,12 @@ class LlamaForCausalLMPipelined(Layer):
     batch % n_microbatches == 0.
     """
 
-    def __init__(self, config: LlamaConfig, mesh, n_microbatches=2):
+    def __init__(self, config: LlamaConfig, mesh, n_microbatches=2,
+                 schedule='gpipe'):
         super().__init__()
+        if schedule not in ('gpipe', '1f1b'):
+            raise ValueError(f"schedule must be 'gpipe'|'1f1b', got {schedule}")
+        self.schedule = schedule
         self.config = config
         n_stages = mesh.shape['pp']
         if config.num_hidden_layers % n_stages:
@@ -62,6 +67,12 @@ class LlamaForCausalLMPipelined(Layer):
         x = self.embed_tokens[input_ids]                     # (B, S, H)
         mbs = x.reshape(n, B // n, S, -1)
 
+        out = pipeline_apply(list(self.stage_blocks), mbs, self._stage_fn(),
+                             self._mesh, n, axis='pp')
+        hidden = self.norm(out.reshape(B, S, -1))
+        return hidden @ self.lm_head
+
+    def _stage_fn(self):
         per = self.per_stage
 
         def stage_fn(stage_params, h):
@@ -72,10 +83,7 @@ class LlamaForCausalLMPipelined(Layer):
                 h, _ = stage_params[i](h, positions)
             return h
 
-        out = pipeline_apply(list(self.stage_blocks), mbs, stage_fn,
-                             self._mesh, n, axis='pp')
-        hidden = self.norm(out.reshape(B, S, -1))
-        return hidden @ self.lm_head
+        return stage_fn
 
     def loss(self, input_ids, labels=None):
         from ..ops import softmax_cross_entropy
@@ -83,5 +91,31 @@ class LlamaForCausalLMPipelined(Layer):
         if labels is None:
             labels = input_ids[:, 1:]
             input_ids = input_ids[:, :-1]
+        if self.schedule == '1f1b':
+            return self._loss_1f1b(input_ids, labels)
         logits = self(input_ids)
         return softmax_cross_entropy(logits, labels).mean()
+
+    def _loss_1f1b(self, input_ids, labels):
+        """1F1B fused fwd/bwd: loss (norm+head+xent) runs on the LAST
+        stage per microbatch so backward starts while later microbatches
+        are still in flight; live activations stay O(n_stages) (ref:
+        pipeline_parallel.py::forward_backward_pipeline 1F1B)."""
+        from ..ops import softmax_cross_entropy
+
+        B, S = input_ids.shape
+        n = self._n_micro
+        assert B % n == 0, f'batch {B} % microbatches {n} != 0'
+        x = self.embed_tokens[input_ids]                   # (B, S, H)
+        mbs = x.reshape(n, B // n, S, -1)
+        tgts = labels.reshape(n, B // n, S)
+        extra = {'norm': self.norm, 'head': self.lm_head}
+
+        def loss_fn(extra, y, tgt):
+            hidden = extra['norm'](y)
+            logits = hidden @ extra['head']
+            return softmax_cross_entropy(logits, tgt).mean()
+
+        return pipeline_1f1b_loss(
+            list(self.stage_blocks), extra, mbs, tgts, self._stage_fn(),
+            loss_fn, self._mesh, n, axis='pp')

@@ -56,12 +56,14 @@ def scaled_dot_product_attention(
     rng_key=None,
 ):
     """Flash attention on TPU; lax reference elsewhere/with masks it can't take."""
+    from ...ops import use_pallas
+
     use_flash = (
         dropout_p == 0.0
         and attn_mask is None
         and query.shape[-1] % 8 == 0
         and query.shape[1] >= 128
-        and jax.default_backend() not in ('cpu',)
+        and use_pallas()
     )
     if use_flash:
         try:

@@ -10,12 +10,22 @@ import jax
 
 
 def _on_tpu():
-    return jax.default_backend() not in ('cpu',)
+    # TPU only: pallas-in-interpret on other accelerators is orders of
+    # magnitude slower than the lax fallback
+    return jax.default_backend() == 'tpu'
+
+
+def use_pallas():
+    """True when pallas fast paths should dispatch (TPU + flag on)."""
+    from ..framework.flags import get_flags
+
+    return _on_tpu() and get_flags(['FLAGS_use_pallas_kernels'])[
+        'FLAGS_use_pallas_kernels']
 
 
 def rms_norm(x, weight=None, epsilon=1e-6):
     """Fused RMSNorm; pallas kernel on TPU (ops/pallas/rms_norm.py)."""
-    if _on_tpu() and x.shape[-1] % 128 == 0 and x.dtype != jax.numpy.float64:
+    if use_pallas() and x.shape[-1] % 128 == 0 and x.dtype != jax.numpy.float64:
         try:
             from .pallas.rms_norm import rms_norm as _k
 
@@ -33,7 +43,9 @@ def softmax_cross_entropy(logits, labels):
     import jax
     import jax.numpy as jnp
 
-    if _on_tpu() and logits.shape[-1] % 128 == 0:
+    # any vocab size: the kernel masks the padded tail block (the guard
+    # only excludes degenerate tiny vocabs where tiling can't help)
+    if use_pallas() and logits.shape[-1] >= 128:
         try:
             from .pallas.softmax_xent import softmax_cross_entropy_with_logits
 

@@ -36,9 +36,10 @@ def _interpret():
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, bq, bk, nk, offset):
+                scale, causal, bq, bk, nk, offset, Sq, Sk):
     ik = pl.program_id(3)
     iq = pl.program_id(2)
+    k_tail = Sk % bk != 0                               # static
 
     @pl.when(ik == 0)
     def _():
@@ -54,14 +55,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         q = q_ref[0, 0].astype(jnp.float32)             # (bq, D)
         k = k_ref[0, 0].astype(jnp.float32)             # (bk, D)
         v = v_ref[0, 0].astype(jnp.float32)
+        if k_tail:
+            # padded key rows read unspecified memory; zero v so the
+            # (masked-to-zero-prob) tail can't inject inf/nan into acc
+            vrow = ik * bk + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+            v = jnp.where(vrow < Sk, v, 0.0)
 
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
+        if causal or k_tail:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-            s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+            # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq),
+            # merged with the key-tail validity mask
+            ok = (qpos + offset >= kpos) if causal else True
+            if k_tail:
+                ok = ok & (kpos < Sk) if causal else (kpos < Sk)
+            s = jnp.where(ok, s, NEG_INF)
 
         m_prev = m_scr[:, 0]                             # (bq,)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -78,9 +88,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         l = l_scr[:, 0]
         safe = jnp.maximum(l, 1e-30)
         o_ref[0, 0] = (acc[:] / safe[:, None]).astype(o_ref.dtype)
-        # lse stored (bq, 1): TPU block tiling wants the trailing dims
-        # (divisible-by-8, ==array-dim) — a rank-4 (B,H,Sq,1) array obeys
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(safe))[:, None]
+        # lse stored (B,H,1,Sq): Sq on the lane dim — a (B,H,Sq,1) layout
+        # pads the trailing 1 to 128 lanes in HBM (128x expansion, ~190MB
+        # at 7B bench shapes)
+        lse_ref[0, 0, 0] = m_scr[:, 0] + jnp.log(safe)
 
 
 def _fwd(q, k, v, scale, causal, bq, bk):
@@ -93,7 +104,8 @@ def _fwd(q, k, v, scale, causal, bq, bk):
     nq, nk = pl.cdiv(Sq, bq), pl.cdiv(Sk, bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, offset=Sk - Sq)
+                               bq=bq, bk=bk, nk=nk, offset=Sk - Sq,
+                               Sq=Sq, Sk=Sk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -104,11 +116,11 @@ def _fwd(q, k, v, scale, causal, bq, bk):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 1, Sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -125,9 +137,10 @@ def _fwd(q, k, v, scale, causal, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, bq, bk, nk, offset):
+                   dq_acc, *, scale, causal, bq, bk, nk, offset, Sq, Sk):
     ik = pl.program_id(3)
     iq = pl.program_id(2)
+    k_tail = Sk % bk != 0                                # static
 
     @pl.when(ik == 0)
     def _():
@@ -137,20 +150,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]                            # (bq,)
-    delta = delta_ref[0, 0, :, 0]                        # (bq,)
+    lse = lse_ref[0, 0, 0]                               # (bq,)
+    delta = delta_ref[0, 0, 0]                           # (bq,)
+    if k_tail:
+        krow = ik * bk + jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
+        k = jnp.where(krow < Sk, k, 0.0)
+        v = jnp.where(krow < Sk, v, 0.0)
 
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    if causal:
+    kvalid = True
+    if causal or k_tail:
         qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-        s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+        ok = (qpos + offset >= kpos) if causal else True
+        if k_tail:
+            kvalid = kpos < Sk
+            ok = (ok & kvalid) if causal else kvalid
+        s = jnp.where(ok, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])                        # (bq, bk)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None])
+    if k_tail:
+        ds = jnp.where(kvalid, ds, 0.0)
     dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -161,9 +185,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq,
-                    offset):
+                    offset, Sq, Sk):
     iq = pl.program_id(3)
     ik = pl.program_id(2)
+    q_tail = Sq % bq != 0                                # static
 
     @pl.when(iq == 0)
     def _():
@@ -174,8 +199,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
+    lse = lse_ref[0, 0, 0]                               # (bq,)
+    delta = delta_ref[0, 0, 0]                           # (bq,)
+    qvalid = True
+    if q_tail:
+        # padded query rows read unspecified q/do/lse/delta — they would
+        # contaminate the dk/dv sums over the query axis. Zero the loads
+        # and (below) the p/ds rows.
+        qrow = iq * bq + jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
+        q = jnp.where(qrow < Sq, q, 0.0)
+        do = jnp.where(qrow < Sq, do, 0.0)
+        qvalid = iq * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0) < Sq
 
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
@@ -185,11 +220,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
         s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])
+    if q_tail:
+        p = jnp.where(qvalid, p, 0.0)
     dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None])
+    if q_tail:
+        ds = jnp.where(qvalid, ds, 0.0)
     dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -209,20 +248,22 @@ def _bwd(scale, causal, bq, bk, res, g):
     bk_ = min(bk, Sk)
     nq, nk = pl.cdiv(Sq, bq_), pl.cdiv(Sk, bk_)
 
+    # (B, H, 1, Sq): Sq on the lane dim to avoid 128x HBM padding
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)              # (B, H, Sq, 1)
+                    axis=-1)[:, :, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq_, bk=bk_, nk=nk, offset=Sk - Sq),
+                          bq=bq_, bk=bk_, nk=nk, offset=Sk - Sq,
+                          Sq=Sq, Sk=Sk),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq_, 1), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq_, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
@@ -233,15 +274,16 @@ def _bwd(scale, causal, bq, bk, res, g):
     # per-q-head dk/dv, then reduce GQA groups
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq_, bk=bk_, nq=nq, offset=Sk - Sq),
+                          bq=bq_, bk=bk_, nq=nq, offset=Sk - Sq,
+                          Sq=Sq, Sk=Sk),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq_, 1), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq_, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h, j, 0)),

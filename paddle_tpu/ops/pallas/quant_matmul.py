@@ -28,7 +28,7 @@ def quantize_weight(w, axis=0):
     return q.astype(jnp.int8), scale.reshape(-1)
 
 
-def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk):
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K):
     k = pl.program_id(2)
 
     @pl.when(k == 0)
@@ -37,6 +37,13 @@ def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk):
 
     x = x_ref[:].astype(jnp.float32)                     # (bm, bk)
     w = w_ref[:].astype(jnp.float32)                     # (bk, bn) dequant in VMEM
+    if K % bk:
+        # tail K block: the padded x columns / w rows read unspecified
+        # memory — zero them out of the accumulation
+        kcol = k * bk + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(kcol < K, x, 0.0)
+        krow = k * bk + jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
+        w = jnp.where(krow < K, w, 0.0)
     acc[:] = acc[:] + jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -55,7 +62,7 @@ def quant_matmul(x, wq, scale, block_m=256, block_n=256, block_k=512,
     bm, bn, bk = min(block_m, M), min(block_n, N), min(block_k, K)
     nk = pl.cdiv(K, bk)
     return pl.pallas_call(
-        functools.partial(_kernel, nk=nk),
+        functools.partial(_kernel, nk=nk, bk=bk, K=K),
         grid=(pl.cdiv(M, bm), pl.cdiv(N, bn), nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),

@@ -27,7 +27,7 @@ def _interpret():
 
 
 def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,
-                bv, nv):
+                bv, nv, V):
     """grid (row_blocks, vocab_blocks); scratch persists across vocab steps."""
     j = pl.program_id(1)
 
@@ -39,6 +39,12 @@ def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,
 
     x = x_ref[:].astype(jnp.float32)                    # (br, bv)
     label = label_ref[:, 0]                             # (br,)
+    br = x.shape[0]
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (br, x.shape[1]), 1)
+    if V % bv:
+        # tail vocab block: the padded columns read unspecified memory —
+        # mask them out of the running max / sum-exp
+        x = jnp.where(cols < V, x, NEG_INF)
     m_prev = m_scr[:, 0]
     m_new = jnp.maximum(m_prev, jnp.max(x, axis=-1))
     alpha = jnp.exp(m_prev - m_new)
@@ -47,8 +53,6 @@ def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,
     l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     # pick this block's label logit if the label falls in [j*bv, (j+1)*bv)
-    br = x.shape[0]
-    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (br, x.shape[1]), 1)
     hit = cols == label[:, None]
     picked = jnp.max(jnp.where(hit, x, NEG_INF), axis=-1)
     p_scr[:] = jnp.maximum(p_scr[:], jnp.broadcast_to(picked[:, None],
@@ -61,15 +65,18 @@ def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,
         lse_ref[:, 0] = lse
 
 
-def _bwd_kernel(x_ref, label_ref, lse_ref, g_ref, dx_ref, *, bv):
+def _bwd_kernel(x_ref, label_ref, lse_ref, g_ref, dx_ref, *, bv, V):
     j = pl.program_id(1)
     x = x_ref[:].astype(jnp.float32)
     label = label_ref[:, 0]
     lse = lse_ref[:, 0]
     g = g_ref[:, 0]
-    p = jnp.exp(x - lse[:, None])                       # softmax block
     br = x.shape[0]
     cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (br, x.shape[1]), 1)
+    p = jnp.exp(x - lse[:, None])                       # softmax block
+    if V % bv:
+        # tail block: exp(garbage) can be inf/nan — force dx=0 off-vocab
+        p = jnp.where(cols < V, p, 0.0)
     onehot = (cols == label[:, None]).astype(jnp.float32)
     dx_ref[:] = ((p - onehot) * g[:, None]).astype(dx_ref.dtype)
 
@@ -84,7 +91,7 @@ def _run_fwd(x2, labels):
     R, V = x2.shape
     br, bv = _block_sizes(R, V)
     loss, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, bv=bv, nv=pl.cdiv(V, bv)),
+        functools.partial(_fwd_kernel, bv=bv, nv=pl.cdiv(V, bv), V=V),
         grid=(pl.cdiv(R, br), pl.cdiv(V, bv)),
         in_specs=[
             pl.BlockSpec((br, bv), lambda i, j: (i, j)),
@@ -124,7 +131,7 @@ def _xent_bwd(res, g):
     R, V = x2.shape
     br, bv = _block_sizes(R, V)
     dx = pl.pallas_call(
-        functools.partial(_bwd_kernel, bv=bv),
+        functools.partial(_bwd_kernel, bv=bv, V=V),
         grid=(pl.cdiv(R, br), pl.cdiv(V, bv)),
         in_specs=[
             pl.BlockSpec((br, bv), lambda i, j: (i, j)),

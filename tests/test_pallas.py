@@ -88,3 +88,98 @@ class TestRMSNorm:
         np.testing.assert_allclose(
             np.asarray(pallas_rms_norm(x)), np.asarray(ref_rms_norm(x)),
             rtol=1e-5, atol=1e-5)
+
+
+class TestTailMasking:
+    """Odd (non-block-aligned) shapes — the padded-tail region.
+
+    VERDICT r2 items #2-4: every kernel must mask its padded tail; these
+    shapes are chosen to hit each kernel's tail path (flash S % block_k,
+    xent V % block_v, quant K % block_k) against the lax references.
+    """
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_flash_fwd_odd_seq(self, causal):
+        # S=1100: 1100 % 1024 = 76-row tail in both q and k blocks
+        q, k, v = _qkv(S=1100)
+        out = flash_attention(q, k, v, causal=causal)
+        ref = _sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_flash_grads_odd_seq(self, causal):
+        q, k, v = _qkv(S=300)  # 300 % 256 = 44 tail
+
+        def loss(fn, *a):
+            return (fn(*a) ** 2).sum()
+
+        g1 = jax.grad(lambda *a: loss(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                            block_q=256, block_k=256),
+            *a), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: loss(
+            lambda q, k, v: _sdpa_reference(q, k, v, is_causal=causal),
+            *a), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_flash_cross_attention_odd_kv(self):
+        # Sq != Sk with both odd (non-causal cross attention)
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(1, 130, 2, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 300, 2, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 300, 2, 64)), jnp.float32)
+        out = flash_attention(q, k, v, causal=False, block_q=128, block_k=256)
+        ref = _sdpa_reference(q, k, v, is_causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize('V', [2176, 1000, 32000])
+    def test_xent_odd_vocab(self, V):
+        from paddle_tpu.ops.pallas.softmax_xent import (
+            softmax_cross_entropy_with_logits)
+
+        rng = np.random.default_rng(V)
+        logits = jnp.asarray(rng.normal(size=(16, V)) * 3, jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, (16,)), jnp.int32)
+        loss = softmax_cross_entropy_with_logits(logits, labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ref = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize('V', [2176, 1000])
+    def test_xent_bwd_odd_vocab(self, V):
+        from paddle_tpu.ops.pallas.softmax_xent import (
+            softmax_cross_entropy_with_logits)
+
+        rng = np.random.default_rng(V + 1)
+        logits = jnp.asarray(rng.normal(size=(8, V)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, (8,)), jnp.int32)
+        g1 = jax.grad(
+            lambda x: softmax_cross_entropy_with_logits(x, labels).sum()
+        )(logits)
+
+        def ref_loss(x):
+            logp = jax.nn.log_softmax(x, axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).sum()
+
+        g2 = jax.grad(ref_loss)(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize('K', [600, 11008])
+    def test_quant_matmul_odd_k(self, K):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            quant_matmul, quantize_weight)
+
+        rng = np.random.default_rng(K)
+        x = jnp.asarray(rng.normal(size=(16, K)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(K, 64)), jnp.float32)
+        wq, scale = quantize_weight(w)
+        out = quant_matmul(x, wq, scale)
+        ref = x @ (wq.astype(jnp.float32) * scale[None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-3)
