@@ -250,6 +250,9 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
 
     mb_shape = microbatches.shape[1:]
     mb_dtype = microbatches.dtype
+    # soft/float targets (regression, soft labels) get a real cotangent;
+    # integer targets are non-differentiable
+    diff_targets = jnp.issubdtype(targets.dtype, jnp.inexact)
 
     def body(params, extra, mbs, tgts):
         rank = lax.axis_index(axis)
@@ -258,10 +261,11 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
         zeros_mb = jnp.zeros(mb_shape, mb_dtype)
         zeros_p = jax.tree.map(jnp.zeros_like, local)
         zeros_e = jax.tree.map(jnp.zeros_like, extra)
+        zeros_t = jnp.zeros(targets.shape[1:], targets.dtype)
 
         def tick(carry, t):
             (act_q, grad_q, stash, act_msg, grad_msg,
-             pgrad, egrad, dmbs, loss_acc) = carry
+             pgrad, egrad, dmbs, dtgts, loss_acc) = carry
             fm = fwd_tab[t, rank]
             bm = bwd_tab[t, rank]
             ram = ra_tab[t, rank]
@@ -297,7 +301,7 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
 
             # 3. backward (recompute-vjp on the stashed input)
             def do_bwd(args):
-                pgrad, egrad, dmbs, loss_acc = args
+                pgrad, egrad, dmbs, dtgts, loss_acc = args
                 x = lax.dynamic_index_in_dim(
                     stash, jnp.clip(bm, 0) % S, 0, keepdims=False)
                 g_in = lax.dynamic_index_in_dim(
@@ -306,20 +310,29 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                     tgts, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
 
                 def last_stage(_):
-                    def f(par, ex, xx):
-                        return loss_fn(ex, stage_fn(par, xx), tgt)
+                    if diff_targets:
+                        def f(par, ex, xx, tt):
+                            return loss_fn(ex, stage_fn(par, xx), tt)
 
-                    lval, vjp = jax.vjp(f, local, extra, x)
-                    dpar, dex, dx = vjp(jnp.ones((), lval.dtype))
-                    return dpar, dex, dx, lval
+                        lval, vjp = jax.vjp(f, local, extra, x, tgt)
+                        dpar, dex, dx, dt = vjp(jnp.ones((), lval.dtype))
+                    else:
+                        def f(par, ex, xx):
+                            return loss_fn(ex, stage_fn(par, xx), tgt)
+
+                        lval, vjp = jax.vjp(f, local, extra, x)
+                        dpar, dex, dx = vjp(jnp.ones((), lval.dtype))
+                        dt = zeros_t
+                    return dpar, dex, dx, dt, lval.astype(jnp.float32)
 
                 def mid_stage(_):
                     _, vjp = jax.vjp(lambda par, xx: stage_fn(par, xx),
                                      local, x)
                     dpar, dx = vjp(g_in)
-                    return dpar, zeros_e, dx, jnp.zeros((), jnp.float32)
+                    return (dpar, zeros_e, dx, zeros_t,
+                            jnp.zeros((), jnp.float32))
 
-                dpar, dex, dx, lval = lax.cond(
+                dpar, dex, dx, dt, lval = lax.cond(
                     rank == p - 1, last_stage, mid_stage, None)
                 pgrad = jax.tree.map(jnp.add, pgrad, dpar)
                 egrad = jax.tree.map(jnp.add, egrad, dex)
@@ -329,18 +342,24 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                     lambda d: lax.dynamic_update_index_in_dim(
                         d, dx.astype(d.dtype), jnp.clip(bm, 0, M - 1), 0),
                     lambda d: d, dmbs)
-                return (pgrad, egrad, dmbs, loss_acc + lval), dx
+                if diff_targets:
+                    dtgts = lax.cond(
+                        rank == p - 1,
+                        lambda d: lax.dynamic_update_index_in_dim(
+                            d, dt.astype(d.dtype), jnp.clip(bm, 0, M - 1), 0),
+                        lambda d: d, dtgts)
+                return (pgrad, egrad, dmbs, dtgts, loss_acc + lval), dx
 
-            (pgrad, egrad, dmbs, loss_acc), grad_out = lax.cond(
+            (pgrad, egrad, dmbs, dtgts, loss_acc), grad_out = lax.cond(
                 bm >= 0, do_bwd,
                 lambda args: (args, zeros_mb),
-                (pgrad, egrad, dmbs, loss_acc))
+                (pgrad, egrad, dmbs, dtgts, loss_acc))
 
             # 4. rotate: activations ride +1, gradients ride -1
             act_msg = lax.ppermute(act_out, axis, perm_f)
             grad_msg = lax.ppermute(grad_out, axis, perm_b)
             return (act_q, grad_q, stash, act_msg, grad_msg,
-                    pgrad, egrad, dmbs, loss_acc), None
+                    pgrad, egrad, dmbs, dtgts, loss_acc), None
 
         init = (
             jnp.zeros((Qa,) + mb_shape, mb_dtype),
@@ -349,25 +368,30 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
             zeros_mb, zeros_mb,
             zeros_p, zeros_e,
             jnp.zeros((M,) + mb_shape, mb_dtype),
+            jnp.zeros(targets.shape, targets.dtype),
             jnp.zeros((), jnp.float32),
         )
         carry, _ = lax.scan(tick, init, jnp.arange(T))
-        (_, _, _, _, _, pgrad, egrad, dmbs, loss_acc) = carry
+        (_, _, _, _, _, pgrad, egrad, dmbs, dtgts, loss_acc) = carry
         # loss/extra-grads/input-grads live on single ranks; psum shares
         loss = lax.psum(loss_acc, axis) / M
         egrad = jax.tree.map(lambda g: lax.psum(g, axis) / M, egrad)
         dmbs = lax.psum(dmbs, axis) / M
+        if diff_targets:
+            dtgts = lax.psum(dtgts, axis) / M
         pgrad = jax.tree.map(lambda g: g[None] / M, pgrad)  # re-add stage axis
-        return loss, pgrad, egrad, dmbs
+        return loss, pgrad, egrad, dmbs, dtgts
 
     param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P(), P(), P()),
-        out_specs=(P(), param_specs, P(), P()),
+        out_specs=(P(), param_specs, P(), P(), P()),
         check_vma=False,
     )
-    return fn(stacked_params, extra_params, microbatches, targets)
+    loss, pgrad, egrad, dmbs, dtgts = fn(stacked_params, extra_params,
+                                         microbatches, targets)
+    return loss, pgrad, egrad, dmbs, (dtgts if diff_targets else None)
 
 
 def pipeline_1f1b_loss(stacked_params, extra_params, microbatches, targets,
@@ -387,17 +411,18 @@ def pipeline_1f1b_loss(stacked_params, extra_params, microbatches, targets,
 
     @jax.custom_vjp
     def f(stacked, extra, mbs, tgts):
-        loss, _, _, _ = run(stacked, extra, mbs, tgts)
+        loss, _, _, _, _ = run(stacked, extra, mbs, tgts)
         return loss
 
     def f_fwd(stacked, extra, mbs, tgts):
-        loss, dp, de, dm = run(stacked, extra, mbs, tgts)
-        return loss, (dp, de, dm)
+        loss, dp, de, dm, dt = run(stacked, extra, mbs, tgts)
+        return loss, (dp, de, dm, dt)
 
     def f_bwd(res, g):
-        dp, de, dm = res
+        dp, de, dm, dt = res
         scale = lambda t: jax.tree.map(lambda x: x * g, t)
-        return scale(dp), scale(de), scale(dm), None
+        return (scale(dp), scale(de), scale(dm),
+                scale(dt) if dt is not None else None)
 
     f.defvjp(f_fwd, f_bwd)
     return f(stacked_params, extra_params, microbatches, targets)

@@ -1,0 +1,34 @@
+"""paddle_tpu.distribution (ref: python/paddle/distribution/__init__.py).
+
+The reference's probability toolbox rebuilt on `jax.random` +
+`jax.scipy.special`: every density/entropy/KL is a traced closed form
+(jit/grad/vmap-able) and every sampler threads explicit PRNG keys from
+the framework's global stream. Not carried over: LKJCholesky (niche
+prior, no jax sampler primitive — SURVEY §6 scope call).
+"""
+from . import transform  # noqa: F401
+from .continuous import (Beta, Cauchy, Chi2, Dirichlet, Exponential, Gamma,
+                         Gumbel, Laplace, LogNormal, MultivariateNormal,
+                         Normal, StudentT, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       Multinomial, Poisson)
+from .distribution import Distribution, ExponentialFamily, Independent
+from .kl import kl_divergence, register_kl
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
+from .transformed_distribution import TransformedDistribution
+
+__all__ = [
+    'Bernoulli', 'Beta', 'Binomial', 'Categorical', 'Cauchy', 'Chi2',
+    'Dirichlet', 'Distribution', 'Exponential', 'ExponentialFamily', 'Gamma',
+    'Geometric', 'Gumbel', 'Independent', 'Laplace', 'LogNormal',
+    'Multinomial', 'MultivariateNormal', 'Normal', 'Poisson', 'StudentT',
+    'TransformedDistribution', 'Uniform', 'kl_divergence', 'register_kl',
+    'AbsTransform', 'AffineTransform', 'ChainTransform', 'ExpTransform',
+    'IndependentTransform', 'PowerTransform', 'ReshapeTransform',
+    'SigmoidTransform', 'SoftmaxTransform', 'StackTransform',
+    'StickBreakingTransform', 'TanhTransform', 'Transform', 'transform',
+]

@@ -1,0 +1,437 @@
+"""Continuous distributions (ref: python/paddle/distribution/{normal,
+uniform,beta,cauchy,chi2,dirichlet,exponential,gamma,gumbel,laplace,
+lognormal,multivariate_normal,student_t}.py).
+
+Each is a thin closed-form layer over `jax.random` samplers and
+`jax.scipy.special`, so sampling/log_prob/entropy all jit and batch.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as jss
+
+from .distribution import Distribution, ExponentialFamily
+
+_EULER = float(np.euler_gamma)
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+def _f(x):
+    return jnp.asarray(x, jnp.result_type(float))
+
+
+class Normal(ExponentialFamily):
+    """ref: paddle.distribution.Normal(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = jnp.broadcast_arrays(_f(loc), _f(scale))
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def rsample(self, shape=(), key=None):
+        eps = jax.random.normal(self._key(key), self._extend(shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -0.5 * z ** 2 - jnp.log(self.scale) - 0.5 * _LOG2PI
+
+    def entropy(self):
+        return 0.5 + 0.5 * _LOG2PI + jnp.log(self.scale)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jss.erf((value - self.loc)
+                                  / (self.scale * math.sqrt(2.0))))
+
+    def icdf(self, value):
+        return self.loc + self.scale * math.sqrt(2.0) * jss.erfinv(
+            2 * value - 1)
+
+
+class LogNormal(ExponentialFamily):
+    """ref: paddle.distribution.LogNormal — exp of a Normal."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = jnp.broadcast_arrays(_f(loc), _f(scale))
+        self.base = Normal(self.loc, self.scale)
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+    def rsample(self, shape=(), key=None):
+        return jnp.exp(self.base.rsample(shape, key))
+
+    def log_prob(self, value):
+        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self.base.entropy() + self.loc
+
+    def cdf(self, value):
+        return self.base.cdf(jnp.log(value))
+
+
+class Uniform(Distribution):
+    """ref: paddle.distribution.Uniform(low, high) on [low, high)."""
+
+    def __init__(self, low, high):
+        self.low, self.high = jnp.broadcast_arrays(_f(low), _f(high))
+        super().__init__(self.low.shape)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def rsample(self, shape=(), key=None):
+        u = jax.random.uniform(self._key(key), self._extend(shape))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+    def cdf(self, value):
+        return jnp.clip((value - self.low) / (self.high - self.low), 0.0, 1.0)
+
+
+class Exponential(ExponentialFamily):
+    """ref: paddle.distribution.Exponential(rate)."""
+
+    def __init__(self, rate):
+        self.rate = _f(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return self.rate ** -2
+
+    def rsample(self, shape=(), key=None):
+        e = jax.random.exponential(self._key(key), self._extend(shape))
+        return e / self.rate
+
+    def log_prob(self, value):
+        lp = jnp.log(self.rate) - self.rate * value
+        return jnp.where(value >= 0, lp, -jnp.inf)
+
+    def entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    def cdf(self, value):
+        return jnp.where(value >= 0, 1 - jnp.exp(-self.rate * value), 0.0)
+
+
+class Laplace(Distribution):
+    """ref: paddle.distribution.Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = jnp.broadcast_arrays(_f(loc), _f(scale))
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+    def rsample(self, shape=(), key=None):
+        e = jax.random.laplace(self._key(key), self._extend(shape))
+        return self.loc + self.scale * e
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return 1.0 + jnp.log(2 * self.scale)
+
+    def cdf(self, value):
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+
+class Cauchy(Distribution):
+    """ref: paddle.distribution.Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = jnp.broadcast_arrays(_f(loc), _f(scale))
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return jnp.full_like(self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        return jnp.full_like(self.loc, jnp.nan)
+
+    def rsample(self, shape=(), key=None):
+        c = jax.random.cauchy(self._key(key), self._extend(shape))
+        return self.loc + self.scale * c
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def entropy(self):
+        return jnp.log(4 * math.pi * self.scale)
+
+    def cdf(self, value):
+        return jnp.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+
+class Gamma(ExponentialFamily):
+    """ref: paddle.distribution.Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration, self.rate = jnp.broadcast_arrays(
+            _f(concentration), _f(rate))
+        super().__init__(self.concentration.shape)
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+    def rsample(self, shape=(), key=None):
+        g = jax.random.gamma(self._key(key), self.concentration,
+                             self._extend(shape))
+        return g / self.rate
+
+    def log_prob(self, value):
+        a, b = self.concentration, self.rate
+        lp = (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+              - jss.gammaln(a))
+        return jnp.where(value > 0, lp, -jnp.inf)
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return a - jnp.log(b) + jss.gammaln(a) + (1 - a) * jss.digamma(a)
+
+
+class Chi2(Gamma):
+    """ref: paddle.distribution.Chi2(df) == Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = _f(df)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df, 0.5))
+
+
+class Beta(ExponentialFamily):
+    """ref: paddle.distribution.Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta):
+        self.alpha, self.beta = jnp.broadcast_arrays(_f(alpha), _f(beta))
+        super().__init__(self.alpha.shape)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+    def rsample(self, shape=(), key=None):
+        return jax.random.beta(self._key(key), self.alpha, self.beta,
+                               self._extend(shape))
+
+    def log_prob(self, value):
+        a, b = self.alpha, self.beta
+        return (jss.xlogy(a - 1, value) + jss.xlog1py(b - 1, -value)
+                - jss.betaln(a, b))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return (jss.betaln(a, b) - (a - 1) * jss.digamma(a)
+                - (b - 1) * jss.digamma(b)
+                + (a + b - 2) * jss.digamma(a + b))
+
+
+class Dirichlet(ExponentialFamily):
+    """ref: paddle.distribution.Dirichlet(concentration)."""
+
+    def __init__(self, concentration):
+        self.concentration = _f(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / jnp.sum(self.concentration, -1,
+                                            keepdims=True)
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return m * (1 - m) / (a0 + 1)
+
+    def rsample(self, shape=(), key=None):
+        return jax.random.dirichlet(self._key(key), self.concentration,
+                                    self._extend(shape))
+
+    def log_prob(self, value):
+        a = self.concentration
+        norm = jss.gammaln(jnp.sum(a, -1)) - jnp.sum(jss.gammaln(a), -1)
+        return jnp.sum(jss.xlogy(a - 1, value), -1) + norm
+
+    def entropy(self):
+        a = self.concentration
+        K = a.shape[-1]
+        a0 = jnp.sum(a, -1)
+        log_b = jnp.sum(jss.gammaln(a), -1) - jss.gammaln(a0)
+        return (log_b + (a0 - K) * jss.digamma(a0)
+                - jnp.sum((a - 1) * jss.digamma(a), -1))
+
+
+class Gumbel(Distribution):
+    """ref: paddle.distribution.Gumbel(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = jnp.broadcast_arrays(_f(loc), _f(scale))
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * _EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def rsample(self, shape=(), key=None):
+        g = jax.random.gumbel(self._key(key), self._extend(shape))
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.log(self.scale) + 1 + _EULER
+
+    def cdf(self, value):
+        return jnp.exp(-jnp.exp(-(value - self.loc) / self.scale))
+
+
+class StudentT(Distribution):
+    """ref: paddle.distribution.StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df, self.loc, self.scale = jnp.broadcast_arrays(
+            _f(df), _f(loc), _f(scale))
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return jnp.where(self.df > 2, v,
+                         jnp.where(self.df > 1, jnp.inf, jnp.nan))
+
+    def rsample(self, shape=(), key=None):
+        t = jax.random.t(self._key(key), self.df, self._extend(shape))
+        return self.loc + self.scale * t
+
+    def log_prob(self, value):
+        d = self.df
+        z = (value - self.loc) / self.scale
+        return (jss.gammaln((d + 1) / 2) - jss.gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+    def entropy(self):
+        d = self.df
+        return ((d + 1) / 2 * (jss.digamma((d + 1) / 2) - jss.digamma(d / 2))
+                + 0.5 * jnp.log(d) + jss.betaln(d / 2, 0.5)
+                + jnp.log(self.scale))
+
+
+class MultivariateNormal(Distribution):
+    """ref: paddle.distribution.MultivariateNormal(loc, covariance_matrix |
+    scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _f(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                'exactly one of covariance_matrix/scale_tril required')
+        if scale_tril is not None:
+            self.scale_tril = _f(scale_tril)
+        else:
+            self.scale_tril = jnp.linalg.cholesky(_f(covariance_matrix))
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        L = self.scale_tril
+        return L @ jnp.swapaxes(L, -1, -2)
+
+    @property
+    def variance(self):
+        return jnp.sum(self.scale_tril ** 2, -1)
+
+    def rsample(self, shape=(), key=None):
+        eps = jax.random.normal(self._key(key),
+                                self._extend(shape) + self.event_shape)
+        return self.loc + jnp.einsum('...ij,...j->...i', self.scale_tril, eps)
+
+    def log_prob(self, value):
+        d = value - self.loc
+        # solve L z = d (triangular); broadcast L over the value batch
+        L = jnp.broadcast_to(self.scale_tril,
+                             d.shape[:-1] + self.scale_tril.shape[-2:])
+        z = jax.scipy.linalg.solve_triangular(
+            L, d[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1)), -1)
+        k = self.loc.shape[-1]
+        return -0.5 * jnp.sum(z ** 2, -1) - half_logdet - 0.5 * k * _LOG2PI
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        half_logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1)), -1)
+        return 0.5 * k * (1 + _LOG2PI) + half_logdet

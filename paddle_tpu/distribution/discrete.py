@@ -1,0 +1,259 @@
+"""Discrete distributions (ref: python/paddle/distribution/{bernoulli,
+binomial,categorical,geometric,multinomial,poisson}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jss
+
+from .distribution import Distribution, ExponentialFamily
+
+
+def _f(x):
+    return jnp.asarray(x, jnp.result_type(float))
+
+
+def _probs_to_logits(probs):
+    return jnp.log(probs) - jnp.log1p(-probs)
+
+
+class Bernoulli(ExponentialFamily):
+    """ref: paddle.distribution.Bernoulli(probs)."""
+
+    def __init__(self, probs=None, logits=None):
+        if (probs is None) == (logits is None):
+            raise ValueError('pass exactly one of probs/logits')
+        if probs is not None:
+            self.probs = _f(probs)
+            self.logits = _probs_to_logits(self.probs)
+        else:
+            self.logits = _f(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.bernoulli(self._key(key), self.probs,
+                                    self._extend(shape)).astype(
+                                        self.probs.dtype)
+
+    def rsample(self, shape=(), key=None, temperature=1.0):
+        """Gumbel-sigmoid relaxation (ref: Bernoulli.rsample temperature)."""
+        u = jax.random.uniform(self._key(key), self._extend(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return jax.nn.sigmoid((self.logits + logistic) / temperature)
+
+    def log_prob(self, value):
+        v = _f(value)
+        return -jax.nn.softplus(jnp.where(v > 0.5, -self.logits, self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return -(jss.xlogy(p, p) + jss.xlog1py(1 - p, -p))
+
+    def cdf(self, value):
+        v = _f(value)
+        return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs, 1.0))
+
+
+class Geometric(Distribution):
+    """ref: paddle.distribution.Geometric(probs) — pmf (1-p)^k p, k>=0."""
+
+    def __init__(self, probs):
+        self.probs = _f(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), key=None):
+        u = jax.random.uniform(self._key(key), self._extend(shape),
+                               minval=jnp.finfo(jnp.float32).tiny)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        k = _f(value)
+        return jss.xlog1py(k, -self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return -(jss.xlog1py(1 - p, -p) + jss.xlogy(p, p)) / p
+
+    def cdf(self, value):
+        return -jnp.expm1(jss.xlog1py(jnp.floor(_f(value)) + 1, -self.probs))
+
+
+class Categorical(Distribution):
+    """ref: paddle.distribution.Categorical(logits) over the last axis."""
+
+    def __init__(self, logits=None, probs=None):
+        if (probs is None) == (logits is None):
+            raise ValueError('pass exactly one of probs/logits')
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_f(logits), -1)
+        else:
+            self.logits = jnp.log(_f(probs)
+                                  / jnp.sum(_f(probs), -1, keepdims=True))
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def num_categories(self):
+        return self.logits.shape[-1]
+
+    @property
+    def mean(self):
+        return jnp.sum(self.probs * jnp.arange(self.num_categories), -1)
+
+    @property
+    def variance(self):
+        idx = jnp.arange(self.num_categories)
+        m = self.mean[..., None]
+        return jnp.sum(self.probs * (idx - m) ** 2, -1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(self._key(key), self.logits,
+                                      shape=self._extend(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(self.logits, v.shape + self.logits.shape[-1:]),
+            v[..., None], -1)[..., 0]
+
+    def probs_of(self, value):
+        """ref: Categorical.probs(value) (renamed: `probs` is the param)."""
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        return -jnp.sum(self.probs * self.logits, -1)
+
+
+class Multinomial(Distribution):
+    """ref: paddle.distribution.Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _f(probs)
+        self.probs = p / jnp.sum(p, -1, keepdims=True)
+        self.logits = jnp.log(self.probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        # n iid categorical draws, counted per bucket — static shapes
+        draws = jax.random.categorical(
+            self._key(key), self.logits,
+            shape=(self.total_count,) + self._extend(shape))
+        onehot = jax.nn.one_hot(draws, self.probs.shape[-1],
+                                dtype=self.probs.dtype)
+        return jnp.sum(onehot, axis=0)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _f(value)
+        coeff = jss.gammaln(jnp.asarray(self.total_count + 1.0)) - jnp.sum(
+            jss.gammaln(v + 1), -1)
+        return coeff + jnp.sum(jss.xlogy(v, self.probs), -1)
+
+
+class Binomial(Distribution):
+    """ref: paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _f(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        draws = jax.random.bernoulli(
+            self._key(key), self.probs,
+            (self.total_count,) + self._extend(shape))
+        return jnp.sum(draws.astype(self.probs.dtype), axis=0)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _f(value)
+        n = float(self.total_count)
+        coeff = (jss.gammaln(jnp.asarray(n + 1.0)) - jss.gammaln(v + 1)
+                 - jss.gammaln(n - v + 1))
+        return coeff + jss.xlogy(v, self.probs) + jss.xlog1py(n - v,
+                                                              -self.probs)
+
+    def entropy(self):
+        # exact summation over the (static) support — same approach the
+        # reference uses for distributions without a closed form
+        k = jnp.arange(self.total_count + 1.0)
+        shape = (self.total_count + 1,) + (1,) * self.probs.ndim
+        lp = self.log_prob(k.reshape(shape))
+        return -jnp.sum(jnp.exp(lp) * lp, axis=0)
+
+
+class Poisson(ExponentialFamily):
+    """ref: paddle.distribution.Poisson(rate)."""
+
+    # truncation depth for the entropy summation (no closed form exists;
+    # covers rates up to ~200 at fp32 accuracy)
+    _ENTROPY_TERMS = 512
+
+    def __init__(self, rate):
+        self.rate = _f(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), key=None):
+        return jax.random.poisson(self._key(key), self.rate,
+                                  self._extend(shape)).astype(self.rate.dtype)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _f(value)
+        return jss.xlogy(v, self.rate) - self.rate - jss.gammaln(v + 1)
+
+    def entropy(self):
+        k = jnp.arange(float(self._ENTROPY_TERMS))
+        shape = (self._ENTROPY_TERMS,) + (1,) * self.rate.ndim
+        lp = self.log_prob(k.reshape(shape))
+        return -jnp.sum(jnp.exp(lp) * lp, axis=0)

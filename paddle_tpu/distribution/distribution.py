@@ -1,0 +1,139 @@
+"""Distribution base (ref: python/paddle/distribution/distribution.py).
+
+TPU-native redesign: pure-functional math on jnp arrays; sampling draws
+explicit `jax.random` keys (from the framework's global stream when the
+caller passes none), so every method traces cleanly under `jax.jit` and
+reparameterized samples (`rsample`) differentiate through `jax.grad`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base class (ref: paddle.distribution.Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return jnp.sqrt(self.variance)
+
+    def _key(self, key):
+        if key is not None:
+            return key
+        from ..framework import random as random_mod
+
+        return random_mod.split_key()
+
+    def sample(self, shape=(), key=None):
+        """Draw (non-differentiable) samples of `shape + batch + event`."""
+        return jax.lax.stop_gradient(self.rsample(shape, key))
+
+    def rsample(self, shape=(), key=None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        """sample shape + batch shape."""
+        return _shape(shape) + self._batch_shape
+
+    def __repr__(self):
+        return (f'{type(self).__name__}(batch_shape={self._batch_shape}, '
+                f'event_shape={self._event_shape})')
+
+
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family members (ref:
+    distribution/exponential_family.py). Concrete members implement
+    closed-form entropy/KL directly; the natural-parameter Bregman
+    machinery the reference uses is replaced by per-pair registrations
+    in kl.py (same results, no double-backward trick needed)."""
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (ref: distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        if self.reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError(
+                f'reinterpreted_batch_rank {reinterpreted_batch_rank} exceeds '
+                f'batch rank {len(base.batch_shape)}')
+        cut = len(base.batch_shape) - self.reinterpreted_batch_rank
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self.reinterpreted_batch_rank == 0:
+            return lp
+        return jnp.sum(lp, axis=tuple(range(-self.reinterpreted_batch_rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self.reinterpreted_batch_rank == 0:
+            return ent
+        return jnp.sum(ent, axis=tuple(range(-self.reinterpreted_batch_rank, 0)))

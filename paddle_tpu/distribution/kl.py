@@ -1,0 +1,179 @@
+"""KL divergences (ref: python/paddle/distribution/kl.py).
+
+`register_kl(P, Q)` decorates a closed-form KL(p || q); dispatch walks
+both MROs and picks the most specific registered pair (so Chi2 — a
+Gamma subclass — resolves to the Gamma/Gamma rule).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy import special as jss
+
+from .continuous import (Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel,
+                         Laplace, LogNormal, MultivariateNormal, Normal,
+                         Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .distribution import Independent
+
+_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """ref: paddle.distribution.register_kl."""
+    def decorator(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p, q):
+    """ref: paddle.distribution.kl_divergence(p, q) = KL(p || q)."""
+    best, best_score = None, None
+    for (pc, qc), fn in _REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = (type(p).__mro__.index(pc), type(q).__mro__.index(qc))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is None:
+        raise NotImplementedError(
+            f'no KL registered for ({type(p).__name__}, {type(q).__name__})')
+    return best(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p.base, q.base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # infinite where p's support leaves q's
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (p.low < q.low) | (p.high > q.high)
+    return jnp.where(outside, jnp.inf, result)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return ratio - 1 - jnp.log(ratio)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    ap, bp, aq, bq = p.concentration, p.rate, q.concentration, q.rate
+    return ((ap - aq) * jss.digamma(ap) - jss.gammaln(ap) + jss.gammaln(aq)
+            + aq * (jnp.log(bp) - jnp.log(bq)) + ap * (bq / bp - 1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    sp = p.alpha + p.beta
+    return (jss.betaln(q.alpha, q.beta) - jss.betaln(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * jss.digamma(p.alpha)
+            + (p.beta - q.beta) * jss.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * jss.digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    ap, aq = p.concentration, q.concentration
+    a0 = jnp.sum(ap, -1)
+    return (jss.gammaln(a0) - jnp.sum(jss.gammaln(ap), -1)
+            - jss.gammaln(jnp.sum(aq, -1)) + jnp.sum(jss.gammaln(aq), -1)
+            + jnp.sum((ap - aq) * (jss.digamma(ap)
+                                   - jss.digamma(a0)[..., None]), -1))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return jnp.sum(p.probs * (p.logits - q.logits), -1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    t1 = jss.xlogy(p.probs, p.probs / q.probs)
+    t2 = jss.xlogy(1 - p.probs, (1 - p.probs) / (1 - q.probs))
+    return t1 + t2
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    # E_p[k] = (1-p)/p; KL = log(p/q) + E[k] log((1-p)/(1-q))
+    return (jnp.log(p.probs) - jnp.log(q.probs)
+            + (1 - p.probs) / p.probs
+            * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs)))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return (p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+            + q.rate - p.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    d = jnp.abs(p.loc - q.loc)
+    return (-jnp.log(scale_ratio)
+            + scale_ratio * jnp.exp(-d / p.scale)
+            + d / q.scale - 1)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    import numpy as np
+
+    euler = float(np.euler_gamma)
+    ratio = p.scale / q.scale
+    # E_p[exp(-(x - q.loc)/q.scale)] via the Gumbel MGF
+    t = jnp.exp((q.loc - p.loc) / q.scale) * jnp.exp(
+        jss.gammaln(1 + ratio))
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + euler * (ratio - 1)
+            + t - 1 + (p.loc - q.loc) / q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    import jax
+
+    k = p.loc.shape[-1]
+    Lp, Lq = p.scale_tril, q.scale_tril
+    half_logdet_p = jnp.sum(
+        jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), -1)
+    half_logdet_q = jnp.sum(
+        jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), -1)
+    # tr(Σq⁻¹ Σp) = ||Lq⁻¹ Lp||_F²
+    M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    trace = jnp.sum(M ** 2, axis=(-2, -1))
+    d = q.loc - p.loc
+    z = jax.scipy.linalg.solve_triangular(Lq, d[..., None], lower=True)[..., 0]
+    maha = jnp.sum(z ** 2, -1)
+    return half_logdet_q - half_logdet_p + 0.5 * (trace + maha - k)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    num = (p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2
+    den = 4 * p.scale * q.scale
+    return jnp.log(num / den)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError('mismatched reinterpreted ranks')
+    kl = kl_divergence(p.base, q.base)
+    if p.reinterpreted_batch_rank == 0:
+        return kl
+    return jnp.sum(kl, axis=tuple(range(-p.reinterpreted_batch_rank, 0)))

@@ -1,0 +1,308 @@
+"""Bijective transforms (ref: python/paddle/distribution/transform.py).
+
+Each transform maps x → y with a tracked log|det J|; compose with
+TransformedDistribution for reparameterized flows. All ops are jnp
+elementwise/softmax primitives, so transforms jit and differentiate.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Transform:
+    """Base bijector. `event_rank` is the event ndim the log-det sums over
+    (0 = elementwise)."""
+
+    event_rank = 0
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.result_type(float))
+        self.scale = jnp.asarray(scale, jnp.result_type(float))
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.result_type(float))
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x)), numerically safe
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective; inverse returns the positive branch)."""
+
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ … ∘ t_1 applied left-to-right."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.event_rank = max([t.event_rank for t in self.transforms],
+                              default=0)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        ldj = 0.0
+        for t in self.transforms:
+            part = t.forward_log_det_jacobian(x)
+            # lift elementwise parts to this chain's event rank
+            extra = self.event_rank - t.event_rank
+            if extra > 0:
+                part = jnp.sum(part, axis=tuple(range(-extra, 0)))
+            ldj = ldj + part
+            x = t.forward(x)
+        return ldj
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Sum the base transform's log-det over trailing batch dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self.event_rank = base.event_rank + self.reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        if self.reinterpreted_batch_rank == 0:
+            return ldj
+        return jnp.sum(ldj, axis=tuple(range(-self.reinterpreted_batch_rank,
+                                             0)))
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event block; volume-preserving (log-det 0)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        import numpy as np
+
+        if int(np.prod(self.in_event_shape)) != int(
+                np.prod(self.out_event_shape)):
+            raise ValueError('in/out event sizes differ')
+        self.event_rank = len(self.in_event_shape)
+
+    def forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape if n else tuple(shape)
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape if n else tuple(shape)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not bijective: inverse returns
+    log y, normalised up to a constant — matches the reference)."""
+
+    event_rank = 1
+
+    def forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError('softmax is not bijective')
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, x):
+        parts = [getattr(t, method)(xi) for t, xi in zip(
+            self.transforms,
+            jnp.split(x, len(self.transforms), axis=self.axis))]
+        return jnp.concatenate(parts, axis=self.axis)
+
+    def forward(self, x):
+        return self._map('forward', x)
+
+    def inverse(self, y):
+        return self._map('inverse', y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map('forward_log_det_jacobian', x)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → open (K)-simplex via stick breaking (ref:
+    transform.py::StickBreakingTransform)."""
+
+    event_rank = 1
+
+    def forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        z1m_cumprod = jnp.cumprod(1 - z, -1)
+        pad_z = jnp.pad(z, [(0, 0)] * (x.ndim - 1) + [(0, 1)],
+                        constant_values=1.0)
+        pad_cum = jnp.pad(z1m_cumprod, [(0, 0)] * (x.ndim - 1) + [(1, 0)],
+                          constant_values=1.0)
+        return pad_z * pad_cum
+
+    def inverse(self, y):
+        k = y.shape[-1] - 1
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        cum = 1 - jnp.cumsum(y[..., :-1], -1)
+        shifted = jnp.concatenate(
+            [jnp.ones_like(y[..., :1]), cum[..., :-1]], -1)
+        z = y[..., :-1] / shifted
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        # dy_i/dx_i = sigmoid'(t_i) * (stick remaining before segment i);
+        # the Jacobian is triangular, so the log-det is the diagonal sum
+        y = self.forward(x)                       # (..., k+1)
+        remaining = 1 - jnp.cumsum(y[..., :-1], -1)
+        before = jnp.concatenate(
+            [jnp.ones_like(y[..., :1]), remaining[..., :-1]], -1)
+        return jnp.sum(-jax.nn.softplus(-t) - jax.nn.softplus(t)
+                       + jnp.log(before + 1e-38), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

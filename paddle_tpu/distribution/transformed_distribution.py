@@ -1,0 +1,42 @@
+"""TransformedDistribution (ref: python/paddle/distribution/
+transformed_distribution.py): push a base distribution through a chain
+of bijective transforms."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    """y = T(x), x ~ base. log p(y) = log p_base(T⁻¹(y)) + log|det J_T⁻¹|."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        base_event = base.batch_shape + base.event_shape
+        out = self.transform.forward_shape(base_event)
+        # event rank grows to at least the transform's event rank
+        ev = max(len(base.event_shape), self.transform.event_rank)
+        super().__init__(out[:len(out) - ev], out[len(out) - ev:])
+
+    def rsample(self, shape=(), key=None):
+        return self.transform.forward(self.base.rsample(shape, key))
+
+    def sample(self, shape=(), key=None):
+        return self.transform.forward(self.base.sample(shape, key))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ildj = -self.transform.forward_log_det_jacobian(x)
+        lp = self.base.log_prob(x)
+        # reduce base log_prob over dims the transform absorbed into the
+        # event (elementwise base + event_rank>0 transform)
+        extra = self.transform.event_rank - len(self.base.event_shape)
+        if extra > 0:
+            lp = jnp.sum(lp, axis=tuple(range(-extra, 0)))
+        return lp + ildj

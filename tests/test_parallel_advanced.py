@@ -331,10 +331,10 @@ class Test1F1B:
         def loss_fn(extra, y, tgt):
             return ((y * extra['w'] - tgt) ** 2).mean()
 
-        loss, dp, de, dm = pipeline_1f1b(stacked, extra, mbs, tgts,
-                                         stage_fn, loss_fn, mesh, M)
+        loss, dp, de, dm, dt = pipeline_1f1b(stacked, extra, mbs, tgts,
+                                             stage_fn, loss_fn, mesh, M)
 
-        def ref_loss(blocks_list, extra, mbs):
+        def ref_loss(blocks_list, extra, mbs, tgts):
             tot = 0.0
             for m in range(M):
                 y = mbs[m]
@@ -343,8 +343,8 @@ class Test1F1B:
                 tot = tot + loss_fn(extra, y, tgts[m])
             return tot / M
 
-        rl, (rgb, rge, rgm) = jax.value_and_grad(
-            ref_loss, argnums=(0, 1, 2))(blocks, extra, mbs)
+        rl, (rgb, rge, rgm, rgt) = jax.value_and_grad(
+            ref_loss, argnums=(0, 1, 2, 3))(blocks, extra, mbs, tgts)
         np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
         ref_leaves = [jax.tree.leaves(b) for b in rgb]
         got_leaves = jax.tree.leaves(dp)
@@ -356,6 +356,9 @@ class Test1F1B:
         np.testing.assert_allclose(np.asarray(de['w']), np.asarray(rge['w']),
                                    rtol=1e-4)
         np.testing.assert_allclose(np.asarray(dm), np.asarray(rgm),
+                                   rtol=1e-4, atol=1e-6)
+        # float targets get a true cotangent (soft labels / regression)
+        np.testing.assert_allclose(np.asarray(dt), np.asarray(rgt),
                                    rtol=1e-4, atol=1e-6)
 
     def test_llama_1f1b_matches_gpipe_and_trains(self):
