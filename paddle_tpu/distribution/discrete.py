@@ -84,8 +84,6 @@ class Geometric(Distribution):
                                minval=jnp.finfo(jnp.float32).tiny)
         return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
 
-    rsample = sample
-
     def log_prob(self, value):
         k = _f(value)
         return jss.xlog1py(k, -self.probs) + jnp.log(self.probs)
@@ -130,8 +128,6 @@ class Categorical(Distribution):
         return jax.random.categorical(self._key(key), self.logits,
                                       shape=self._extend(shape))
 
-    rsample = sample
-
     def log_prob(self, value):
         v = jnp.asarray(value, jnp.int32)
         return jnp.take_along_axis(
@@ -173,8 +169,6 @@ class Multinomial(Distribution):
                                 dtype=self.probs.dtype)
         return jnp.sum(onehot, axis=0)
 
-    rsample = sample
-
     def log_prob(self, value):
         v = _f(value)
         coeff = jss.gammaln(jnp.asarray(self.total_count + 1.0)) - jnp.sum(
@@ -203,8 +197,6 @@ class Binomial(Distribution):
             self._key(key), self.probs,
             (self.total_count,) + self._extend(shape))
         return jnp.sum(draws.astype(self.probs.dtype), axis=0)
-
-    rsample = sample
 
     def log_prob(self, value):
         v = _f(value)
@@ -245,8 +237,6 @@ class Poisson(ExponentialFamily):
     def sample(self, shape=(), key=None):
         return jax.random.poisson(self._key(key), self.rate,
                                   self._extend(shape)).astype(self.rate.dtype)
-
-    rsample = sample
 
     def log_prob(self, value):
         v = _f(value)

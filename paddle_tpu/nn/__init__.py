@@ -1,6 +1,8 @@
 """paddle_tpu.nn (ref: python/paddle/nn/__init__.py)."""
+from . import decode  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm,
     ClipGradByNorm,
