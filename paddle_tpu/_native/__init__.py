@@ -8,30 +8,45 @@ path. Falls back gracefully (AVAILABLE=False) if no compiler.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, '_libring.so')
 _SRC = os.path.join(_HERE, 'ring_buffer.cpp')
 
 AVAILABLE = False
 _lib = None
 
 
-def _build():
-    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC, '-o', _LIB_PATH]
+def _lib_path():
+    """Cache dir keyed on the source hash: a changed .cpp always rebuilds,
+    and no binary artifact lives in the source tree / version control."""
+    with open(_SRC, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get(
+        'PADDLE_TPU_CACHE',
+        os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu'))
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f'_libring-{digest}.so')
+
+
+def _build(lib_path):
+    # atomic: build to a temp name, rename into place
+    tmp = lib_path + f'.tmp{os.getpid()}'
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC, '-o', tmp]
     subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, lib_path)
 
 
 def _load():
     global _lib, AVAILABLE
     try:
-        if (not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
-            _build()
-        _lib = ctypes.CDLL(_LIB_PATH)
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            _build(lib_path)
+        _lib = ctypes.CDLL(lib_path)
         _lib.rb_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         _lib.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         _lib.rb_push.restype = ctypes.c_int

@@ -17,6 +17,8 @@ import jax
 from ..framework import tree as tree_util
 from ..framework.tree import global_norm, merge, split_trainable
 
+from .eager import Variable, backward, to_variable  # noqa: E402,F401
+
 __all__ = [
     'grad',
     'value_and_grad',
@@ -29,6 +31,9 @@ __all__ = [
     'vjp',
     'jacobian',
     'hessian',
+    'Variable',
+    'to_variable',
+    'backward',
 ]
 
 

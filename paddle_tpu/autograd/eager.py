@@ -1,0 +1,241 @@
+"""Eager tape autograd — `Tensor.backward()` parity (ref:
+python/paddle/base/dygraph/tensor_patch_methods.py::backward,
+python/paddle/autograd/backward_mode.py).
+
+Paddle's dygraph tensors record into a C++ autograd graph;
+`loss.backward()` walks it and deposits `.grad` on leaves. The
+TPU-native framework is functional (`value_and_grad` is the primary
+API), but this shim provides the same eager feel for scripts and
+interactive use: `Variable` wraps a jax array, every overloaded op runs
+`jax.vjp` eagerly and records the pullback on a tape, and
+`loss.backward()` walks the tape in reverse topological order.
+
+    x = to_variable(jnp.ones((3,)), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    x.grad  # -> 2*x
+
+Each op dispatches to XLA eagerly (no jit) — intended for convenience,
+not the training hot path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Variable:
+    """A tape-recording wrapper over a jax array (ref: dygraph Tensor)."""
+
+    __array_priority__ = 100  # beat numpy in mixed binary ops
+
+    def __init__(self, value, stop_gradient=True, _parents=(), _vjp=None):
+        self.value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._parents = _parents      # Variables this value depends on
+        self._vjp = _vjp              # pullback: out_cot -> parent cots
+
+    # -- graph construction -------------------------------------------------
+    @staticmethod
+    def _apply(fn, *args, **kwargs):
+        """Run fn on unwrapped values; record a vjp over Variable args."""
+        vals = [a.value if isinstance(a, Variable) else a for a in args]
+        live = [i for i, a in enumerate(args)
+                if isinstance(a, Variable) and not a.stop_gradient]
+        if not live:
+            return Variable(fn(*vals, **kwargs), stop_gradient=True)
+
+        def prim(*lv):
+            full = list(vals)
+            for i, v in zip(live, lv):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        out, vjp = jax.vjp(prim, *[vals[i] for i in live])
+        return Variable(out, stop_gradient=False,
+                        _parents=tuple(args[i] for i in live), _vjp=vjp)
+
+    # -- backward -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """ref: Tensor.backward — reverse-walk the tape, accumulate .grad."""
+        if self.stop_gradient:
+            raise RuntimeError('backward() on a stop_gradient tensor')
+        seed = (jnp.ones_like(self.value) if grad_tensor is None
+                else jnp.asarray(grad_tensor))
+        if grad_tensor is None and self.value.ndim != 0:
+            if self.value.size != 1:
+                raise RuntimeError(
+                    'backward() without grad_tensor needs a scalar loss')
+            seed = jnp.ones_like(self.value)
+
+        # reverse topological order
+        order, seen = [], set()
+
+        def visit(v):
+            if id(v) in seen or v.stop_gradient:
+                return
+            seen.add(id(v))
+            for p in v._parents:
+                visit(p)
+            order.append(v)
+
+        visit(self)
+        cots = {id(self): seed}
+        for v in reversed(order):
+            cot = cots.pop(id(v), None)
+            if cot is None:
+                continue
+            v.grad = cot if v.grad is None else v.grad + cot
+            if v._vjp is None:
+                continue
+            parent_cots = v._vjp(cot)
+            for p, pc in zip(v._parents, parent_cots):
+                if p.stop_gradient:
+                    continue
+                cots[id(p)] = cots[id(p)] + pc if id(p) in cots else pc
+            if not retain_graph:
+                v._vjp, v._parents = None, ()
+
+    def clear_grad(self):
+        self.grad = None
+
+    # -- array protocol -----------------------------------------------------
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self.value)
+
+    def item(self):
+        return self.value.item()
+
+    def __repr__(self):
+        return (f'Variable(shape={self.value.shape}, '
+                f'stop_gradient={self.stop_gradient},\n{self.value})')
+
+    def __float__(self):
+        return float(self.value)
+
+    # -- operators ------------------------------------------------------
+    def __add__(self, o):
+        return self._apply(jnp.add, self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._apply(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return self._apply(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return self._apply(jnp.multiply, self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._apply(jnp.divide, self, o)
+
+    def __rtruediv__(self, o):
+        return self._apply(jnp.divide, o, self)
+
+    def __matmul__(self, o):
+        return self._apply(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return self._apply(jnp.matmul, o, self)
+
+    def __pow__(self, o):
+        return self._apply(jnp.power, self, o)
+
+    def __neg__(self):
+        return self._apply(jnp.negative, self)
+
+    def __getitem__(self, idx):
+        return self._apply(lambda v: v[idx], self)
+
+    # -- common methods (mirroring Tensor methods) ------------------------
+    def sum(self, axis=None, keepdim=False):
+        return self._apply(
+            lambda v: jnp.sum(v, axis=axis, keepdims=keepdim), self)
+
+    def mean(self, axis=None, keepdim=False):
+        return self._apply(
+            lambda v: jnp.mean(v, axis=axis, keepdims=keepdim), self)
+
+    def max(self, axis=None, keepdim=False):
+        return self._apply(
+            lambda v: jnp.max(v, axis=axis, keepdims=keepdim), self)
+
+    def min(self, axis=None, keepdim=False):
+        return self._apply(
+            lambda v: jnp.min(v, axis=axis, keepdims=keepdim), self)
+
+    def reshape(self, shape):
+        return self._apply(lambda v: jnp.reshape(v, shape), self)
+
+    def transpose(self, perm=None):
+        return self._apply(lambda v: jnp.transpose(v, perm), self)
+
+    def exp(self):
+        return self._apply(jnp.exp, self)
+
+    def log(self):
+        return self._apply(jnp.log, self)
+
+    def tanh(self):
+        return self._apply(jnp.tanh, self)
+
+    def sigmoid(self):
+        return self._apply(jax.nn.sigmoid, self)
+
+    def relu(self):
+        return self._apply(jax.nn.relu, self)
+
+    def sqrt(self):
+        return self._apply(jnp.sqrt, self)
+
+    def abs(self):
+        return self._apply(jnp.abs, self)
+
+    def detach(self):
+        return Variable(self.value, stop_gradient=True)
+
+    def cast(self, dtype):
+        return self._apply(lambda v: v.astype(dtype), self)
+
+    astype = cast
+
+
+def to_variable(value, stop_gradient=False):
+    """ref: paddle.to_tensor(..., stop_gradient=False) in dygraph —
+    wrap an array for eager tape autograd."""
+    if isinstance(value, Variable):
+        return value
+    return Variable(value, stop_gradient=stop_gradient)
+
+
+def apply(fn, *args, **kwargs):
+    """Record an arbitrary jax function application on the tape."""
+    return Variable._apply(fn, *args, **kwargs)
+
+
+def backward(tensors, grad_tensors=None):
+    """ref: paddle.autograd.backward(tensors, grad_tensors)."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=True)

@@ -4,9 +4,9 @@ Paddle's `fleet.init(is_collective=True, strategy=...)` builds NCCL
 groups; `fleet.distributed_model/distributed_optimizer` wrap model and
 optimizer with hybrid-parallel machinery. TPU-native: init builds the
 global Mesh from the strategy's hybrid_configs; distributed_model is
-`parallelize` (annotate + place); distributed_optimizer is a no-op
-passthrough — sharded optimizer states fall out of GSPMD when
-`opt.init` runs on sharded params.
+`parallelize` (annotate + place); distributed_optimizer applies the
+strategy's optimizer-side knobs (ZeRO slot sharding for
+sharding_stage 1/2, k-step GradientMerge for gradient_merge_steps).
 """
 from __future__ import annotations
 
@@ -56,9 +56,24 @@ def distributed_model(model, rules=None, fsdp=None):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """ref: fleet.distributed_optimizer. GSPMD shards optimizer slots
-    automatically (they inherit param shardings at opt.init), so the
-    optimizer passes through unchanged — ZeRO-1/2 come free."""
+    """ref: fleet.distributed_optimizer — applies the strategy's
+    optimizer-side knobs: gradient_merge_steps wraps the optimizer in
+    GradientMerge (k-step accumulation), sharding_stage 1/2 wraps it in
+    GroupShardedOptimizer (ZeRO slot/grad sharding over the data axes)."""
+    strategy = strategy or _strategy or DistributedStrategy()
+    if getattr(strategy, 'sharding_stage', 0) in (1, 2):
+        from .sharding import GroupShardedOptimizer
+
+        mesh = get_mesh()
+        if mesh is not None:
+            optimizer = GroupShardedOptimizer(
+                optimizer, mesh,
+                shard_grads=(strategy.sharding_stage == 2))
+    k = getattr(strategy, 'gradient_merge_steps', 1)
+    if k and k > 1:
+        from ..optimizer.wrappers import GradientMerge
+
+        optimizer = GradientMerge(optimizer, k_steps=k)
     return optimizer
 
 

@@ -52,7 +52,10 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
         raise ValueError(f'n_microbatches must be >= 1, got {n_microbatches}')
 
     def body(stage_params, microbatches):
-        # microbatches: (n_micro, mb, ...) identical on every rank
+        # microbatches: (n_micro, mb, ...) identical on every rank;
+        # promote to pp-varying so the vma types line up with the
+        # per-rank compute (check_vma=True)
+        microbatches = lax.pvary(microbatches, axis)
         rank = lax.axis_index(axis)
         n_ticks = n_microbatches + n_stages - 1
         mb_shape = microbatches.shape[1:]
@@ -84,8 +87,9 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
             buf = lax.ppermute(y, axis, perm)
             return (buf, outputs), None
 
-        buf0 = jnp.zeros(mb_shape, microbatches.dtype)
-        outs0 = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+        buf0 = lax.pvary(jnp.zeros(mb_shape, microbatches.dtype), axis)
+        outs0 = lax.pvary(
+            jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype), axis)
         # scan (not fori_loop): reverse-differentiable, so the 1F1B/GPipe
         # backward falls out of jax.grad through the schedule
         (_, outputs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
@@ -116,7 +120,10 @@ def pipeline_apply(stacked_params, microbatches, stage_fn, mesh: Mesh,
     fn = jax.shard_map(
         local_body, mesh=mesh,
         in_specs=(param_specs, P()), out_specs=P(),
-        check_vma=False,
+        # only 'pp' is hand-scheduled; other mesh axes (dp/tp/fsdp) stay
+        # under GSPMD so hybrid dp×pp×tp composes in one train step
+        axis_names={axis},
+        check_vma=True,
     )
     return fn(stacked_params, microbatches)
 
@@ -257,11 +264,16 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
     def body(params, extra, mbs, tgts):
         rank = lax.axis_index(axis)
         local = jax.tree.map(lambda x: x[0], params)   # strip stage axis
+        # replicated inputs → pp-varying so vma types line up with the
+        # per-rank compute (check_vma=True)
+        pv = lambda t: jax.tree.map(lambda x: lax.pvary(x, axis), t)
+        mbs, tgts, extra = pv(mbs), pv(tgts), pv(extra)
 
-        zeros_mb = jnp.zeros(mb_shape, mb_dtype)
+        zeros_mb = lax.pvary(jnp.zeros(mb_shape, mb_dtype), axis)
         zeros_p = jax.tree.map(jnp.zeros_like, local)
         zeros_e = jax.tree.map(jnp.zeros_like, extra)
-        zeros_t = jnp.zeros(targets.shape[1:], targets.dtype)
+        zeros_t = lax.pvary(jnp.zeros(targets.shape[1:], targets.dtype),
+                            axis)
 
         def tick(carry, t):
             (act_q, grad_q, stash, act_msg, grad_msg,
@@ -315,13 +327,13 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                             return loss_fn(ex, stage_fn(par, xx), tt)
 
                         lval, vjp = jax.vjp(f, local, extra, x, tgt)
-                        dpar, dex, dx, dt = vjp(jnp.ones((), lval.dtype))
+                        dpar, dex, dx, dt = vjp(lax.pvary(jnp.ones((), lval.dtype), axis))
                     else:
                         def f(par, ex, xx):
                             return loss_fn(ex, stage_fn(par, xx), tgt)
 
                         lval, vjp = jax.vjp(f, local, extra, x)
-                        dpar, dex, dx = vjp(jnp.ones((), lval.dtype))
+                        dpar, dex, dx = vjp(lax.pvary(jnp.ones((), lval.dtype), axis))
                         dt = zeros_t
                     return dpar, dex, dx, dt, lval.astype(jnp.float32)
 
@@ -330,7 +342,7 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                                      local, x)
                     dpar, dx = vjp(g_in)
                     return (dpar, zeros_e, dx, zeros_t,
-                            jnp.zeros((), jnp.float32))
+                            lax.pvary(jnp.zeros((), jnp.float32), axis))
 
                 dpar, dex, dx, dt, lval = lax.cond(
                     rank == p - 1, last_stage, mid_stage, None)
@@ -362,14 +374,14 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                     pgrad, egrad, dmbs, dtgts, loss_acc), None
 
         init = (
-            jnp.zeros((Qa,) + mb_shape, mb_dtype),
-            jnp.zeros((Qg,) + mb_shape, mb_dtype),
-            jnp.zeros((S,) + mb_shape, mb_dtype),
+            lax.pvary(jnp.zeros((Qa,) + mb_shape, mb_dtype), axis),
+            lax.pvary(jnp.zeros((Qg,) + mb_shape, mb_dtype), axis),
+            lax.pvary(jnp.zeros((S,) + mb_shape, mb_dtype), axis),
             zeros_mb, zeros_mb,
             zeros_p, zeros_e,
-            jnp.zeros((M,) + mb_shape, mb_dtype),
-            jnp.zeros(targets.shape, targets.dtype),
-            jnp.zeros((), jnp.float32),
+            lax.pvary(jnp.zeros((M,) + mb_shape, mb_dtype), axis),
+            lax.pvary(jnp.zeros(targets.shape, targets.dtype), axis),
+            lax.pvary(jnp.zeros((), jnp.float32), axis),
         )
         carry, _ = lax.scan(tick, init, jnp.arange(T))
         (_, _, _, _, _, pgrad, egrad, dmbs, dtgts, loss_acc) = carry
@@ -379,6 +391,10 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
         dmbs = lax.psum(dmbs, axis) / M
         if diff_targets:
             dtgts = lax.psum(dtgts, axis) / M
+        else:
+            # integer targets: cotangent is all-zeros; psum just settles
+            # the replication type for the P() out_spec
+            dtgts = lax.psum(dtgts, axis)
         pgrad = jax.tree.map(lambda g: g[None] / M, pgrad)  # re-add stage axis
         return loss, pgrad, egrad, dmbs, dtgts
 
@@ -387,7 +403,9 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
         body, mesh=mesh,
         in_specs=(param_specs, P(), P(), P()),
         out_specs=(P(), param_specs, P(), P(), P()),
-        check_vma=False,
+        # 'pp' is hand-scheduled; dp/tp/fsdp stay GSPMD-managed (hybrid)
+        axis_names={axis},
+        check_vma=True,
     )
     loss, pgrad, egrad, dmbs, dtgts = fn(stacked_params, extra_params,
                                          microbatches, targets)

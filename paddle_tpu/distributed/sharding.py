@@ -3,22 +3,101 @@ group_sharded.py, fleet/meta_parallel/sharding/*).
 
 Paddle implements three explicit stages (optimizer-state / gradient /
 parameter sharding) with hand-written broadcast/reduce-scatter phases.
-TPU-native, the three stages are *sharding declarations*, not code:
+TPU-native, the three stages are *sharding declarations* that GSPMD
+lowers to the same reduce-scatter/all-gather schedule:
 
-  stage 1/2 — optimizer slots inherit param PartitionSpecs when
-      `opt.init` runs on sharded params; grads are reduce-scattered by
-      GSPMD when the batch axis is sharded. Nothing to wrap.
-  stage 3 — parameters themselves sharded over the data axis:
-      `shard_model(model, mesh, fsdp_axis='fsdp')` adds the 'fsdp' axis
-      to each param's largest free dim; XLA all-gathers just-in-time at
-      each use and frees afterwards — the ZeRO-3 schedule, compiled.
+  stage 1 ('os')   — optimizer slots (moments, master weights) carry a
+      NamedSharding over the data axes: each device stores 1/N of every
+      slot. `GroupShardedOptimizer` places the state at init and
+      re-constrains it after every update so it STAYS sharded under jit.
+  stage 2 ('os_g') — additionally constrains the incoming grads to the
+      same specs, forcing the grad averaging into reduce-scatter form
+      (each device materialises only its 1/N grad shard for the update).
+  stage 3 ('p_g_os') — parameters themselves sharded:
+      `shard_model(model, mesh, fsdp_axis='fsdp')`; XLA all-gathers
+      just-in-time at each use — the ZeRO-3 schedule, compiled.
 
 `group_sharded_parallel` keeps the reference's call shape.
 """
 from __future__ import annotations
 
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
 from .mesh import get_mesh
 from .parallel import shard_model
+
+
+def _zero_axes(mesh):
+    """Data axes available for slot sharding (size > 1)."""
+    return tuple(a for a in ('dp', 'fsdp')
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def zero_spec(shape, mesh, axes=None):
+    """PartitionSpec sharding the largest divisible dim over the data
+    axes (ZeRO's flat 1/N split, expressed per-tensor)."""
+    axes = axes if axes is not None else _zero_axes(mesh)
+    if not axes or not shape:
+        return P()
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+    # largest dim divisible by the full axis product
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n == 0:
+            spec = [None] * len(shape)
+            spec[i] = axes if len(axes) > 1 else axes[0]
+            return P(*spec)
+    return P()
+
+
+class GroupShardedOptimizer:
+    """ZeRO stage-1/2 wrapper (ref: sharding/group_sharded.py
+    GroupShardedOptimizerStage2): delegates the math to the wrapped
+    optimizer, owns the *placement* of its state."""
+
+    def __init__(self, inner, mesh, shard_grads=False, axes=None):
+        self._inner = inner
+        self._mesh = mesh
+        self._axes = axes if axes is not None else _zero_axes(mesh)
+        self._shard_grads = shard_grads
+
+    def _spec_tree(self, tree):
+        return jax.tree.map(
+            lambda x: zero_spec(getattr(x, 'shape', ()), self._mesh,
+                                self._axes), tree)
+
+    def _constrain(self, tree):
+        return jax.tree.map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(self._mesh, s))
+            if hasattr(x, 'shape') else x,
+            tree, self._spec_tree(tree))
+
+    def init(self, model):
+        state = self._inner.init(model)
+        shardings = jax.tree.map(
+            lambda x, s: NamedSharding(self._mesh, s),
+            state, self._spec_tree(state))
+        state = jax.device_put(state, shardings)
+        self._inner.state = state
+        return state
+
+    def apply_gradients(self, model, grads, state=None):
+        if self._shard_grads:
+            # stage 2: grads land in reduce-scattered (sharded) form
+            grads = self._constrain(grads)
+        model, state = self._inner.apply_gradients(model, grads, state)
+        state = self._constrain(state)
+        self._inner.state = state
+        return model, state
+
+    def __getattr__(self, name):
+        if name.startswith('_'):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
 
 
 def group_sharded_parallel(model, optimizer, level='p_g_os', scaler=None,
@@ -37,9 +116,9 @@ def group_sharded_parallel(model, optimizer, level='p_g_os', scaler=None,
     if mesh is not None and level == 'p_g_os':
         model = shard_model(model, mesh, fsdp_axis='fsdp')
     elif mesh is not None:
-        # stages 1/2: params replicated over fsdp; optimizer slots will be
-        # sharded by GSPMD's memory-saving pass; ensure placement is set
         model = shard_model(model, mesh)
+        optimizer = GroupShardedOptimizer(optimizer, mesh,
+                                          shard_grads=(level == 'os_g'))
     return model, optimizer, scaler
 
 

@@ -28,6 +28,24 @@ def quantize_weight(w, axis=0):
     return q.astype(jnp.int8), scale.reshape(-1)
 
 
+FP8_MAX = {jnp.float8_e4m3fn: 448.0, jnp.float8_e5m2: 57344.0}
+
+
+def quantize_weight_fp8(w, axis=0, dtype=jnp.float8_e4m3fn):
+    """fp weight (K, N) → (fp8 weight, fp32 per-output-column scale).
+
+    Closes SURVEY §2.6/§2.12 fp8 stretch: same kernel as int8 (the
+    dequant is an `astype` in VMEM), fp8 keeps ~2 decimal digits of
+    mantissa where int8 keeps uniform steps — better for outlier-heavy
+    weights; HBM traffic is halved vs bf16 either way.
+    """
+    fmax = FP8_MAX[dtype]
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / fmax, 1e-12)
+    q = (w.astype(jnp.float32) / scale).astype(dtype)
+    return q, scale.reshape(-1)
+
+
 def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K):
     k = pl.program_id(2)
 

@@ -1,6 +1,8 @@
 """paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .wrappers import (ExponentialMovingAverage, GradientMerge,  # noqa: F401
+                       LookAhead)
 from .optimizers import (  # noqa: F401
     ASGD,
     Adadelta,

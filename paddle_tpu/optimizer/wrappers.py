@@ -1,0 +1,166 @@
+"""Optimizer wrappers: gradient accumulation, EMA, LookAhead.
+
+refs: fleet gradient_merge pass (python/paddle/distributed/fleet/
+meta_optimizers/gradient_merge_optimizer.py), ExponentialMovingAverage
+(python/paddle/static/nn/common.py:4032), paddle.incubate.LookAhead.
+
+All three are functional state transformers around the base Optimizer
+protocol (init/apply_gradients), so they compose with jit, GSPMD
+sharding, and each other.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tree import split_trainable
+
+
+def _zeros_like_trainable(model):
+    t, _ = split_trainable(model)
+    return jax.tree.map(jnp.zeros_like, t)
+
+
+class GradientMerge:
+    """Accumulate grads for k_steps micro-batches, then apply one real
+    update with the (averaged) sum — the reference's gradient_merge.
+
+    Consumes DistributedStrategy.gradient_merge_steps via
+    fleet.distributed_optimizer; usable standalone:
+
+        opt = GradientMerge(AdamW(1e-4), k_steps=4)
+    """
+
+    def __init__(self, inner, k_steps: int, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError(f'k_steps must be >= 1, got {k_steps}')
+        self._inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def init(self, model):
+        state = {
+            'inner': self._inner.init(model),
+            'acc': _zeros_like_trainable(model),
+            'count': jnp.zeros((), jnp.int32),
+        }
+        self.state = state
+        return state
+
+    def apply_gradients(self, model, grads, state=None):
+        state = state if state is not None else self.state
+        acc = jax.tree.map(jnp.add, state['acc'], grads)
+        count = state['count'] + 1
+
+        def do_update(_):
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            g = jax.tree.map(lambda a: a * scale, acc)
+            new_model, inner_state = self._inner.apply_gradients(
+                model, g, state['inner'])
+            zeros = jax.tree.map(jnp.zeros_like, acc)
+            return new_model, inner_state, zeros, jnp.zeros((), jnp.int32)
+
+        def skip(_):
+            return model, state['inner'], acc, count
+
+        model, inner_state, acc, count = jax.lax.cond(
+            count >= self.k_steps, do_update, skip, None)
+        new_state = {'inner': inner_state, 'acc': acc, 'count': count}
+        self.state = new_state
+        return model, new_state
+
+    def __getattr__(self, name):
+        if name.startswith('_'):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+class ExponentialMovingAverage:
+    """ref: paddle.static.ExponentialMovingAverage — shadow weights
+    w_ema = decay * w_ema + (1 - decay) * w, with bias correction like
+    the reference's thres_steps-free default."""
+
+    def __init__(self, decay=0.999):
+        self.decay = float(decay)
+
+    def init(self, model):
+        # shadow starts at zero and apply() divides by (1 - decay^t),
+        # matching the reference's bias-corrected recurrence
+        t, _ = split_trainable(model)
+        return {'shadow': jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), t),
+                'step': jnp.zeros((), jnp.int32)}
+
+    def update(self, state, model):
+        t, _ = split_trainable(model)
+        d = self.decay
+        shadow = jax.tree.map(
+            lambda s, p: d * s + (1 - d) * p.astype(jnp.float32),
+            state['shadow'], t)
+        return {'shadow': shadow, 'step': state['step'] + 1}
+
+    def apply(self, model, state, bias_correction=True):
+        """Returns a copy of `model` with EMA weights swapped in."""
+        from ..framework.tree import merge
+
+        t, f = split_trainable(model)
+        corr = 1.0 - self.decay ** jnp.maximum(state['step'], 1) \
+            if bias_correction else 1.0
+        ema_t = jax.tree.map(
+            lambda s, p: (s / corr).astype(p.dtype), state['shadow'], t)
+        return merge(ema_t, f)
+
+    def restore(self, model, original_trainable):
+        from ..framework.tree import merge
+
+        _, f = split_trainable(model)
+        return merge(original_trainable, f)
+
+
+class LookAhead:
+    """ref: paddle.incubate.LookAhead(inner, alpha=0.5, k=5) — keep slow
+    weights; every k fast steps, slow += alpha*(fast - slow), fast = slow."""
+
+    def __init__(self, inner, alpha=0.5, k=5):
+        self._inner = inner
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def init(self, model):
+        t, _ = split_trainable(model)
+        state = {
+            'inner': self._inner.init(model),
+            'slow': jax.tree.map(lambda p: p.astype(jnp.float32), t),
+            'count': jnp.zeros((), jnp.int32),
+        }
+        self.state = state
+        return state
+
+    def apply_gradients(self, model, grads, state=None):
+        from ..framework.tree import merge
+
+        state = state if state is not None else self.state
+        model, inner_state = self._inner.apply_gradients(
+            model, grads, state['inner'])
+        count = state['count'] + 1
+
+        def sync(_):
+            t, f = split_trainable(model)
+            slow = jax.tree.map(
+                lambda s, p: s + self.alpha * (p.astype(jnp.float32) - s),
+                state['slow'], t)
+            fast = jax.tree.map(lambda s, p: s.astype(p.dtype), slow, t)
+            return merge(fast, f), slow, jnp.zeros((), jnp.int32)
+
+        def keep(_):
+            return model, state['slow'], count
+
+        model, slow, count = jax.lax.cond(count >= self.k, sync, keep, None)
+        new_state = {'inner': inner_state, 'slow': slow, 'count': count}
+        self.state = new_state
+        return model, new_state
+
+    def __getattr__(self, name):
+        if name.startswith('_'):
+            raise AttributeError(name)
+        return getattr(self._inner, name)

@@ -121,8 +121,9 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
 
     b = jnp.round(boxes.astype(jnp.float32) * spatial_scale)
     x1, y1 = b[:, 0], b[:, 1]
-    x2 = jnp.maximum(b[:, 2], x1 + 1)
-    y2 = jnp.maximum(b[:, 3], y1 + 1)
+    # reference kernel uses inclusive end coords (height = end - start + 1)
+    x2 = jnp.maximum(b[:, 2] + 1, x1 + 1)
+    y2 = jnp.maximum(b[:, 3] + 1, y1 + 1)
     bin_h = (y2 - y1) / ph
     bin_w = (x2 - x1) / pw
 
@@ -289,12 +290,19 @@ def box_coder(prior_box, prior_box_var, target_box,
             out = out / var
         return out
     elif code_type == 'decode_center_size':
-        # t: (N, 4) deltas (axis=0 semantics) → corner boxes
+        # t: (..., M, 4) deltas → corner boxes; `axis` says which target
+        # dim the M priors line up with (ref box_coder axis semantics)
+        if t.ndim == 3 and axis == 0:
+            expand = lambda v: v[:, None]
+        else:
+            expand = lambda v: v
+        pw_, phh_ = expand(pw), expand(phh)
+        px_, py_ = expand(px), expand(py)
         d = t * var
-        cx = d[..., 0] * pw + px
-        cy = d[..., 1] * phh + py
-        w = jnp.exp(d[..., 2]) * pw
-        h = jnp.exp(d[..., 3]) * phh
+        cx = d[..., 0] * pw_ + px_
+        cy = d[..., 1] * phh_ + py_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * phh_
         return jnp.stack([cx - w * 0.5, cy - h * 0.5,
                           cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
     raise ValueError(f'unknown code_type {code_type}')
@@ -391,8 +399,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
     off_y = off[:, :, :, 0].reshape(N, dg, kh, kw, Ho, Wo)
     off_x = off[:, :, :, 1].reshape(N, dg, kh, kw, Ho, Wo)
-    ys = base_y.transpose(2, 3, 0, 1)[None, None] + off_y.transpose(
-        0, 1, 2, 3, 4, 5)                               # N,dg,kh,kw,Ho,Wo
+    ys = base_y.transpose(2, 3, 0, 1)[None, None] + off_y  # N,dg,kh,kw,Ho,Wo
     xs = base_x.transpose(2, 3, 0, 1)[None, None] + off_x
 
     if mask is not None:

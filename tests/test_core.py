@@ -174,3 +174,91 @@ def test_astype_roundtrip():
     m.astype(pt.float32)
     y = m(jnp.ones((1, 4)))
     assert y.dtype == jnp.float32
+
+
+class TestEagerTape:
+    """Tensor.backward() shim (SURVEY §2.2; ref: dygraph
+    tensor_patch_methods.py::backward)."""
+
+    def test_scalar_loss_backward(self):
+        import paddle_tpu as pt
+
+        x = pt.autograd.to_variable(jnp.asarray([1.0, 2.0, 3.0]))
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad), [2.0, 4.0, 6.0])
+
+    def test_chain_and_accumulation(self):
+        import paddle_tpu as pt
+
+        x = pt.autograd.to_variable(jnp.asarray(2.0))
+        # z = x^2 + 3x: dz/dx = 2x + 3 = 7
+        z = x * x + 3.0 * x
+        z.backward()
+        np.testing.assert_allclose(float(x.grad), 7.0)
+        # second backward accumulates (paddle semantics)
+        z2 = x * x + 3.0 * x
+        z2.backward()
+        np.testing.assert_allclose(float(x.grad), 14.0)
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_matmul_branching_graph(self):
+        import paddle_tpu as pt
+
+        rng = np.random.default_rng(0)
+        a = pt.autograd.to_variable(jnp.asarray(rng.normal(size=(3, 4)),
+                                                jnp.float32))
+        b = pt.autograd.to_variable(jnp.asarray(rng.normal(size=(4, 2)),
+                                                jnp.float32))
+        # diamond: y used twice
+        y = a @ b
+        loss = (y * y).sum() + y.sum()
+        loss.backward()
+
+        def ref(av, bv):
+            y = av @ bv
+            return (y * y).sum() + y.sum()
+
+        ga, gb = jax.grad(ref, argnums=(0, 1))(a.value, b.value)
+        np.testing.assert_allclose(np.asarray(a.grad), np.asarray(ga),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(b.grad), np.asarray(gb),
+                                   rtol=1e-5)
+
+    def test_stop_gradient_blocks(self):
+        import paddle_tpu as pt
+
+        x = pt.autograd.to_variable(jnp.asarray(3.0))
+        c = pt.autograd.to_variable(jnp.asarray(5.0), stop_gradient=True)
+        y = x * c
+        y.backward()
+        np.testing.assert_allclose(float(x.grad), 5.0)
+        assert c.grad is None
+        d = x.detach()
+        assert d.stop_gradient
+
+    def test_methods_and_nonscalar_seed(self):
+        import paddle_tpu as pt
+
+        x = pt.autograd.to_variable(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))
+        y = x.exp().log().reshape((4,))     # identity chain, reshaped
+        y.backward(jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+        np.testing.assert_allclose(np.asarray(x.grad),
+                                   [[1.0, 2.0], [3.0, 4.0]], rtol=1e-5)
+
+    def test_module_level_backward(self):
+        import paddle_tpu as pt
+
+        x = pt.autograd.to_variable(jnp.asarray(2.0))
+        y = x * x
+        pt.autograd.backward([y])
+        np.testing.assert_allclose(float(x.grad), 4.0)
+
+    def test_backward_on_nonscalar_raises(self):
+        import paddle_tpu as pt
+        import pytest as _pytest
+
+        x = pt.autograd.to_variable(jnp.asarray([1.0, 2.0]))
+        with _pytest.raises(RuntimeError):
+            (x * x).backward()

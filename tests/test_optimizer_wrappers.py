@@ -1,0 +1,180 @@
+"""GradientMerge / EMA / LookAhead (VERDICT r2 items #7-8, ADVICE:
+gradient_merge_steps must actually be consumed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.optimizer import (SGD, AdamW, ExponentialMovingAverage,
+                                  GradientMerge, LookAhead)
+
+
+def _data(n=16, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, 2)), jnp.float32)
+    return x, y
+
+
+class TestGradientMerge:
+    def test_k_micro_steps_equal_one_large_batch(self):
+        """k accumulated micro-batches == one update on the concatenated
+        batch (SGD: exact linearity)."""
+        x, y = _data(16, 8)
+        pt.seed(0)
+        model_a = nn.Linear(8, 2)
+        pt.seed(0)
+        model_b = nn.Linear(8, 2)
+
+        opt_a = GradientMerge(SGD(learning_rate=0.1), k_steps=4)
+        state_a = opt_a.init(model_a)
+
+        @jax.jit
+        def micro(model, state, xs, ys):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: ((m(xs) - ys) ** 2).mean())(model)
+            model, state = opt_a.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        for i in range(4):
+            model_a, state_a, _ = micro(model_a, state_a,
+                                        x[i * 4:(i + 1) * 4],
+                                        y[i * 4:(i + 1) * 4])
+
+        opt_b = SGD(learning_rate=0.1)
+        state_b = opt_b.init(model_b)
+        # mean over the 4 micro losses == mean of per-micro means; the
+        # large batch uses the same overall mean
+        loss, grads = pt.autograd.value_and_grad(
+            lambda m: ((m(x) - y) ** 2).mean())(model_b)
+        model_b, _ = opt_b.apply_gradients(model_b, grads, state_b)
+
+        for a, b in zip(jax.tree.leaves(model_a), jax.tree.leaves(model_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_no_update_until_k(self):
+        x, y = _data()
+        pt.seed(1)
+        model = nn.Linear(8, 2)
+        before = [np.asarray(p) for p in jax.tree.leaves(model)]
+        opt = GradientMerge(AdamW(learning_rate=0.1), k_steps=3)
+        state = opt.init(model)
+        loss, grads = pt.autograd.value_and_grad(
+            lambda m: ((m(x) - y) ** 2).mean())(model)
+        model, state = opt.apply_gradients(model, grads, state)
+        model, state = opt.apply_gradients(model, grads, state)
+        for a, b in zip(jax.tree.leaves(model), before):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        model, state = opt.apply_gradients(model, grads, state)  # 3rd: fires
+        changed = any(not np.allclose(np.asarray(a), b)
+                      for a, b in zip(jax.tree.leaves(model), before))
+        assert changed
+        assert int(state['count']) == 0
+
+    def test_fleet_strategy_consumes_knob(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.mesh import DistributedStrategy
+
+        s = DistributedStrategy(gradient_merge_steps=4)
+        opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3), s)
+        assert isinstance(opt, GradientMerge) and opt.k_steps == 4
+
+
+class TestEMA:
+    def test_shadow_formula_and_apply(self):
+        pt.seed(2)
+        model = nn.Linear(4, 2)
+        ema = ExponentialMovingAverage(decay=0.9)
+        state = ema.init(model)
+
+        # perturb weights, update ema twice; verify closed form (shadow
+        # starts at zero, reference recurrence)
+        from paddle_tpu.framework.tree import split_trainable
+
+        t0, _ = split_trainable(model)
+        leaves0 = [np.asarray(l, np.float64) for l in jax.tree.leaves(t0)]
+        model2 = jax.tree.map(lambda p: p + 1.0, model)
+        state = ema.update(state, model2)
+        model3 = jax.tree.map(lambda p: p + 1.0, model2)
+        state = ema.update(state, model3)
+
+        want = {}
+        for i, l0 in enumerate(leaves0):
+            s1 = 0.9 * 0.0 + 0.1 * (l0 + 1.0)
+            s2 = 0.9 * s1 + 0.1 * (l0 + 2.0)
+            want[i] = s2
+        applied = ema.apply(model3, state, bias_correction=False)
+        ta, _ = split_trainable(applied)
+        for i, l in enumerate(jax.tree.leaves(ta)):
+            np.testing.assert_allclose(np.asarray(l, np.float64), want[i],
+                                       rtol=1e-6)
+
+    def test_bias_correction(self):
+        pt.seed(3)
+        model = nn.Linear(4, 2)
+        ema = ExponentialMovingAverage(decay=0.99)
+        state = ema.init(model)
+        # zero-initialised shadow: after 1 update of an unchanged model,
+        # the bias-corrected EMA recovers the weights exactly
+        # (shadow = (1-d)*w, corrected by 1/(1-d^1))
+        state = ema.update(state, model)
+        applied = ema.apply(model, state)
+        for a, b in zip(jax.tree.leaves(applied), jax.tree.leaves(model)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3)
+
+
+class TestLookAhead:
+    def test_sync_every_k(self):
+        x, y = _data()
+        pt.seed(4)
+        model = nn.Linear(8, 2)
+        from paddle_tpu.framework.tree import split_trainable
+
+        slow0 = [np.asarray(l) for l in jax.tree.leaves(
+            split_trainable(model)[0])]
+        opt = LookAhead(SGD(learning_rate=0.05), alpha=0.5, k=2)
+        state = opt.init(model)
+
+        @jax.jit
+        def step(model, state):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: ((m(x) - y) ** 2).mean())(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state
+
+        m1, s1 = step(model, state)     # fast step, no sync
+        slow_after1 = [np.asarray(l) for l in jax.tree.leaves(s1['slow'])]
+        for a, b in zip(slow_after1, slow0):
+            np.testing.assert_array_equal(a, b)
+
+        m2, s2 = step(m1, s1)           # sync: slow moves, fast == slow
+        t2, _ = split_trainable(m2)
+        for fast, slow in zip(jax.tree.leaves(t2),
+                              jax.tree.leaves(s2['slow'])):
+            np.testing.assert_allclose(np.asarray(fast), np.asarray(slow),
+                                       rtol=1e-6)
+        moved = any(not np.allclose(np.asarray(a), b)
+                    for a, b in zip(jax.tree.leaves(s2['slow']), slow0))
+        assert moved
+
+    def test_converges(self):
+        x, y = _data(32)
+        pt.seed(5)
+        model = nn.Linear(8, 2)
+        opt = LookAhead(AdamW(learning_rate=1e-2), alpha=0.5, k=3)
+        state = opt.init(model)
+
+        @jax.jit
+        def step(model, state):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: ((m(x) - y) ** 2).mean())(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        model, state, l0 = step(model, state)
+        for _ in range(30):
+            model, state, loss = step(model, state)
+        assert float(loss) < float(l0)

@@ -183,3 +183,59 @@ class TestTailMasking:
         ref = x @ (wq.astype(jnp.float32) * scale[None, :])
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-3)
+
+
+class TestFp8Matmul:
+    """SURVEY §2.6/§2.12 fp8 stretch — e4m3 weights through quant_matmul."""
+
+    @pytest.mark.parametrize('K', [512, 600])
+    def test_fp8_matches_fp32(self, K):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            quant_matmul, quantize_weight_fp8)
+
+        rng = np.random.default_rng(K)
+        x = jnp.asarray(rng.normal(size=(16, K)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(K, 64)), jnp.float32)
+        wq, scale = quantize_weight_fp8(w)
+        assert wq.dtype == jnp.float8_e4m3fn
+        out = quant_matmul(x, wq, scale)
+        ref = x @ w
+        # e4m3 has a 3-bit mantissa: ~6% per-element error, averaged down
+        # by the K-sum; compare against the exact fp32 product
+        err = np.abs(np.asarray(out) - np.asarray(ref))
+        rel = err.max() / np.abs(np.asarray(ref)).max()
+        assert rel < 0.05, rel
+
+    def test_fp8_beats_or_matches_int8_on_outliers(self):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            quant_matmul, quantize_weight, quantize_weight_fp8)
+
+        rng = np.random.default_rng(0)
+        # outlier-heavy weights: a few huge rows blow up the int8 scale
+        w = rng.normal(size=(256, 64)).astype(np.float32)
+        w[::64] *= 50.0
+        wj = jnp.asarray(w)
+        x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+        ref = np.asarray(x @ wj)
+
+        qi, si = quantize_weight(wj)
+        q8, s8 = quantize_weight_fp8(wj)
+        err_i = np.abs(np.asarray(quant_matmul(x, qi, si)) - ref).mean()
+        err_8 = np.abs(np.asarray(quant_matmul(x, q8, s8)) - ref).mean()
+        assert err_8 < err_i * 1.5  # fp8 at least competitive
+
+    def test_weight_only_linear_fp8(self):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            quantize_weight_fp8, weight_only_linear)
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(128, 32)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+        wq, scale = quantize_weight_fp8(w)
+        out = weight_only_linear(x, wq, scale, b)
+        ref = x @ w + b
+        assert out.shape == (2, 8, 32)
+        rel = np.abs(np.asarray(out - ref)).max() / np.abs(
+            np.asarray(ref)).max()
+        assert rel < 0.05

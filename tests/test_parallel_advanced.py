@@ -425,3 +425,119 @@ class Test1F1B:
         f1b = temp_bytes(LlamaForCausalLMPipelined(
             cfg, mesh, n_microbatches=16, schedule='1f1b'))
         assert f1b < gpipe, (f1b, gpipe)
+
+
+class TestZeroSharding:
+    """ADVICE r2: ZeRO 1/2 must really shard optimizer slots — per-device
+    addressable slot bytes ≈ 1/N on the 8-device mesh."""
+
+    def test_stage2_slot_bytes_and_equivalence(self):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.optimizer import AdamW
+
+        mesh = dist.init_parallel_env(dp=8, fsdp=1, tp=1)
+        try:
+            pt.seed(0)
+            model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                                  nn.Linear(128, 64))
+            ref_opt = AdamW(learning_rate=1e-3)
+            total = sum(x.nbytes for x in jax.tree.leaves(
+                ref_opt.init(model)['slots']))
+
+            model2, opt2, _ = group_sharded_parallel(
+                model, AdamW(learning_rate=1e-3), level='os_g')
+            state = opt2.init(model2)
+            per_dev = sum(l.addressable_shards[0].data.nbytes
+                          for l in jax.tree.leaves(state['slots']))
+            assert abs(total / per_dev - 8) < 0.2, (total, per_dev)
+
+            x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 64)),
+                            jnp.float32)
+            y = jnp.asarray(np.random.default_rng(1).normal(size=(16, 64)),
+                            jnp.float32)
+
+            @jax.jit
+            def step(model, state, x, y):
+                loss, grads = pt.autograd.value_and_grad(
+                    lambda m: ((m(x) - y) ** 2).mean())(model)
+                model, state = opt2.apply_gradients(model, grads, state)
+                return model, state, loss
+
+            m, s, _ = step(model2, state, x, y)
+            # slots STAY sharded through the jitted update
+            sharded = [l for l in jax.tree.leaves(s['slots'])
+                       if l.addressable_shards[0].data.nbytes * 8 == l.nbytes]
+            assert len(sharded) == len(jax.tree.leaves(s['slots']))
+            for _ in range(5):
+                m, s, loss = step(m, s, x, y)
+
+            # bit-equivalent to the unsharded optimizer
+            pt.seed(0)
+            model_r = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                                    nn.Linear(128, 64))
+            st = ref_opt.init(model_r)
+
+            @jax.jit
+            def step_r(model, state, x, y):
+                loss, grads = pt.autograd.value_and_grad(
+                    lambda m: ((m(x) - y) ** 2).mean())(model)
+                model, state = ref_opt.apply_gradients(model, grads, state)
+                return model, state, loss
+
+            mr, sr, _ = step_r(model_r, st, x, y)
+            for _ in range(5):
+                mr, sr, lr = step_r(mr, sr, x, y)
+            np.testing.assert_allclose(float(loss), float(lr), rtol=1e-5)
+        finally:
+            dist.set_mesh(None)
+
+
+class TestHybridParallel:
+    """ADVICE r2: pp composed with dp AND tp in ONE jitted train step."""
+
+    def test_dp_pp_tp_one_train_step(self):
+        from jax.sharding import NamedSharding
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_pp import LlamaForCausalLMPipelined
+        from paddle_tpu.optimizer import AdamW
+
+        cfg = llama_tiny(vocab_size=64, hidden_size=32, layers=4, heads=2,
+                         kv_heads=2, intermediate_size=64, max_pos=32)
+        batch = jnp.asarray(np.random.default_rng(1).integers(0, 64, (8, 17)),
+                            jnp.int32)
+
+        mesh_pp = dist.build_mesh(devices=jax.devices()[:4], pp=4, dp=1)
+        pt.seed(21)
+        m_ref = LlamaForCausalLMPipelined(cfg, mesh_pp, n_microbatches=2,
+                                          schedule='1f1b')
+        l_ref = float(pt.autograd.value_and_grad(
+            lambda m: m.loss(batch))(m_ref)[0])
+
+        mesh = dist.build_mesh(devices=jax.devices(), dp=2, pp=2, tp=2)
+        pt.seed(21)
+        model = LlamaForCausalLMPipelined(cfg, mesh, n_microbatches=2,
+                                          schedule='1f1b')
+        rules = [
+            (r'.*stage_blocks.*(q|k|v|gate|up)_proj$', P('pp', None, 'tp')),
+            (r'.*stage_blocks.*(o|down)_proj$', P('pp', 'tp', None)),
+            (r'.*stage_blocks.*', P('pp')),
+            (r'.*embed_tokens$', P('tp', None)),
+            (r'.*lm_head$', P(None, 'tp')),
+        ]
+        model = dist.parallelize(model, mesh, rules=rules)
+        opt = AdamW(learning_rate=1e-2)
+        state = opt.init(model)
+        b = jax.device_put(batch, NamedSharding(mesh, P('dp', None)))
+
+        @jax.jit
+        def step(model, state, b):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: m.loss(b))(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        m, s, l0 = step(model, state, b)
+        np.testing.assert_allclose(float(l0), l_ref, rtol=2e-3)
+        for _ in range(3):
+            m, s, loss = step(m, s, b)
+        assert float(loss) < float(l0)

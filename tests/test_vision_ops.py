@@ -48,6 +48,14 @@ class TestRoIAlign:
 
 
 class TestRoIPool:
+    def test_inclusive_end_pixel(self):
+        # reference kernel: box_height = end - start + 1, so the pixel AT
+        # the end coordinate belongs to the last bin
+        x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 7, 7].set(9.0)
+        boxes = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
+        out = V.roi_pool(x, boxes, jnp.asarray([1]), output_size=1)
+        assert float(out[0, 0, 0, 0]) == 9.0
+
     def test_max_of_bins(self):
         x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 1, 1].set(5.0).at[
             0, 0, 6, 6].set(7.0)
