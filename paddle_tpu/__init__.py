@@ -51,8 +51,10 @@ from . import metric  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import incubate  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import utils  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle_tpu.audio (ref: python/paddle/audio) — feature extraction
+(Spectrogram/Mel/LogMel/MFCC) + functional helpers over jnp/signal.stft.
+Backends/datasets (file IO, download) are out of scope per SURVEY §6.
+"""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

@@ -1,0 +1,96 @@
+"""Audio feature layers (ref: python/paddle/audio/features/layers.py:
+Spectrogram:45, MelSpectrogram:130, LogMelSpectrogram:237, MFCC:344).
+
+Each layer is a thin pytree module over `signal.stft` + the functional
+helpers — the whole feature pipeline is one fused XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.base import Layer
+from ..signal import stft
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+
+class Spectrogram(Layer):
+    """ref: audio.features.Spectrogram — |STFT|^power."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window='hann', power=2.0, center=True, pad_mode='reflect',
+                 dtype='float32'):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, fftbins=True,
+                                 dtype=dtype)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    """ref: audio.features.MelSpectrogram — fbank @ spectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window='hann', power=2.0, center=True, pad_mode='reflect',
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm='slaney',
+                 dtype='float32'):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)                  # (..., F, T)
+        return jnp.einsum('mf,...ft->...mt', self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    """ref: audio.features.LogMelSpectrogram — power_to_db(mel)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window='hann', power=2.0, center=True, pad_mode='reflect',
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm='slaney',
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype='float32'):
+        super().__init__()
+        self.mel_spectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel_spectrogram(x), self.ref_value,
+                           self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """ref: audio.features.MFCC — DCT-II over the log-mel spectrogram."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window='hann', power=2.0, center=True,
+                 pad_mode='reflect', n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm='slaney', ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype='float32'):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self.log_mel(x)                       # (..., n_mels, T)
+        return jnp.einsum('mk,...mt->...kt', self.dct, mel)

@@ -1,0 +1,196 @@
+"""Audio functional ops (ref: python/paddle/audio/functional/
+functional.py:29-353, window.py).
+
+Pure jnp closed forms — every helper is a traced function of static
+sizes, so feature extraction pipelines jit end-to-end on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def hz_to_mel(freq, htk=False):
+    """ref: audio/functional.py::hz_to_mel (Slaney by default)."""
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(freq, 1e-10)
+                                           / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    """ref: audio/functional.py::mel_to_hz."""
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype='float32'):
+    """ref: audio/functional.py::mel_frequencies."""
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return mel_to_hz(mels, htk).astype(dtype)
+
+
+def fft_frequencies(sr, n_fft, dtype='float32'):
+    """ref: audio/functional.py::fft_frequencies."""
+    return jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm='slaney', dtype='float32'):
+    """ref: audio/functional.py::compute_fbank_matrix — triangular mel
+    filterbank, (n_mels, 1 + n_fft//2)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]          # (n_mels+2, F)
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == 'slaney':
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights.astype(dtype)
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """ref: audio/functional.py::power_to_db."""
+    x = jnp.asarray(magnitude)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm='ortho', dtype='float32'):
+    """ref: audio/functional.py::create_dct — DCT-II basis
+    (n_mels, n_mfcc)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == 'ortho':
+        scale = jnp.full((n_mfcc,), math.sqrt(2.0 / n_mels))
+        scale = scale.at[0].set(math.sqrt(1.0 / n_mels))
+        basis = basis * scale[None, :]
+    else:
+        basis = basis * 2.0
+    return basis.astype(dtype)
+
+
+# -- windows (ref: audio/functional/window.py::get_window) ------------------
+
+def _hann(M, sym=True):
+    return _general_cosine(M, [0.5, 0.5], sym)
+
+
+def _hamming(M, sym=True):
+    return _general_cosine(M, [0.54, 0.46], sym)
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.5, 0.08], sym)
+
+
+def _general_cosine(M, a, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    fac = jnp.linspace(-math.pi, math.pi, N)
+    w = jnp.zeros((N,))
+    for i, c in enumerate(a):
+        w = w + c * jnp.cos(i * fac)
+    return w[:M]
+
+
+def _bartlett(M, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    n = jnp.arange(N, dtype=jnp.float32)
+    w = 1.0 - jnp.abs(2.0 * n / (N - 1) - 1.0)
+    return w[:M]
+
+
+def _gaussian(M, std, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    n = jnp.arange(N, dtype=jnp.float32) - (N - 1) / 2.0
+    return jnp.exp(-0.5 * (n / std) ** 2)[:M]
+
+
+def _cosine(M, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    return jnp.sin(math.pi / N * (jnp.arange(N) + 0.5))[:M]
+
+
+def _triang(M, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    n = jnp.arange(1, (N + 1) // 2 + 1, dtype=jnp.float32)
+    if N % 2 == 0:
+        w = (2 * n - 1.0) / N
+        w = jnp.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (N + 1.0)
+        w = jnp.concatenate([w, w[-2::-1]])
+    return w[:M]
+
+
+def _exponential(M, tau=1.0, sym=True):
+    if M <= 1:
+        return jnp.ones((max(M, 0),))
+    N = M if sym else M + 1
+    n = jnp.arange(N, dtype=jnp.float32)
+    return jnp.exp(-jnp.abs(n - (N - 1) / 2.0) / tau)[:M]
+
+
+_WINDOWS = {
+    'hann': _hann, 'hamming': _hamming, 'blackman': _blackman,
+    'bartlett': _bartlett, 'cosine': _cosine, 'triang': _triang,
+}
+_WINDOWS_PARAM = {'gaussian': _gaussian, 'exponential': _exponential}
+
+
+def get_window(window, win_length, fftbins=True, dtype='float32'):
+    """ref: audio/functional/window.py::get_window. `window` is a name
+    or (name, param) tuple; fftbins=True gives the periodic variant."""
+    sym = not fftbins
+    if isinstance(window, str):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        name, args = window[0], tuple(window[1:])
+    else:
+        raise ValueError(f'unsupported window spec {window!r}')
+    if name in _WINDOWS:
+        w = _WINDOWS[name](win_length, sym=sym)
+    elif name in _WINDOWS_PARAM:
+        w = _WINDOWS_PARAM[name](win_length, *args, sym=sym)
+    else:
+        raise ValueError(f'unknown window {name!r}')
+    return w.astype(dtype)

@@ -1,0 +1,6 @@
+"""paddle_tpu.incubate (ref: python/paddle/incubate) — experimental /
+fused surfaces. LookAhead re-exported for API parity
+(paddle.incubate.LookAhead).
+"""
+from . import nn  # noqa: F401
+from ..optimizer.wrappers import LookAhead  # noqa: F401

@@ -1,0 +1,198 @@
+"""Fused ops (ref: python/paddle/incubate/nn/functional/*).
+
+The reference hand-fuses these into single CUDA kernels; on TPU the
+same fusion happens in XLA, so each "fused_*" here is the composed jnp
+expression (single dispatch under jit) routed through the pallas fast
+paths where one exists (rms_norm, flash attention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    """ref: incubate/nn/functional/fused_matmul_bias.py."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = x @ y
+    return out if bias is None else out + bias
+
+
+fused_linear = fused_matmul_bias
+
+
+def swiglu(x, y=None):
+    """ref: incubate/nn/functional/swiglu.py — silu(x) * y; single-arg
+    form splits the last dim in half."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """ref: fused_rms_norm.py — dispatches to the pallas kernel on TPU."""
+    from ...ops import rms_norm as _rms
+
+    out = _rms(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, residual=None, **kw):
+    """ref: fused_layer_norm.py (residual-add + LN)."""
+    from ...nn.functional.norm import layer_norm
+
+    if residual is not None:
+        x = x + residual
+    return layer_norm(x, x.shape[-1], norm_weight, norm_bias, epsilon)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode='upscale_in_train',
+                      rng_key=None):
+    """ref: fused_dropout_add.py — dropout(x) + y."""
+    if p == 0.0 or not training:
+        return x + y
+    from ...framework import random as random_mod
+
+    key = rng_key if rng_key is not None else random_mod.split_key()
+    keep = jax.random.bernoulli(key, 1 - p, x.shape)
+    if mode == 'upscale_in_train':
+        x = jnp.where(keep, x / (1 - p), 0.0)
+    else:
+        x = jnp.where(keep, x, 0.0)
+    return x + y
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """ref: fused_rotary_position_embedding.py.
+
+    q/k/v: (B, S, H, D). When sin/cos are None they are computed from
+    positions with the default 10000 theta. Accepts the reference's
+    full-head-dim cos/sin layout ((1, S, 1, D), both halves duplicated)
+    or the compact (S, D/2)/(B, S, D/2) tables. use_neox_rotary_style
+    selects rotate-half (True) vs GPT-J interleaved pairs (False).
+    Returns rotated (q, k, v) — v passes through (rope only mixes q/k,
+    the reference accepts it for API parity).
+    """
+    from ...models.llama import apply_rotary, rope_cos_sin
+
+    B, S, _, D = q.shape
+    if cos is None or sin is None:
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        cos, sin = rope_cos_sin(position_ids, D, dtype=q.dtype)
+    else:
+        cos = jnp.squeeze(jnp.asarray(cos))
+        sin = jnp.squeeze(jnp.asarray(sin))
+        if cos.shape[-1] == D:
+            # reference layout duplicates the half-table along D; for
+            # interleaved style the duplication is pairwise
+            cos = cos[..., ::2] if not use_neox_rotary_style else \
+                cos[..., :D // 2]
+            sin = sin[..., ::2] if not use_neox_rotary_style else \
+                sin[..., :D // 2]
+        if cos.ndim == 2:                  # (S, D/2) → (B, S, D/2)
+            cos = jnp.broadcast_to(cos[None], (B,) + cos.shape)
+            sin = jnp.broadcast_to(sin[None], (B,) + sin.shape)
+
+    if use_neox_rotary_style:
+        rot = lambda x: apply_rotary(x, cos, sin)
+    else:
+        # GPT-J style: rotate adjacent pairs (2i, 2i+1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+
+        def rot(x):
+            xp = x.reshape(*x.shape[:-1], D // 2, 2)
+            xe, xo = xp[..., 0], xp[..., 1]
+            re = xe * c - xo * s
+            ro = xo * c + xe * s
+            return jnp.stack([re, ro], -1).reshape(x.shape).astype(x.dtype)
+
+    out_q = rot(q)
+    out_k = rot(k) if k is not None else None
+    return out_q, out_k, v
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, num_heads=None):
+    """ref: fused_transformer.py::fused_multi_head_attention — packed-QKV
+    self-attention block with residual + layer norm, flash-attention fast
+    path on TPU.
+
+    x: (B, S, E); qkv_weight: (3, num_heads, head_dim, E) (reference
+    layout); linear_weight: (E, E).
+    """
+    from ...nn.functional.attention import scaled_dot_product_attention
+    from ...nn.functional.norm import layer_norm
+
+    B, S, E = x.shape
+    three, H, D, _ = qkv_weight.shape
+    assert three == 3 and H * D == E
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, E, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv = jnp.einsum('bse,thde->bsthd', x, qkv_weight)     # (B,S,3,H,D)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape(3, H, D)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]     # (B,S,H,D)
+    out = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    out = out.reshape(B, S, E) @ linear_weight
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate:
+        out = fused_dropout_add(out, residual, dropout_rate, training)
+    else:
+        out = out + residual
+    if not pre_layer_norm:
+        out = layer_norm(out, E, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation='relu',
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True):
+    """ref: fused_transformer.py::fused_feedforward — LN + MLP + residual."""
+    from ...nn.functional.norm import layer_norm
+
+    E = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, E, ln1_scale, ln1_bias, ln1_epsilon)
+    act = {'relu': jax.nn.relu, 'gelu': jax.nn.gelu,
+           'silu': jax.nn.silu}[activation]
+    h = act(fused_matmul_bias(x, linear1_weight, linear1_bias))
+    if dropout1_rate and training:
+        h = fused_dropout_add(h, jnp.zeros_like(h), dropout1_rate, training)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    out = fused_dropout_add(h, residual, dropout2_rate, training) \
+        if dropout2_rate and training else h + residual
+    if not pre_layer_norm:
+        out = layer_norm(out, E, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_bias_act(x, bias=None, act_method='gelu'):
+    """ref: fused_bias_act.py."""
+    if bias is not None:
+        x = x + bias
+    return {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'silu': jax.nn.silu,
+            'swiglu': swiglu}[act_method](x)

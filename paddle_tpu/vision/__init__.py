@@ -4,5 +4,6 @@ from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .. import models  # noqa: F401  (paddle.vision.models alias)
 from .ops import (DeformConv2D, PSRoIPool, RoIAlign, RoIPool,  # noqa: F401
-                  box_coder, deform_conv2d, nms, nms_mask, prior_box,
-                  psroi_pool, roi_align, roi_pool, yolo_box)
+                  box_coder, deform_conv2d, matrix_nms, nms, nms_mask,
+                  prior_box, psroi_pool, roi_align, roi_pool, yolo_box,
+                  yolo_loss)

@@ -491,6 +491,226 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     return boxes, scores
 
 
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """ref: paddle.vision.ops.yolo_loss (vision/ops.py:69) — YOLOv3 loss.
+
+    x: (N, S*(5+nc), H, W) head output; gt_box: (N, B, 4) center-format
+    (cx, cy, w, h) normalized to [0, 1]; gt_label: (N, B) int;
+    gt_score: (N, B) mixup weights. Returns per-image loss (N,).
+
+    Targets are built with static-shape scatters (one `.at[].add` per
+    component over the (N, B) ground-truth table) instead of the
+    reference's per-box CUDA loops; ignore masking compares every
+    decoded prediction against every gt in one batched IoU.
+    """
+    N, C, H, W = x.shape
+    S = len(anchor_mask)
+    nc = class_num
+    assert C == S * (5 + nc), (C, S, nc)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)   # (A, 2)
+    an_sel = an_all[jnp.asarray(anchor_mask)]                   # (S, 2)
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+
+    feats = x.reshape(N, S, 5 + nc, H, W)
+    tx, ty = feats[:, :, 0], feats[:, :, 1]                     # logits
+    tw, th = feats[:, :, 2], feats[:, :, 3]
+    obj_logit = feats[:, :, 4]
+    cls_logit = feats[:, :, 5:]                                 # (N,S,nc,H,W)
+
+    gtb = gt_box.astype(jnp.float32)
+    gx, gy, gw, gh = gtb[..., 0], gtb[..., 1], gtb[..., 2], gtb[..., 3]
+    valid = (gw > 0) & (gh > 0)                                 # (N, B)
+    score = (jnp.ones_like(gx) if gt_score is None
+             else gt_score.astype(jnp.float32))
+
+    # best anchor per gt by shape IoU (centered boxes)
+    gw_abs, gh_abs = gw * input_w, gh * input_h
+    inter = (jnp.minimum(gw_abs[..., None], an_all[None, None, :, 0])
+             * jnp.minimum(gh_abs[..., None], an_all[None, None, :, 1]))
+    union = (gw_abs * gh_abs)[..., None] + \
+        (an_all[:, 0] * an_all[:, 1])[None, None] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # (N,B)
+    mask_arr = jnp.asarray(anchor_mask)
+    on_scale = jnp.any(best_anchor[..., None] == mask_arr[None, None], -1)
+    a_local = jnp.argmax(
+        (best_anchor[..., None] == mask_arr[None, None]).astype(jnp.int32),
+        -1)                                                     # (N, B)
+    use = valid & on_scale
+
+    gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+    n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], gi.shape)
+    # route unused gts to cell (0,0,0) with zero weight
+    a_s = jnp.where(use, a_local, 0)
+    gj_s = jnp.where(use, gj, 0)
+    gi_s = jnp.where(use, gi, 0)
+    live = jnp.where(use, 1.0, 0.0)                             # (N, B)
+
+    def scatter(vals):
+        out = jnp.zeros((N, S, H, W), jnp.float32)
+        return out.at[n_idx, a_s, gj_s, gi_s].add(vals * live)
+
+    sel_w = an_sel[a_local][..., 0] / input_w                   # (N, B)
+    sel_h = an_sel[a_local][..., 1] / input_h
+    t_x = gx * W - gi.astype(jnp.float32)
+    t_y = gy * H - gj.astype(jnp.float32)
+    t_w = jnp.log(jnp.maximum(gw / jnp.maximum(sel_w, 1e-9), 1e-9))
+    t_h = jnp.log(jnp.maximum(gh / jnp.maximum(sel_h, 1e-9), 1e-9))
+    box_w = 2.0 - gw * gh                                       # small-box boost
+
+    cnt = scatter(jnp.ones_like(gx))                            # (N,S,H,W)
+    safe = jnp.maximum(cnt, 1.0)                                # avg collisions
+    pos = jnp.minimum(cnt, 1.0)
+    tgt_x = scatter(t_x) / safe
+    tgt_y = scatter(t_y) / safe
+    tgt_w = scatter(t_w) / safe
+    tgt_h = scatter(t_h) / safe
+    # per-cell loss weight: small-box boost × mixup score
+    wmap = scatter(box_w * score) / safe
+
+    # ignore mask: decoded pred boxes vs every gt
+    gxs = jnp.arange(W, dtype=jnp.float32)
+    gys = jnp.arange(H, dtype=jnp.float32)
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    px = (jax.nn.sigmoid(tx) * alpha + beta + gxs[None, None, None, :]) / W
+    py = (jax.nn.sigmoid(ty) * alpha + beta + gys[None, None, :, None]) / H
+    pw = jnp.exp(tw) * an_sel[None, :, 0, None, None] / input_w
+    phh = jnp.exp(th) * an_sel[None, :, 1, None, None] / input_h
+
+    def corners(cx, cy, w, h):
+        return cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+
+    px1, py1, px2, py2 = corners(px, py, pw, phh)               # (N,S,H,W)
+    gx1, gy1, gx2, gy2 = corners(gx, gy, gw, gh)                # (N,B)
+
+    def bcast_pred(t):
+        return t.reshape(N, S * H * W, 1)
+
+    def bcast_gt(t):
+        return t.reshape(N, 1, -1)
+
+    iw = jnp.maximum(jnp.minimum(bcast_pred(px2), bcast_gt(gx2))
+                     - jnp.maximum(bcast_pred(px1), bcast_gt(gx1)), 0)
+    ih = jnp.maximum(jnp.minimum(bcast_pred(py2), bcast_gt(gy2))
+                     - jnp.maximum(bcast_pred(py1), bcast_gt(gy1)), 0)
+    inter_p = iw * ih
+    area_p = bcast_pred(pw * phh)
+    area_g = bcast_gt(gw * gh)
+    iou = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-10)
+    iou = jnp.where(bcast_gt(valid.astype(jnp.float32)) > 0, iou, 0.0)
+    best_iou = jnp.max(iou, -1).reshape(N, S, H, W)
+    noobj_mask = (best_iou <= ignore_thresh).astype(jnp.float32) * (1 - pos)
+
+    def bce(logit, target):
+        return jax.nn.softplus(logit) - logit * target
+
+    loss_xy = (bce(tx, tgt_x) + bce(ty, tgt_y)) * wmap * pos
+    loss_wh = (jnp.abs(tw - tgt_w) + jnp.abs(th - tgt_h)) * wmap * pos
+    sc_map = scatter(score) / safe                    # mixup score per cell
+    # mixup: the objectness TARGET is the gt score (soft label), matching
+    # the reference's tobj assignment — not a loss weight
+    loss_obj = bce(obj_logit, pos * sc_map) * (pos + noobj_mask)
+
+    smooth_pos = 1.0 - 1.0 / nc if use_label_smooth else 1.0
+    smooth_neg = 1.0 / nc if use_label_smooth else 0.0
+    lbl = jnp.where(use, gt_label, 0).astype(jnp.int32)
+    cls_hit = jnp.zeros((N, S, nc, H, W), jnp.float32)
+    cls_hit = cls_hit.at[n_idx, a_s, lbl, gj_s, gi_s].add(live)
+    cls_soft = jnp.where(cls_hit > 0, smooth_pos, smooth_neg)
+    loss_cls = bce(cls_logit, cls_soft) * (pos * sc_map)[:, :, None]
+
+    per_image = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                 + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return per_image
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True):
+    """ref: paddle.vision.ops.matrix_nms (vision/ops.py:2358) — SOLOv2's
+    parallel soft-NMS: every box's score is decayed by its overlap with
+    higher-scored boxes of the same class, no sequential suppression.
+
+    bboxes: (N, M, 4); scores: (N, C, M). Returns (out (K, 6) rows of
+    [label, score, x1, y1, x2, y2], [index], rois_num) like the
+    reference (eager/host API — the decay core is jittable).
+    """
+    N, M, _ = bboxes.shape
+    C = scores.shape[1]
+    top = M if nms_top_k is None or nms_top_k < 0 else min(nms_top_k, M)
+    norm_off = 0.0 if normalized else 1.0
+
+    def _iou_off(b):
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area = (jnp.maximum(x2 - x1 + norm_off, 0)
+                * jnp.maximum(y2 - y1 + norm_off, 0))
+        iw = (jnp.minimum(x2[:, None], x2[None, :])
+              - jnp.maximum(x1[:, None], x1[None, :]) + norm_off)
+        ih = (jnp.minimum(y2[:, None], y2[None, :])
+              - jnp.maximum(y1[:, None], y1[None, :]) + norm_off)
+        inter = jnp.maximum(iw, 0) * jnp.maximum(ih, 0)
+        return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                   1e-10)
+
+    def decay_scores(boxes, sc):
+        """boxes (M, 4), sc (M,) one class → (decayed scores, order,
+        valid mask), reference semantics (matrix_nms_kernel.cc:81-152):
+        boxes <= score_threshold are dropped BEFORE suppression, decay
+        is min-capped at 1, gaussian decay is exp((max²-iou²)*sigma)."""
+        order = jnp.argsort(-sc)[:top]
+        sb = boxes[order]
+        ss = sc[order]
+        valid = ss > score_threshold
+        iou = _iou_off(sb)
+        upper = jnp.tril(iou, -1).T        # upper[j, i] = iou(j, i), j < i
+        upper = upper * valid[:, None]     # dropped boxes never suppress
+        # compensate of suppressor j: its own max overlap with any
+        # higher-scored (valid) box
+        comp = jnp.max(upper, axis=0)
+        if use_gaussian:
+            decay = jnp.exp((comp[:, None] ** 2 - upper ** 2)
+                            * gaussian_sigma)
+        else:
+            decay = (1 - upper) / jnp.maximum(1 - comp[:, None], 1e-10)
+        factor = jnp.minimum(jnp.min(decay, axis=0), 1.0)
+        return ss * factor, order, valid
+
+    outs, idxs, counts = [], [], []
+    for n in range(N):
+        rows = []
+        boxes_np = np.asarray(bboxes[n])
+        for c in range(C):
+            if c == background_label:
+                continue
+            dec, order, valid = decay_scores(bboxes[n], scores[n, c])
+            dec_np, order_np = np.asarray(dec), np.asarray(order)
+            keep = (dec_np > post_threshold) & np.asarray(valid)
+            for rank in np.nonzero(keep)[0]:
+                i = int(order_np[rank])
+                rows.append((float(c), float(dec_np[rank]),
+                             *boxes_np[i].tolist(), i))
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k is not None and keep_top_k >= 0:
+            rows = rows[:keep_top_k]
+        counts.append(len(rows))
+        for r in rows:
+            outs.append(r[:6])
+            idxs.append(n * M + r[6])
+    out = jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6))
+    index = jnp.asarray(np.asarray(idxs, np.int32).reshape(-1, 1))
+    rois_num = jnp.asarray(counts, jnp.int32)
+    result = [out]
+    if return_index:
+        result.append(index)
+    if return_rois_num:
+        result.append(rois_num)
+    return tuple(result) if len(result) > 1 else out
+
+
 # ---------------------------------------------------------------------------
 # Layer wrappers (ref: vision/ops.py classes)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,94 @@
+"""Audio features vs scipy/librosa-formula goldens (ref:
+python/paddle/audio test surface)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.signal
+
+from paddle_tpu import audio
+
+
+class TestWindows:
+    @pytest.mark.parametrize('name', ['hann', 'hamming', 'blackman',
+                                      'bartlett', 'cosine', 'triang'])
+    @pytest.mark.parametrize('fftbins', [True, False])
+    def test_matches_scipy(self, name, fftbins):
+        got = np.asarray(audio.functional.get_window(name, 64,
+                                                     fftbins=fftbins))
+        want = scipy.signal.get_window(name, 64, fftbins=fftbins)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_gaussian(self):
+        got = np.asarray(audio.functional.get_window(('gaussian', 7.0), 32))
+        want = scipy.signal.get_window(('gaussian', 7.0), 32)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestMelScale:
+    def test_hz_mel_roundtrip(self):
+        f = jnp.asarray([0.0, 440.0, 1000.0, 4000.0, 11025.0])
+        for htk in (False, True):
+            back = audio.functional.mel_to_hz(
+                audio.functional.hz_to_mel(f, htk), htk)
+            np.testing.assert_allclose(np.asarray(back), np.asarray(f),
+                                       rtol=1e-4, atol=1e-2)
+
+    def test_htk_formula(self):
+        # htk: mel = 2595 log10(1 + f/700)
+        got = float(audio.functional.hz_to_mel(1000.0, htk=True))
+        np.testing.assert_allclose(got, 2595 * math.log10(1 + 1000 / 700),
+                                   rtol=1e-6)
+
+    def test_fbank_matrix_properties(self):
+        fb = np.asarray(audio.functional.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # each filter is non-empty and unimodal triangular
+        assert (fb.max(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        x = jnp.asarray([1.0, 10.0, 100.0])
+        got = np.asarray(audio.functional.power_to_db(x, top_db=None))
+        np.testing.assert_allclose(got, [0.0, 10.0, 20.0], atol=1e-5)
+
+    def test_create_dct_ortho(self):
+        # ortho DCT-II basis: columns orthonormal
+        d = np.asarray(audio.functional.create_dct(13, 40))
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+class TestFeatureLayers:
+    def _sig(self, T=4000, sr=16000):
+        t = np.arange(T) / sr
+        x = np.sin(2 * np.pi * 440 * t) + 0.5 * np.sin(2 * np.pi * 2000 * t)
+        return jnp.asarray(x[None], jnp.float32)   # (1, T)
+
+    def test_spectrogram_peaks_at_tones(self):
+        sr, n_fft = 16000, 512
+        spec = audio.Spectrogram(n_fft=n_fft)(self._sig(sr=sr))
+        assert spec.shape[1] == 1 + n_fft // 2
+        mean = np.asarray(spec[0]).mean(axis=1)
+        # strongest bin should be at 440Hz (bin 440/16000*512 = 14)
+        assert abs(int(np.argmax(mean)) - 14) <= 1
+
+    def test_mel_and_logmel_shapes(self):
+        x = self._sig()
+        mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[1] == 40
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert logmel.shape == mel.shape
+        np.testing.assert_allclose(
+            np.asarray(logmel),
+            10 * np.log10(np.maximum(np.asarray(mel), 1e-10)), atol=1e-4)
+
+    def test_mfcc_shape_and_jit(self):
+        x = self._sig()
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+        out = jax.jit(lambda m, x: m(x))(mfcc, x)
+        assert out.shape[1] == 13
+        assert np.isfinite(np.asarray(out)).all()

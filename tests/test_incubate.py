@@ -1,0 +1,161 @@
+"""incubate fused ops vs unfused compositions (ref:
+python/paddle/incubate/nn/functional)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn import functional as F
+
+
+class TestFusedOps:
+    def test_fused_linear(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(16,)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(F.fused_linear(x, w, b)),
+                                   np.asarray(x @ w + b), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(F.fused_matmul_bias(x, w.T, transpose_y=True)),
+            np.asarray(x @ w), rtol=1e-5)
+
+    def test_swiglu_both_forms(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(F.swiglu(x, y)),
+            np.asarray(jax.nn.silu(x) * y), rtol=1e-5)
+        packed = jnp.concatenate([x, y], -1)
+        np.testing.assert_allclose(np.asarray(F.swiglu(packed)),
+                                   np.asarray(F.swiglu(x, y)), rtol=1e-5)
+
+    def test_fused_norms(self):
+        from paddle_tpu.nn.functional.norm import layer_norm, rms_norm
+
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+        res = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(F.fused_rms_norm(x, w)),
+                                   np.asarray(rms_norm(x, w)), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(F.fused_layer_norm(x, w, residual=res)),
+            np.asarray(layer_norm(x + res, 128, w)), rtol=1e-5)
+
+    def test_fused_dropout_add(self):
+        x = jnp.ones((4, 4))
+        y = jnp.full((4, 4), 2.0)
+        # p=0 or eval mode: plain add
+        np.testing.assert_allclose(np.asarray(F.fused_dropout_add(x, y)),
+                                   3.0)
+        np.testing.assert_allclose(
+            np.asarray(F.fused_dropout_add(x, y, p=0.5, training=False)),
+            3.0)
+        out = F.fused_dropout_add(x, y, p=0.5,
+                                  rng_key=jax.random.PRNGKey(0))
+        vals = np.unique(np.asarray(out))
+        assert set(np.round(vals, 4)).issubset({2.0, 4.0})
+
+    def test_fused_rope_matches_llama(self):
+        from paddle_tpu.models.llama import apply_rotary, rope_cos_sin
+
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(2, 16, 4, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 16, 4, 32)), jnp.float32)
+        oq, ok, ov = F.fused_rotary_position_embedding(q, k)
+        pos = jnp.broadcast_to(jnp.arange(16)[None], (2, 16))
+        cos, sin = rope_cos_sin(pos, 32)
+        np.testing.assert_allclose(np.asarray(oq),
+                                   np.asarray(apply_rotary(q, cos, sin)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ok),
+                                   np.asarray(apply_rotary(k, cos, sin)),
+                                   rtol=1e-5)
+        assert ov is None
+
+    def test_fused_mha_matches_unfused(self):
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+        from paddle_tpu.nn.functional.norm import layer_norm
+
+        rng = np.random.default_rng(4)
+        B, S, H, D = 2, 8, 2, 16
+        E = H * D
+        x = jnp.asarray(rng.normal(size=(B, S, E)), jnp.float32)
+        qkv_w = jnp.asarray(rng.normal(size=(3, H, D, E)) * 0.1, jnp.float32)
+        lin_w = jnp.asarray(rng.normal(size=(E, E)) * 0.1, jnp.float32)
+
+        out = F.fused_multi_head_attention(
+            x, qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=jnp.ones(E), pre_ln_bias=jnp.zeros(E))
+
+        xn = layer_norm(x, E, jnp.ones(E), jnp.zeros(E))
+        qkv = jnp.einsum('bse,thde->bsthd', xn, qkv_w)
+        att = _sdpa_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        want = att.reshape(B, S, E) @ lin_w + x
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_fused_ffn_matches_unfused(self):
+        from paddle_tpu.nn.functional.norm import layer_norm
+
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(32, 64)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(64, 32)) * 0.1, jnp.float32)
+        out = F.fused_feedforward(
+            x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+            activation='gelu', pre_layer_norm=True,
+            ln1_scale=jnp.ones(32), ln1_bias=jnp.zeros(32))
+        want = jax.nn.gelu(
+            layer_norm(x, 32, jnp.ones(32), jnp.zeros(32)) @ w1) @ w2 + x
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lookahead_reexport(self):
+        from paddle_tpu.incubate import LookAhead
+        from paddle_tpu.optimizer import SGD
+
+        assert LookAhead(SGD(learning_rate=0.1)).k == 5
+
+
+class TestRopeLayouts:
+    def test_paddle_full_dim_tables(self):
+        from paddle_tpu.incubate.nn import functional as F
+        from paddle_tpu.models.llama import apply_rotary, rope_cos_sin
+
+        rng = np.random.default_rng(6)
+        B, S, H, D = 1, 4, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        cos_h, sin_h = rope_cos_sin(pos, D)
+        # reference layout: (1, S, 1, D) with halves duplicated
+        cos_full = jnp.concatenate([cos_h, cos_h], -1).reshape(1, S, 1, D)
+        sin_full = jnp.concatenate([sin_h, sin_h], -1).reshape(1, S, 1, D)
+        oq, _, _ = F.fused_rotary_position_embedding(
+            q, sin=sin_full, cos=cos_full)
+        want = apply_rotary(q, cos_h, sin_h)
+        np.testing.assert_allclose(np.asarray(oq), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_gptj_interleaved_style(self):
+        from paddle_tpu.incubate.nn import functional as F
+
+        rng = np.random.default_rng(7)
+        B, S, H, D = 1, 4, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        oq, _, _ = F.fused_rotary_position_embedding(
+            q, use_neox_rotary_style=False)
+        # manual GPT-J rotation of pair (0,1) at position s, freq 0
+        theta = 1.0
+        got = np.asarray(oq)
+        x = np.asarray(q)
+        for s in range(S):
+            c, sn = np.cos(s * theta), np.sin(s * theta)
+            np.testing.assert_allclose(
+                got[0, s, 0, 0], x[0, s, 0, 0] * c - x[0, s, 0, 1] * sn,
+                rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                got[0, s, 0, 1], x[0, s, 0, 1] * c + x[0, s, 0, 0] * sn,
+                rtol=1e-4, atol=1e-5)
