@@ -32,15 +32,29 @@ def swiglu(x, y=None):
     return jax.nn.silu(x) * y
 
 
+def _flatten_norm(x, begin_norm_axis):
+    """Paddle norm semantics: normalize over ALL trailing axes from
+    begin_norm_axis; returns (flattened x, restore shape) — a no-op view
+    for the default last-axis case."""
+    axis = begin_norm_axis % x.ndim if begin_norm_axis >= 0 else \
+        x.ndim + begin_norm_axis
+    if axis == x.ndim - 1:
+        return x, None
+    shape = x.shape
+    return x.reshape(shape[:axis] + (-1,)), shape
+
+
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, **kw):
     """ref: fused_rms_norm.py — dispatches to the pallas kernel on TPU."""
     from ...ops import rms_norm as _rms
 
-    out = _rms(x, norm_weight, epsilon)
+    xf, shape = _flatten_norm(x, begin_norm_axis)
+    out = _rms(xf, norm_weight.reshape(-1) if norm_weight is not None
+               else None, epsilon)
     if norm_bias is not None:
-        out = out + norm_bias
-    return out
+        out = out + norm_bias.reshape(-1)
+    return out if shape is None else out.reshape(shape)
 
 
 def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
@@ -50,13 +64,24 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
 
     if residual is not None:
         x = x + residual
-    return layer_norm(x, x.shape[-1], norm_weight, norm_bias, epsilon)
+    xf, shape = _flatten_norm(x, begin_norm_axis)
+    out = layer_norm(xf, xf.shape[-1],
+                     norm_weight.reshape(-1) if norm_weight is not None
+                     else None,
+                     norm_bias.reshape(-1) if norm_bias is not None
+                     else None, epsilon)
+    return out if shape is None else out.reshape(shape)
 
 
 def fused_dropout_add(x, y, p=0.0, training=True, mode='upscale_in_train',
                       rng_key=None):
     """ref: fused_dropout_add.py — dropout(x) + y."""
-    if p == 0.0 or not training:
+    if p == 0.0:
+        return x + y
+    if not training:
+        # downscale_in_infer: train keeps raw activations, infer scales
+        if mode == 'downscale_in_infer':
+            x = x * (1 - p)
         return x + y
     from ...framework import random as random_mod
 
@@ -89,18 +114,20 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             position_ids = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
         cos, sin = rope_cos_sin(position_ids, D, dtype=q.dtype)
     else:
-        cos = jnp.squeeze(jnp.asarray(cos))
-        sin = jnp.squeeze(jnp.asarray(sin))
-        if cos.shape[-1] == D:
-            # reference layout duplicates the half-table along D; for
-            # interleaved style the duplication is pairwise
-            cos = cos[..., ::2] if not use_neox_rotary_style else \
-                cos[..., :D // 2]
-            sin = sin[..., ::2] if not use_neox_rotary_style else \
-                sin[..., :D // 2]
-        if cos.ndim == 2:                  # (S, D/2) → (B, S, D/2)
-            cos = jnp.broadcast_to(cos[None], (B,) + cos.shape)
-            sin = jnp.broadcast_to(sin[None], (B,) + sin.shape)
+        def canon(t):
+            t = jnp.asarray(t)
+            if t.ndim == 4:                # reference layout (B|1, S, 1, D)
+                t = t[:, :, 0, :]
+            if t.ndim == 2:                # (S, Dx) → (1, S, Dx)
+                t = t[None]
+            if t.shape[-1] == D:
+                # full-head-dim table: halves duplicated (neox) or
+                # pairwise-duplicated (interleaved)
+                t = t[..., ::2] if not use_neox_rotary_style else \
+                    t[..., :D // 2]
+            return jnp.broadcast_to(t, (B, S, D // 2))
+
+        cos, sin = canon(cos), canon(sin)
 
     if use_neox_rotary_style:
         rot = lambda x: apply_rotary(x, cos, sin)
@@ -149,6 +176,16 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     if qkv_bias is not None:
         qkv = qkv + qkv_bias.reshape(3, H, D)[None, None]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]     # (B,S,H,D)
+    new_cache = None
+    if cache_kv is not None:
+        # ref layout (2, B, H, S_past, D): append, attend over the
+        # full prefix, and return the grown cache alongside the output
+        past_k = jnp.swapaxes(cache_kv[0], 1, 2)           # (B,S_past,H,D)
+        past_v = jnp.swapaxes(cache_kv[1], 1, 2)
+        k = jnp.concatenate([past_k, k], axis=1)
+        v = jnp.concatenate([past_v, v], axis=1)
+        new_cache = jnp.stack([jnp.swapaxes(k, 1, 2),
+                               jnp.swapaxes(v, 1, 2)])
     out = scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
         training=training)
@@ -161,6 +198,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         out = out + residual
     if not pre_layer_norm:
         out = layer_norm(out, E, ln_scale, ln_bias, ln_epsilon)
+    if new_cache is not None:
+        return out, new_cache
     return out
 
 

@@ -146,37 +146,43 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, 0]                               # (bq,)
-    delta = delta_ref[0, 0, 0]                           # (bq,)
-    if k_tail:
-        krow = ik * bk + jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
-        k = jnp.where(krow < Sk, k, 0.0)
-        v = jnp.where(krow < Sk, v, 0.0)
+    # causal block skip (same as fwd): fully-masked blocks contribute 0
+    live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
 
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    kvalid = True
-    if causal or k_tail:
-        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-        ok = (qpos + offset >= kpos) if causal else True
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0]                           # (bq,)
+        delta = delta_ref[0, 0, 0]                       # (bq,)
         if k_tail:
-            kvalid = kpos < Sk
-            ok = (ok & kvalid) if causal else kvalid
-        s = jnp.where(ok, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])                        # (bq, bk)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    if k_tail:
-        ds = jnp.where(kvalid, ds, 0.0)
-    dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            krow = ik * bk + jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
+            k = jnp.where(krow < Sk, k, 0.0)
+            v = jnp.where(krow < Sk, v, 0.0)
+
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kvalid = True
+        if causal or k_tail:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
+            ok = (qpos + offset >= kpos) if causal else True
+            if k_tail:
+                kvalid = kpos < Sk
+                ok = (ok & kvalid) if causal else kvalid
+            s = jnp.where(ok, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        if k_tail:
+            ds = jnp.where(kvalid, ds, 0.0)
+        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _():
@@ -195,42 +201,49 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, 0]                               # (bq,)
-    delta = delta_ref[0, 0, 0]                           # (bq,)
-    qvalid = True
-    if q_tail:
-        # padded query rows read unspecified q/do/lse/delta — they would
-        # contaminate the dk/dv sums over the query axis. Zero the loads
-        # and (below) the p/ds rows.
-        qrow = iq * bq + jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
-        q = jnp.where(qrow < Sq, q, 0.0)
-        do = jnp.where(qrow < Sq, do, 0.0)
-        qvalid = iq * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 0) < Sq
+    # causal block skip (same as fwd): fully-masked blocks contribute 0
+    live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
 
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
-    if causal:
-        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-        s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    if q_tail:
-        p = jnp.where(qvalid, p, 0.0)
-    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    if q_tail:
-        ds = jnp.where(qvalid, ds, 0.0)
-    dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0]                           # (bq,)
+        delta = delta_ref[0, 0, 0]                       # (bq,)
+        qvalid = True
+        if q_tail:
+            # padded query rows read unspecified q/do/lse/delta — they
+            # would contaminate the dk/dv sums over the query axis. Zero
+            # the loads and (below) the p/ds rows.
+            qrow = iq * bq + jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
+            q = jnp.where(qrow < Sq, q, 0.0)
+            do = jnp.where(qrow < Sq, do, 0.0)
+            qvalid = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0) < Sq
+
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
+            s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if q_tail:
+            p = jnp.where(qvalid, p, 0.0)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        if q_tail:
+            ds = jnp.where(qvalid, ds, 0.0)
+        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(iq == nq - 1)
     def _():

@@ -679,16 +679,21 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         factor = jnp.minimum(jnp.min(decay, axis=0), 1.0)
         return ss * factor, order, valid
 
+    # decay all classes of one image in a single jitted vmap dispatch,
+    # then one device→host transfer per image
+    decay_all = jax.jit(jax.vmap(decay_scores, in_axes=(None, 0)))
+
     outs, idxs, counts = [], [], []
     for n in range(N):
         rows = []
         boxes_np = np.asarray(bboxes[n])
+        dec_a, order_a, valid_a = jax.tree.map(
+            np.asarray, decay_all(bboxes[n], scores[n]))
         for c in range(C):
             if c == background_label:
                 continue
-            dec, order, valid = decay_scores(bboxes[n], scores[n, c])
-            dec_np, order_np = np.asarray(dec), np.asarray(order)
-            keep = (dec_np > post_threshold) & np.asarray(valid)
+            dec_np, order_np = dec_a[c], order_a[c]
+            keep = (dec_np > post_threshold) & valid_a[c]
             for rank in np.nonzero(keep)[0]:
                 i = int(order_np[rank])
                 rows.append((float(c), float(dec_np[rank]),

@@ -159,3 +159,66 @@ class TestRopeLayouts:
             np.testing.assert_allclose(
                 got[0, s, 0, 1], x[0, s, 0, 1] * c + x[0, s, 0, 0] * sn,
                 rtol=1e-4, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_rope_decode_step_s1(self):
+        from paddle_tpu.incubate.nn import functional as F
+
+        rng = np.random.default_rng(8)
+        B, S, H, D = 1, 1, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cos = jnp.ones((1, S, 1, D))
+        sin = jnp.zeros((1, S, 1, D))
+        oq, _, _ = F.fused_rotary_position_embedding(q, sin=sin, cos=cos)
+        np.testing.assert_allclose(np.asarray(oq), np.asarray(q), rtol=1e-6)
+
+    def test_mha_cache_kv(self):
+        from paddle_tpu.incubate.nn import functional as F
+
+        rng = np.random.default_rng(9)
+        B, H, D = 1, 2, 8
+        E = H * D
+        qkv_w = jnp.asarray(rng.normal(size=(3, H, D, E)) * 0.1, jnp.float32)
+        lin_w = jnp.asarray(rng.normal(size=(E, E)) * 0.1, jnp.float32)
+        x_full = jnp.asarray(rng.normal(size=(B, 3, E)), jnp.float32)
+
+        # full-sequence pass (causal-free, so last token attends to all)
+        out_full = F.fused_multi_head_attention(
+            x_full, qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=jnp.ones(E), pre_ln_bias=jnp.zeros(E))
+
+        # incremental: run 2 tokens, cache, then the 3rd
+        qkv = jnp.einsum('bse,thde->bsthd', __import__(
+            'paddle_tpu').nn.functional.layer_norm(
+                x_full[:, :2], E, jnp.ones(E), jnp.zeros(E)), qkv_w)
+        cache = jnp.stack([jnp.swapaxes(qkv[:, :, 1], 1, 2),
+                           jnp.swapaxes(qkv[:, :, 2], 1, 2)])
+        out3, new_cache = F.fused_multi_head_attention(
+            x_full[:, 2:], qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=jnp.ones(E), pre_ln_bias=jnp.zeros(E),
+            cache_kv=cache)
+        assert new_cache.shape == (2, B, H, 3, D)
+        np.testing.assert_allclose(np.asarray(out3[:, 0]),
+                                   np.asarray(out_full[:, 2]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dropout_downscale_in_infer(self):
+        from paddle_tpu.incubate.nn import functional as F
+
+        x = jnp.full((4,), 2.0)
+        y = jnp.full((4,), 1.0)
+        out = F.fused_dropout_add(x, y, p=0.5, training=False,
+                                  mode='downscale_in_infer')
+        np.testing.assert_allclose(np.asarray(out), 2.0)  # 2*0.5 + 1
+
+    def test_begin_norm_axis(self):
+        from paddle_tpu.incubate.nn import functional as F
+        from paddle_tpu.nn.functional.norm import layer_norm
+
+        rng = np.random.default_rng(10)
+        x = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+        got = F.fused_layer_norm(x, begin_norm_axis=1)
+        want = layer_norm(x.reshape(2, 12), 12).reshape(2, 3, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
