@@ -125,6 +125,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 # pairwise-duplicated (interleaved)
                 t = t[..., ::2] if not use_neox_rotary_style else \
                     t[..., :D // 2]
+            if position_ids is not None:
+                # gather table rows at the requested positions (decode
+                # steps pass the full-length table + position_ids=[[t]])
+                t = jnp.broadcast_to(t, (B,) + t.shape[1:])
+                t = jnp.take_along_axis(
+                    t, jnp.asarray(position_ids)[:, :, None], axis=1)
             return jnp.broadcast_to(t, (B, S, D // 2))
 
         cos, sin = canon(cos), canon(sin)

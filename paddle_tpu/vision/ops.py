@@ -12,6 +12,7 @@ reference's variable-length index list (eager/host use).
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -627,21 +628,14 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return per_image
 
 
-def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
-               nms_top_k=400, keep_top_k=200, use_gaussian=False,
-               gaussian_sigma=2.0, background_label=0, normalized=True,
-               return_index=False, return_rois_num=True):
-    """ref: paddle.vision.ops.matrix_nms (vision/ops.py:2358) — SOLOv2's
-    parallel soft-NMS: every box's score is decayed by its overlap with
-    higher-scored boxes of the same class, no sequential suppression.
-
-    bboxes: (N, M, 4); scores: (N, C, M). Returns (out (K, 6) rows of
-    [label, score, x1, y1, x2, y2], [index], rois_num) like the
-    reference (eager/host API — the decay core is jittable).
-    """
-    N, M, _ = bboxes.shape
-    C = scores.shape[1]
-    top = M if nms_top_k is None or nms_top_k < 0 else min(nms_top_k, M)
+@functools.lru_cache(maxsize=64)
+def _matrix_nms_decay_fn(score_threshold, top, use_gaussian, gaussian_sigma,
+                         normalized):
+    """Jitted per-class decay core for matrix_nms (reference semantics:
+    matrix_nms_kernel.cc:81-152 — boxes <= score_threshold are dropped
+    BEFORE suppression, decay is min-capped at 1, gaussian decay is
+    exp((max²-iou²)*sigma)). Cached so repeated inference reuses the
+    compilation."""
     norm_off = 0.0 if normalized else 1.0
 
     def _iou_off(b):
@@ -657,10 +651,6 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                                    1e-10)
 
     def decay_scores(boxes, sc):
-        """boxes (M, 4), sc (M,) one class → (decayed scores, order,
-        valid mask), reference semantics (matrix_nms_kernel.cc:81-152):
-        boxes <= score_threshold are dropped BEFORE suppression, decay
-        is min-capped at 1, gaussian decay is exp((max²-iou²)*sigma)."""
         order = jnp.argsort(-sc)[:top]
         sb = boxes[order]
         ss = sc[order]
@@ -679,9 +669,29 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         factor = jnp.minimum(jnp.min(decay, axis=0), 1.0)
         return ss * factor, order, valid
 
-    # decay all classes of one image in a single jitted vmap dispatch,
-    # then one device→host transfer per image
-    decay_all = jax.jit(jax.vmap(decay_scores, in_axes=(None, 0)))
+    return jax.jit(jax.vmap(decay_scores, in_axes=(None, 0)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True):
+    """ref: paddle.vision.ops.matrix_nms (vision/ops.py:2358) — SOLOv2's
+    parallel soft-NMS: every box's score is decayed by its overlap with
+    higher-scored boxes of the same class, no sequential suppression.
+
+    bboxes: (N, M, 4); scores: (N, C, M). Returns (out (K, 6) rows of
+    [label, score, x1, y1, x2, y2], [index], rois_num) like the
+    reference (eager/host API — the decay core is jittable).
+    """
+    N, M, _ = bboxes.shape
+    C = scores.shape[1]
+    top = M if nms_top_k is None or nms_top_k < 0 else min(nms_top_k, M)
+    # module-level jitted factory: compilation is cached across calls
+    # (keyed on the static decay parameters + shapes)
+    decay_all = _matrix_nms_decay_fn(
+        float(score_threshold), int(top), bool(use_gaussian),
+        float(gaussian_sigma), bool(normalized))
 
     outs, idxs, counts = [], [], []
     for n in range(N):

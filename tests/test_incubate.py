@@ -222,3 +222,25 @@ class TestReviewRegressions:
         want = layer_norm(x.reshape(2, 12), 12).reshape(2, 3, 4)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5)
+
+
+class TestRopePositionIds:
+    def test_decode_step_gathers_positions(self):
+        from paddle_tpu.incubate.nn import functional as F
+        from paddle_tpu.models.llama import apply_rotary, rope_cos_sin
+
+        rng = np.random.default_rng(11)
+        B, H, D, max_pos = 2, 2, 8, 16
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        # full-length reference-layout table
+        all_pos = jnp.arange(max_pos)[None]
+        cos_h, sin_h = rope_cos_sin(all_pos, D)          # (1, max_pos, D/2)
+        cos_t = jnp.concatenate([cos_h, cos_h], -1).reshape(1, max_pos, 1, D)
+        sin_t = jnp.concatenate([sin_h, sin_h], -1).reshape(1, max_pos, 1, D)
+        pos = jnp.asarray([[5], [9]])
+        oq, _, _ = F.fused_rotary_position_embedding(
+            q, sin=sin_t, cos=cos_t, position_ids=pos)
+        cos_g, sin_g = rope_cos_sin(pos, D)              # (B, 1, D/2)
+        want = apply_rotary(q, cos_g, sin_g)
+        np.testing.assert_allclose(np.asarray(oq), np.asarray(want),
+                                   rtol=1e-5)

@@ -178,3 +178,29 @@ class TestLookAhead:
         for _ in range(30):
             model, state, loss = step(model, state)
         assert float(loss) < float(l0)
+
+
+class TestHapiIntegration:
+    def test_model_fit_with_gradient_merge(self):
+        """GradientMerge implements the Optimizer protocol, so it drops
+        into Model.prepare/fit (VERDICT r2 item #10 done-criterion)."""
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+        from paddle_tpu.io import TensorDataset
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = (x @ rng.normal(size=(8, 1))).astype(np.float32)
+
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        model = pt.Model(net)
+        model.prepare(
+            optimizer=GradientMerge(AdamW(learning_rate=1e-2), k_steps=2),
+            loss=nn.MSELoss())
+        hist_first = model.train_batch([x[:8]], [y[:8]])
+        for _ in range(3):
+            model.fit(TensorDataset([x, y]), batch_size=8, epochs=1,
+                      verbose=0)
+        hist_last = model.train_batch([x[:8]], [y[:8]])
+        assert hist_last[0] < hist_first[0]
