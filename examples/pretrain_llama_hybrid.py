@@ -21,10 +21,10 @@ if 'xla_force_host_platform_device_count' not in os.environ.get('XLA_FLAGS', '')
                                + ' --xla_force_host_platform_device_count=8')
 import jax
 
-# this demo targets the virtual 8-device CPU mesh: force CPU before the
-# backend initialises unless the machine actually has >= 8 accelerators
-# (a site preset like JAX_PLATFORMS pointing at 1 chip would otherwise
-# break the dp2 x pp2 x tp2 mesh factoring)
+# this demo always runs on the virtual 8-device CPU mesh (a site preset
+# like JAX_PLATFORMS pointing at 1 real chip would break the
+# dp2 x pp2 x tp2 factoring); adapt the mesh degrees before dropping
+# this override on a real multi-chip host
 os.environ['JAX_PLATFORMS'] = 'cpu'
 jax.config.update('jax_platforms', 'cpu')
 

@@ -54,9 +54,22 @@ def scaled_dot_product_attention(
     scale=None,
     training=True,
     rng_key=None,
+    segment_ids=None,
+    kv_segment_ids=None,
 ):
-    """Flash attention on TPU; lax reference elsewhere/with masks it can't take."""
+    """Flash attention on TPU; lax reference elsewhere/with masks it can't take.
+
+    segment_ids (+optional kv_segment_ids for Sq != Sk): (B, Sq)/(B, Sk)
+    int32 packed-sequence ids — attention is block-diagonal within equal
+    ids (flash kernel fast path on TPU).
+    """
     from ...ops import use_pallas
+
+    if segment_ids is not None and kv_segment_ids is None:
+        if query.shape[1] != key.shape[1]:
+            raise ValueError(
+                'segment_ids with Sq != Sk requires kv_segment_ids')
+        kv_segment_ids = segment_ids
 
     use_flash = (
         dropout_p == 0.0
@@ -69,12 +82,25 @@ def scaled_dot_product_attention(
         try:
             from ...ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(query, key, value, causal=is_causal, scale=scale)
+            return flash_attention(query, key, value, causal=is_causal,
+                                   scale=scale, segment_ids=segment_ids,
+                                   kv_segment_ids=kv_segment_ids)
         except Exception as e:
             import warnings
 
             warnings.warn(f'pallas flash attention unavailable, using lax '
                           f'reference: {e!r}', stacklevel=2)
+    if segment_ids is not None:
+        qseg = jnp.asarray(segment_ids)
+        kseg = jnp.asarray(kv_segment_ids)
+        seg_mask = (qseg[:, :, None] == kseg[:, None, :])[:, None]
+        if attn_mask is None:
+            attn_mask = seg_mask
+        elif attn_mask.dtype == jnp.bool_:
+            attn_mask = attn_mask & seg_mask
+        else:
+            # additive float mask: masked-out pairs get -inf-like bias
+            attn_mask = jnp.where(seg_mask, attn_mask, -1e30)
     return _sdpa_reference(
         query, key, value, attn_mask, dropout_p, is_causal, scale, rng_key, training
     )

@@ -35,8 +35,12 @@ def _interpret():
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, bq, bk, nk, offset, Sq, Sk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
+                scale, causal, bq, bk, nk, offset, Sq, Sk, has_seg=False):
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+    else:
+        o_ref, lse_ref, acc, m_scr, l_scr = refs
     ik = pl.program_id(3)
     iq = pl.program_id(2)
     k_tail = Sk % bk != 0                               # static
@@ -63,19 +67,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
 
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal or k_tail:
+        ok = None
+        if causal or k_tail or has_seg:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq),
-            # merged with the key-tail validity mask
-            ok = (qpos + offset >= kpos) if causal else True
+            # merged with the key-tail validity and segment masks
+            ok = (qpos + offset >= kpos) if causal else \
+                jnp.ones((bq, bk), bool)
             if k_tail:
-                ok = ok & (kpos < Sk) if causal else (kpos < Sk)
+                ok = ok & (kpos < Sk)
+            if has_seg:
+                ok = ok & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
             s = jnp.where(ok, s, NEG_INF)
 
         m_prev = m_scr[:, 0]                             # (bq,)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
+        if ok is not None:
+            # a fully-masked row has m_new == NEG_INF and exp(0) == 1
+            # everywhere — force those probabilities to the true 0 so
+            # empty-segment queries return 0 and leak no gradient
+            p = jnp.where(ok, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
         acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
@@ -94,26 +107,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         lse_ref[0, 0, 0] = m_scr[:, 0] + jnp.log(safe)
 
 
-def _fwd(q, k, v, scale, causal, bq, bk):
-    """q: (B, H, Sq, D); k/v: (B, Hkv, Sk, D) → (out, lse)."""
+def _fwd(q, k, v, scale, causal, bq, bk, qseg=None, kseg=None):
+    """q: (B, H, Sq, D); k/v: (B, Hkv, Sk, D) → (out, lse).
+
+    qseg/kseg: optional (B, Sq)/(B, Sk) int32 segment ids — tokens only
+    attend within equal ids (packed-sequence block-diagonal mask).
+    """
     B, H, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
     group = H // Hkv
     bq = min(bq, Sq)
     bk = min(bk, Sk)
     nq, nk = pl.cdiv(Sq, bq), pl.cdiv(Sk, bk)
+    has_seg = qseg is not None
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, offset=Sk - Sq,
-                               Sq=Sq, Sk=Sk)
+                               Sq=Sq, Sk=Sk, has_seg=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h // group, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, bk), lambda b, h, i, j: (b, j)),
+        ]
+        operands += [jnp.asarray(qseg, jnp.int32),
+                     jnp.asarray(kseg, jnp.int32)]
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h // group, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
@@ -128,7 +155,7 @@ def _fwd(q, k, v, scale, causal, bq, bk):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -136,8 +163,13 @@ def _fwd(q, k, v, scale, causal, bq, bk):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, bq, bk, nk, offset, Sq, Sk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                   scale, causal, bq, bk, nk, offset, Sq, Sk,
+                   has_seg=False):
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
     ik = pl.program_id(3)
     iq = pl.program_id(2)
     k_tail = Sk % bk != 0                                # static
@@ -165,16 +197,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         kvalid = True
-        if causal or k_tail:
+        if causal or k_tail or has_seg:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-            ok = (qpos + offset >= kpos) if causal else True
+            ok = (qpos + offset >= kpos) if causal else \
+                jnp.ones((bq, bk), bool)
             if k_tail:
                 kvalid = kpos < Sk
-                ok = (ok & kvalid) if causal else kvalid
+                ok = ok & kvalid
+            if has_seg:
+                ok = ok & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        if causal or k_tail or has_seg:
+            # empty-segment rows: lse ≈ NEG_INF makes exp(s - lse) = 1
+            p = jnp.where(ok, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -190,8 +228,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq,
-                    offset, Sq, Sk):
+                    *refs, scale, causal, bq, bk, nq,
+                    offset, Sq, Sk, has_seg=False):
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     iq = pl.program_id(3)
     ik = pl.program_id(2)
     q_tail = Sq % bq != 0                                # static
@@ -225,12 +267,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
+        if causal or has_seg:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
-            s = jnp.where(qpos + offset >= kpos, s, NEG_INF)
+            ok = (qpos + offset >= kpos) if causal else \
+                jnp.ones((bq, bk), bool)
+            if has_seg:
+                ok = ok & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
+        if causal or has_seg:
+            # empty-segment rows: lse ≈ NEG_INF makes exp(s - lse) = 1
+            p = jnp.where(ok, p, 0.0)
         if q_tail:
             p = jnp.where(qvalid, p, 0.0)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -251,9 +300,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, bq, bk, res, g):
+def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None):
     q, k, v, out, lse = res
     do, _ = g
+    has_seg = qseg is not None
+    seg_ops = ([jnp.asarray(qseg, jnp.int32), jnp.asarray(kseg, jnp.int32)]
+               if has_seg else [])
     B, H, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
     group = H // Hkv
@@ -265,39 +317,51 @@ def _bwd(scale, causal, bq, bk, res, g):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, :, None, :]
 
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
+        pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
+    ]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq_), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, bk_), lambda b, h, i, j: (b, j)),
+        ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq_, bk=bk_, nk=nk, offset=Sk - Sq,
-                          Sq=Sq, Sk=Sk),
+                          Sq=Sq, Sk=Sk, has_seg=has_seg),
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bk_, D), lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
-            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, i, j: (b, h, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq_, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_ops)
 
     # per-q-head dk/dv, then reduce GQA groups
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
+        pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
+    ]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq_), lambda b, h, j, i: (b, i)),
+            pl.BlockSpec((1, bk_), lambda b, h, j, i: (b, j)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq_, bk=bk_, nq=nq, offset=Sk - Sq,
-                          Sq=Sq, Sk=Sk),
+                          Sq=Sq, Sk=Sk, has_seg=has_seg),
         grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bq_, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
-            pl.BlockSpec((1, 1, 1, bq_), lambda b, h, j, i: (b, h, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk_, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -311,7 +375,7 @@ def _bwd(scale, causal, bq, bk, res, g):
             pltpu.VMEM((bk_, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_ops)
 
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Sk, D).sum(axis=2)
@@ -341,13 +405,48 @@ def _flash_bwd(scale, causal, bq, bk, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_seg(q, k, v, qseg, kseg, scale, causal, bq, bk):
+    out, _ = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, qseg, kseg, scale, causal, bq, bk):
+    out, lse = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg)
+    return out, (q, k, v, out, lse, qseg, kseg)
+
+
+def _flash_seg_bwd(scale, causal, bq, bk, res, g):
+    q, k, v, out, lse, qseg, kseg = res
+    dq, dk, dv = _bwd(scale, causal, bq, bk, (q, k, v, out, lse),
+                      (g, None), qseg, kseg)
+    return dq, dk, dv, None, None
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D). Returns (B, Sq, H, D)."""
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    segment_ids=None, kv_segment_ids=None):
+    """q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D). Returns (B, Sq, H, D).
+
+    segment_ids/(kv_segment_ids): optional (B, Sq)/(B, Sk) int32 packed-
+    sequence ids — attention is block-diagonal within equal ids (tokens
+    of different packed documents never attend to each other). With
+    causal=True both masks compose. A query whose segment has no kv
+    tokens returns 0 for that row.
+    """
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash(qt, kt, vt, float(scale), bool(causal), block_q, block_k)
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        out = _flash_seg(qt, kt, vt, jnp.asarray(segment_ids, jnp.int32),
+                         jnp.asarray(kv_seg, jnp.int32),
+                         float(scale), bool(causal), block_q, block_k)
+    else:
+        out = _flash(qt, kt, vt, float(scale), bool(causal), block_q, block_k)
     return jnp.swapaxes(out, 1, 2)

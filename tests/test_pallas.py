@@ -239,3 +239,108 @@ class TestFp8Matmul:
         rel = np.abs(np.asarray(out - ref)).max() / np.abs(
             np.asarray(ref)).max()
         assert rel < 0.05
+
+
+class TestSegmentMasking:
+    """Packed-sequence block-diagonal masking (SURVEY §2.12)."""
+
+    def _ref(self, q, k, v, qseg, kseg, causal):
+        mask = qseg[:, :, None] == kseg[:, None, :]      # (B, Sq, Sk)
+        if causal:
+            Sq, Sk = q.shape[1], k.shape[1]
+            mask = mask & jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        return _sdpa_reference(q, k, v, attn_mask=mask[:, None], is_causal=False)
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_fwd_matches_masked_reference(self, causal):
+        rng = np.random.default_rng(0)
+        B, S = 2, 256
+        q = jnp.asarray(rng.normal(size=(B, S, 2, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, 2, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, 2, 64)), jnp.float32)
+        # 3 packed documents of uneven lengths
+        seg = jnp.asarray(np.concatenate([
+            np.zeros(100), np.ones(89), np.full(S - 189, 2)])[None].repeat(
+                B, 0), jnp.int32)
+        out = flash_attention(q, k, v, causal=causal, segment_ids=seg)
+        ref = self._ref(q, k, v, seg, seg, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_grads_match_masked_reference(self):
+        rng = np.random.default_rng(1)
+        B, S = 1, 128
+        q = jnp.asarray(rng.normal(size=(B, S, 2, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, 2, 32)), jnp.float32)
+        seg = jnp.asarray(np.concatenate([np.zeros(70), np.ones(S - 70)])[
+            None], jnp.int32)
+
+        g1 = jax.grad(lambda *a: (flash_attention(
+            *a, causal=True, segment_ids=seg) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: (self._ref(*a, seg, seg, True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_gqa_and_odd_blocks(self):
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(1, 300, 4, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 300, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 300, 2, 32)), jnp.float32)
+        seg = jnp.asarray(np.concatenate([np.zeros(150), np.ones(150)])[
+            None], jnp.int32)
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                              block_q=256, block_k=256)
+        ref = self._ref(q, k, v, seg, seg, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_empty_segment_rows_zero_and_no_grad_leak(self):
+        # query segment 99 has no kv tokens: output must be 0 and no
+        # gradient may leak into other segments' k/v
+        rng = np.random.default_rng(3)
+        S = 128
+        q = jnp.asarray(rng.normal(size=(1, S, 2, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, S, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, S, 2, 32)), jnp.float32)
+        qseg = jnp.asarray(np.concatenate([np.full(64, 99), np.zeros(64)])[
+            None], jnp.int32)
+        kseg = jnp.zeros((1, S), jnp.int32)
+        out = flash_attention(q, k, v, causal=False, segment_ids=qseg,
+                              kv_segment_ids=kseg)
+        np.testing.assert_allclose(np.asarray(out[0, :64]), 0.0, atol=1e-6)
+
+        def loss(k, v):
+            o = flash_attention(q, k, v, causal=False, segment_ids=qseg,
+                                kv_segment_ids=kseg)
+            return (o[0, :64].astype(jnp.float32) ** 2).sum()
+
+        dk, dv = jax.grad(loss, argnums=(0, 1))(k, v)
+        np.testing.assert_allclose(np.asarray(dk), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dv), 0.0, atol=1e-6)
+
+    def test_sdpa_segment_with_float_mask_and_cross_lengths(self):
+        from paddle_tpu.nn.functional.attention import (
+            scaled_dot_product_attention)
+
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), jnp.float32)
+        bias = jnp.zeros((1, 1, 16, 16), jnp.float32)
+        seg = jnp.zeros((1, 16), jnp.int32)
+        out = scaled_dot_product_attention(q, k, k, attn_mask=bias,
+                                           segment_ids=seg)
+        ref = scaled_dot_product_attention(q, k, k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5)
+        # Sq != Sk without kv ids must raise, with kv ids must work
+        q2 = q[:, :8]
+        with pytest.raises(ValueError):
+            scaled_dot_product_attention(q2, k, k, segment_ids=seg[:, :8])
+        out2 = scaled_dot_product_attention(q2, k, k,
+                                            segment_ids=seg[:, :8],
+                                            kv_segment_ids=seg)
+        assert out2.shape == (1, 8, 2, 8)
