@@ -54,6 +54,7 @@ from .hapi import Model, summary  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401

@@ -1,0 +1,114 @@
+"""paddle_tpu.geometric (ref: python/paddle/geometric) — graph segment
+math + message passing over XLA segment/scatter primitives.
+
+The reference's CUDA graph_send_recv kernels become `jax.ops.segment_*`
+reductions (sorted-scatter under the hood, TPU-friendly); `num_segments`
+/ `out_size` must be static under jit, matching the reference's
+requirement that out_size be known for the static graph. Sampling /
+reindex (host-side graph preprocessing, ref geometric/sampling) stay on
+numpy — they are data-pipeline utilities, not device code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+]
+
+
+def _num_segments(segment_ids, n):
+    if n is not None:
+        return int(n)
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise ValueError(
+            'num_segments must be passed explicitly under jit '
+            '(the output size must be static)')
+    import numpy as np
+
+    return int(np.asarray(jnp.max(segment_ids)) + 1)
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    """ref: paddle.geometric.segment_sum (geometric/math.py:29)."""
+    return jax.ops.segment_sum(data, segment_ids,
+                               _num_segments(segment_ids, num_segments))
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    """ref: geometric/math.py:88 — empty segments yield 0 like the ref."""
+    n = _num_segments(segment_ids, num_segments)
+    tot = jax.ops.segment_sum(data, segment_ids, n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids, data.dtype),
+                              segment_ids, n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return tot / jnp.maximum(cnt.reshape(shape), 1)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    """ref: geometric/math.py:149 — empty segments yield 0 like the ref."""
+    n = _num_segments(segment_ids, num_segments)
+    out = jax.ops.segment_min(data, segment_ids, n)
+    return _zero_empty(out, segment_ids, n, data)
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    """ref: geometric/math.py:209 — empty segments yield 0 like the ref."""
+    n = _num_segments(segment_ids, num_segments)
+    out = jax.ops.segment_max(data, segment_ids, n)
+    return _zero_empty(out, segment_ids, n, data)
+
+
+def _zero_empty(out, segment_ids, n, data):
+    cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids), segment_ids, n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return jnp.where(cnt.reshape(shape) > 0, out, 0)
+
+
+_REDUCERS = {
+    'sum': jax.ops.segment_sum,
+    'add': jax.ops.segment_sum,
+    'mean': None,                      # handled via sum/count
+    'min': jax.ops.segment_min,
+    'max': jax.ops.segment_max,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op='sum', out_size=None):
+    """ref: geometric/message_passing/send_recv.py:55 — gather src-node
+    features along edges, reduce at dst nodes."""
+    return send_ue_recv(x, None, src_index, dst_index, 'add', reduce_op,
+                        out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op='add',
+                 reduce_op='sum', out_size=None):
+    """ref: send_recv.py:210 — combine src features with edge features
+    (add/sub/mul/div), reduce at dst."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {list(_REDUCERS)}")
+    msg = x[src_index]                                  # (E, ...)
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim < msg.ndim:                           # per-edge scalar
+            y = y.reshape(y.shape + (1,) * (msg.ndim - y.ndim))
+        msg = {'add': msg + y, 'sub': msg - y, 'mul': msg * y,
+               'div': msg / y}[message_op]
+    n = out_size if out_size is not None else x.shape[0]
+    if reduce_op == 'mean':
+        return segment_mean(msg, dst_index, n)
+    out = _REDUCERS[reduce_op](msg, dst_index, n)
+    if reduce_op in ('min', 'max'):
+        out = _zero_empty(out, dst_index, n, msg)
+    return out
+
+
+def send_uv(x, y, src_index, dst_index, message_op='add'):
+    """ref: send_recv.py:413 — per-edge message from src (x) and dst (y)
+    node features, no reduction."""
+    xs = x[src_index]
+    yd = y[dst_index]
+    return {'add': xs + yd, 'sub': xs - yd, 'mul': xs * yd,
+            'div': xs / yd}[message_op]

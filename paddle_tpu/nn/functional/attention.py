@@ -65,6 +65,8 @@ def scaled_dot_product_attention(
     """
     from ...ops import use_pallas
 
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError('kv_segment_ids requires segment_ids')
     if segment_ids is not None and kv_segment_ids is None:
         if query.shape[1] != key.shape[1]:
             raise ValueError(
@@ -101,9 +103,16 @@ def scaled_dot_product_attention(
         else:
             # additive float mask: masked-out pairs get -inf-like bias
             attn_mask = jnp.where(seg_mask, attn_mask, -1e30)
-    return _sdpa_reference(
+    out = _sdpa_reference(
         query, key, value, attn_mask, dropout_p, is_causal, scale, rng_key, training
     )
+    if segment_ids is not None:
+        # match the kernel's empty-segment convention: a query whose
+        # segment has no kv tokens returns 0 (softmax of an all-masked
+        # row would otherwise emit the uniform mean of v and leak grads)
+        row_valid = jnp.any(seg_mask[:, 0], axis=-1)     # (B, Sq)
+        out = jnp.where(row_valid[:, :, None, None], out, 0.0)
+    return out
 
 
 flash_attention = scaled_dot_product_attention
