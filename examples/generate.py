@@ -28,6 +28,9 @@ def main():
                              rng_key=jax.random.PRNGKey(7))
     print('sampled:', np.asarray(sampled[0]))
 
+    beam = model.generate(prompt, max_new_tokens=16, num_beams=4)
+    print('beam-4 :', np.asarray(beam[0]))
+
 
 if __name__ == '__main__':
     main()
