@@ -57,6 +57,7 @@ from . import fft  # noqa: F401
 from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import signal  # noqa: F401
+from . import text  # noqa: F401
 from . import sparse  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
@@ -84,3 +85,69 @@ def is_complex(x):
 
 def is_integer(x):
     return _dtype_mod.is_integer(x.dtype if hasattr(x, 'dtype') else x)
+
+
+# ---- top-level long tail (ref: python/paddle/__init__.py __all__) ----------
+from .tensor import extension as _ext  # noqa: E402
+from .tensor.extension import *  # noqa: F401,F403,E402
+from .tensor.extension import rank, shape, tolist  # noqa: F401,E402
+from .tensor.random import (  # noqa: F401,E402
+    binomial,
+    cauchy_,
+    geometric_,
+    log_normal,
+    log_normal_,
+)
+from .framework import compat as _compat  # noqa: E402
+from .framework.compat import (  # noqa: F401,E402
+    LazyGuard,
+    ParamAttr,
+    batch,
+    check_shape,
+    create_parameter,
+    disable_signal_handler,
+    disable_static,
+    enable_static,
+    get_cuda_rng_state,
+    in_dynamic_mode,
+    set_cuda_rng_state,
+    set_grad_enabled,
+    set_printoptions,
+)
+from .autograd import enable_grad, is_grad_enabled  # noqa: F401,E402
+from .device import CPUPlace as CUDAPinnedPlace  # noqa: F401,E402
+from .device import TPUPlace as CUDAPlace  # noqa: F401,E402
+from .framework.dtype import bool_ as bool  # noqa: F401,E402,A001
+from .framework.dtype import float8_e4m3 as float8_e4m3fn  # noqa: F401,E402
+from .framework.dtype import float8_e5m2  # noqa: F401,E402
+
+dtype = _jnp.dtype  # paddle.dtype: the dtype type itself
+
+# In-place variants: jax arrays are immutable, so each `op_` is the pure
+# op — reference code uses the return value, which matches.
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+for _name in [
+    'abs', 'acos', 'addmm', 'asin', 'atan', 'atan2', 'bitwise_and',
+    'bitwise_not', 'bitwise_or', 'bitwise_xor', 'cast', 'ceil', 'clip',
+    'copysign', 'cos', 'cumprod', 'cumsum', 'digamma', 'divide', 'equal',
+    'erf', 'erfinv', 'exp', 'expm1', 'fill_diagonal', 'flatten', 'floor',
+    'floor_divide', 'floor_mod', 'frac', 'gammainc', 'gammaincc',
+    'gammaln', 'gcd', 'greater_equal', 'greater_than', 'hardtanh',
+    'hypot', 'i0', 'index_add', 'index_fill', 'index_put', 'lcm',
+    'ldexp', 'less_equal', 'less_than', 'lerp', 'lgamma', 'log', 'log10',
+    'log1p', 'log2', 'logical_and', 'logical_not', 'logical_or',
+    'logical_xor', 'logit', 'masked_fill', 'masked_scatter', 'mod',
+    'multigammaln', 'multiply', 'nan_to_num', 'neg', 'normal', 'pow',
+    'polygamma', 'put_along_axis', 'reciprocal', 'remainder', 'renorm',
+    'round', 'rsqrt', 'scale', 'scatter', 'sigmoid', 'sin', 'sinc',
+    'sinh', 'sqrt', 'square', 'squeeze', 'subtract', 't', 'tan', 'tanh',
+    'tril', 'triu', 'trunc', 'uniform', 'unsqueeze', 'where', 'zero',
+    'bitwise_left_shift', 'bitwise_right_shift', 'exponential',
+    'bernoulli', 'transpose',
+]:
+    _fn = getattr(_self, _name, None)
+    if _fn is not None and not hasattr(_self, _name + '_'):
+        setattr(_self, _name + '_', _fn)
+del _sys, _self, _name, _fn

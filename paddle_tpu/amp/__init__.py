@@ -155,3 +155,14 @@ class debugging:
         return None
 
     check_numerics = staticmethod(check_numerics)
+
+
+def is_float16_supported(device=None):
+    """ref: paddle.amp.is_float16_supported — fp16 compute works on TPU
+    (upcast-accumulate), bf16 is the native fast path."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """ref: paddle.amp.is_bfloat16_supported — bf16 IS the TPU MXU dtype."""
+    return True

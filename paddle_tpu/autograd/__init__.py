@@ -115,11 +115,21 @@ class PyLayer:
     class _Ctx:
         def __init__(self):
             self.saved = ()
+            self._unpack = None
 
         def save_for_backward(self, *xs):
+            # capture the hook PAIR at save time: backward may run after
+            # the with-block exits (the reference's documented pattern),
+            # so unpack must not be looked up from the live stack
+            hooks = saved_tensors_hooks._active
+            if hooks:
+                pack, self._unpack = hooks[-1]
+                xs = tuple(pack(x) for x in xs)
             self.saved = xs
 
         def saved_tensor(self):
+            if self._unpack is not None:
+                return tuple(self._unpack(x) for x in self.saved)
             return self.saved
 
     def __init_subclass__(cls, **kw):
@@ -132,11 +142,17 @@ class PyLayer:
         def fwd(*args):
             ctx = PyLayer._Ctx()
             out = cls.forward(ctx, *args)
+            # the hook pair captured at save time is static (a Python
+            # function, not a tracer): carry it on the class so bwd —
+            # traced in the same grad transform — sees it even when
+            # backward runs after the hooks context has exited
+            cls._saved_unpack = ctx._unpack
             return out, ctx.saved
 
         def bwd(saved, g):
             ctx = PyLayer._Ctx()
             ctx.saved = saved
+            ctx._unpack = getattr(cls, '_saved_unpack', None)
             grads = cls.backward(ctx, g)
             if not isinstance(grads, tuple):
                 grads = (grads,)
@@ -164,3 +180,33 @@ def jacobian(fn, x):
 
 def hessian(fn, x):
     return jax.hessian(fn)(x)
+
+
+# ref: paddle.autograd.PyLayerContext — the ctx object handed to
+# PyLayer.forward/backward
+PyLayerContext = PyLayer._Ctx
+
+
+class saved_tensors_hooks:
+    """ref: paddle.autograd.saved_tensors_hooks(pack, unpack) — transform
+    residuals as they are stashed for backward. PyLayer consults the
+    active hook pair in save_for_backward / saved_tensor; jax.grad's own
+    residuals are managed by XLA (remat covers the memory use case)."""
+
+    _active = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append((self.pack_hook,
+                                            self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False
+
+
+__all__ += ['PyLayerContext', 'saved_tensors_hooks']

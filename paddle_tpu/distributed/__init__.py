@@ -46,6 +46,68 @@ from .parallel import (  # noqa: F401
     shard_model,
     shard_tensor,
 )
+from .recompute import (  # noqa: F401
+    recompute,
+    recompute_sequential,
+    recompute_wrapper,
+)
+from . import io  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    DistAttr,
+    DistModel,
+    Partial,
+    Placement,
+    ProcessMesh,
+    ReduceType,
+    Replicate,
+    Shard,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    Strategy,
+    dtensor_from_fn,
+    placements_to_spec,
+    reshard,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_scaler,
+    spec_to_placements,
+    to_static,
+    unshard_dtensor,
+)
+from .compat import (  # noqa: F401
+    CountFilterEntry,
+    Group,
+    InMemoryDataset,
+    ParallelEnv,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    all_gather_object,
+    alltoall,
+    alltoall_single,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_backend,
+    get_group,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    irecv,
+    is_available,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    scatter_object_list,
+    send,
+    spawn,
+    split,
+    wait,
+)
 from . import fleet  # noqa: F401
 from . import moe  # noqa: F401
 from . import pipeline  # noqa: F401

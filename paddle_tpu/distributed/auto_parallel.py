@@ -1,0 +1,415 @@
+"""Semantic auto-parallel API (ref: python/paddle/distributed/auto_parallel
+/api.py — ProcessMesh / Shard / Replicate / Partial, shard_tensor,
+reshard, shard_layer, shard_optimizer, DistModel).
+
+The mapping is exact, not emulated: paddle's ProcessMesh IS
+`jax.sharding.Mesh`, a placements list IS a `PartitionSpec` (placement i
+says how MESH dim i uses tensor dims), and `reshard` IS `device_put`
+with a new NamedSharding — GSPMD then inserts the collectives the
+reference's reshard pass hand-plans.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ReduceType:
+    """ref: paddle.distributed.ReduceType."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class Placement:
+    """Base of Shard/Replicate/Partial (ref: dist.Placement)."""
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f'Shard(dim={self.dim})'
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(('shard', self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return 'Replicate()'
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash('replicate')
+
+
+class Partial(Placement):
+    """A pending-reduction placement. jax has no first-class partial
+    arrays outside shard_map; at placement time it degrades to
+    Replicate (the reduction is already done on materialized values)."""
+
+    def __init__(self, reduce_type=ReduceType.kRedSum):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f'Partial({self.reduce_type})'
+
+
+class ProcessMesh:
+    """ref: paddle.distributed.ProcessMesh(mesh, dim_names) — an
+    n-dimensional array of process ids with named dims. Backed by one
+    `jax.sharding.Mesh` over the matching devices."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f'd{i}' for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f'{arr.ndim}-d mesh needs {arr.ndim} dim_names, '
+                             f'got {list(dim_names)}')
+        self._ids = arr
+        self._dim_names = tuple(dim_names)
+        devices = np.asarray(jax.devices(), object)[arr.reshape(-1) %
+                                                    len(jax.devices())]
+        self._jax_mesh = Mesh(devices.reshape(arr.shape), self._dim_names)
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_mesh(self):
+        """The backing jax Mesh (TPU-native handle)."""
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __repr__(self):
+        return f'ProcessMesh(shape={self.shape}, dim_names={self.dim_names})'
+
+
+def _as_jax_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        return mesh.get_mesh()
+    if isinstance(mesh, Mesh):
+        return mesh
+    raise TypeError(f'expected ProcessMesh or jax Mesh, got {type(mesh)}')
+
+
+def placements_to_spec(placements, mesh, ndim):
+    """placements[i] describes MESH dim i; invert to a PartitionSpec
+    (tensor-dim major)."""
+    jm = _as_jax_mesh(mesh)
+    names = jm.axis_names
+    per_tensor_dim = [[] for _ in range(ndim)]
+    for i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            per_tensor_dim[pl.dim].append(names[i])
+    entries = [tuple(axs) if len(axs) > 1 else (axs[0] if axs else None)
+               for axs in per_tensor_dim]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def spec_to_placements(spec, mesh, ndim):
+    """Inverse of placements_to_spec."""
+    jm = _as_jax_mesh(mesh)
+    placements = [Replicate() for _ in jm.axis_names]
+    for tdim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            placements[jm.axis_names.index(name)] = Shard(tdim)
+    return placements
+
+
+def shard_tensor(x, mesh, placements, dtype=None, stop_gradient=True):
+    """ref: dist.shard_tensor(data, mesh, placements)."""
+    x = jax.numpy.asarray(x, dtype)
+    jm = _as_jax_mesh(mesh)
+    spec = placements_to_spec(placements, jm, x.ndim)
+    return jax.device_put(x, NamedSharding(jm, spec))
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """ref: dist.dtensor_from_fn — build then place."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh, placements):
+    """ref: dist.reshard — move to a new placement; XLA emits the
+    collective (all-gather / all-to-all / slice) that realizes it."""
+    jm = _as_jax_mesh(mesh)
+    spec = placements_to_spec(placements, jm, jax.numpy.asarray(x).ndim)
+    return jax.device_put(x, NamedSharding(jm, spec))
+
+
+def unshard_dtensor(x):
+    """ref: dist.unshard_dtensor — gather to a fully-replicated value."""
+    if hasattr(x, 'sharding') and isinstance(getattr(x, 'sharding', None),
+                                             NamedSharding):
+        jm = x.sharding.mesh
+        return jax.device_put(x, NamedSharding(jm, P()))
+    return x
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """ref: dist.shard_layer — place every parameter of `layer`.
+    `shard_fn(name, layer, mesh)` may assign per-param placements; the
+    default replicates (GSPMD still shards activations from the inputs).
+    Returns the same pytree-Layer with placed parameter arrays."""
+    jm = _as_jax_mesh(process_mesh)
+
+    def place_params(lyr, prefix=''):
+        if shard_fn is not None:
+            shard_fn(prefix.rstrip('.'), lyr, process_mesh)
+        for name, value in list(getattr(lyr, '__dict__', {}).items()):
+            from ..nn.layer.base import Layer
+
+            if isinstance(value, Layer):
+                place_params(value, f'{prefix}{name}.')
+            elif name in getattr(lyr, '_param_meta', {}):
+                lyr.__dict__[name] = jax.device_put(
+                    value, NamedSharding(jm, P()))
+        return lyr
+
+    if shard_fn is None and input_fn is None and output_fn is None:
+        return place_params(layer)
+    out = place_params(layer)
+    if input_fn is not None or output_fn is not None:
+        inner_forward = out.forward
+
+        def wrapped(*args, **kwargs):
+            if input_fn is not None:
+                args = input_fn(args, process_mesh)
+            res = inner_forward(*args, **kwargs)
+            if output_fn is not None:
+                res = output_fn(res, process_mesh)
+            return res
+
+        out.forward = wrapped
+    return out
+
+
+class ShardingStage1:
+    """ref: dist.ShardingStage1(axis, mesh) — shard optimizer STATE over
+    the data axis (ZeRO-1)."""
+
+    stage = 1
+
+    def __init__(self, axis='dp', mesh=None):
+        self.axis, self.mesh = axis, mesh
+
+
+class ShardingStage2(ShardingStage1):
+    stage = 2  # + gradient sharding (reduce-scatter; GSPMD emits it)
+
+
+class ShardingStage3(ShardingStage1):
+    stage = 3  # + parameter sharding
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ref: dist.shard_optimizer — wrap so optimizer slots are placed
+    sharded. `shard_fn` is a ShardingStage1/2/3 marker (or a callable
+    placing a single slot array)."""
+    inner_init = optimizer.init
+
+    def sharded_init(model):
+        state = inner_init(model)
+        if shard_fn is None:
+            return state
+        if callable(shard_fn) and not isinstance(shard_fn, ShardingStage1):
+            state['slots'] = jax.tree.map(shard_fn, state['slots'])
+            return state
+        axis = shard_fn.axis
+        mesh = shard_fn.mesh
+        jm = _as_jax_mesh(mesh) if mesh is not None else None
+        if jm is None:
+            from .mesh import get_mesh
+
+            jm = get_mesh()
+        size = jm.shape[axis] if axis in jm.axis_names else 1
+
+        def place(x):
+            spec = P(axis) if (x.ndim and x.shape[0] % max(size, 1) == 0
+                               and size > 1) else P()
+            return jax.device_put(x, NamedSharding(jm, spec))
+
+        state['slots'] = jax.tree.map(place, state['slots'])
+        if 'master' in state:
+            state['master'] = jax.tree.map(
+                lambda m: place(m) if m is not None else None,
+                state['master'])
+        return state
+
+    optimizer.init = sharded_init
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """ref: dist.shard_scaler — the GradScaler state is a scalar; it is
+    already replicated under GSPMD, so this is the identity."""
+    return scaler
+
+
+class Strategy:
+    """ref: dist.Strategy for dist.to_static — thin config carrier."""
+
+    def __init__(self, config=None):
+        self.sharding = type('c', (), {'enable': False, 'stage': 1})()
+        self.fused_passes = type('c', (), {'enable': False})()
+        self.pipeline = type('c', (), {'enable': False})()
+        self.gradient_merge = type('c', (), {'enable': False, 'avg': True,
+                                             'k_steps': 1})()
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)
+
+
+class DistModel:
+    """ref: dist.to_static return type — a compiled distributed
+    train/eval step around (model, loss, optimizer)."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = 'train'
+        self._state = optimizer.init(layer) if optimizer is not None else None
+
+        def train_step(model, state, *batch):
+            from ..autograd import value_and_grad
+
+            def closure(m):
+                out = m(*batch[:-1])
+                return self._loss(out, batch[-1])
+
+            lossv, grads = value_and_grad(closure)(model)
+            model, state = self._opt.apply_gradients(model, grads, state)
+            return model, state, lossv
+
+        def eval_step(model, *batch):
+            out = model(*batch[:-1])
+            return self._loss(out, batch[-1])
+
+        self._train_step = jax.jit(train_step)
+        self._eval_step = jax.jit(eval_step)
+
+    def train(self):
+        self._mode = 'train'
+        self.network.train()
+
+    def eval(self):
+        self._mode = 'eval'
+        self.network.eval()
+
+    def __call__(self, *batch):
+        if self._mode == 'train':
+            self.network, self._state, loss = self._train_step(
+                self.network, self._state, *batch)
+            return loss
+        return self._eval_step(self.network, *batch)
+
+    def state_dict(self, mode='all'):
+        return self.network.state_dict()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """ref: dist.to_static — build the jitted distributed model."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+class DistAttr:
+    """ref: dist.DistAttr(mesh, sharding_specs) — legacy attr carrier."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None,
+                     is_dataset_splitted=False):
+    """ref: dist.shard_dataloader — wrap a DataLoader so every yielded
+    batch is placed on the mesh (batch dim sharded over `shard_dims`)."""
+    jm = _as_jax_mesh(meshes[0] if isinstance(meshes, (list, tuple))
+                      else meshes)
+    dims = shard_dims if shard_dims is not None else jm.axis_names[0]
+    if isinstance(dims, str):
+        dims = (dims,)
+
+    def place(x):
+        x = jax.numpy.asarray(x)
+        size = 1
+        for d in dims:
+            size *= jm.shape[d]
+        spec = P(tuple(dims)) if (x.ndim and x.shape[0] % size == 0
+                                  and size > 1) else P()
+        return jax.device_put(x, NamedSharding(jm, spec))
+
+    class _ShardedLoader:
+        def __iter__(self):
+            for batch in dataloader:
+                yield jax.tree.map(place, batch)
+
+        def __len__(self):
+            return len(dataloader)
+
+    return _ShardedLoader()

@@ -1,0 +1,315 @@
+"""Process-group / point-to-point compatibility surface
+(ref: python/paddle/distributed/{parallel,communication/*}.py).
+
+Under SPMD there is ONE program on all devices: "groups" are mesh axis
+names, point-to-point is `ppermute` (the ICI-native primitive), and
+object collectives are trivial because every shard of the program
+already holds the host object. Parameter-server datasets
+(InMemoryDataset/QueueDataset, *Entry) are out of scope per SURVEY §6
+(ps mode is CUDA/CPU-cluster machinery XLA replaces wholesale).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import collective
+from .mesh import get_mesh, get_rank, get_world_size
+
+
+class Group:
+    """ref: paddle.distributed.collective.Group — here a named view of a
+    mesh axis (or an explicit rank list for bookkeeping)."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks=None, axis=None):
+        self.ranks = list(ranks) if ranks is not None else []
+        self.axis = axis
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+
+    @property
+    def nranks(self):
+        if self.axis is not None:
+            m = get_mesh()
+            if m is not None and self.axis in m.axis_names:
+                return m.shape[self.axis]
+        return len(self.ranks) or get_world_size()
+
+    def __repr__(self):
+        return f'Group(id={self.id}, axis={self.axis}, ranks={self.ranks})'
+
+
+_groups: dict[int, Group] = {}
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None):
+    """ref: paddle.distributed.new_group. Prefer `axis='tp'` (a mesh
+    axis); a bare rank list is retained for bookkeeping only — SPMD
+    collectives are routed by axis name, not rank sets."""
+    g = Group(ranks=ranks, axis=axis)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(id=0):
+    """ref: paddle.distributed.get_group."""
+    return _groups.get(id)
+
+
+def is_initialized():
+    """ref: paddle.distributed.is_initialized."""
+    return get_mesh() is not None
+
+
+def destroy_process_group(group=None):
+    """ref: paddle.distributed.destroy_process_group."""
+    if group is None:
+        _groups.clear()
+        from .mesh import set_mesh
+
+        set_mesh(None)
+    else:
+        _groups.pop(getattr(group, 'id', group), None)
+
+
+def is_available():
+    """ref: paddle.distributed.is_available — XLA collectives are always
+    compiled in."""
+    return True
+
+
+def get_backend(group=None):
+    """ref: paddle.distributed.get_backend — 'XLA' (the reference
+    reports NCCL/GLOO)."""
+    return 'XLA'
+
+
+class ParallelMode:
+    """ref: paddle.distributed.ParallelMode."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ParallelEnv:
+    """ref: paddle.distributed.ParallelEnv — rank/world topology view."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return jax.devices()[0].id
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        import os
+
+        return os.environ.get('PADDLE_CURRENT_ENDPOINT', '127.0.0.1:0')
+
+    @property
+    def trainer_endpoints(self):
+        import os
+
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        return eps.split(',') if eps else [self.current_endpoint]
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """ref: paddle.distributed.spawn — the reference forks one CUDA
+    process per GPU. SPMD inverts this: ONE process drives every local
+    TPU chip, and multi-host launch is `jax.distributed.initialize` (see
+    distributed.launch). So spawn degenerates to calling `func` once."""
+    return func(*args)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """ref: paddle.distributed.gather — SPMD form: every shard computes
+    the gather (XLA all-gather); `dst` is advisory."""
+    out = collective.all_gather(tensor, group=_axis(group))
+    if gather_list is not None:
+        n = collective.axis_size(_axis(group))
+        gather_list.extend(jnp.split(out, n, axis=0))
+    return out
+
+
+def _axis(group):
+    if group is None:
+        return 'dp'
+    if isinstance(group, str):
+        return group
+    return getattr(group, 'axis', None) or 'dp'
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """ref: paddle.distributed.alltoall (list form) — stack, all_to_all
+    over the axis, split back."""
+    x = jnp.stack(list(in_tensor_list), axis=0)
+    out = collective.all_to_all(x, group=_axis(group), split_axis=0,
+                                concat_axis=0)
+    outs = list(out)
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+    return outs
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """ref: paddle.distributed.alltoall_single (equal splits)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            'uneven alltoall splits are not expressible as one static SPMD '
+            'op; pad to equal splits (the MoE layers here do exactly that)')
+    return collective.all_to_all(in_tensor, group=_axis(group),
+                                 split_axis=0, concat_axis=0)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """ref: paddle.distributed.send. SPMD has no one-sided send; the
+    matching send/recv PAIR is a ppermute by a uniform shift, so this
+    returns the value that the (src -> dst) ring shift delivers. Use
+    `collective.send_recv` / `ppermute` for pipeline exchanges."""
+    shift = dst - get_rank()
+    return collective.send_recv(tensor, group=_axis(group) or 'pp',
+                                shift=shift if shift else 1)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """ref: paddle.distributed.recv — see `send`."""
+    shift = get_rank() - src
+    return collective.send_recv(tensor, group=_axis(group) or 'pp',
+                                shift=shift if shift else 1)
+
+
+def isend(tensor, dst=0, group=None):
+    """Async flavor: XLA overlaps collectives automatically; returns a
+    completed-task handle for API parity."""
+    return _DoneTask(send(tensor, dst, group))
+
+
+def irecv(tensor, src=0, group=None):
+    return _DoneTask(recv(tensor, src, group))
+
+
+class _DoneTask:
+    def __init__(self, value):
+        self.value = value
+
+    def wait(self):
+        return self.value
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """ref: paddle.distributed.wait — block until the async value is
+    materialized."""
+    return jax.block_until_ready(tensor)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """ref: paddle.distributed.all_gather_object. One SPMD program =
+    every "rank" already holds `obj`; the gathered list is world_size
+    copies (exactly what the reference produces)."""
+    n = get_world_size()
+    object_list.extend([obj] * n)
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """ref: paddle.distributed.broadcast_object_list — identity under
+    one-program SPMD."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """ref: paddle.distributed.scatter_object_list — rank r takes the
+    r-th object."""
+    if in_object_list:
+        out_object_list.append(in_object_list[get_rank()
+                                              % len(in_object_list)])
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """ref: gloo CPU-barrier bootstrap — no-op (single-controller jax)."""
+
+
+def gloo_barrier():
+    collective.barrier()
+
+
+def gloo_release():
+    pass
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref: paddle.distributed.split — megatron-style sharded
+    linear/embedding. The TPU-native forms are the mp_layers
+    (ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding);
+    this functional form builds the matching layer on the fly."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+
+    if operation == 'linear':
+        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+        layer = cls(size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == 'embedding':
+        layer = VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    raise ValueError(f'unsupported split operation: {operation}')
+
+
+def _ps_mode_stub(name):
+    class _Stub:
+        """Parameter-server-mode API retained for import compatibility.
+
+        The reference's ps mode (CPU clusters + sparse embedding tables)
+        is out of scope for the TPU rebuild (SURVEY §6): TPU training
+        feeds through `paddle_tpu.io.DataLoader` + `distributed.
+        shard_dataloader`, and giant embeddings shard over the mesh via
+        VocabParallelEmbedding instead of a parameter server.
+        """
+
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f'{name} belongs to the reference\'s parameter-server mode '
+                f'(excluded on TPU — SURVEY §6). Use io.DataLoader / '
+                f'distributed.shard_dataloader for input pipelines and '
+                f'VocabParallelEmbedding for sharded embeddings.')
+
+    _Stub.__name__ = name
+    return _Stub
+
+
+QueueDataset = _ps_mode_stub('QueueDataset')
+InMemoryDataset = _ps_mode_stub('InMemoryDataset')
+CountFilterEntry = _ps_mode_stub('CountFilterEntry')
+ShowClickEntry = _ps_mode_stub('ShowClickEntry')
+ProbabilityEntry = _ps_mode_stub('ProbabilityEntry')

@@ -1,0 +1,22 @@
+"""distributed.io (ref: python/paddle/distributed/io.py) — persistables
+save/load for distributed programs; here thin forwards to the
+framework's checkpoint machinery (orbax handles the sharded case)."""
+from __future__ import annotations
+
+from ..framework.io import load, save  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """ref: distributed.io.save_persistables — static-graph form; the
+    dynamic equivalent is framework.save(state_dict, path)."""
+    if main_program is not None and hasattr(main_program, 'state_dict'):
+        save(main_program.state_dict(), f'{dirname}/{filename or "model"}')
+        return
+    raise ValueError('pass an object with state_dict(); the TPU-native '
+                     'path is framework.save / distributed.checkpoint')
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    return load(f'{dirname}/{filename or "model"}')

@@ -135,10 +135,29 @@ def parallelize(model, mesh: Mesh | None = None, rules: Rules | None = None,
     return shard_model(model, mesh, fsdp_axis=fsdp_axis)
 
 
-def shard_tensor(x, mesh: Mesh | None = None, *spec_entries, spec=None):
-    """ref: paddle.distributed.shard_tensor — place one array."""
+def shard_tensor(x, mesh: Mesh | None = None, *spec_entries, spec=None,
+                 placements=None):
+    """ref: paddle.distributed.shard_tensor — place one array. Accepts
+    either PartitionSpec entries (TPU-native) or the reference's
+    `placements` list / ProcessMesh (auto-parallel semantic API)."""
+    from .auto_parallel import Placement, ProcessMesh, placements_to_spec
+
+    if isinstance(mesh, ProcessMesh):
+        if placements is None and spec_entries and isinstance(
+                spec_entries[0], (list, tuple)) and all(
+                isinstance(p, Placement) for p in spec_entries[0]):
+            placements = spec_entries[0]
+        jm = mesh.get_mesh()
+        spec = placements_to_spec(placements or [], jm,
+                                  jax.numpy.asarray(x).ndim)
+        return jax.device_put(x, NamedSharding(jm, spec))
     mesh = mesh or get_mesh()
-    spec = spec if spec is not None else P(*spec_entries)
+    if placements is not None:
+        from .auto_parallel import placements_to_spec as p2s
+
+        spec = p2s(placements, mesh, jax.numpy.asarray(x).ndim)
+    elif spec is None:
+        spec = P(*spec_entries)
     return jax.device_put(x, NamedSharding(mesh, _valid_spec(spec, x.shape, mesh)))
 
 

@@ -1,0 +1,90 @@
+"""Activation recomputation (ref: python/paddle/distributed/fleet/utils/
+recompute.py, and fleet/meta_parallel's segment recompute).
+
+The reference re-runs each wrapped segment's forward inside backward to
+trade FLOPs for activation memory. On TPU that is exactly
+`jax.checkpoint` (remat): XLA re-emits the segment's ops in the backward
+computation, and the `dots` policy keeps MXU outputs resident (cheap to
+store, expensive to recompute) while re-deriving the elementwise tail
+(free to recompute, expensive in HBM).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_POLICIES = {
+    'full': None,  # save nothing: recompute everything
+    'dots': 'dots_with_no_batch_dims_saveable',
+    'dots_saveable': 'dots_saveable',
+    'nothing_saveable': 'nothing_saveable',
+    'everything_saveable': 'everything_saveable',
+}
+
+
+def _resolve_policy(policy):
+    if policy is None or policy == 'full':
+        return None
+    name = _POLICIES.get(policy, policy)
+    if callable(name):
+        return name
+    try:
+        return getattr(jax.checkpoint_policies, name)
+    except AttributeError:
+        raise ValueError(
+            f'unknown recompute policy {policy!r}; pick from '
+            f'{sorted(_POLICIES)} or pass a jax.checkpoint_policies '
+            f'callable') from None
+
+
+def recompute(function, *args, policy='full', prevent_cse=True, **kwargs):
+    """Run `function(*args, **kwargs)` with its activations rematerialized
+    in backward (ref: fleet/utils/recompute.py::recompute).
+
+    `policy='full'` recomputes everything (the reference's behaviour);
+    `'dots'` keeps matmul outputs and recomputes only elementwise ops —
+    usually the right TPU trade (HBM is the bottleneck, MXU re-runs are
+    not free)."""
+    fn = jax.checkpoint(function, policy=_resolve_policy(policy),
+                        prevent_cse=prevent_cse)
+    return fn(*args, **kwargs)
+
+
+def recompute_wrapper(function=None, *, policy='full', prevent_cse=True):
+    """Decorator form: `@recompute_wrapper(policy='dots')`."""
+    def wrap(fn):
+        return functools.wraps(fn)(
+            jax.checkpoint(fn, policy=_resolve_policy(policy),
+                           prevent_cse=prevent_cse))
+    return wrap(function) if function is not None else wrap
+
+
+def recompute_sequential(ctx, functions, *args, policy='full'):
+    """Segmented remat over a Sequential / list of callables
+    (ref: distributed/fleet/recompute/recompute.py::recompute_sequential).
+    `ctx['segments']` (default 1) chunks the chain; each chunk is one
+    remat segment, so peak live activations drop from the whole chain to
+    one chunk. `preserve_rng_state` is implicit: PRNG keys are explicit
+    pytree state here, so recomputation always replays the same keys."""
+    fns = list(functions)
+    segments = int(ctx.get('segments', 1)) if isinstance(ctx, dict) else 1
+    segments = max(1, min(segments, len(fns) or 1))
+    bounds = [len(fns) * i // segments for i in range(segments + 1)]
+
+    def chunk_fn(chunk):
+        def run(*xs):
+            out = xs if len(xs) > 1 else xs[0]
+            for fn in chunk:
+                out = fn(*out) if isinstance(out, tuple) else fn(out)
+            return out
+        return run
+
+    out = args if len(args) > 1 else args[0]
+    for i in range(segments):
+        chunk = fns[bounds[i]:bounds[i + 1]]
+        if not chunk:
+            continue
+        ck = jax.checkpoint(chunk_fn(chunk), policy=_resolve_policy(policy))
+        out = ck(*out) if isinstance(out, tuple) else ck(out)
+    return out

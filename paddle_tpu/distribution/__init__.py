@@ -3,13 +3,14 @@
 The reference's probability toolbox rebuilt on `jax.random` +
 `jax.scipy.special`: every density/entropy/KL is a traced closed form
 (jit/grad/vmap-able) and every sampler threads explicit PRNG keys from
-the framework's global stream. Not carried over: LKJCholesky (niche
-prior, no jax sampler primitive — SURVEY §6 scope call).
+the framework's global stream. LKJCholesky samples via the vectorized
+onion construction (beta marginals are a jax.random primitive).
 """
 from . import transform  # noqa: F401
-from .continuous import (Beta, Cauchy, Chi2, Dirichlet, Exponential, Gamma,
-                         Gumbel, Laplace, LogNormal, MultivariateNormal,
-                         Normal, StudentT, Uniform)
+from .continuous import (Beta, Cauchy, Chi2, ContinuousBernoulli, Dirichlet,
+                         Exponential, Gamma, Gumbel, Laplace, LKJCholesky,
+                         LogNormal, MultivariateNormal, Normal, StudentT,
+                         Uniform)
 from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
                        Multinomial, Poisson)
 from .distribution import Distribution, ExponentialFamily, Independent
@@ -23,6 +24,7 @@ from .transformed_distribution import TransformedDistribution
 
 __all__ = [
     'Bernoulli', 'Beta', 'Binomial', 'Categorical', 'Cauchy', 'Chi2',
+    'ContinuousBernoulli', 'LKJCholesky',
     'Dirichlet', 'Distribution', 'Exponential', 'ExponentialFamily', 'Gamma',
     'Geometric', 'Gumbel', 'Independent', 'Laplace', 'LogNormal',
     'Multinomial', 'MultivariateNormal', 'Normal', 'Poisson', 'StudentT',

@@ -435,3 +435,136 @@ class MultivariateNormal(Distribution):
         half_logdet = jnp.sum(
             jnp.log(jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1)), -1)
         return 0.5 * k * (1 + _LOG2PI) + half_logdet
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """ref: paddle.distribution.ContinuousBernoulli(probs, lims) — the
+    [0, 1]-supported exponential-family relaxation of Bernoulli
+    (Loaiza-Ganem & Cunningham 2019). Near probs=0.5 the normalizer's
+    closed form is 0/0, so a Taylor expansion takes over inside `lims`
+    (same scheme as the reference kernel)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _f(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _safe_probs(self):
+        # value used on the closed-form branch only
+        return jnp.where(self._outside(), self.probs, 0.499)
+
+    def _log_norm(self):
+        """log C(p) with C = 2 atanh(1-2p) / (1-2p) (p != 1/2) else 2."""
+        p = self._safe_probs()
+        closed = jnp.log(jnp.abs(2.0 * jnp.arctanh(1 - 2 * p))) \
+            - jnp.log(jnp.abs(1 - 2 * p))
+        # Taylor around 1/2: log 2 + 4/3 (p-1/2)^2 + 104/45 (p-1/2)^4
+        d = self.probs - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0) * d ** 2 \
+            + (104.0 / 45.0) * d ** 4
+        return jnp.where(self._outside(), closed, taylor)
+
+    @property
+    def mean(self):
+        p = self._safe_probs()
+        closed = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        d = self.probs - 0.5
+        taylor = 0.5 + d / 3.0 + (16.0 / 45.0) * d ** 3
+        return jnp.where(self._outside(), closed, taylor)
+
+    @property
+    def variance(self):
+        p = self._safe_probs()
+        closed = p * (p - 1) / (1 - 2 * p) ** 2 \
+            + 1 / (2 * jnp.arctanh(1 - 2 * p)) ** 2
+        d = self.probs - 0.5
+        taylor = 1.0 / 12.0 - (2.0 / 15.0) * d ** 2
+        return jnp.where(self._outside(), closed, taylor)
+
+    def log_prob(self, value):
+        value = _f(value)
+        return (self._log_norm() + value * jnp.log(self.probs)
+                + (1 - value) * jnp.log1p(-self.probs))
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def cdf(self, value):
+        p = self._safe_probs()
+        x = _f(value)
+        num = (p ** x) * ((1 - p) ** (1 - x)) + p - 1
+        closed = num / (2 * p - 1)
+        out = jnp.where(self._outside(), closed, x)
+        return jnp.clip(out, 0.0, 1.0)
+
+    def icdf(self, value):
+        p = self._safe_probs()
+        u = _f(value)
+        closed = (jnp.log1p(u * (2 * p - 1) / (1 - p))
+                  / (jnp.log(p) - jnp.log1p(-p)))
+        return jnp.where(self._outside(), closed, u)
+
+    def rsample(self, shape=(), key=None):
+        u = jax.random.uniform(self._key(key), self._extend(shape))
+        return self.icdf(u)
+
+    def sample(self, shape=(), key=None):
+        return self.rsample(shape, key)
+
+    def entropy(self):
+        m = self.mean
+        return -(self._log_norm() + m * jnp.log(self.probs)
+                 + (1 - m) * jnp.log1p(-self.probs))
+
+
+class LKJCholesky(Distribution):
+    """ref: paddle.distribution.LKJCholesky(dim, concentration) — prior
+    over Cholesky factors of correlation matrices. Sampling uses the
+    vectorized onion construction (beta marginals + hypersphere rows);
+    density follows the Stan LKJ-Cholesky form
+    prod L_ii^(2(eta-1) + dim - i) with the mvlgamma normalizer."""
+
+    def __init__(self, dim, concentration=1.0, sample_method='onion'):
+        if dim < 2:
+            raise ValueError(f'dim must be >= 2, got {dim}')
+        if sample_method not in ('onion', 'cvine'):
+            raise ValueError(f'bad sample_method: {sample_method}')
+        self.dim = int(dim)
+        self.concentration = _f(concentration)
+        super().__init__(jnp.shape(self.concentration))
+        offset = jnp.concatenate(
+            [jnp.zeros((1,)), jnp.arange(self.dim - 1, dtype=jnp.float32)])
+        self._beta_a = offset + 0.5
+        self._beta_b = (self.concentration[..., None]
+                        + 0.5 * (self.dim - 2) - 0.5 * offset)
+
+    def sample(self, shape=(), key=None):
+        key = self._key(key)
+        k1, k2 = jax.random.split(key)
+        bshape = tuple(shape) + self.batch_shape + (self.dim,)
+        y = jax.random.beta(k1, jnp.broadcast_to(self._beta_a, bshape),
+                            jnp.broadcast_to(self._beta_b, bshape))
+        u = jnp.tril(jax.random.normal(
+            k2, bshape + (self.dim,)), -1)
+        norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+        u_sphere = u / jnp.where(norm == 0, 1.0, norm)
+        w = jnp.sqrt(y)[..., None] * u_sphere
+        diag = jnp.sqrt(jnp.clip(1 - jnp.sum(w ** 2, axis=-1), 1e-38))
+        return w + jnp.eye(self.dim) * diag[..., None, :]
+
+    def log_prob(self, value):
+        value = _f(value)
+        diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
+        order = (2 * (self.concentration[..., None] - 1)
+                 + self.dim - jnp.arange(2, self.dim + 1))
+        unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+        dm1 = self.dim - 1
+        alpha = self.concentration + 0.5 * dm1
+        denominator = jss.gammaln(alpha) * dm1
+        numerator = jss.multigammaln(alpha - 0.5, dm1)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        return unnorm - (pi_const + numerator - denominator)

@@ -29,3 +29,45 @@ __all__ = [
     'rfft2', 'irfft2', 'rfftn', 'irfftn', 'hfft', 'ihfft', 'fftfreq',
     'rfftfreq', 'fftshift', 'ifftshift',
 ]
+
+
+def hfftn(x, s=None, axes=None, norm='backward', name=None):
+    """N-D FFT of a signal with Hermitian symmetry along the last
+    transform axis -> real output (ref: paddle.fft.hfftn; jnp has no
+    hfftn, but axis transforms commute, so this is fftn over the leading
+    axes composed with hfft over the last)."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    s_lead = tuple(s[:-1]) if s is not None else None
+    n_last = s[-1] if s is not None else None
+    out = _f.fftn(x, s=s_lead, axes=axes[:-1], norm=norm) if len(axes) > 1 else x
+    return _f.hfft(out, n=n_last, axis=axes[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm='backward', name=None):
+    """Inverse of hfftn: real input -> Hermitian half-spectrum
+    (ref: paddle.fft.ihfftn)."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    n_last = s[-1] if s is not None else None
+    out = _f.ihfft(x, n=n_last, axis=axes[-1], norm=norm)
+    if len(axes) > 1:
+        s_lead = tuple(s[:-1]) if s is not None else None
+        out = _f.ifftn(out, s=s_lead, axes=axes[:-1], norm=norm)
+    return out
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    """ref: paddle.fft.hfft2."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+__all__ += ['hfft2', 'ihfft2', 'hfftn', 'ihfftn']

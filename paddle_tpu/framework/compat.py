@@ -1,0 +1,149 @@
+"""API-compatibility helpers (ref: python/paddle/base/framework.py,
+base/param_attr.py, jit/api.py::LazyGuard and friends).
+
+These exist so reference training scripts import-and-run unchanged.
+Static/dynamic mode is a no-op distinction here: everything traces
+through jax, so "dynamic mode" is always truthful and `enable_static`
+only flips a flag that `in_dynamic_mode` reports.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+_static_mode = [False]
+
+
+def enable_static():
+    """ref: paddle.enable_static. Graph capture in this framework is
+    `jit.to_static` (jax tracing); this flag only tracks intent."""
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def disable_signal_handler():
+    """ref: paddle.disable_signal_handler — CUDA-runtime concern; no-op."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref: paddle.set_printoptions — arrays print through numpy."""
+    kw = {}
+    if precision is not None:
+        kw['precision'] = precision
+    if threshold is not None:
+        kw['threshold'] = threshold
+    if edgeitems is not None:
+        kw['edgeitems'] = edgeitems
+    if linewidth is not None:
+        kw['linewidth'] = linewidth
+    if sci_mode is not None:
+        kw['suppress'] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class ParamAttr:
+    """ref: paddle.ParamAttr — bundles initializer/regularizer/lr for a
+    parameter; Layer.create_parameter unwraps `.initializer`."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class LazyGuard:
+    """ref: paddle.LazyGuard — defers parameter init in the reference.
+    Initialization here is already lazy-at-trace (pure functions of PRNG
+    keys), so the guard is a transparent context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: paddle.batch — wrap a sample reader into a batch reader
+    (legacy io API kept for script compatibility)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape):
+    """ref: paddle.static.check_shape — validate a shape declaration."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if s is not None and not isinstance(s, int):
+                raise TypeError(f'shape entries must be int/None, got {s!r}')
+    return shape
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: paddle.create_parameter (static-graph helper): a free
+    Parameter outside any Layer."""
+    from ..nn import initializer as I
+    from ..nn.layer.base import Parameter
+
+    init = default_initializer
+    if init is None and attr is not None and getattr(attr, 'initializer', None):
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    from . import dtype as dtype_mod
+
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    return Parameter(init(tuple(shape), dt))
+
+
+def get_cuda_rng_state():
+    """CUDA-API compat: returns the framework PRNG state (the TPU/JAX
+    analogue — one threaded key, not a per-device CUDA state vector)."""
+    from .random import get_rng_state
+
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    from .random import set_rng_state
+
+    if isinstance(state, (list, tuple)) and state:
+        state = state[0]
+    set_rng_state(state)
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode):
+    """ref: paddle.set_grad_enabled."""
+    from ..autograd import _grad_enabled
+
+    _grad_enabled.append(bool(mode))
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()

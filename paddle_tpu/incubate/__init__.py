@@ -4,3 +4,173 @@ fused surfaces. LookAhead re-exported for API parity
 """
 from . import nn  # noqa: F401
 from ..optimizer.wrappers import LookAhead  # noqa: F401
+
+from ..geometric import (  # noqa: F401  (ref: incubate graph ops are the
+    segment_max,          # geometric segment/message-passing ops)
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type='sum',
+                    out_size=None):
+    """ref: paddle.incubate.graph_send_recv — gather at src, segment-
+    reduce at dst (the geometric send_u_recv op)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def softmax_mask_fuse(x, mask):
+    """ref: paddle.incubate.softmax_mask_fuse — softmax(x + mask); XLA
+    fuses the add into the softmax, which is all the CUDA kernel did."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.nn.softmax(x.astype(jnp.float32) + mask.astype(jnp.float32),
+                          axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """ref: paddle.incubate.softmax_mask_fuse_upper_triangle — causal
+    masked softmax over the last two axes."""
+    import jax
+    import jax.numpy as jnp
+
+    s_q, s_k = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+    logits = jnp.where(causal, x.astype(jnp.float32), -1e30)
+    return jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+
+
+def identity_loss(x, reduction='none'):
+    """ref: paddle.incubate.identity_loss (IPU loss anchor; on TPU just
+    the requested reduction)."""
+    import jax.numpy as jnp
+
+    if reduction in (0, 'sum'):
+        return jnp.sum(x)
+    if reduction in (1, 'mean'):
+        return jnp.mean(x)
+    return x
+
+
+_sampler_rng = []
+
+
+def _rng():
+    # persistent across calls: fresh default_rng(0) per call would make
+    # every "random" neighbour draw identical, defeating the sampling
+    import numpy as np
+
+    if not _sampler_rng:
+        _sampler_rng.append(np.random.default_rng())
+    return _sampler_rng[0]
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False):
+    """ref: paddle.incubate.graph_khop_sampler — k-hop neighbourhood
+    sampling. Host-side (graph sampling is data-dependent control flow;
+    the reference's kernel is also a host-orchestrated gather)."""
+    import numpy as np
+
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    frontier = np.asarray(input_nodes).reshape(-1)
+    all_rows, all_cols = [], []
+    rng = _rng()
+    for size in sample_sizes:
+        rs, cs = [], []
+        for v in frontier:
+            lo, hi = int(colptr[v]), int(colptr[v + 1])
+            neigh = row[lo:hi]
+            if size >= 0 and len(neigh) > size:
+                neigh = rng.choice(neigh, size, replace=False)
+            rs.extend(neigh.tolist())
+            cs.extend([int(v)] * len(neigh))
+        all_rows.extend(rs)
+        all_cols.extend(cs)
+        frontier = np.unique(np.asarray(rs, np.int64))
+    edge_src = np.asarray(all_rows, np.int64)
+    edge_dst = np.asarray(all_cols, np.int64)
+    nodes = np.unique(np.concatenate([np.asarray(input_nodes).reshape(-1),
+                                      edge_src]))
+    # relabel to local ids
+    lut = {int(n): i for i, n in enumerate(nodes)}
+    reindex_src = np.asarray([lut[int(s)] for s in edge_src], np.int64)
+    reindex_dst = np.asarray([lut[int(d)] for d in edge_dst], np.int64)
+    return reindex_src, reindex_dst, nodes, None
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False):
+    """ref: paddle.incubate.graph_sample_neighbors — one-hop sampling."""
+    import numpy as np
+
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    rng = _rng()
+    out_neigh, out_count = [], []
+    for v in np.asarray(input_nodes).reshape(-1):
+        lo, hi = int(colptr[v]), int(colptr[v + 1])
+        neigh = row[lo:hi]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, sample_size, replace=False)
+        out_neigh.extend(neigh.tolist())
+        out_count.append(len(neigh))
+    return (np.asarray(out_neigh, np.int64),
+            np.asarray(out_count, np.int64))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False):
+    """ref: paddle.incubate.graph_reindex — relabel a neighbourhood set
+    to contiguous local ids."""
+    import numpy as np
+
+    x = np.asarray(x).reshape(-1)
+    neighbors = np.asarray(neighbors).reshape(-1)
+    nodes = list(dict.fromkeys(x.tolist() + neighbors.tolist()))
+    lut = {int(n): i for i, n in enumerate(nodes)}
+    reindex = np.asarray([lut[int(n)] for n in neighbors], np.int64)
+    count = np.asarray(count, np.int64)
+    dst = np.repeat(np.arange(len(x), dtype=np.int64), count)
+    return reindex, dst, np.asarray(nodes, np.int64)
+
+
+class ModelAverage:
+    """ref: paddle.incubate.ModelAverage — running average of parameters
+    applied at eval; the TPU-native EMA wrapper covers the mechanism."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        from ..optimizer.wrappers import ExponentialMovingAverage
+
+        # window-rate ≈ (1 - decay): map onto the EMA machinery
+        self._ema = ExponentialMovingAverage(
+            decay=1.0 - average_window_rate)
+        self._state = None
+
+    def update(self, model):
+        if self._state is None:
+            self._state = self._ema.init(model)
+        self._state = self._ema.update(self._state, model)
+        return self._state
+
+    def apply(self, model):
+        """Returns a copy of `model` with averaged weights swapped in."""
+        return self._ema.apply(model, self._state)
+
+    def restore(self, model):
+        """Functional framework: the original model was never mutated."""
+        return model
+
+
+class inference:
+    """ref: paddle.incubate.inference namespace (TensorRT wrappers —
+    CUDA-only; the TPU path is jit.save -> StableHLO)."""

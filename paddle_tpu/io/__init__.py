@@ -22,6 +22,8 @@ from .dataloader import (  # noqa: F401
     RandomSampler,
     Sampler,
     SequenceSampler,
+    SubsetRandomSampler,
     WeightedRandomSampler,
     default_collate_fn,
+    get_worker_info,
 )

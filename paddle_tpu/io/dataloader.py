@@ -155,7 +155,9 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_id=0, num_workers=1):
+    _worker_info[0] = WorkerInfo(worker_id, num_workers, dataset)
     while True:
         task = index_queue.get()
         if task is None:
@@ -168,7 +170,8 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn):
             data_queue.put((seq, None, repr(e)))
 
 
-def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn):
+def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn,
+                     worker_id=0, num_workers=1):
     """Worker for the native shared-memory fast path: batches go through
     the C++ SPSC ring (one memcpy into shm) instead of a pickled pipe
     (ref: the reference's C++ DataLoader + shared-memory transport)."""
@@ -177,6 +180,7 @@ def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn):
 
     from .. import _native
 
+    _worker_info[0] = WorkerInfo(worker_id, num_workers, dataset)
     ring = _native.ShmRing(name=ring_name, create=False)
     try:
         while True:
@@ -297,10 +301,11 @@ class DataLoader:
         workers = [
             ctx.Process(
                 target=_worker_loop,
-                args=(self.dataset, index_queue, data_queue, self.collate_fn),
+                args=(self.dataset, index_queue, data_queue, self.collate_fn,
+                      i, self.num_workers),
                 daemon=True,
             )
-            for _ in range(self.num_workers)
+            for i in range(self.num_workers)
         ]
         for w in workers:
             w.start()
@@ -355,7 +360,8 @@ class DataLoader:
         workers = [
             ctx.Process(
                 target=_worker_loop_shm,
-                args=(self.dataset, index_queue, rings[i].name, self.collate_fn),
+                args=(self.dataset, index_queue, rings[i].name,
+                      self.collate_fn, i, self.num_workers),
                 daemon=True,
             )
             for i in range(self.num_workers)
@@ -440,3 +446,38 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         except StopIteration:
             pass
         yield out
+
+
+class SubsetRandomSampler(Sampler):
+    """ref: paddle.io.SubsetRandomSampler."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+
+        order = _np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WorkerInfo:
+    """ref: paddle.io.get_worker_info return type."""
+
+    def __init__(self, id, num_workers, dataset=None, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = [None]
+
+
+def get_worker_info():
+    """ref: paddle.io.get_worker_info — None in the main process, worker
+    metadata inside a DataLoader worker."""
+    return _worker_info[0]

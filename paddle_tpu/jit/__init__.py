@@ -132,7 +132,7 @@ def load(path, **config):
         with open(mlir_path, 'rb') as f:
             exported = jax.export.deserialize(bytearray(f.read()))
 
-        class LoadedFunction:
+        class LoadedFunction(TranslatedLayer):
             def __init__(self):
                 self.state_dict_ = state
 
@@ -187,3 +187,22 @@ def compilation_report(fn, *example_args, **kw):
         'bytes_accessed': cost.get('bytes accessed', 0),
         'hlo_head': compiled.as_text()[:2000] if hasattr(compiled, 'as_text') else '',
     }
+
+
+# `jit.load` returns this callable wrapper; the reference's equivalent
+# class is TranslatedLayer (ref: python/paddle/jit/translated_layer.py)
+TranslatedLayer = type('TranslatedLayer', (), {})  # isinstance marker base
+
+_sot_verbosity = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref: paddle.jit.set_verbosity — tracing has no bytecode
+    translator here; the knob stores intent for debugging hooks."""
+    _sot_verbosity[0] = level
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref: paddle.jit.set_code_level (SOT bytecode dump — N/A under
+    jax tracing; kept for script compatibility)."""
+    _sot_verbosity[0] = level

@@ -151,3 +151,15 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Functional top-k accuracy (ref: python/paddle/metric/metrics.py::
+    accuracy). input: (N, C) scores; label: (N,) or (N, 1) int."""
+    import jax.numpy as jnp
+
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1)
+    topk = jnp.argsort(-input, axis=-1)[:, :k]
+    hit = jnp.any(topk == label[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

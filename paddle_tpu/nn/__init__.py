@@ -13,7 +13,12 @@ from .layer.base import Buffer, Layer, Parameter  # noqa: F401
 from .layer.common import (  # noqa: F401
     AlphaDropout,
     Bilinear,
+    ChannelShuffle,
     CosineSimilarity,
+    FeatureAlphaDropout,
+    Unflatten,
+    ZeroPad1D,
+    ZeroPad3D,
     Dropout,
     Dropout2D,
     Dropout3D,

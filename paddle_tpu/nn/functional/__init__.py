@@ -1,6 +1,13 @@
 """nn.functional namespace (ref: python/paddle/nn/functional/__init__.py)."""
 from .activation import *  # noqa: F401,F403
-from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    scaled_dot_product_attention,
+    sparse_attention,
+)
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401
     conv1d,
@@ -20,3 +27,19 @@ from .norm import (  # noqa: F401
     rms_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from .pooling import (  # noqa: F401
+    fractional_max_pool2d,
+    fractional_max_pool3d,
+    lp_pool1d,
+    max_unpool1d,
+    max_unpool2d,
+    max_unpool3d,
+)
+from .vision import (  # noqa: F401
+    affine_grid,
+    channel_shuffle,
+    gather_tree,
+    grid_sample,
+    sequence_mask,
+    temporal_shift,
+)

@@ -164,3 +164,15 @@ def swiglu(x, y=None):
     if y is None:
         x, y = jnp.split(x, 2, axis=-1)
     return jax.nn.silu(x) * y
+
+
+# The reference's in-place variants (relu_ etc. mutate their input). jax
+# arrays are immutable, so these are aliases of the pure ops — matching
+# the reference's *return value*, which is how downstream code uses them.
+relu_ = relu
+tanh_ = tanh
+elu_ = elu
+hardtanh_ = hardtanh
+leaky_relu_ = leaky_relu
+softmax_ = softmax
+thresholded_relu_ = thresholded_relu

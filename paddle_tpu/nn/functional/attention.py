@@ -13,7 +13,9 @@ import jax
 import jax.numpy as jnp
 
 
-def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, rng_key=None, training=True):
+def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                    scale=None, rng_key=None, training=True,
+                    return_probs=False):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = scale or (1.0 / math.sqrt(D))
@@ -41,7 +43,7 @@ def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, sca
         keep = jax.random.bernoulli(key, 1 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1 - dropout_p), 0.0)
     out = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    return (out.astype(q.dtype), p) if return_probs else out.astype(q.dtype)
 
 
 def scaled_dot_product_attention(
@@ -116,3 +118,154 @@ def scaled_dot_product_attention(
 
 
 flash_attention = scaled_dot_product_attention
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, *, fixed_seed_offset=None,
+                         rng_name='', training=True, name=None):
+    """Packed-QKV flash attention (ref: nn/functional/flash_attention.py::
+    flash_attn_qkvpacked). qkv: (B, S, 3, H, D). Returns (out, softmax) —
+    softmax is None unless requested (and requesting it forces the
+    non-flash path, as the reference's kernel does for its debug mode)."""
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if return_softmax:
+        return _sdpa_reference(q, k, v, dropout_p=dropout, is_causal=causal,
+                               training=training, return_probs=True)
+    out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name='', varlen_padded=True,
+                                training=True, name=None):
+    """Varlen packed flash attention (ref: flash_attention.py::
+    flash_attn_varlen_qkvpacked). qkv: (total_tokens, 3, H, D) with
+    cumulative sequence boundaries `cu_seqlens_*`.
+
+    TPU-native mapping: the token stream is ONE long row and the varlen
+    boundaries become segment ids — exactly the packed-sequence fast path
+    the pallas flash kernel already supports (block-diagonal masking),
+    so no unpadding/repadding round-trip is needed.
+    """
+    total, _, h, d = qkv.shape
+    q = qkv[None, :, 0]
+    k = qkv[None, :, 1]
+    v = qkv[None, :, 2]
+    positions = jnp.arange(total)
+    seg_q = jnp.searchsorted(jnp.asarray(cu_seqlens_q)[1:], positions,
+                             side='right').astype(jnp.int32)[None]
+    if return_softmax:  # debug mode: dense block-diagonal probabilities
+        seg_mask = (seg_q[:, :, None] == seg_q[:, None, :])[:, None]
+        out, p = _sdpa_reference(q, k, v, attn_mask=seg_mask,
+                                 dropout_p=dropout, is_causal=causal,
+                                 scale=scale, training=training,
+                                 return_probs=True)
+        return out[0], p[0]
+    out = scaled_dot_product_attention(
+        q, k, v, dropout_p=dropout, is_causal=causal, scale=scale,
+        training=training, segment_ids=seg_q)
+    return out[0], None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        fixed_seed_offset=None, rng_name='', training=True,
+                        name=None):
+    """FlashMask attention (ref: flash_attention.py::flashmask_attention).
+
+    `startend_row_indices` (B, H|1, Sk, 1|2|4) encodes column-wise sparse
+    masks: with 1 value LTS (causal: rows >= LTS masked), 2 values
+    [LTS, LTE) masked below the diagonal, 4 values
+    [LTS, LTE) ∪ [UTS, UTE) for bidirectional. This implementation lowers
+    the encoding to a boolean mask consumed by the fused attention path —
+    the row-index compression is a CUDA-kernel memory optimisation; under
+    XLA the mask fuses into the attention einsum anyway.
+    """
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    rows = jnp.arange(sq)[:, None]                      # query index
+    if startend_row_indices is None:
+        mask = None
+    else:
+        idx = jnp.asarray(startend_row_indices)         # (B, Hm, Sk, C)
+        c = idx.shape[-1]
+        idx = idx.transpose(0, 1, 3, 2)[:, :, :, None, :]  # (B,Hm,C,1,Sk)
+        if causal:
+            if c == 1:
+                lts = idx[:, :, 0]
+                mask = rows < lts                        # keep rows < LTS
+            elif c == 2:
+                lts, lte = idx[:, :, 0], idx[:, :, 1]
+                mask = (rows < lts) | (rows >= lte)
+            else:
+                raise ValueError(f'causal flashmask expects 1 or 2 values, '
+                                 f'got {c}')
+        else:
+            if c == 2:
+                lts, ute = idx[:, :, 0], idx[:, :, 1]
+                mask = (rows < lts) & (rows >= ute)
+            elif c == 4:
+                lts, lte = idx[:, :, 0], idx[:, :, 1]
+                uts, ute = idx[:, :, 2], idx[:, :, 3]
+                mask = ~(((rows >= lts) & (rows < lte))
+                         | ((rows >= uts) & (rows < ute)))
+            else:
+                raise ValueError(f'non-causal flashmask expects 2 or 4 '
+                                 f'values, got {c}')
+    if window_size is not None:
+        w = (window_size, window_size) if isinstance(window_size, int) \
+            else tuple(window_size)
+        cols = jnp.arange(sk)[None, :]
+        win = (rows - cols <= w[0]) & (cols - rows <= w[1])
+        mask = win[None, None] if mask is None else mask & win[None, None]
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=mask, dropout_p=dropout,
+        is_causal=causal, training=training)
+    if mask is not None:
+        # same empty-row convention as the segment-masked kernels: a query
+        # whose every key is masked returns 0, not the uniform mean of v
+        eff = mask
+        if causal:
+            cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            eff = eff & cm[None, None]
+        row_valid = jnp.any(eff, axis=-1)                # (B, Hm, Sq)
+        out = jnp.where(
+            jnp.moveaxis(row_valid, 1, -1)[..., None], out, 0.0)
+    return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None):
+    """CSR-patterned sparse attention (ref: nn/functional/
+    sparse_attention.py; the reference requires CUDA 11.3+). q/k/v:
+    (B, H, S, D); offset (B, H, S+1); columns (B, H, nnz).
+
+    On TPU the CSR pattern is lowered to a boolean mask and fused into
+    the dense attention — XLA's MXU tiling beats gather-based sparse
+    matmul until sparsity is extreme, and the semantics (softmax only
+    over the listed columns) are preserved exactly.
+    """
+    b, h, s, d = query.shape
+    nnz = sparse_csr_columns.shape[-1]
+
+    def one_head(offset, columns):
+        row_of = jnp.searchsorted(offset, jnp.arange(nnz), side='right') - 1
+        m = jnp.zeros((s, s), bool)
+        return m.at[row_of, columns].set(True)
+
+    mask = jax.vmap(jax.vmap(one_head))(
+        jnp.asarray(sparse_csr_offset), jnp.asarray(sparse_csr_columns))
+    if key_padding_mask is not None:
+        mask = mask & (jnp.asarray(key_padding_mask) != 0)[:, None, None, :]
+    if attn_mask is not None:
+        mask = mask & (jnp.asarray(attn_mask) != 0)[None, None]
+    qt = query.transpose(0, 2, 1, 3)    # -> (B, S, H, D) sdpa layout
+    kt = key.transpose(0, 2, 1, 3)
+    vt = value.transpose(0, 2, 1, 3)
+    out = scaled_dot_product_attention(qt, kt, vt, attn_mask=mask)
+    return out.transpose(0, 2, 1, 3)

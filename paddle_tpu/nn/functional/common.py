@@ -240,3 +240,16 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 
 def class_center_sample(label, num_classes, num_samples):  # pragma: no cover
     raise NotImplementedError('class_center_sample: PS-specific, out of TPU scope')
+
+
+def zeropad2d(x, padding, data_format='NCHW'):
+    """Zero-pad H/W of a 4-D tensor; padding = [left, right, top, bottom]
+    (ref: nn/functional/common.py::zeropad2d)."""
+    l, r, t, b = [int(p) for p in padding]
+    if data_format == 'NCHW':
+        widths = [(0, 0), (0, 0), (t, b), (l, r)]
+    elif data_format == 'NHWC':
+        widths = [(0, 0), (t, b), (l, r), (0, 0)]
+    else:
+        raise ValueError(f'bad data_format: {data_format}')
+    return jnp.pad(x, widths)

@@ -296,3 +296,227 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     ce = jnp.mean(-jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
     reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) + jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
     return ce + reg
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction='mean'):
+    """Multi-class margin (hinge) loss (ref: loss.py::multi_margin_loss):
+    mean_j( max(0, margin - x[y] + x[j])^p ) over j != y."""
+    x = input.astype(jnp.float32)
+    n, c = x.shape
+    xy = jnp.take_along_axis(x, label[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - xy + x)
+    if p != 1:
+        m = m ** p
+    if weight is not None:
+        m = m * jnp.take(weight.astype(jnp.float32), label)[:, None]
+    # the j == y term contributes max(0, margin)^p; mask it out
+    m = m * (1 - jax.nn.one_hot(label, c, dtype=m.dtype))
+    return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction='mean'):
+    """ref: loss.py::triplet_margin_with_distance_loss — like
+    triplet_margin_loss but with a caller-supplied distance."""
+    if distance_function is None:
+        distance_function = lambda a, b: jnp.linalg.norm(a - b, axis=-1)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, distance_function(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss (ref: loss.py::hsigmoid_loss; bit layout
+    per phi/kernels/funcs/matrix_bit_code.h::SimpleCode — class c is heap
+    node c + num_classes; weight row for prefix node n is n - 1).
+
+    Default tree: complete binary heap over the classes. Custom tree:
+    `path_table` [N, L] rows of weight indices (negative = padding) and
+    `path_code` [N, L] binary targets.
+    """
+    x = input.astype(jnp.float32)
+    label = label.reshape(-1)
+    if path_table is None:
+        # static max code length: bits of (2*num_classes - 1) minus 1
+        max_len = int(2 * num_classes - 1).bit_length() - 1
+        c = label + num_classes
+        bits = jnp.arange(max_len)
+        # integer floor(log2(c)): count of powers of two <= c (float log2
+        # rounds the wrong way exactly at the powers of two)
+        length = jnp.sum(
+            c[:, None] >= (1 << jnp.arange(1, max_len + 1))[None],
+            axis=1).astype(jnp.int32)
+        valid = bits[None, :] < length[:, None]
+        # bit i (LSB-first): weight index (c >> (i+1)) - 1, target (c >> i) & 1
+        idx = jnp.where(valid, (c[:, None] >> (bits[None] + 1)) - 1, 0)
+        code = ((c[:, None] >> bits[None]) & 1).astype(jnp.float32)
+    else:
+        valid = path_table >= 0
+        idx = jnp.where(valid, path_table, 0)
+        code = path_code.astype(jnp.float32)
+    w = jnp.take(weight.astype(jnp.float32), idx, axis=0)   # (N, L, D)
+    pre = jnp.einsum('nd,nld->nl', x, w)
+    if bias is not None:
+        pre = pre + jnp.take(bias.astype(jnp.float32).reshape(-1), idx)
+    # BCE-with-logits vs target bit, summed over the path
+    per_node = jax.nn.softplus(pre) - code * pre
+    return jnp.sum(jnp.where(valid, per_node, 0.0), axis=1, keepdims=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction='mean'):
+    """ArcFace-family margin softmax (ref: loss.py::margin_cross_entropy):
+    target logit cos(theta) -> cos(m1*theta + m2) - m3, all scaled by s.
+
+    `group`: None/False computes locally; a mesh axis NAME (str) computes
+    the class-parallel version under shard_map — the TPU analogue of the
+    reference's model-parallel process group. `logits` is then the LOCAL
+    class shard (equal widths across the axis); labels are GLOBAL class
+    ids, translated to shard-local columns via the shard's axis index so
+    only the owning shard applies the margin / contributes the NLL term.
+    """
+    x = logits.astype(jnp.float32)
+    label = label.reshape(-1)
+    n, c = x.shape
+    if isinstance(group, str):  # class-parallel: x is the local shard
+        offset = jax.lax.axis_index(group) * c
+        local = label - offset
+        in_shard = (local >= 0) & (local < c)
+        onehot = (jax.nn.one_hot(jnp.clip(local, 0, c - 1), c, dtype=x.dtype)
+                  * in_shard[:, None].astype(x.dtype))
+        # owner shard contributes the target cosine; everyone gets it
+        cos_t = jax.lax.psum(jnp.sum(x * onehot, axis=-1), group)
+    else:
+        onehot = jax.nn.one_hot(label, c, dtype=x.dtype)
+        cos_t = jnp.sum(x * onehot, axis=-1)
+    cos_t = jnp.clip(cos_t, -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = x * (1 - onehot) + target[:, None] * onehot
+    z = adjusted * scale
+    if isinstance(group, str):
+        zmax = jax.lax.pmax(jnp.max(z, axis=-1), group)
+        e = jnp.exp(z - zmax[:, None])
+        denom = jax.lax.psum(jnp.sum(e, axis=-1), group)
+        logp = z - zmax[:, None] - jnp.log(denom)[:, None]
+        softmax = e / denom[:, None]
+        # onehot is zero off the owner shard, so psum counts the term once
+        nll = jax.lax.psum(-jnp.sum(logp * onehot, axis=-1), group)
+    else:
+        logp = jax.nn.log_softmax(z, axis=-1)
+        softmax = jnp.exp(logp)
+        nll = -jnp.sum(logp * onehot, axis=-1)
+    loss = _reduce(nll[:, None], reduction)
+    return (loss, softmax) if return_softmax else loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None):
+    """Adaptive softmax (Grave et al.) — frequent classes in the head,
+    rare classes in down-projected tail clusters
+    (ref: loss.py::adaptive_log_softmax_with_loss). `tail_weights[i]` is
+    [proj (D, d_i), out (d_i, n_i)]; `cutoffs` ends with n_classes.
+
+    Returns (output, loss): per-sample target log-prob and mean NLL.
+    """
+    x = input.astype(jnp.float32)
+    cutoffs = [int(v) for v in cutoffs]
+    shortlist = cutoffs[0]
+    n_clusters = len(cutoffs) - 1
+    head = x @ head_weight
+    if head_bias is not None:
+        head = head + head_bias
+    head_logp = jax.nn.log_softmax(head, axis=-1)   # (N, shortlist + K)
+
+    out = jnp.take_along_axis(
+        head_logp, jnp.clip(label, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+    out = jnp.where(label < shortlist, out, 0.0)
+    for i in range(n_clusters):
+        lo, hi = cutoffs[i], cutoffs[i + 1]
+        proj, w_out = tail_weights[i]
+        tail_logp = jax.nn.log_softmax((x @ proj) @ w_out, axis=-1)
+        in_cluster = (label >= lo) & (label < hi)
+        rel = jnp.clip(label - lo, 0, hi - lo - 1)
+        lp = (head_logp[:, shortlist + i]
+              + jnp.take_along_axis(tail_logp, rel[:, None], axis=1)[:, 0])
+        out = jnp.where(in_cluster, lp, out)
+    return out, -jnp.mean(out)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction='mean'):
+    """RNN-Transducer loss (ref: loss.py::rnnt_loss; the reference wraps
+    warp-transducer's CUDA kernel).
+
+    TPU-native design: the forward-variable recurrence runs as an outer
+    `lax.scan` over T with an inner scan over U (static [B, Tmax, Umax]
+    grid, length masking instead of dynamic shapes). Gradients come from
+    autodiff through the scan rather than a hand-written backward.
+    FastEmit regularization scales the emission branch's *gradient* by
+    (1 + lambda) without changing the loss value — implemented with a
+    stop_gradient identity, exactly matching warp-transducer's behaviour.
+    """
+    lp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    b, tmax, umax_p1, _ = lp.shape
+    umax = umax_p1 - 1
+    neg_inf = jnp.float32(-1e30)
+
+    blank_lp = lp[..., blank]                            # (B, T, U+1)
+    lab = jnp.clip(label, 0, None).astype(jnp.int32)     # (B, Umax)
+    emit_lp = jnp.take_along_axis(
+        lp[:, :, :umax, :], lab[:, None, :, None], axis=-1)[..., 0]
+    if fastemit_lambda:
+        emit_lp = emit_lp + fastemit_lambda * (
+            emit_lp - jax.lax.stop_gradient(emit_lp))
+    u_range = jnp.arange(umax_p1)
+    u_valid = u_range[None] <= label_lengths[:, None]    # (B, U+1)
+
+    alpha0 = jnp.where(u_range[None] == 0, 0.0, neg_inf)
+    alpha0 = jnp.broadcast_to(alpha0, (b, umax_p1))
+
+    def t_step(alpha_prev, t):
+        # blank transition from the previous time step
+        from_blank = alpha_prev + blank_lp[:, t - 1, :]
+
+        def u_step(carry, u):
+            # emit transition within this time step: alpha[t, u] also
+            # hears alpha[t, u-1] + emit_lp[t, u-1]
+            prev_u = carry
+            here = from_blank[:, u]
+            emit = jnp.where(u > 0,
+                             prev_u + emit_lp[:, t, jnp.maximum(u - 1, 0)],
+                             neg_inf)
+            val = jnp.logaddexp(here, emit)
+            return val, val
+
+        _, cols = jax.lax.scan(u_step, jnp.full((b,), neg_inf), u_range)
+        alpha_t = cols.T                                  # (B, U+1)
+        # first time step keeps only the emit chain from alpha[0, 0]
+        alpha_t = jnp.where(u_valid, alpha_t, neg_inf)
+        return alpha_t, alpha_t
+
+    # t = 0 row: pure emission chain alpha[0, u] = sum emit_lp[0, :u]
+    emit0 = jnp.concatenate(
+        [jnp.zeros((b, 1)), jnp.cumsum(emit_lp[:, 0, :], axis=-1)], axis=-1)
+    alpha_t0 = jnp.where(u_valid, emit0, neg_inf)
+
+    if tmax > 1:
+        _, rows = jax.lax.scan(
+            lambda a, t: t_step(a, t), alpha_t0, jnp.arange(1, tmax))
+        alphas = jnp.concatenate([alpha_t0[None], rows], axis=0)  # (T, B, U+1)
+    else:
+        alphas = alpha_t0[None]
+    # final log-prob: alpha[T_b - 1, U_b] + blank at (T_b - 1, U_b)
+    t_idx = (input_lengths - 1).astype(jnp.int32)
+    u_idx = label_lengths.astype(jnp.int32)
+    batch = jnp.arange(b)
+    final_alpha = alphas[t_idx, batch, u_idx]
+    final_blank = blank_lp[batch, t_idx, u_idx]
+    nll = -(final_alpha + final_blank)
+    return _reduce(nll, reduction)

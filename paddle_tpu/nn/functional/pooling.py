@@ -1,6 +1,7 @@
 """Pooling (ref: python/paddle/nn/functional/pooling.py) via lax.reduce_window."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -47,23 +48,35 @@ def _window(x, n, kernel, stride, padding, data_format, init, op, ceil_mode=Fals
     return lax.reduce_window(x, init, op, dims, strides, full_pad), dims, strides
 
 
-def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCL'):
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCL'):
     stride = stride or kernel_size
+    if return_mask:
+        return _max_pool_with_indices(x, 1, kernel_size, stride, padding,
+                                      ceil_mode, data_format)
     out, _, _ = _window(x, 1, kernel_size, stride, padding, data_format,
                         -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
                         lax.max, ceil_mode)
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCHW'):
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW'):
     stride = stride or kernel_size
+    if return_mask:
+        return _max_pool_with_indices(x, 2, kernel_size, stride, padding,
+                                      ceil_mode, data_format)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     out, _, _ = _window(x, 2, kernel_size, stride, padding, data_format, init, lax.max, ceil_mode)
     return out
 
 
-def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCDHW'):
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCDHW'):
     stride = stride or kernel_size
+    if return_mask:
+        return _max_pool_with_indices(x, 3, kernel_size, stride, padding,
+                                      ceil_mode, data_format)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     out, _, _ = _window(x, 3, kernel_size, stride, padding, data_format, init, lax.max, ceil_mode)
     return out
@@ -139,16 +152,36 @@ def adaptive_avg_pool3d(x, output_size, data_format='NCDHW'):
     return _adaptive(x, 3, output_size, data_format, lambda v, a, keepdims=False: jnp.mean(v, axis=a, keepdims=keepdims))
 
 
+def _adaptive_max(x, n, output_size, return_mask, data_format):
+    if not return_mask:
+        return _adaptive(x, n, output_size, data_format,
+                         lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+    # indices path: adaptive regions [floor(i*in/out), ceil((i+1)*in/out))
+    import numpy as np
+    xc, restore = _to_nc(x, n, data_format)
+    spatial = xc.shape[2:]
+    out_size = _tuple(output_size, n)
+    out_size = tuple(spatial[i] if out_size[i] is None else out_size[i]
+                     for i in range(n))
+    bounds = []
+    for i in range(n):
+        idx = np.arange(out_size[i])
+        starts = (idx * spatial[i]) // out_size[i]
+        ends = -(-((idx + 1) * spatial[i]) // out_size[i])
+        bounds.append((starts, ends))
+    return _region_max_pool(xc, n, bounds, out_size, True, restore)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, data_format='NCL'):
-    return _adaptive(x, 1, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+    return _adaptive_max(x, 1, output_size, return_mask, data_format)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, data_format='NCHW'):
-    return _adaptive(x, 2, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+    return _adaptive_max(x, 2, output_size, return_mask, data_format)
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, data_format='NCDHW'):
-    return _adaptive(x, 3, output_size, data_format, lambda v, a, keepdims=False: jnp.max(v, axis=a, keepdims=keepdims))
+    return _adaptive_max(x, 3, output_size, return_mask, data_format)
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format='NCHW'):
@@ -159,3 +192,224 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
         data_format, 0.0, lax.add, ceil_mode,
     )
     return jnp.power(s, 1.0 / p).astype(x.dtype)
+
+
+# ---- max-pool indices / unpooling / fractional pooling ----------------------
+# (ref: nn/functional/pooling.py::max_pool*(return_mask), max_unpool1d/2d/3d,
+# fractional_max_pool2d/3d). Indices are flattened over the UNPADDED spatial
+# dims, as the reference kernels produce. The window argmax is computed by
+# stacking the prod(kernel) strided slices (static unroll — XLA fuses this
+# into one gather-free elementwise reduction) rather than reduce_window,
+# which cannot carry an argmax payload.
+import itertools as _it
+
+import numpy as _np
+
+
+def _to_nc(x, n, data_format):
+    """Canonicalize to NC-first; returns (x, restore_fn)."""
+    if data_format.startswith('NC'):
+        return x, lambda v: v
+    perm = (0, n + 1) + tuple(range(1, n + 1))
+    inv = (0,) + tuple(range(2, n + 2)) + (1,)
+    return x.transpose(perm), lambda v: v.transpose(inv)
+
+
+def _max_pool_with_indices(x, n, kernel, stride, padding, ceil_mode,
+                           data_format):
+    x, restore = _to_nc(x, n, data_format)
+    k, s = _tuple(kernel, n), _tuple(stride, n)
+    pad = _pads(padding, n)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * n if pad == 'VALID' else None
+        if pad is None:
+            raise ValueError("padding='SAME' unsupported with return_mask")
+    spatial = x.shape[2:]
+    pad = [list(p) for p in pad]
+    out_sizes = []
+    for i in range(n):
+        size = spatial[i] + pad[i][0] + pad[i][1]
+        if ceil_mode:
+            rem = (size - k[i]) % s[i]
+            if rem:
+                pad[i][1] += s[i] - rem
+                size += s[i] - rem
+        out_sizes.append((size - k[i]) // s[i] + 1)
+
+    # integers compare exactly in their own dtype (a float32 cast would
+    # round values above 2^24); floats go through f32 with -inf padding
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        cmp_dtype, pad_val = jnp.float32, -jnp.inf
+    else:
+        cmp_dtype, pad_val = x.dtype, jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x.astype(cmp_dtype), [(0, 0), (0, 0)] + [tuple(p) for p in pad],
+                 constant_values=pad_val)
+    idx_map = jnp.arange(int(_np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+    idx_map = jnp.pad(idx_map, [tuple(p) for p in pad], constant_values=-1)
+
+    vals, idxs = [], []
+    for offs in _it.product(*[range(kk) for kk in k]):
+        sl = tuple(slice(offs[i], offs[i] + (out_sizes[i] - 1) * s[i] + 1, s[i])
+                   for i in range(n))
+        vals.append(xp[(slice(None), slice(None)) + sl])
+        idxs.append(idx_map[sl])
+    vals = jnp.stack(vals, axis=-1)             # (N, C, *out, K)
+    idxs = jnp.stack(idxs, axis=-1)             # (*out, K)
+    best = jnp.argmax(vals, axis=-1)
+    out = jnp.take_along_axis(vals, best[..., None], axis=-1)[..., 0]
+    indices = jnp.take_along_axis(
+        jnp.broadcast_to(idxs, vals.shape), best[..., None], axis=-1)[..., 0]
+    return (restore(out.astype(x.dtype)),
+            restore(indices.astype(jnp.int32)))
+
+
+def _max_unpool(x, indices, n, kernel_size, stride=None, padding=0,
+                output_size=None, data_format='NCHW'):
+    x, restore = _to_nc(x, n, data_format)
+    indices, _ = _to_nc(indices, n, data_format)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    p = _tuple(padding, n)
+    if output_size is None:
+        out_sp = tuple((x.shape[2 + i] - 1) * s[i] - 2 * p[i] + k[i]
+                       for i in range(n))
+    else:
+        out_sp = tuple(output_size[-n:])
+    nb, ch = x.shape[:2]
+    flat = int(_np.prod(out_sp))
+
+    def scatter(ind, val):
+        return jnp.zeros((flat,), val.dtype).at[ind.ravel()].set(val.ravel())
+
+    out = jax.vmap(jax.vmap(scatter))(
+        indices.reshape(nb, ch, -1), x.reshape(nb, ch, -1))
+    return restore(out.reshape((nb, ch) + out_sp))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format='NCL'):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format='NCHW'):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format='NCDHW'):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format='NCL'):
+    p = float(norm_type)
+    stride = stride or kernel_size
+    s, _, _ = _window(
+        jnp.power(jnp.abs(x.astype(jnp.float32)), p), 1, kernel_size, stride,
+        padding, data_format, 0.0, lax.add, ceil_mode)
+    return jnp.power(s, 1.0 / p).astype(x.dtype)
+
+
+def _fractional_bounds(in_size, out_size, u, kernel=None):
+    """Graham's pseudo-random pooling regions: start_i = ceil(a(i+u)-1),
+    end_i = ceil(a(i+1+u)-1) (kernel overrides the window length)."""
+    alpha = in_size / out_size
+    i = _np.arange(out_size)
+    starts = _np.ceil(alpha * (i + u) - 1).astype(int).clip(0, in_size - 1)
+    if kernel is not None:
+        ends = starts + kernel
+    else:
+        ends = _np.ceil(alpha * (i + 1 + u) - 1).astype(int)
+    ends = ends.clip(1, in_size)
+    ends = _np.maximum(ends, starts + 1)
+    return starts, ends
+
+
+def _fractional_max_pool(x, n, output_size, kernel_size, random_u,
+                         return_mask, data_format):
+    x, restore = _to_nc(x, n, data_format)
+    spatial = x.shape[2:]
+    out_size = _tuple(output_size, n)
+    out_size = tuple(spatial[i] if out_size[i] is None else out_size[i]
+                     for i in range(n))
+    k = _tuple(kernel_size, n) if kernel_size is not None else (None,) * n
+    if random_u is None:
+        from ...framework import random as _rand
+        random_u = float(jax.random.uniform(_rand.split_key(), ()))
+    if not (0 < random_u < 1):
+        raise ValueError(f'random_u must be in (0, 1), got {random_u}')
+
+    bounds = [_fractional_bounds(spatial[i], out_size[i], random_u, k[i])
+              for i in range(n)]
+    return _region_max_pool(x, n, bounds, out_size, return_mask, restore)
+
+
+def _region_max_pool(x, n, bounds, out_size, return_mask, restore):
+    """Max over per-dim variable-length regions given as (starts, ends)
+    numpy arrays — shared by fractional and adaptive max pooling."""
+    spatial = x.shape[2:]
+    maxw = [int((e - s).max()) for s, e in bounds]
+    # gather indices (out_i, maxw_i) per dim + validity masks
+    gidx, gmask = [], []
+    for i in range(n):
+        starts, ends = bounds[i]
+        offs = _np.arange(maxw[i])
+        idx = starts[:, None] + offs[None]
+        mask = idx < ends[:, None]
+        gidx.append(jnp.asarray(idx.clip(0, spatial[i] - 1)))
+        gmask.append(jnp.asarray(mask))
+    # patch gather: successively index each spatial dim
+    patches = x
+    for i in range(n):
+        ax = 2 + i * 2  # each expansion splits dim i into (out_i, maxw_i)
+        patches = jnp.moveaxis(
+            jnp.take(patches, gidx[i].ravel(), axis=ax), ax, ax
+        ).reshape(patches.shape[:ax] + (out_size[i], maxw[i])
+                  + patches.shape[ax + 1:])
+    # patches: (N, C, out_0, w_0, out_1, w_1, ...) -> bring windows last
+    perm = ([0, 1] + [2 + 2 * i for i in range(n)]
+            + [3 + 2 * i for i in range(n)])
+    patches = patches.transpose(perm)
+    win = patches.reshape(patches.shape[:2 + n] + (-1,))
+    # build combined window mask with broadcasting
+    m = gmask[0].reshape(out_size[0], maxw[0], *([1, 1] * (n - 1)))
+    for i in range(1, n):
+        shape = [1, 1] * n
+        shape[2 * i], shape[2 * i + 1] = out_size[i], maxw[i]
+        m = m * gmask[i].reshape(shape)
+    m = m.transpose([2 * i for i in range(n)] + [2 * i + 1 for i in range(n)])
+    m = m.reshape(out_size + (-1,))
+    win = jnp.where(m, win.astype(jnp.float32), -jnp.inf)
+    out = jnp.max(win, axis=-1).astype(x.dtype)
+    if not return_mask:
+        return restore(out)
+    # global flat index of the argmax within the unpadded input
+    best = jnp.argmax(win, axis=-1)
+    flat_idx = 0
+    for i in range(n):
+        # window-local offset along dim i of the flattened window position
+        stride_rest = int(_np.prod(maxw[i + 1:])) if i + 1 <= n - 1 else 1
+        loc = (best // stride_rest) % maxw[i]
+        starts = jnp.asarray(bounds[i][0])
+        shape = [1] * n
+        shape[i] = out_size[i]
+        dim_idx = starts.reshape(shape) + loc
+        flat_idx = flat_idx * spatial[i] + dim_idx
+    indices = jnp.broadcast_to(flat_idx, out.shape).astype(jnp.int32)
+    return restore(out), restore(indices)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, data_format='NCHW'):
+    return _fractional_max_pool(x, 2, output_size, kernel_size, random_u,
+                                return_mask, data_format)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, data_format='NCDHW'):
+    return _fractional_max_pool(x, 3, output_size, kernel_size, random_u,
+                                return_mask, data_format)

@@ -1,0 +1,221 @@
+"""Vision functionals: affine_grid, grid_sample, channel_shuffle,
+temporal_shift, sequence_mask (ref: python/paddle/nn/functional/vision.py,
+extension.py).
+
+TPU notes: grid_sample is a gather-heavy op that XLA lowers to dynamic
+gathers — all shapes here are static, the 2^ndim corner loop is unrolled
+in Python (ndim is 2 or 3, known at trace time), and the per-corner
+weights fuse into the gather epilogue. No data-dependent control flow.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _unnormalize(coord, size, align_corners):
+    """[-1, 1] grid coordinate -> pixel coordinate."""
+    if align_corners:
+        return (coord + 1) * 0.5 * (size - 1)
+    return ((coord + 1) * size - 1) * 0.5
+
+
+def _reflect(coord, size, align_corners):
+    """Reflect out-of-range pixel coordinates back into the valid range
+    (padding_mode='reflection'; boundary behaviour matches the reference:
+    reflection axes at pixel centers when align_corners else at edges)."""
+    if size == 1:
+        return jnp.zeros_like(coord)
+    if align_corners:
+        span = size - 1
+        coord = jnp.abs(coord) % (2 * span)
+        return jnp.where(coord > span, 2 * span - coord, coord)
+    span = size
+    coord = jnp.abs(coord + 0.5) % (2 * span)
+    coord = jnp.where(coord > span, 2 * span - coord, coord) - 0.5
+    return jnp.clip(coord, 0, size - 1)
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True):
+    """Sample `x` at the flow-field `grid` locations.
+
+    x: (N, C, H, W) or (N, C, D, H, W); grid: (N, H_out, W_out, 2) or
+    (N, D_out, H_out, W_out, 3) with coordinates in [-1, 1] ordered
+    (x, y[, z]) — x indexes the *last* (width) axis, matching the
+    reference (ref: nn/functional/vision.py::grid_sample).
+    """
+    if mode not in ('bilinear', 'nearest'):
+        raise ValueError(f"mode must be 'bilinear' or 'nearest', got {mode}")
+    if padding_mode not in ('zeros', 'border', 'reflection'):
+        raise ValueError(f"bad padding_mode: {padding_mode}")
+    ndim = x.ndim - 2  # spatial rank: 2 or 3
+    if grid.ndim != x.ndim or grid.shape[-1] != ndim:
+        raise ValueError(f'grid shape {grid.shape} does not match x {x.shape}')
+    sizes = x.shape[2:]                       # (H, W) or (D, H, W)
+    out_spatial = grid.shape[1:-1]
+    compute_dtype = jnp.promote_types(x.dtype, jnp.float32)
+
+    # Per-axis pixel coordinates. grid's last dim is (x, y[, z]) =
+    # (w, h[, d]) — reverse it to match the spatial-dims order of `x`.
+    coords = []
+    for axis in range(ndim):
+        c = _unnormalize(grid[..., ndim - 1 - axis].astype(compute_dtype),
+                         sizes[axis], align_corners)
+        if padding_mode == 'border':
+            c = jnp.clip(c, 0, sizes[axis] - 1)
+        elif padding_mode == 'reflection':
+            c = _reflect(c, sizes[axis], align_corners)
+        coords.append(c)
+
+    x_flat = x.reshape(x.shape[0], x.shape[1], -1)  # (N, C, prod(sizes))
+
+    def _gather(idx_list, weight):
+        """Gather x at integer per-axis indices, weighting by `weight`
+        and zeroing out-of-bounds taps (padding_mode='zeros')."""
+        valid = None
+        flat = 0
+        for axis, idx in enumerate(idx_list):
+            if padding_mode == 'zeros':
+                ok = (idx >= 0) & (idx <= sizes[axis] - 1)
+                valid = ok if valid is None else (valid & ok)
+            idx = jnp.clip(idx, 0, sizes[axis] - 1)
+            flat = flat * sizes[axis] + idx
+        vals = jax.vmap(lambda xf, ix: jnp.take(xf, ix.ravel(), axis=1)
+                        )(x_flat, flat)           # (N, C, prod(out))
+        vals = vals.reshape(x.shape[0], x.shape[1], *out_spatial)
+        if valid is not None:
+            weight = weight * valid.astype(compute_dtype)
+        return vals * weight[:, None]
+
+    if mode == 'nearest':
+        idx = [jnp.round(c).astype(jnp.int32) for c in coords]
+        out = _gather(idx, jnp.ones(grid.shape[:-1], compute_dtype))
+    else:
+        lo = [jnp.floor(c) for c in coords]
+        frac = [c - l for c, l in zip(coords, lo)]
+        lo = [l.astype(jnp.int32) for l in lo]
+        out = 0
+        for corner in range(2 ** ndim):  # unrolled: 4 (2-D) or 8 (3-D) taps
+            bits = [(corner >> a) & 1 for a in range(ndim)]
+            idx = [l + b for l, b in zip(lo, bits)]
+            w = 1.0
+            for f, b in zip(frac, bits):
+                w = w * (f if b else (1 - f))
+            out = out + _gather(idx, w)
+    return out.astype(x.dtype)
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """Generate a sampling grid from batched affine matrices.
+
+    theta: (N, 2, 3) with out_shape [N, C, H, W] -> grid (N, H, W, 2); or
+    (N, 3, 4) with out_shape [N, C, D, H, W] -> grid (N, D, H, W, 3)
+    (ref: nn/functional/vision.py::affine_grid).
+    """
+    out_shape = [int(s) for s in out_shape]
+    ndim = len(out_shape) - 2
+    if theta.shape[-2:] != (ndim, ndim + 1):
+        raise ValueError(f'theta {theta.shape} does not match out_shape '
+                         f'{out_shape}')
+    spatial = out_shape[2:]
+    dtype = theta.dtype
+
+    def _base(size):
+        if align_corners:
+            return (jnp.linspace(-1.0, 1.0, size, dtype=dtype) if size > 1
+                    else jnp.zeros((1,), dtype))
+        return (2 * jnp.arange(size, dtype=dtype) + 1) / size - 1
+
+    # Homogeneous base coordinates ordered (x=w, y=h[, z=d], 1).
+    axes = [_base(s) for s in spatial]
+    mesh = jnp.meshgrid(*axes, indexing='ij')     # each (*spatial,)
+    base = jnp.stack(list(reversed(mesh)) + [jnp.ones(spatial, dtype)],
+                     axis=-1)                     # (*spatial, ndim+1)
+    # (N, *spatial, ndim): one matmul per batch — fine for the MXU.
+    return jnp.einsum('...i,nji->n...j', base, theta)
+
+
+def channel_shuffle(x, groups, data_format='NCHW'):
+    """Rearrange channels by transposing the (groups, C//groups) split
+    (ref: nn/functional/vision.py::channel_shuffle)."""
+    if data_format not in ('NCHW', 'NHWC'):
+        raise ValueError(f'bad data_format: {data_format}')
+    c_axis = 1 if data_format == 'NCHW' else x.ndim - 1
+    c = x.shape[c_axis]
+    if c % groups:
+        raise ValueError(f'channels {c} not divisible by groups {groups}')
+    shape = list(x.shape)
+    split = shape[:c_axis] + [groups, c // groups] + shape[c_axis + 1:]
+    perm = list(range(len(split)))
+    perm[c_axis], perm[c_axis + 1] = perm[c_axis + 1], perm[c_axis]
+    return x.reshape(split).transpose(perm).reshape(shape)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format='NCHW'):
+    """Shift a ratio of channels one step along the temporal axis
+    (ref: nn/functional/extension.py::temporal_shift). x: (N*T, C, H, W)
+    or (N*T, H, W, C); the first `shift_ratio*C` channels take their value
+    from t+1, the next `shift_ratio*C` from t-1, the rest pass through."""
+    if data_format not in ('NCHW', 'NHWC'):
+        raise ValueError(f'bad data_format: {data_format}')
+    nchw = data_format == 'NCHW'
+    nt = x.shape[0]
+    if nt % seg_num:
+        raise ValueError(f'batch {nt} not divisible by seg_num {seg_num}')
+    c = x.shape[1] if nchw else x.shape[-1]
+    xt = x.reshape((nt // seg_num, seg_num) + x.shape[1:])  # (N, T, ...)
+    c_axis = 2 if nchw else xt.ndim - 1
+    c1 = int(c * shift_ratio)
+    c2 = 2 * c1
+
+    def _chan(lo, hi):
+        sl = [slice(None)] * xt.ndim
+        sl[c_axis] = slice(lo, hi)
+        return xt[tuple(sl)]
+
+    def _tshift(seg, direction):
+        # direction +1: value from t+1 (pad at the end); -1: from t-1.
+        pad = [(0, 0)] * seg.ndim
+        pad[1] = (0, 1) if direction > 0 else (1, 0)
+        padded = jnp.pad(seg, pad)
+        return (padded[:, 1:] if direction > 0 else padded[:, :-1])
+
+    out = jnp.concatenate(
+        [_tshift(_chan(0, c1), +1), _tshift(_chan(c1, c2), -1),
+         _chan(c2, None)], axis=c_axis)
+    return out.reshape(x.shape)
+
+
+def sequence_mask(x, maxlen=None, dtype='int64'):
+    """Length tensor -> boolean-style mask: out[..., j] = j < x[...]
+    (ref: nn/functional/extension.py::sequence_mask). `maxlen` must be
+    static under jit (defaults to max(x) eagerly)."""
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    steps = jnp.arange(maxlen, dtype=jnp.int64 if x.dtype == jnp.int64
+                       else jnp.int32)
+    return (steps < x[..., None]).astype(dtype)
+
+
+def gather_tree(ids, parents):
+    """Reconstruct beam-search token paths from per-step ids and parent
+    beam indices (ref: nn/functional/extension.py::gather_tree). Shapes
+    (max_time, batch, beam). A reverse `lax.scan` follows parent pointers
+    from the last step — the backtrace every beam decoder needs."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    if ids.ndim != 3:
+        raise ValueError(f'gather_tree expects (time, batch, beam), '
+                         f'got {ids.shape}')
+    beam = ids.shape[-1]
+
+    def step(beam_idx, inp):
+        step_ids, step_parents = inp
+        tok = jnp.take_along_axis(step_ids, beam_idx, axis=-1)
+        nxt = jnp.take_along_axis(step_parents, beam_idx, axis=-1)
+        return nxt, tok
+
+    init = jnp.broadcast_to(jnp.arange(beam)[None], ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return toks

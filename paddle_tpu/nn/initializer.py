@@ -144,3 +144,87 @@ class Orthogonal(Initializer):
 TruncatedNormalInitializer = TruncatedNormal
 NormalInitializer = Normal
 ConstantInitializer = Constant
+
+
+def calculate_gain(nonlinearity, param=None):
+    """ref: paddle.nn.initializer.calculate_gain."""
+    import math
+
+    gains = {
+        'linear': 1.0, 'conv1d': 1.0, 'conv2d': 1.0, 'conv3d': 1.0,
+        'conv1d_transpose': 1.0, 'conv2d_transpose': 1.0,
+        'conv3d_transpose': 1.0, 'sigmoid': 1.0,
+        'tanh': 5.0 / 3.0, 'relu': math.sqrt(2.0),
+        'selu': 3.0 / 4.0,
+    }
+    if nonlinearity == 'leaky_relu':
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity not in gains:
+        raise ValueError(f'unsupported nonlinearity: {nonlinearity}')
+    return gains[nonlinearity]
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (ref: initializer/dirac.py):
+    out[i, i % C_in, center...] = 1 within each of `groups` blocks."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        import numpy as np
+
+        arr = np.zeros(shape, np.float32)
+        c_out, c_in = shape[0], shape[1]
+        center = tuple(s // 2 for s in shape[2:])
+        per_group = c_out // self.groups
+        # only the first min(per_group, c_in) outputs of each group carry
+        # an identity tap; the rest stay zero (ref: initializer/dirac.py
+        # min_shape clamp — wrapping extra outputs would duplicate inputs)
+        taps = min(per_group, c_in)
+        for g in range(self.groups):
+            for i in range(taps):
+                arr[(g * per_group + i, i) + center] = 1.0
+        return jnp.asarray(arr, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling transposed-conv kernel
+    (ref: initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype=None):
+        import numpy as np
+
+        if len(shape) < 3:
+            raise ValueError('Bilinear init expects a conv kernel shape')
+        spatial = shape[2:]
+        weights = np.ones((1,), np.float32)
+        for s in spatial:
+            factor = (s + 1) // 2
+            if s % 2 == 1:
+                center = factor - 1.0
+            else:
+                center = factor - 0.5
+            og = np.arange(s, dtype=np.float32)
+            filt = 1.0 - np.abs(og - center) / factor
+            weights = np.outer(weights.ravel(), filt)
+        weights = weights.reshape(spatial)
+        arr = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                arr[i, j] = weights
+        return jnp.asarray(arr, dtype)
+
+
+_global_initializer = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref: paddle.nn.initializer.set_global_initializer — default
+    initializers used by create_parameter when none is given."""
+    _global_initializer[0] = (weight_init, bias_init)
+
+
+def get_global_initializer():
+    return _global_initializer[0]

@@ -75,3 +75,20 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+Silu = SiLU  # the reference exports both spellings
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input
+    (ref: nn/layer/activation.py::Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f'Softmax2D expects 3-D or 4-D input, '
+                             f'got {x.ndim}-D')
+        return F.softmax(x, axis=-3)

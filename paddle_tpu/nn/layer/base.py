@@ -235,6 +235,10 @@ class Layer:
 
         dtype = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
         if initializer is None:
+            glob = I.get_global_initializer()
+            if glob is not None:
+                initializer = glob[1] if is_bias else glob[0]
+        if initializer is None:
             initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = initializer(shape, dtype)
         return Parameter(value, trainable=trainable, spec=spec)

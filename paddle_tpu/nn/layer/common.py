@@ -229,6 +229,17 @@ class PixelUnshuffle(Layer):
         return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
 
 
+class ChannelShuffle(Layer):
+    """Rearrange channels across groups (ref: nn/layer/vision.py::ChannelShuffle)."""
+
+    def __init__(self, groups, data_format='NCHW', name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
 class Unfold(Layer):
     def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         super().__init__()
@@ -247,3 +258,42 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides, self.paddings, self.dilations)
+
+
+class ZeroPad1D(Pad1D):
+    """ref: nn/layer/common.py::ZeroPad1D(padding, data_format, name)."""
+
+    def __init__(self, padding, data_format='NCL', name=None):
+        super().__init__(padding, 'constant', 0.0, data_format)
+
+
+class ZeroPad3D(Pad3D):
+    """ref: nn/layer/common.py::ZeroPad3D(padding, data_format, name)."""
+
+    def __init__(self, padding, data_format='NCDHW', name=None):
+        super().__init__(padding, 'constant', 0.0, data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    """ref: nn/layer/common.py::FeatureAlphaDropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+class Unflatten(Layer):
+    """Expand one axis into the given shape
+    (ref: nn/layer/common.py::Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, tuple(shape)
+
+    def forward(self, x):
+        from ...tensor.extension import unflatten
+
+        return unflatten(x, self.axis, self.shape)

@@ -184,3 +184,146 @@ class CTCLoss(Layer):
 
     def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths, self.blank, self.reduction, norm_by_times)
+
+
+class MultiMarginLoss(Layer):
+    """ref: nn/layer/loss.py::MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction='mean',
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.weight = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """ref: nn/layer/loss.py::TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction='mean', name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class RNNTLoss(Layer):
+    """ref: nn/layer/loss.py::RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction='mean',
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid with learned node classifiers
+    (ref: nn/layer/loss.py::HSigmoidLoss). Holds the (num_classes-1, D)
+    non-leaf weight matrix (custom trees supply per-call paths)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError('num_classes must be >= 2 for the default tree')
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter((rows, feature_size))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (rows, 1), is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError('custom tree requires path_table and path_code')
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (ref: nn/layer/loss.py::
+    AdaptiveLogSoftmaxWithLoss): frequent classes scored directly, rare
+    classes through down-projected tail clusters (cluster i projects to
+    in_features / div_value**(i+1) dims) — O(head) compute for the
+    common case instead of O(n_classes)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError('cutoffs must be unique, positive, increasing '
+                             'and < n_classes')
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            (in_features, shortlist + n_clusters))
+        self.head_bias = (self.create_parameter(
+            (shortlist + n_clusters,), is_bias=True) if head_bias else None)
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter((in_features, hsz))
+            out = self.create_parameter((hsz, osz))
+            self.add_parameter(f'tail_proj_{i}', proj)
+            self.add_parameter(f'tail_out_{i}', out)
+            self.tail_weights.append([proj, out])
+
+    def _tails(self):
+        # read through the registered attributes so jit/pytree updates
+        # (which rebind attributes, not the cached list) are respected
+        out = []
+        for i in range(len(self.cutoffs) - 1):
+            out.append([getattr(self, f'tail_proj_{i}'),
+                        getattr(self, f'tail_out_{i}')])
+        return out
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self._tails(), self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):
+        """Full (N, n_classes) log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+
+        x = input.astype(jnp.float32)
+        head = x @ self.head_weight
+        if self.head_bias is not None:
+            head = head + self.head_bias
+        head_logp = jax.nn.log_softmax(head, axis=-1)
+        shortlist = self.cutoffs[0]
+        pieces = [head_logp[:, :shortlist]]
+        for i, (proj, w_out) in enumerate(self._tails()):
+            tail_logp = jax.nn.log_softmax((x @ proj) @ w_out, axis=-1)
+            pieces.append(head_logp[:, shortlist + i:shortlist + i + 1]
+                          + tail_logp)
+        return jnp.concatenate(pieces, axis=-1)
+
+    def predict(self, input):
+        import jax.numpy as jnp
+
+        return jnp.argmax(self.log_prob(input), axis=-1)

@@ -20,25 +20,31 @@ class _Pool(Layer):
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCL', name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                              self.return_mask, self.ceil_mode, self.data_format)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCHW', name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                              self.return_mask, self.ceil_mode, self.data_format)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format='NCDHW', name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                              self.return_mask, self.ceil_mode, self.data_format)
 
 
 class AvgPool1D(_Pool):
@@ -95,22 +101,128 @@ class AdaptiveAvgPool3D(_Pool):
 class AdaptiveMaxPool1D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(output_size=output_size, data_format='NCL')
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size, data_format=self.data_format)
+        return F.adaptive_max_pool1d(x, self.output_size, self.return_mask,
+                                       data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(output_size=output_size, data_format='NCHW')
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size, data_format=self.data_format)
+        return F.adaptive_max_pool2d(x, self.output_size, self.return_mask,
+                                       data_format=self.data_format)
 
 
 class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(output_size=output_size, data_format='NCDHW')
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self.output_size, data_format=self.data_format)
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask,
+                                       data_format=self.data_format)
+
+
+class MaxUnPool1D(Layer):
+    """ref: nn/layer/pooling.py::MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format='NCL',
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size, self.data_format)
+
+
+class MaxUnPool2D(Layer):
+    """ref: nn/layer/pooling.py::MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format='NCHW',
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size, self.data_format)
+
+
+class MaxUnPool3D(Layer):
+    """ref: nn/layer/pooling.py::MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format='NCDHW',
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size, self.data_format)
+
+
+class LPPool1D(Layer):
+    """ref: nn/layer/pooling.py::LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format='NCL', name=None):
+        super().__init__()
+        self.norm_type, self.kernel_size = norm_type, kernel_size
+        self.stride, self.padding = stride, padding
+        self.ceil_mode, self.data_format = ceil_mode, data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class LPPool2D(Layer):
+    """ref: nn/layer/pooling.py::LPPool2D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format='NCHW', name=None):
+        super().__init__()
+        self.norm_type, self.kernel_size = norm_type, kernel_size
+        self.stride, self.padding = stride, padding
+        self.ceil_mode, self.data_format = ceil_mode, data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class FractionalMaxPool2D(Layer):
+    """ref: nn/layer/pooling.py::FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    """ref: nn/layer/pooling.py::FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .wrappers import (ExponentialMovingAverage, GradientMerge,  # noqa: F401
                        LookAhead)

@@ -1,0 +1,262 @@
+"""L-BFGS optimizer (ref: python/paddle/optimizer/lbfgs.py).
+
+The reference mutates parameters in place inside a closure-driven loop;
+here the step is functional — `step(closure, model)` returns the updated
+model — but the algorithm is the same: limited-memory two-loop recursion
+over the last `history_size` (s, y) pairs, optional strong-Wolfe cubic
+line search. Control flow runs on the host (L-BFGS is an eager,
+full-batch method: each iteration is data-dependent, so there is nothing
+for XLA to pipeline), while every loss/grad evaluation is a jitted jax
+call over the flattened trainable vector.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tree import merge, split_trainable
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Argmin of the cubic fitting (x1,f1,g1),(x2,f2,g2), clipped to
+    bounds — the safeguarded interpolation classic line searches use."""
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_sq = d1 * d1 - g1 * g2
+    if d2_sq >= 0:
+        d2 = np.sqrt(d2_sq)
+        if x1 <= x2:
+            t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            t = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return float(min(max(t, lo), hi))
+    return float((lo + hi) / 2.0)
+
+
+def _strong_wolfe(fdir, t, d_norm, f0, g0, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """Strong-Wolfe line search along a fixed direction.
+
+    fdir(t) -> (f, directional_derivative). Returns (f, t, n_evals).
+    Bracketing + zoom with cubic interpolation (the same scheme the
+    reference's `_strong_wolfe` implements).
+    """
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    f_new, g_new = fdir(t)
+    ls_iter = 1
+    bracket = None
+    while ls_iter < max_ls:
+        if f_new > f0 + c1 * t * g0 or (ls_iter > 1 and f_new >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_new, g_new)
+            break
+        if abs(g_new) <= -c2 * g0:
+            return f_new, t, ls_iter
+        if g_new >= 0:
+            bracket = (t, f_new, g_new, t_prev, f_prev, g_prev)
+            break
+        t_next = _cubic_interpolate(t_prev, f_prev, g_prev, t, f_new, g_new,
+                                    bounds=(1.01 * t, 10 * t))
+        t_prev, f_prev, g_prev = t, f_new, g_new
+        t = t_next
+        f_new, g_new = fdir(t)
+        ls_iter += 1
+    if bracket is None:  # ran out of expansion budget
+        return f_new, t, ls_iter
+
+    lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+    insuf_progress = False
+    while ls_iter < max_ls:
+        if abs(hi_t - lo_t) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(lo_t, lo_f, lo_g, hi_t, hi_f, hi_g)
+        # keep the trial point meaningfully interior
+        eps = 0.1 * abs(hi_t - lo_t)
+        span_lo, span_hi = min(lo_t, hi_t), max(lo_t, hi_t)
+        if min(t - span_lo, span_hi - t) < eps:
+            if insuf_progress or t >= span_hi or t <= span_lo:
+                t = span_hi - eps if abs(t - span_hi) < abs(t - span_lo) \
+                    else span_lo + eps
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+        f_new, g_new = fdir(t)
+        ls_iter += 1
+        if f_new > f0 + c1 * t * g0 or f_new >= lo_f:
+            hi_t, hi_f, hi_g = t, f_new, g_new
+        else:
+            if abs(g_new) <= -c2 * g0:
+                return f_new, t, ls_iter
+            if g_new * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+            lo_t, lo_f, lo_g = t, f_new, g_new
+    return lo_f, lo_t, ls_iter
+
+
+class LBFGS:
+    """ref: python/paddle/optimizer/lbfgs.py::LBFGS.
+
+    Usage:
+        opt = LBFGS(learning_rate=1.0, line_search_fn='strong_wolfe')
+        for _ in range(outer_steps):
+            loss, model = opt.step(closure, model)   # closure(model)->loss
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 name=None):
+        if line_search_fn not in (None, 'strong_wolfe'):
+            raise ValueError(f'unsupported line_search_fn: {line_search_fn}')
+        self.lr = float(learning_rate)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        # persistent across step() calls, like the reference's state dict
+        self._old_dirs: list[np.ndarray] = []
+        self._old_stps: list[np.ndarray] = []
+        self._ro: list[float] = []
+        self._H_diag = 1.0
+        self._prev_flat_grad = None
+        self._d = None          # last search direction (persists across steps)
+        self._t = None          # last accepted step length
+        self._n_iter = 0
+
+    def _flatten(self, model):
+        t, f = split_trainable(model)
+        leaves, treedef = jax.tree.flatten(t)
+        shapes = [l.shape for l in leaves]
+        sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+
+        def unflatten(vec):
+            out, off = [], 0
+            for s, n, proto in zip(shapes, sizes, leaves):
+                out.append(vec[off:off + n].reshape(s).astype(proto.dtype))
+                off += n
+            return merge(jax.tree.unflatten(treedef, out), f)
+
+        vec = jnp.concatenate([l.astype(jnp.float32).ravel()
+                               for l in leaves]) if leaves else jnp.zeros((0,))
+        return vec, unflatten
+
+    def step(self, closure, model):
+        """One outer L-BFGS step (up to `max_iter` inner iterations).
+        `closure(model) -> scalar loss` must be re-evaluable (it is called
+        again during the line search). Returns (initial_loss, new_model).
+        """
+        from ..autograd import value_and_grad
+
+        x0, unflatten = self._flatten(model)
+
+        # one compile per (closure, param structure) — NOT per step() call;
+        # recompiling each outer step would dominate the runtime. The
+        # closure is held by strong reference (`is`, not id()) so a freed
+        # closure can never alias a new one; note the cached function also
+        # captures the first call's non-trainable leaves, which is sound
+        # because LBFGS closures are pure objectives.
+        cache_key = (x0.shape, str(x0.dtype))
+        if (getattr(self, '_fg_closure', None) is closure
+                and getattr(self, '_fg_key', None) == cache_key):
+            f_and_g = self._fg
+        else:
+            @jax.jit
+            def f_and_g(vec):
+                m = unflatten(vec)
+                loss, grads = value_and_grad(closure)(m)
+                gleaves = jax.tree.leaves(grads)
+                flat = (jnp.concatenate([g.astype(jnp.float32).ravel()
+                                         for g in gleaves])
+                        if gleaves else jnp.zeros_like(vec))
+                return loss.astype(jnp.float32), flat
+
+            self._fg_key, self._fg = cache_key, f_and_g
+            self._fg_closure = closure
+
+        x = np.asarray(x0, np.float64)
+        loss, flat_grad = f_and_g(jnp.asarray(x, jnp.float32))
+        orig_loss = float(loss)
+        loss = orig_loss
+        flat_grad = np.asarray(flat_grad, np.float64)
+        current_evals = 1
+        if np.abs(flat_grad).max() <= self.tolerance_grad:
+            return jnp.asarray(orig_loss), unflatten(jnp.asarray(x, jnp.float32))
+
+        d, t = self._d, self._t
+        for _ in range(self.max_iter):
+            self._n_iter += 1
+            if self._n_iter == 1 or self._prev_flat_grad is None:
+                d = -flat_grad
+                self._old_dirs, self._old_stps, self._ro = [], [], []
+                self._H_diag = 1.0
+            else:
+                y = flat_grad - self._prev_flat_grad
+                s = d * t
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(self._old_dirs) == self.history_size:
+                        self._old_dirs.pop(0)
+                        self._old_stps.pop(0)
+                        self._ro.pop(0)
+                    self._old_dirs.append(y)
+                    self._old_stps.append(s)
+                    self._ro.append(1.0 / ys)
+                    self._H_diag = ys / float(y @ y)
+                # two-loop recursion
+                num = len(self._old_dirs)
+                q = -flat_grad
+                al = [0.0] * num
+                for i in range(num - 1, -1, -1):
+                    al[i] = float(self._old_stps[i] @ q) * self._ro[i]
+                    q = q - al[i] * self._old_dirs[i]
+                d = q * self._H_diag
+                for i in range(num):
+                    be_i = float(self._old_dirs[i] @ d) * self._ro[i]
+                    d = d + self._old_stps[i] * (al[i] - be_i)
+            self._prev_flat_grad = flat_grad.copy()
+            prev_loss = loss
+
+            gtd = float(flat_grad @ d)
+            if gtd > -self.tolerance_change:
+                break
+            if self._n_iter == 1:
+                t = min(1.0, 1.0 / np.abs(flat_grad).sum()) * self.lr
+            else:
+                t = self.lr
+
+            if self.line_search_fn == 'strong_wolfe':
+                def fdir(tt):
+                    fv, gv = f_and_g(jnp.asarray(x + tt * d, jnp.float32))
+                    return float(fv), float(np.asarray(gv, np.float64) @ d)
+
+                d_norm = np.abs(d).max()
+                loss, t, ls_evals = _strong_wolfe(
+                    fdir, t, d_norm, loss, gtd,
+                    tolerance_change=self.tolerance_change)
+                current_evals += ls_evals
+                x = x + t * d
+                _, flat_grad = f_and_g(jnp.asarray(x, jnp.float32))
+                flat_grad = np.asarray(flat_grad, np.float64)
+            else:
+                x = x + t * d
+                lv, gv = f_and_g(jnp.asarray(x, jnp.float32))
+                loss, flat_grad = float(lv), np.asarray(gv, np.float64)
+                current_evals += 1
+
+            if current_evals >= self.max_eval:
+                break
+            if np.abs(flat_grad).max() <= self.tolerance_grad:
+                break
+            if np.abs(t * d).max() <= self.tolerance_change and \
+                    abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        self._d, self._t = d, t
+        return jnp.asarray(orig_loss), unflatten(jnp.asarray(x, jnp.float32))

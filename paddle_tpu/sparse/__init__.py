@@ -33,7 +33,13 @@ class SparseCooTensor:
         return self.values.shape[0]
 
     def to_dense(self):
-        dense = jnp.zeros(self.shape + self.values.shape[1:], self.values.dtype)
+        # `shape` may be the sparse dims only, or (paddle-style) already
+        # include the values' trailing dense dims — detect which
+        if len(self.shape) == self.indices.shape[0] + self.values.ndim - 1:
+            dense = jnp.zeros(self.shape, self.values.dtype)
+        else:
+            dense = jnp.zeros(self.shape + self.values.shape[1:],
+                              self.values.dtype)
         return dense.at[tuple(self.indices)].add(self.values)
 
     def coalesce(self):
@@ -113,3 +119,269 @@ def transpose(x, perm=(1, 0)):
 
 
 SparseCooTensor.transpose = lambda self, perm=(1, 0): transpose(self, perm)
+
+
+class SparseCsrTensor:
+    """CSR format (ref: paddle.sparse.sparse_csr_tensor return type):
+    (crows, cols, values, shape). 2-D (or batched 3-D) only, like the
+    reference."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = jnp.asarray(crows)
+        self.cols = jnp.asarray(cols)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def _row_ids(self):
+        # nnz -> owning row, from the compressed row pointer
+        return jnp.searchsorted(self.crows, jnp.arange(self.nnz()),
+                                side='right') - 1
+
+    def to_dense(self):
+        rows = self._row_ids()
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[rows, self.cols].add(self.values)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(
+            jnp.stack([self._row_ids(), self.cols]), self.values, self.shape)
+
+    def __repr__(self):
+        return (f'SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, '
+                f'dtype={self.dtype})')
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: paddle.sparse.sparse_csr_tensor."""
+    return SparseCsrTensor(crows, cols, jnp.asarray(values, dtype), shape)
+
+
+def _is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _map_values(fn, x):
+    """Apply an elementwise op to the nonzero values, keeping sparsity.
+    (Only zero-preserving ops are exposed this way, matching the
+    reference's sparse unary API.)"""
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, fn(x.values), x.shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, fn(x.values), x.shape)
+    return fn(jnp.asarray(x))
+
+
+def sin(x): return _map_values(jnp.sin, x)
+def tan(x): return _map_values(jnp.tan, x)
+def asin(x): return _map_values(jnp.arcsin, x)
+def atan(x): return _map_values(jnp.arctan, x)
+def sinh(x): return _map_values(jnp.sinh, x)
+def tanh(x): return _map_values(jnp.tanh, x)
+def asinh(x): return _map_values(jnp.arcsinh, x)
+def atanh(x): return _map_values(jnp.arctanh, x)
+def sqrt(x): return _map_values(jnp.sqrt, x)
+def square(x): return _map_values(jnp.square, x)
+def log1p(x): return _map_values(jnp.log1p, x)
+def abs(x): return _map_values(jnp.abs, x)
+def neg(x): return _map_values(jnp.negative, x)
+def expm1(x): return _map_values(jnp.expm1, x)
+def deg2rad(x): return _map_values(jnp.deg2rad, x)
+def rad2deg(x): return _map_values(jnp.rad2deg, x)
+def isnan(x): return _map_values(jnp.isnan, x)
+
+
+def pow(x, factor):
+    return _map_values(lambda v: jnp.power(v, factor), x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    def conv(v):
+        return v.astype(value_dtype) if value_dtype else v
+    out = _map_values(conv, x)
+    if index_dtype and isinstance(out, SparseCooTensor):
+        out = SparseCooTensor(out.indices.astype(index_dtype), out.values,
+                              out.shape)
+    if index_dtype and isinstance(out, SparseCsrTensor):
+        out = SparseCsrTensor(out.crows.astype(index_dtype),
+                              out.cols.astype(index_dtype), out.values,
+                              out.shape)
+    return out
+
+
+def _binary(fn, a, b):
+    """Elementwise binary on matching-sparsity operands; general case
+    lowers to dense (documented TPU trade: see module docstring)."""
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        ac, bc = a.coalesce(), b.coalesce()
+        if (ac.indices.shape == bc.indices.shape
+                and bool(jnp.all(ac.indices == bc.indices))):
+            return SparseCooTensor(ac.indices, fn(ac.values, bc.values),
+                                   ac.shape)
+    if isinstance(a, SparseCsrTensor) and isinstance(b, SparseCsrTensor):
+        if (a.cols.shape == b.cols.shape
+                and bool(jnp.all(a.cols == b.cols))
+                and bool(jnp.all(a.crows == b.crows))):
+            return SparseCsrTensor(a.crows, a.cols, fn(a.values, b.values),
+                                   a.shape)
+    return fn(to_dense(a), to_dense(b))
+
+
+def subtract(a, b):
+    return _binary(jnp.subtract, a, b)
+
+
+def multiply(a, b):
+    return _binary(jnp.multiply, a, b)
+
+
+def divide(a, b):
+    return _binary(jnp.divide, a, b)
+
+
+def coalesce(x):
+    return x.coalesce() if isinstance(x, SparseCooTensor) else x
+
+
+def is_same_shape(x, y):
+    xs = x.shape if hasattr(x, 'shape') else ()
+    ys = y.shape if hasattr(y, 'shape') else ()
+    return tuple(xs) == tuple(ys)
+
+
+def reshape(x, shape):
+    """ref: paddle.sparse.reshape — recompute COO indices for the new
+    shape (same linearization)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        return jnp.reshape(jnp.asarray(x), shape)
+    shape = list(shape)
+    n_elem = 1
+    for s in x.shape:
+        n_elem *= s
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[neg[0]] = n_elem // known
+    flat = jnp.ravel_multi_index(tuple(x.indices), x.shape, mode='clip')
+    new_idx = jnp.stack(jnp.unravel_index(flat, tuple(shape)))
+    return SparseCooTensor(new_idx, x.values, tuple(shape))
+
+
+def slice(x, axes, starts, ends):
+    """ref: paddle.sparse.slice — dense-lowered gather then re-sparsify."""
+    import builtins
+
+    dense = to_dense(x)
+    sl = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(st, en)
+    out = dense[tuple(sl)]
+    if isinstance(x, SparseCooTensor):
+        return dense_to_coo(out)
+    if isinstance(x, SparseCsrTensor):
+        return dense_to_csr(out)
+    return out
+
+
+def dense_to_coo(x, sparse_dim=None):
+    """Eager densifier inverse (host-side nnz discovery — eager only,
+    like the reference's Tensor.to_sparse_coo)."""
+    x = jnp.asarray(x)
+    nz = np.nonzero(np.asarray(x))
+    idx = jnp.asarray(np.stack(nz))
+    vals = x[tuple(idx)]
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def dense_to_csr(x):
+    x = jnp.asarray(x)
+    assert x.ndim == 2, 'CSR is 2-D'
+    xn = np.asarray(x)
+    rows, cols = np.nonzero(xn)
+    crows = np.zeros(x.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(jnp.asarray(crows), jnp.asarray(cols),
+                           x[rows, cols], x.shape)
+
+
+def mv(a, vec):
+    """Sparse matrix @ dense vector (ref: paddle.sparse.mv)."""
+    vec = jnp.asarray(vec)
+    return matmul(a, vec[:, None])[:, 0]
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (x @ y) (ref: paddle.sparse.addmm)."""
+    return beta * to_dense(input) + alpha * matmul(x, to_dense(y))
+
+
+def masked_matmul(x, y, mask):
+    """Dense @ dense, evaluated only at `mask`'s sparsity pattern
+    (ref: paddle.sparse.masked_matmul — SDDMM). The gather-dot form
+    computes just the nnz dot products, not the full product."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if isinstance(mask, SparseCsrTensor):
+        rows, cols = mask._row_ids(), mask.cols
+        vals = jnp.einsum('nk,nk->n', x[rows], y[:, cols].T)
+        return SparseCsrTensor(mask.crows, mask.cols, vals, mask.shape)
+    rows, cols = mask.indices
+    vals = jnp.einsum('nk,nk->n', x[rows], y[:, cols].T)
+    return SparseCooTensor(mask.indices, vals, mask.shape)
+
+
+def mask_as(x, mask):
+    """Keep x's entries at mask's sparsity pattern
+    (ref: paddle.sparse.mask_as)."""
+    dense = jnp.asarray(to_dense(x))
+    if isinstance(mask, SparseCooTensor):
+        vals = dense[tuple(mask.indices)]
+        return SparseCooTensor(mask.indices, vals, mask.shape)
+    rows, cols = mask._row_ids(), mask.cols
+    return SparseCsrTensor(mask.crows, mask.cols, dense[rows, cols],
+                           mask.shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """ref: paddle.sparse.sum — over values (axis=None) or dense-lowered."""
+    if axis is None:
+        out = jnp.sum(x.values if _is_sparse(x) else jnp.asarray(x))
+        return out.astype(dtype) if dtype else out
+    dense = to_dense(x)
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype:
+        out = out.astype(dtype)
+    if isinstance(x, SparseCooTensor) and not keepdim:
+        return dense_to_coo(out) if out.ndim else out
+    return out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """ref: paddle.sparse.pca_lowrank — dense lowering into linalg."""
+    from ..tensor.linalg import pca_lowrank as dense_pca
+
+    return dense_pca(to_dense(x), q=q, center=center, niter=niter)
+
+
+from . import nn  # noqa: E402,F401

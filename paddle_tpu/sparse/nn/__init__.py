@@ -1,0 +1,152 @@
+"""paddle_tpu.sparse.nn (ref: python/paddle/sparse/nn).
+
+Activations act on the nonzero values in place (sparsity preserved).
+The 3-D convolutions lower to dense XLA convs and re-sparsify:
+`SubmConv3D` keeps the input's sparsity pattern (submanifold semantics —
+exactly what the reference kernel guarantees), `Conv3D` re-derives the
+output pattern from the dense result. On TPU the dense conv IS the fast
+path (MXU wants dense tiles); the sparse formats save HBM at the
+boundaries, which is where the reference's win on point clouds lives
+too.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer.base import Layer
+from .. import (SparseCooTensor, SparseCsrTensor, _map_values, dense_to_coo,
+                to_dense)
+from . import functional  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    """Per-row softmax over the stored nonzeros (ref:
+    sparse/nn/layer/activation.py::Softmax, axis=-1 only)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise ValueError('sparse Softmax supports axis=-1 only '
+                             '(like the reference)')
+
+    def forward(self, x):
+        return functional.softmax(x)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of COO values
+    (ref: sparse/nn/layer/norm.py::BatchNorm)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format='NDHWC',
+                 name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum, self.epsilon = momentum, epsilon
+        from ...nn import initializer as I
+
+        self.weight = self.create_parameter(
+            (num_features,), initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), is_bias=True)
+        self.register_buffer('_mean', jnp.zeros((num_features,)))
+        self.register_buffer('_variance', jnp.ones((num_features,)))
+
+    def forward(self, x):
+        vals = x.values if isinstance(x, SparseCooTensor) else jnp.asarray(x)
+        if self.training:
+            mean = jnp.mean(vals, axis=0)
+            var = jnp.var(vals, axis=0)
+        else:
+            mean, var = self._mean, self._variance
+        out = ((vals - mean) / jnp.sqrt(var + self.epsilon)
+               * self.weight + self.bias)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, out, x.shape)
+        return out
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv via dense lowering (ref: sparse/nn/layer/conv.py::
+    Conv3D; NDHWC, channels last)."""
+
+    SUBM = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NDHWC'):
+        super().__init__()
+        if data_format != 'NDHWC':
+            raise ValueError('sparse conv is NDHWC (like the reference)')
+        from ...nn.layer.conv import Conv3D as DenseConv3D
+
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 data_format='NDHWC')
+
+    def forward(self, x):
+        dense = to_dense(x) if isinstance(x, SparseCooTensor) else x
+        out = self._conv(dense)
+        if not isinstance(x, SparseCooTensor):
+            return out
+        if self.SUBM:
+            # submanifold: output pattern == input pattern — gather the
+            # dense result at the input's active sites
+            vals = out[tuple(x.indices)]        # (nnz, C_out)
+            return SparseCooTensor(x.indices, vals, out.shape)
+        return _site_coo(out)
+
+
+class SubmConv3D(Conv3D):
+    SUBM = True
+
+
+class MaxPool3D(Layer):
+    """ref: sparse/nn/layer/pooling.py::MaxPool3D (NDHWC, dense-lowered)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NDHWC', name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        dense = to_dense(x) if isinstance(x, SparseCooTensor) else x
+        out = F.max_pool3d(dense, self.kernel_size, self.stride,
+                           self.padding, data_format='NDHWC')
+        return dense_to_coo(out) if isinstance(x, SparseCooTensor) else out
+
+
+def _site_coo(dense):
+    """Channels-last dense -> site-based COO: indices over the spatial
+    dims, values carry the channel vector (eager host nnz discovery)."""
+    import numpy as np
+
+    arr = np.asarray(dense)
+    sites = np.nonzero(np.any(arr != 0, axis=-1))
+    idx = jnp.asarray(np.stack(sites))
+    vals = dense[tuple(idx)]
+    return SparseCooTensor(idx, vals, dense.shape)

@@ -1,0 +1,64 @@
+"""sparse.nn.functional (ref: python/paddle/sparse/nn/functional)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import SparseCooTensor, SparseCsrTensor, _map_values
+
+
+def relu(x):
+    return _map_values(lambda v: jnp.maximum(v, 0), x)
+
+
+def relu6(x):
+    return _map_values(lambda v: jnp.clip(v, 0, 6), x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _map_values(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v), x)
+
+
+def softmax(x, axis=-1):
+    """Per-row softmax over stored nonzeros (ref: sparse/nn/functional/
+    activation.py::softmax; CSR rows, or COO last sparse dim)."""
+    if axis != -1:
+        raise ValueError('sparse softmax supports axis=-1 only')
+    if isinstance(x, SparseCsrTensor):
+        rows = x._row_ids()
+        vmax = jnp.full((x.shape[0],), -jnp.inf, jnp.float32).at[rows].max(
+            x.values.astype(jnp.float32))
+        e = jnp.exp(x.values.astype(jnp.float32) - vmax[rows])
+        denom = jnp.zeros((x.shape[0],), jnp.float32).at[rows].add(e)
+        return SparseCsrTensor(x.crows, x.cols, (e / denom[rows]).astype(
+            x.values.dtype), x.shape)
+    if isinstance(x, SparseCooTensor):
+        # group by all-but-last sparse index
+        lead = x.indices[:-1]
+        flat = jnp.ravel_multi_index(
+            tuple(lead), x.shape[:lead.shape[0]], mode='clip') \
+            if lead.shape[0] else jnp.zeros(x.nnz(), jnp.int32)
+        n_rows = 1
+        for s in x.shape[:lead.shape[0]]:
+            n_rows *= s
+        v = x.values.astype(jnp.float32)
+        vmax = jnp.full((n_rows,), -jnp.inf, jnp.float32).at[flat].max(v)
+        e = jnp.exp(v - vmax[flat])
+        denom = jnp.zeros((n_rows,), jnp.float32).at[flat].add(e)
+        return SparseCooTensor(x.indices, (e / denom[flat]).astype(
+            x.values.dtype), x.shape)
+    return jax.nn.softmax(jnp.asarray(x), axis=axis)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """CSR-masked attention (ref: sparse/nn/functional/transformer.py::
+    attention) — delegates to the dense-fused sparse_attention path."""
+    from ...nn.functional.attention import sparse_attention as _sa
+
+    b, h, s, _ = query.shape
+    crows = jnp.broadcast_to(sparse_mask.crows, (b, h, s + 1))
+    cols = jnp.broadcast_to(sparse_mask.cols, (b, h, sparse_mask.nnz()))
+    return _sa(query, key, value, crows, cols,
+               key_padding_mask=key_padding_mask, attn_mask=attn_mask)

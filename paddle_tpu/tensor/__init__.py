@@ -6,7 +6,28 @@ from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from . import random  # noqa: F401
+from .random import (  # noqa: F401
+    bernoulli,
+    exponential_,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_gamma,
+    standard_normal,
+    uniform,
+)
 
 import jax.numpy as _jnp
 
 einsum = _jnp.einsum
+
+# the op modules import jax/jnp/np at module scope; without __all__ the
+# star imports above would re-export them as public tensor API — drop them
+for _leak in ('jax', 'jnp', 'np', 'lax'):
+    globals().pop(_leak, None)
+del _leak

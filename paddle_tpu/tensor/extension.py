@@ -1,0 +1,467 @@
+"""Long-tail tensor ops (ref: python/paddle/tensor/{math,manipulation,
+creation}.py — the remainder of paddle's top-level __all__).
+
+Thin, composable jnp/lax wrappers: on TPU each of these is one or two
+XLA HLOs; there is nothing kernel-shaped to hand-write. Semantics follow
+the reference docstrings (paddle largely mirrors the numpy/torch
+namesakes, which keeps the goldens honest).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [  # keeps `import *` from leaking jax/jnp/lax as paddle_tpu API
+    'block_diag', 'hstack', 'vstack', 'dstack', 'column_stack', 'row_stack',
+    'tensor_split', 'hsplit', 'vsplit', 'dsplit', 'unstack', 'atleast_1d',
+    'atleast_2d', 'atleast_3d', 'diag_embed', 'diagonal', 'diagonal_scatter',
+    'select_scatter', 'slice_scatter', 'index_fill', 'take', 'unflatten',
+    'view_as', 'unfold', 'reverse', 'as_complex', 'as_real',
+    'cartesian_prod', 'combinations', 'logaddexp', 'floor_mod', 'isneginf',
+    'isposinf', 'isreal', 'isin', 'signbit', 'sgn', 'sinc', 'add_n',
+    'nanmedian', 'nanquantile', 'histogram_bin_edges', 'histogramdd',
+    'renorm', 'reduce_as', 'pdist', 'frexp', 'ldexp', 'trapezoid',
+    'cumulative_trapezoid', 'vander', 'bitwise_left_shift',
+    'bitwise_right_shift', 'gammaln', 'gammainc', 'gammaincc',
+    'multigammaln', 'polygamma', 'i0e', 'i1', 'i1e', 'rank', 'shape',
+    'tolist',
+]
+
+# ---- stacking / splitting ---------------------------------------------------
+
+
+def block_diag(inputs):
+    """ref: tensor/manipulation.py::block_diag."""
+    mats = [jnp.atleast_2d(jnp.asarray(m)) for m in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), jnp.result_type(*mats))
+    r = c = 0
+    for m in mats:
+        out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+def hstack(x):
+    return jnp.hstack([jnp.asarray(v) for v in x])
+
+
+def vstack(x):
+    return jnp.vstack([jnp.asarray(v) for v in x])
+
+
+def dstack(x):
+    return jnp.dstack([jnp.asarray(v) for v in x])
+
+
+def column_stack(x):
+    return jnp.column_stack([jnp.asarray(v) for v in x])
+
+
+def row_stack(x):
+    return jnp.vstack([jnp.asarray(v) for v in x])
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    """ref: manipulation.py::tensor_split (uneven split allowed)."""
+    x = jnp.asarray(x)
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        size = x.shape[axis]
+        base, extra = divmod(size, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        idx = jnp.cumsum(jnp.asarray(sizes))[:-1]
+        return jnp.split(x, [int(i) for i in idx], axis=axis)
+    return jnp.split(x, list(num_or_indices), axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    if jnp.asarray(x).ndim < 1:
+        raise ValueError('hsplit expects at least 1-D input')
+    axis = 0 if jnp.asarray(x).ndim == 1 else 1
+    return tensor_split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices):
+    if jnp.asarray(x).ndim < 2:
+        raise ValueError('vsplit expects at least 2-D input')
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    if jnp.asarray(x).ndim < 3:
+        raise ValueError('dsplit expects at least 3-D input')
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unstack(x, axis=0, num=None):
+    """ref: manipulation.py::unstack — split and squeeze the axis."""
+    x = jnp.asarray(x)
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(p, axis=axis) for p in jnp.split(x, n, axis=axis)]
+
+
+def atleast_1d(*inputs):
+    out = [jnp.atleast_1d(jnp.asarray(v)) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs):
+    out = [jnp.atleast_2d(jnp.asarray(v)) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs):
+    out = [jnp.atleast_3d(jnp.asarray(v)) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+# ---- rearrangement / scatter views ------------------------------------------
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal construction (ref: manipulation.py::diag_embed)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + abs(offset)
+    out_ndim = x.ndim + 1
+    d1, d2 = dim1 % out_ndim, dim2 % out_ndim
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    return jnp.moveaxis(out, (-2, -1), (d1, d2))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(jnp.asarray(x), offset=offset, axis1=axis1,
+                        axis2=axis2)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    """Write `y` onto the (offset, axis1, axis2) diagonal of `x`
+    (ref: manipulation.py::diagonal_scatter)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    a1, a2 = axis1 % x.ndim, axis2 % x.ndim
+    moved = jnp.moveaxis(x, (a1, a2), (-2, -1))
+    k = y.shape[-1]
+    idx = jnp.arange(k)
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    moved = moved.at[..., r, c].set(y)
+    return jnp.moveaxis(moved, (-2, -1), (a1, a2))
+
+
+def select_scatter(x, values, axis, index):
+    """ref: manipulation.py::select_scatter."""
+    x = jnp.asarray(x)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].set(values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    """ref: manipulation.py::slice_scatter."""
+    x = jnp.asarray(x)
+    sl = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = slice(st, en, sr)
+    return x.at[tuple(sl)].set(value)
+
+
+def index_fill(x, index, axis, value):
+    """ref: manipulation.py::index_fill."""
+    x = jnp.asarray(x)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = jnp.asarray(index)
+    return x.at[tuple(sl)].set(value)
+
+
+def take(x, index, mode='raise'):
+    """Flattened gather (ref: manipulation.py::take). mode: 'raise'
+    (clip — no host roundtrip under jit), 'wrap', 'clip'."""
+    x = jnp.asarray(x).reshape(-1)
+    idx = jnp.asarray(index)
+    n = x.shape[0]
+    if mode == 'wrap':
+        idx = ((idx % n) + n) % n
+    else:
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(x, idx)
+
+
+def unflatten(x, axis, shape):
+    x = jnp.asarray(x)
+    ax = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    return x.reshape(x.shape[:ax] + shape + x.shape[ax + 1:])
+
+
+def view_as(x, other):
+    return jnp.asarray(x).reshape(jnp.asarray(other).shape)
+
+
+def unfold(x, axis, size, step):
+    """Sliding windows over one axis (ref: manipulation.py::unfold;
+    torch.Tensor.unfold semantics — window dim appended last)."""
+    x = jnp.asarray(x)
+    ax = axis % x.ndim
+    n = (x.shape[ax] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None]     # (n, size)
+    out = jnp.take(x, idx.reshape(-1), axis=ax)
+    out = out.reshape(x.shape[:ax] + (n, size) + x.shape[ax + 1:])
+    return jnp.moveaxis(out, ax + 1, -1)
+
+
+def reverse(x, axis):
+    return jnp.flip(jnp.asarray(x), axis=axis)
+
+
+def as_complex(x):
+    x = jnp.asarray(x)
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def cartesian_prod(x):
+    """ref: manipulation.py::cartesian_prod."""
+    arrs = [jnp.asarray(v).reshape(-1) for v in x]
+    grids = jnp.meshgrid(*arrs, indexing='ij')
+    out = jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return out[:, 0] if len(arrs) == 1 else out
+
+
+def combinations(x, r=2, with_replacement=False):
+    """ref: manipulation.py::combinations — index pattern is static."""
+    import itertools
+
+    x = jnp.asarray(x).reshape(-1)
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = list(gen(range(n), r))
+    if not idx:
+        return jnp.zeros((0, r), x.dtype)
+    return x[jnp.asarray(idx)]
+
+
+# ---- math long tail ---------------------------------------------------------
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def floor_mod(x, y):
+    return jnp.mod(x, y)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def sgn(x):
+    """Sign for real, unit phase for complex (ref: math.py::sgn)."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def add_n(inputs):
+    out = jnp.asarray(inputs[0])
+    for v in inputs[1:]:
+        out = out + jnp.asarray(v)
+    return out
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(jnp.asarray(x), q, axis=axis, keepdims=keepdim)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    x = jnp.asarray(x).reshape(-1).astype(jnp.float32)
+    lo, hi = (jnp.min(x), jnp.max(x)) if min == 0 and max == 0 else (min, max)
+    lo, hi = jnp.where(lo == hi, lo - 0.5, lo), jnp.where(lo == hi, hi + 0.5, hi)
+    return jnp.linspace(lo, hi, bins + 1)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    x = jnp.asarray(x)
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+def renorm(x, p, axis, max_norm):
+    """Clip per-slice p-norms to max_norm (ref: math.py::renorm)."""
+    x = jnp.asarray(x)
+    ax = axis % x.ndim
+    other = tuple(i for i in range(x.ndim) if i != ax)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=other, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+def reduce_as(x, target):
+    """Sum-reduce x to target's (broadcastable) shape
+    (ref: math.py::reduce_as)."""
+    x = jnp.asarray(x)
+    tshape = jnp.asarray(target).shape
+    lead = x.ndim - len(tshape)
+    axes = tuple(range(lead)) + tuple(
+        lead + i for i, s in enumerate(tshape) if s == 1 and x.shape[lead + i] != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distances (ref: math.py::pdist)."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    diff = x[:, None] - x[None]
+    if p == 2.0:
+        d = jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+    elif p == 0:
+        d = jnp.sum(diff != 0, axis=-1).astype(x.dtype)
+    elif p == float('inf'):
+        d = jnp.max(jnp.abs(diff), axis=-1)
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu = jnp.triu_indices(n, k=1)
+    return d[iu]
+
+
+def frexp(x):
+    return jnp.frexp(x)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(jnp.asarray(y), x=x, dx=1.0 if dx is None else dx,
+                         axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """ref: math.py::cumulative_trapezoid."""
+    y = jnp.asarray(y)
+    d = (jnp.diff(jnp.asarray(x), axis=axis) if x is not None
+         else (1.0 if dx is None else dx))
+    ax = axis % y.ndim
+    sl1 = [slice(None)] * y.ndim
+    sl2 = [slice(None)] * y.ndim
+    sl1[ax] = slice(1, None)
+    sl2[ax] = slice(None, -1)
+    avg = (y[tuple(sl1)] + y[tuple(sl2)]) / 2.0
+    return jnp.cumsum(avg * d, axis=ax)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    x = jnp.asarray(x)
+    if is_arithmetic:
+        return jnp.right_shift(x, y)
+    # logical shift: operate on the unsigned view
+    info = jnp.iinfo(x.dtype)
+    ux = x.astype(jnp.dtype(f'uint{info.bits}'))
+    return jnp.right_shift(ux, jnp.asarray(y).astype(ux.dtype)).astype(x.dtype)
+
+
+# ---- special functions ------------------------------------------------------
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (ref: math.py::gammainc)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def multigammaln(x, p):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def polygamma(x, n):
+    if n == 0:
+        return jax.scipy.special.digamma(x)
+    return jax.scipy.special.polygamma(n, x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+# ---- attribute-style helpers (ref: tensor/attribute.py) ---------------------
+
+
+def rank(x):
+    return jnp.asarray(jnp.asarray(x).ndim)
+
+
+def shape(x):
+    """Shape as a tensor (ref: paddle.shape)."""
+    return jnp.asarray(jnp.asarray(x).shape, jnp.int32)
+
+
+def tolist(x):
+    import numpy as _np
+
+    return _np.asarray(x).tolist()

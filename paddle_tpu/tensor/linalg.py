@@ -66,8 +66,13 @@ def cholesky_solve(x, y, upper=False):
     return jax.scipy.linalg.cho_solve((y, not upper), x)
 
 
-def lu(x, pivot=True):
+def lu(x, pivot=True, get_infos=False):
+    """ref: paddle.linalg.lu — pivots are 1-based sequential row swaps
+    (LAPACK ipiv), not 0-based like jax's lu_factor."""
     lu_, piv = jax.scipy.linalg.lu_factor(x)
+    piv = (piv + 1).astype(jnp.int32)
+    if get_infos:
+        return lu_, piv, jnp.zeros((), jnp.int32)
     return lu_, piv
 
 
@@ -142,3 +147,125 @@ def pca_lowrank(x, q=None, center=True, niter=2):
     u, s, vt = jnp.linalg.svd(x, full_matrices=False)
     q = q or min(6, *x.shape[-2:])
     return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+
+def cholesky_inverse(x, upper=False):
+    """ref: paddle.linalg.cholesky_inverse — inverse of A from its
+    Cholesky factor via two triangular solves (no explicit inverse)."""
+    x = jnp.asarray(x)
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    l = x.T if upper else x
+    y = jax.scipy.linalg.solve_triangular(l, eye, lower=True)
+    return y.T @ y
+
+
+def matrix_exp(x):
+    """ref: paddle.linalg.matrix_exp."""
+    return jax.scipy.linalg.expm(jnp.asarray(x))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """ref: paddle.linalg.lu_unpack — split packed LU into (P, L, U)."""
+    lu_data = jnp.asarray(lu_data)
+    m, n = lu_data.shape[-2:]
+    k = min(m, n)
+    l = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    u = jnp.triu(lu_data[..., :k, :])
+    if not unpack_pivots:
+        return None, l, u
+    # pivots (1-based sequential row swaps) -> permutation matrix,
+    # vmapped over any leading batch dims
+    piv = jnp.asarray(lu_pivots).astype(jnp.int32) - 1
+    npiv = piv.shape[-1]
+
+    def one_perm(p1):
+        perm = jnp.arange(m)
+        for i in range(npiv):
+            j = p1[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        return jnp.eye(m, dtype=lu_data.dtype)[perm].T
+
+    if piv.ndim == 1:
+        p = one_perm(piv)
+    else:
+        batch = piv.shape[:-1]
+        p = jax.vmap(one_perm)(piv.reshape(-1, npiv))
+        p = p.reshape(batch + (m, m))
+    out = (p, l, u) if unpack_ludata else (p, None, None)
+    return out
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """Randomized low-rank SVD (ref: paddle.linalg.svd_lowrank; Halko
+    et al. randomized range finder + small exact SVD)."""
+    from ..framework import random as random_mod
+
+    x = jnp.asarray(x).astype(jnp.float32)
+    if M is not None:
+        x = x - jnp.asarray(M)
+    m, n = x.shape[-2:]
+    q = min(q, m, n)
+    key = random_mod.split_key()
+    omega = jax.random.normal(key, (n, q), x.dtype)
+    xt = jnp.swapaxes(x, -1, -2)          # batch-safe transpose
+    # randomized range finder with per-step QR re-orthonormalization —
+    # bare power iteration in fp32 collapses the small singular directions
+    qmat, _ = jnp.linalg.qr(x @ omega)
+    for _ in range(niter):
+        z, _ = jnp.linalg.qr(xt @ qmat)
+        qmat, _ = jnp.linalg.qr(x @ z)
+    b = jnp.swapaxes(qmat, -1, -2) @ x
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, jnp.swapaxes(vt, -1, -2)
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply y by Q = H_0 H_1 ... H_{k-1} from LAPACK-layout
+    Householder data (ref: paddle.linalg.ormqr). x: (m, k) reflectors
+    below the diagonal, tau: (k,).
+
+    Reflectors are applied to y directly — O(m n k), no m*m Q is ever
+    materialized (the tall-skinny case LAPACK's ormqr exists for)."""
+    x = jnp.asarray(x)
+    tau = jnp.asarray(tau)
+    m, k = x.shape[-2], tau.shape[-1]
+
+    def apply_q(z, reverse):
+        # z: (m, n). Q @ z applies H_i for i = k-1..0; Q^T @ z ascending.
+        order = range(k - 1, -1, -1) if reverse else range(k)
+        for i in order:
+            v = jnp.zeros((m,), x.dtype).at[i].set(1.0)
+            v = v.at[i + 1:].set(x[i + 1:, i])
+            z = z - tau[i] * jnp.outer(v, v @ z)
+        return z
+
+    y = jnp.asarray(y)
+    if left:
+        # Q @ y (reverse order) or Q^T @ y (ascending)
+        return apply_q(y, reverse=not transpose)
+    # y @ Q = (Q^T y^T)^T;  y @ Q^T = (Q y^T)^T
+    zt = apply_q(jnp.swapaxes(y, -1, -2), reverse=transpose)
+    return jnp.swapaxes(zt, -1, -2)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype='bfloat16', activation=None):
+    """ref: paddle.linalg.fp8_fp8_half_gemm_fused (cuBLASLt fp8 GEMM).
+    TPU path: the pallas fp8 weight-only kernel when y is pre-quantized
+    fp8, else an XLA dot with fp8 inputs upcast in the MXU."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x.astype(jnp.float32), y.astype(jnp.float32)) * scale
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)
+    if activation in ('gelu',):
+        out = jax.nn.gelu(out)
+    elif activation in ('relu',):
+        out = jnp.maximum(out, 0)
+    return out.astype(output_dtype)

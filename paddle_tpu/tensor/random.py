@@ -97,3 +97,42 @@ def standard_normal(shape, dtype=None):
 
 def standard_gamma(alpha, shape=None):
     return jax.random.gamma(random_mod.split_key(), alpha, shape=shape)
+
+
+def binomial(count, prob):
+    """ref: tensor/random.py::binomial — sample Binomial(count, prob)
+    elementwise."""
+    count = jnp.asarray(count)
+    prob = jnp.asarray(prob, jnp.float32)
+    key = random_mod.split_key()
+    # int64 in the reference; int32 here (x64 is off by default in jax)
+    return jax.random.binomial(key, count.astype(jnp.float32),
+                               prob).astype(jnp.int32)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None):
+    """ref: tensor/random.py::log_normal (module form)."""
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
+    key = random_mod.split_key()
+    return jnp.exp(jax.random.normal(key, tuple(shape)) * std + mean)
+
+
+def log_normal_(x, mean=1.0, std=2.0):
+    """In-place-style variant: fresh samples with x's shape/dtype."""
+    return log_normal(mean, std, jnp.asarray(x).shape).astype(x.dtype)
+
+
+def cauchy_(x, loc=0, scale=1):
+    """ref: Tensor.cauchy_ — fill with Cauchy(loc, scale) samples."""
+    x = jnp.asarray(x)
+    key = random_mod.split_key()
+    return (loc + scale * jax.random.cauchy(key, x.shape)).astype(x.dtype)
+
+
+def geometric_(x, probs):
+    """ref: Tensor.geometric_ — fill with Geometric(probs) samples
+    (number of trials to first success, support {1, 2, ...})."""
+    x = jnp.asarray(x)
+    key = random_mod.split_key()
+    return jax.random.geometric(key, probs, x.shape).astype(x.dtype)

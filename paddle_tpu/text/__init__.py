@@ -1,0 +1,7 @@
+"""paddle_tpu.text (ref: python/paddle/text/__init__.py): Viterbi
+decoding + download-free text datasets."""
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ['viterbi_decode', 'ViterbiDecoder', 'UCIHousing', 'Imdb',
+           'Imikolov']

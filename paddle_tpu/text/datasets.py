@@ -1,0 +1,116 @@
+"""Text datasets (ref: python/paddle/text/datasets/*).
+
+Download-free, like `vision.datasets`: each dataset reads the reference's
+standard local archive when a path is supplied, otherwise serves
+deterministic synthetic data with the right shapes/vocab for tests and
+smoke training (no network egress in this environment).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (ref: text/datasets/uci_housing.py).
+    Reads the whitespace `housing.data` file when `data_file` is given."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode='train'):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(506, self.FEATURES)).astype(np.float32)
+            w = rng.normal(size=(self.FEATURES,)).astype(np.float32)
+            y = x @ w + rng.normal(scale=0.1, size=(506,)).astype(np.float32)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        # reference normalizes features to [0, 1] by min/max then splits 80/20
+        feats, target = raw[:, :-1], raw[:, -1:]
+        lo, hi = feats.min(0), feats.max(0)
+        feats = (feats - lo) / np.maximum(hi - lo, 1e-8)
+        split = int(len(feats) * 0.8)
+        if mode == 'train':
+            self.data, self.target = feats[:split], target[:split]
+        else:
+            self.data, self.target = feats[split:], target[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i], self.target[i]
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (ref: text/datasets/imdb.py): word-id sequences +
+    0/1 labels. Reads the aclImdb tar when `data_file` is given."""
+
+    def __init__(self, data_file=None, mode='train', cutoff=150,
+                 vocab_size=2000, size=512, max_len=64):
+        self.word_idx = {f'w{i}': i for i in range(vocab_size)}
+        if data_file and os.path.exists(data_file):
+            self.docs, self.labels = self._load_tar(data_file, mode, cutoff)
+        else:
+            rng = np.random.default_rng(1 if mode == 'train' else 2)
+            lens = rng.integers(8, max_len, size)
+            self.docs = [rng.integers(0, vocab_size, n).astype(np.int64)
+                         for n in lens]
+            self.labels = rng.integers(0, 2, size).astype(np.int64)
+
+    def _load_tar(self, path, mode, cutoff):
+        docs, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                name = member.name
+                if f'/{mode}/' not in name or not name.endswith('.txt'):
+                    continue
+                if '/pos/' in name:
+                    lab = 1
+                elif '/neg/' in name:
+                    lab = 0
+                else:
+                    continue
+                words = tf.extractfile(member).read().decode(
+                    'utf-8', 'ignore').lower().split()
+                ids = [self.word_idx.get(w, len(self.word_idx))
+                       for w in words]
+                docs.append(np.asarray(ids, np.int64))
+                labels.append(lab)
+        return docs, np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM tuples (ref: text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=5,
+                 mode='train', vocab_size=2000, size=2048):
+        if data_type not in ('NGRAM', 'SEQ'):
+            raise ValueError(f'bad data_type: {data_type}')
+        rng = np.random.default_rng(3 if mode == 'train' else 4)
+        if data_type == 'NGRAM':
+            self.data = rng.integers(
+                0, vocab_size, (size, window_size)).astype(np.int64)
+        else:
+            self.data = [
+                (rng.integers(0, vocab_size, 10).astype(np.int64),
+                 rng.integers(0, vocab_size, 10).astype(np.int64))
+                for _ in range(size)]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]

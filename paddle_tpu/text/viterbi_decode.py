@@ -1,0 +1,83 @@
+"""Viterbi decoding (ref: python/paddle/text/viterbi_decode.py).
+
+The reference runs a fused C++ kernel; here the forward max-product pass
+is a `lax.scan` over time (static shapes, batch-parallel on the VPU) and
+the backtrace is a reverse `lax.scan` over the stored argmax history —
+both jit-safe. Variable lengths are handled by masking: once t reaches a
+sequence's length the alpha row freezes and the history records the
+identity permutation, so a uniform backtrace from the last step recovers
+the path ending at each sequence's own final step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """Highest-scoring tag sequence under unary `potentials`
+    [batch, seq, num_tags] and pairwise `transition_params`
+    [num_tags, num_tags]; `lengths` [batch].
+
+    Returns (scores [batch], paths [batch, max(lengths)] int64 — padded
+    with 0 past each sequence's length; under jit the path length is the
+    static seq dim instead, since dynamic output shapes cannot trace).
+
+    With `include_bos_eos_tag`, the last tag index is the implicit start
+    tag and the second-to-last the stop tag, matching the reference.
+    """
+    potentials = jnp.asarray(potentials)
+    trans = jnp.asarray(transition_params)
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    batch, seq, num_tags = potentials.shape
+
+    alpha = potentials[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[num_tags - 1][None]   # start -> first tag
+
+    def step(alpha, inp):
+        emit, t = inp                               # (B, N), scalar t
+        scores = alpha[:, :, None] + trans[None]    # (B, prev, cur)
+        best_prev = jnp.argmax(scores, axis=1)
+        new_alpha = jnp.max(scores, axis=1) + emit
+        valid = (t < lengths)[:, None]
+        hist = jnp.where(valid, best_prev, jnp.arange(num_tags)[None])
+        return jnp.where(valid, new_alpha, alpha), hist
+
+    if seq > 1:
+        alpha, hist = lax.scan(
+            step, alpha,
+            (potentials[:, 1:].transpose(1, 0, 2), jnp.arange(1, seq)))
+    else:
+        hist = jnp.zeros((0, batch, num_tags), jnp.int32)
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, num_tags - 2][None]  # last tag -> stop
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)
+
+    def back(tag, h):
+        return jnp.take_along_axis(h, tag[:, None], axis=1)[:, 0], tag
+
+    first_tag, tags = lax.scan(back, last_tag, hist, reverse=True)
+    paths = jnp.concatenate([first_tag[:, None], tags.transpose(1, 0)],
+                            axis=1).astype(jnp.int64)
+    paths = jnp.where(jnp.arange(seq)[None] < lengths[:, None], paths, 0)
+    if not isinstance(lengths, jax.core.Tracer):
+        paths = paths[:, :int(jnp.max(lengths))]    # eager: match reference
+    return scores, paths
+
+
+class ViterbiDecoder:
+    """Callable wrapper holding `transition_params`
+    (ref: python/paddle/text/viterbi_decode.py::ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -7,3 +7,32 @@ from .ops import (DeformConv2D, PSRoIPool, RoIAlign, RoIPool,  # noqa: F401
                   box_coder, deform_conv2d, matrix_nms, nms, nms_mask,
                   prior_box, psroi_pool, roi_align, roi_pool, yolo_box,
                   yolo_loss)
+
+
+_image_backend = ['pil']
+
+
+def set_image_backend(backend):
+    """ref: paddle.vision.set_image_backend ('pil' or 'cv2')."""
+    if backend not in ('pil', 'cv2'):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend}")
+    _image_backend[0] = backend
+
+
+def get_image_backend():
+    return _image_backend[0]
+
+
+def image_load(path, backend=None):
+    """ref: paddle.vision.image_load — PIL image (or HWC ndarray for
+    cv2 backend; cv2 is not shipped, numpy stands in)."""
+    backend = backend or _image_backend[0]
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == 'cv2':
+        import numpy as np
+
+        # cv2.imread always yields 3-channel BGR, even for gray files
+        return np.asarray(img.convert('RGB'))[:, :, ::-1]
+    return img

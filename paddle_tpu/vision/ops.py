@@ -796,3 +796,136 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
                              self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None):
+    """Assign RoIs to FPN levels by scale: level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)) clipped to [min, max]
+    (ref: vision/ops.py::distribute_fpn_proposals).
+
+    Host-side grouping (eager): per-level RoI counts are data-dependent,
+    which no static-shape program can express — the reference kernel is
+    likewise a host-sequenced scatter. Returns
+    (multi_rois, restore_ind, rois_num_per_level).
+    """
+    import numpy as np
+
+    rois = np.asarray(fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    level = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+
+    multi_rois, per_level_idx = [], []
+    for lv in range(min_level, max_level + 1):
+        idx = np.nonzero(level == lv)[0]
+        per_level_idx.append(idx)
+        multi_rois.append(jnp.asarray(rois[idx]))
+    order = np.concatenate(per_level_idx) if per_level_idx else np.zeros(0)
+    restore = np.empty_like(order)
+    restore[order.astype(np.int64)] = np.arange(len(order))
+    rois_num_per_level = None
+    if rois_num is not None:
+        rois_num_per_level = [jnp.asarray(np.asarray([len(i)]))
+                              for i in per_level_idx]
+    return multi_rois, jnp.asarray(restore.astype(np.int32)), rois_num_per_level
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False):
+    """RPN proposal generation (ref: vision/ops.py::generate_proposals):
+    decode anchor deltas, clip to the image, drop tiny boxes, NMS.
+
+    Shapes: scores (N, A, H, W), bbox_deltas (N, 4*A, H, W),
+    anchors (H, W, A, 4), variances like anchors. Eager host-side
+    pipeline (proposal counts are data-dependent), one image at a time,
+    matching the reference kernel's per-image loop.
+    """
+    import numpy as np
+
+    scores = np.asarray(scores, np.float32)
+    deltas = np.asarray(bbox_deltas, np.float32)
+    img_size = np.asarray(img_size, np.float32)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 4)
+    var = np.asarray(variances, np.float32).reshape(-1, 4)
+    n, a, hgt, wid = scores.shape
+    offset = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_scores, rois_num = [], [], []
+    for i in range(n):
+        sc = scores[i].transpose(1, 2, 0).reshape(-1)          # (H*W*A,)
+        dl = deltas[i].reshape(a, 4, hgt, wid).transpose(2, 3, 0, 1)
+        dl = dl.reshape(-1, 4)
+        keep_n = min(pre_nms_top_n, len(sc))
+        top = np.argsort(-sc)[:keep_n]
+        sc, dl_t, anc_t, var_t = sc[top], dl[top], anc[top], var[top]
+        # decode center-size deltas
+        aw = anc_t[:, 2] - anc_t[:, 0] + offset
+        ah = anc_t[:, 3] - anc_t[:, 1] + offset
+        ax = anc_t[:, 0] + aw * 0.5
+        ay = anc_t[:, 1] + ah * 0.5
+        cx = var_t[:, 0] * dl_t[:, 0] * aw + ax
+        cy = var_t[:, 1] * dl_t[:, 1] * ah + ay
+        bw = np.exp(np.minimum(var_t[:, 2] * dl_t[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(var_t[:, 3] * dl_t[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - offset, cy + bh / 2 - offset], axis=1)
+        # clip to image
+        ih, iw = img_size[i, 0], img_size[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - offset)
+        # remove small
+        bw2 = boxes[:, 2] - boxes[:, 0] + offset
+        bh2 = boxes[:, 3] - boxes[:, 1] + offset
+        keep = np.nonzero((bw2 >= min_size) & (bh2 >= min_size))[0]
+        boxes, sc = boxes[keep], sc[keep]
+        # nms (reuse the static-shape masked kernel)
+        if len(boxes):
+            kept = np.asarray(nms(jnp.asarray(boxes), nms_thresh,
+                                  scores=jnp.asarray(sc),
+                                  top_k=post_nms_top_n))
+            boxes, sc = boxes[kept], sc[kept]
+        all_rois.append(jnp.asarray(boxes))
+        all_scores.append(jnp.asarray(sc))
+        rois_num.append(len(boxes))
+    rois = jnp.concatenate(all_rois) if all_rois else jnp.zeros((0, 4))
+    out_scores = (jnp.concatenate(all_scores) if all_scores
+                  else jnp.zeros((0,)))
+    if return_rois_num:
+        return rois, out_scores, jnp.asarray(rois_num)
+    return rois, out_scores
+
+
+def read_file(filename):
+    """ref: paddle.vision.ops.read_file — raw bytes as a uint8 tensor."""
+    import numpy as np
+
+    with open(filename, 'rb') as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+def decode_jpeg(x, mode='unchanged'):
+    """ref: paddle.vision.ops.decode_jpeg (the reference uses nvjpeg;
+    here PIL decodes on the host — the TPU has no JPEG engine)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == 'gray':
+        img = img.convert('L')
+    elif mode == 'rgb':
+        img = img.convert('RGB')
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                      # (1, H, W)
+    else:
+        arr = arr.transpose(2, 0, 1)         # (C, H, W)
+    return jnp.asarray(arr)

@@ -1,0 +1,428 @@
+"""New nn.functional surface: unpooling / fractional pooling / extra
+losses / packed flash attention / gather_tree (ref semantics:
+python/paddle/nn/functional/{pooling,loss,extension,flash_attention}.py).
+Goldens from torch where it has the same op, brute force otherwise."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip('torch')
+
+
+# ---- pooling ----------------------------------------------------------------
+
+@pytest.mark.parametrize('ks,st,pad', [(2, 2, 0), (3, 2, 1), ((2, 3), (1, 2), (1, 0))])
+def test_max_pool2d_return_mask(ks, st, pad):
+    x = np.random.default_rng(0).normal(size=(2, 3, 8, 10)).astype(np.float32)
+    out, idx = F.max_pool2d(x, ks, st, pad, return_mask=True)
+    to, ti = torch.nn.functional.max_pool2d(
+        torch.from_numpy(x), ks, st, pad, return_indices=True)
+    np.testing.assert_allclose(np.asarray(out), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), ti.numpy())
+
+
+def test_max_pool1d_3d_return_mask_and_unpool():
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=(2, 3, 12)).astype(np.float32)
+    o1, i1 = F.max_pool1d(x1, 2, 2, 0, return_mask=True)
+    t1, ti1 = torch.nn.functional.max_pool1d(
+        torch.from_numpy(x1), 2, 2, 0, return_indices=True)
+    np.testing.assert_array_equal(np.asarray(i1), ti1.numpy())
+    u1 = F.max_unpool1d(o1, i1, 2)
+    tu1 = torch.nn.functional.max_unpool1d(t1, ti1, 2)
+    np.testing.assert_allclose(np.asarray(u1), tu1.numpy())
+
+    x3 = rng.normal(size=(2, 2, 4, 6, 4)).astype(np.float32)
+    o3, i3 = F.max_pool3d(x3, 2, 2, 0, return_mask=True)
+    t3, ti3 = torch.nn.functional.max_pool3d(
+        torch.from_numpy(x3), 2, 2, 0, return_indices=True)
+    np.testing.assert_array_equal(np.asarray(i3), ti3.numpy())
+    u3 = F.max_unpool3d(o3, i3, 2)
+    tu3 = torch.nn.functional.max_unpool3d(t3, ti3, 2)
+    np.testing.assert_allclose(np.asarray(u3), tu3.numpy())
+
+
+def test_max_unpool2d_layer_roundtrip():
+    x = np.random.default_rng(2).normal(size=(1, 2, 6, 6)).astype(np.float32)
+    out, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+    un = nn.MaxUnPool2D(2)(out, idx)
+    tun = torch.nn.functional.max_unpool2d(
+        *torch.nn.functional.max_pool2d(torch.from_numpy(x), 2, 2,
+                                        return_indices=True), 2)
+    np.testing.assert_allclose(np.asarray(un), tun.numpy())
+
+
+def test_adaptive_max_pool_return_mask():
+    x = np.random.default_rng(3).normal(size=(2, 3, 9, 11)).astype(np.float32)
+    out, idx = F.adaptive_max_pool2d(x, (3, 4), return_mask=True)
+    to, ti = torch.nn.functional.adaptive_max_pool2d(
+        torch.from_numpy(x), (3, 4), return_indices=True)
+    np.testing.assert_allclose(np.asarray(out), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), ti.numpy())
+
+
+def test_fractional_max_pool2d_doc_example():
+    # the reference docstring's worked example: len-7 row, out 5, u=0.3
+    seq = np.array([2, 4, 3, 1, 5, 2, 3], np.float32).reshape(1, 1, 1, 7)
+    out = F.fractional_max_pool2d(seq, (1, 5), random_u=0.3)
+    np.testing.assert_array_equal(np.asarray(out).ravel(), [2, 4, 1, 5, 3])
+    out2, idx = F.fractional_max_pool2d(seq, (1, 5), random_u=0.3,
+                                        return_mask=True)
+    np.testing.assert_array_equal(np.asarray(idx).ravel(), [0, 1, 3, 4, 6])
+
+
+def test_fractional_max_pool3d_shapes():
+    x = np.random.default_rng(4).normal(size=(2, 2, 5, 6, 7)).astype(np.float32)
+    out = F.fractional_max_pool3d(x, (2, 3, 3), random_u=0.4)
+    assert np.asarray(out).shape == (2, 2, 2, 3, 3)
+    # every output must be an element of the input
+    assert np.isin(np.asarray(out), x).all()
+
+
+def test_lp_pool1d():
+    x = np.random.default_rng(5).normal(size=(2, 3, 10)).astype(np.float32)
+    out = F.lp_pool1d(x, 2.0, 2, 2)
+    want = torch.nn.functional.lp_pool1d(torch.from_numpy(x), 2.0, 2, 2)
+    np.testing.assert_allclose(np.asarray(out), want.numpy(), rtol=1e-5)
+    out2 = nn.LPPool1D(2.0, 2, 2)(x)
+    np.testing.assert_allclose(np.asarray(out2), want.numpy(), rtol=1e-5)
+
+
+def test_zeropad_and_unflatten():
+    x = np.ones((1, 2, 3, 4), np.float32)
+    z = F.zeropad2d(x, [1, 2, 3, 4])
+    assert np.asarray(z).shape == (1, 2, 10, 7)
+    assert float(np.asarray(z).sum()) == x.sum()
+    u = nn.Unflatten(1, (1, 2))(x)
+    assert np.asarray(u).shape == (1, 1, 2, 3, 4)
+    assert hasattr(F, 'relu_') and F.relu_ is F.relu
+
+
+# ---- losses -----------------------------------------------------------------
+
+def test_multi_margin_loss():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(5, 7)).astype(np.float32)
+    y = rng.integers(0, 7, 5)
+    w = rng.uniform(0.5, 1.5, 7).astype(np.float32)
+    for p, margin, weight in [(1, 1.0, None), (2, 0.7, w)]:
+        got = F.multi_margin_loss(x, y, p, margin, weight)
+        want = torch.nn.functional.multi_margin_loss(
+            torch.from_numpy(x), torch.from_numpy(y), p=p, margin=margin,
+            weight=None if weight is None else torch.from_numpy(weight))
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_triplet_margin_with_distance_loss():
+    rng = np.random.default_rng(7)
+    a, p_, n = [rng.normal(size=(4, 8)).astype(np.float32) for _ in range(3)]
+    got = F.triplet_margin_with_distance_loss(a, p_, n, swap=True, margin=0.5)
+    want = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.from_numpy(a), torch.from_numpy(p_), torch.from_numpy(n),
+        swap=True, margin=0.5)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    layer = nn.TripletMarginWithDistanceLoss(margin=0.5, swap=True)
+    np.testing.assert_allclose(float(layer(a, p_, n)), float(want), rtol=1e-5)
+
+
+def test_hsigmoid_loss_probabilities_sum_to_one():
+    # with a complete binary heap code, sum_c P(c|x) == 1 for any weights
+    rng = np.random.default_rng(8)
+    for num_classes in (8, 11):
+        x = rng.normal(size=(1, 6)).astype(np.float32)
+        w = rng.normal(size=(num_classes - 1, 6)).astype(np.float32)
+        b = rng.normal(size=(num_classes - 1, 1)).astype(np.float32)
+        losses = [np.asarray(F.hsigmoid_loss(x, np.array([c]), num_classes,
+                                             w, b))[0, 0]
+                  for c in range(num_classes)]
+        total = sum(np.exp(-l) for l in losses)
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_hsigmoid_loss_custom_tree_and_layer():
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    w = rng.normal(size=(5, 4)).astype(np.float32)
+    # two custom paths with padding (-1)
+    table = np.array([[0, 2, -1], [1, 3, 4]])
+    code = np.array([[1, 0, 0], [0, 1, 1]])
+    out = F.hsigmoid_loss(x, np.array([0, 1]), 5, w, None, table, code)
+    # manual: sum softplus(pre) - code*pre over valid nodes
+    want = []
+    for i in range(2):
+        tot = 0.0
+        for j in range(3):
+            if table[i, j] < 0:
+                continue
+            pre = float(x[i] @ w[table[i, j]])
+            tot += np.logaddexp(0, pre) - code[i, j] * pre
+        want.append([tot])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+    layer = nn.HSigmoidLoss(4, 8)
+    l = layer(x, np.array([[3], [5]]))
+    assert np.asarray(l).shape == (2, 1) and np.isfinite(np.asarray(l)).all()
+
+
+def test_adaptive_log_softmax_with_loss_vs_torch():
+    rng = np.random.default_rng(10)
+    d, n_classes, cutoffs = 8, 20, [4, 12]
+    tmod = torch.nn.AdaptiveLogSoftmaxWithLoss(
+        d, n_classes, cutoffs, div_value=2.0, head_bias=True)
+    x = rng.normal(size=(6, d)).astype(np.float32)
+    y = rng.integers(0, n_classes, 6)
+    t_out = tmod(torch.from_numpy(x), torch.from_numpy(y))
+    head_w = tmod.head.weight.detach().numpy().T.copy()
+    head_b = tmod.head.bias.detach().numpy().copy()
+    tails = []
+    for seq in tmod.tail:
+        proj = seq[0].weight.detach().numpy().T.copy()
+        out_w = seq[1].weight.detach().numpy().T.copy()
+        tails.append([jnp.asarray(proj), jnp.asarray(out_w)])
+    got_out, got_loss = F.adaptive_log_softmax_with_loss(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(head_w), tails,
+        cutoffs + [n_classes], jnp.asarray(head_b))
+    np.testing.assert_allclose(np.asarray(got_out), t_out.output.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(got_loss), float(t_out.loss), rtol=1e-4)
+
+
+def test_adaptive_log_softmax_layer():
+    layer = nn.AdaptiveLogSoftmaxWithLoss(8, 20, [4, 12], div_value=2.0,
+                                          head_bias=True)
+    x = np.random.default_rng(11).normal(size=(5, 8)).astype(np.float32)
+    y = np.array([0, 5, 13, 19, 2])
+    out, loss = layer(x, y)
+    lp = layer.log_prob(x)
+    assert np.asarray(lp).shape == (5, 20)
+    # log_prob rows are normalized distributions
+    np.testing.assert_allclose(np.exp(np.asarray(lp)).sum(-1),
+                               np.ones(5), rtol=1e-5)
+    # target entries agree with the fused path
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(lp)[np.arange(5), y], rtol=1e-5)
+    assert np.argmax(np.asarray(lp), -1).shape == layer.predict(x).shape
+    with pytest.raises(ValueError):
+        nn.AdaptiveLogSoftmaxWithLoss(8, 20, [12, 4])
+
+
+def _rnnt_brute_force(lp, label, t_len, u_len, blank):
+    """Sum over all monotonic (T, U) alignment paths by explicit DP."""
+    alpha = np.full((t_len, u_len + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(t_len):
+        for u in range(u_len + 1):
+            if t == 0 and u == 0:
+                continue
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lp[t, u - 1, label[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[t_len - 1, u_len] + lp[t_len - 1, u_len, blank])
+
+
+def test_rnnt_loss_vs_dp():
+    rng = np.random.default_rng(12)
+    b, tmax, umax, v = 3, 4, 3, 5
+    logits = rng.normal(size=(b, tmax, umax + 1, v)).astype(np.float32)
+    labels = rng.integers(1, v, (b, umax)).astype(np.int32)
+    t_lens = np.array([4, 3, 2])
+    u_lens = np.array([3, 2, 1])
+    got = F.rnnt_loss(logits, labels, t_lens, u_lens, blank=0,
+                      fastemit_lambda=0.0, reduction='none')
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    want = [_rnnt_brute_force(lp[i], labels[i], t_lens[i], u_lens[i], 0)
+            for i in range(b)]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+    # fastemit keeps the value, scales the gradient
+    g0 = jax.grad(lambda l: F.rnnt_loss(l, labels, t_lens, u_lens,
+                                        fastemit_lambda=0.0))(jnp.asarray(logits))
+    v1 = F.rnnt_loss(logits, labels, t_lens, u_lens, fastemit_lambda=0.5)
+    v0 = F.rnnt_loss(logits, labels, t_lens, u_lens, fastemit_lambda=0.0)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+    g1 = jax.grad(lambda l: F.rnnt_loss(l, labels, t_lens, u_lens,
+                                        fastemit_lambda=0.5))(jnp.asarray(logits))
+    assert not np.allclose(np.asarray(g0), np.asarray(g1))
+    layer = nn.RNNTLoss(blank=0, fastemit_lambda=0.0)
+    np.testing.assert_allclose(float(layer(logits, labels, t_lens, u_lens)),
+                               float(v0), rtol=1e-6)
+
+
+def test_margin_cross_entropy():
+    rng = np.random.default_rng(13)
+    n, c = 6, 10
+    # logits are cosines: normalize random features against class centers
+    feats = rng.normal(size=(n, 4)); feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+    w = rng.normal(size=(4, c)); w /= np.linalg.norm(w, axis=0, keepdims=True)
+    cos = (feats @ w).astype(np.float32)
+    y = rng.integers(0, c, n)
+    # m1=1, m2=0, m3=0 reduces to plain scaled softmax CE
+    got = F.margin_cross_entropy(cos, y, margin1=1.0, margin2=0.0,
+                                 margin3=0.0, scale=10.0, reduction='mean')
+    want = torch.nn.functional.cross_entropy(torch.from_numpy(cos * 10.0),
+                                             torch.from_numpy(y))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    # ArcFace margin raises the loss
+    harder = F.margin_cross_entropy(cos, y, margin2=0.5, scale=10.0)
+    assert float(harder) > float(got)
+    loss, sm = F.margin_cross_entropy(cos, y, return_softmax=True)
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), np.ones(n), rtol=1e-5)
+
+
+# ---- attention wrappers / gather_tree ---------------------------------------
+
+def test_flash_attn_qkvpacked():
+    rng = np.random.default_rng(14)
+    qkv = rng.normal(size=(2, 16, 3, 2, 8)).astype(np.float32)
+    out, sm = F.flash_attn_qkvpacked(qkv, causal=True)
+    assert sm is None
+    want = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                          qkv[:, :, 2], is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-6)
+    out2, sm2 = F.flash_attn_qkvpacked(qkv, causal=True, return_softmax=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(want), atol=1e-5)
+    assert np.asarray(sm2).shape == (2, 2, 16, 16)
+
+
+def test_flash_attn_varlen_qkvpacked():
+    rng = np.random.default_rng(15)
+    lens = [5, 3, 8]
+    total = sum(lens)
+    qkv = rng.normal(size=(total, 3, 2, 8)).astype(np.float32)
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    out, _ = F.flash_attn_varlen_qkvpacked(
+        qkv, cu, cu, max(lens), max(lens), scale=1.0 / np.sqrt(8))
+    # golden: per-sequence dense attention
+    want = []
+    for i in range(3):
+        s = slice(cu[i], cu[i + 1])
+        want.append(np.asarray(F.scaled_dot_product_attention(
+            qkv[None, s, 0], qkv[None, s, 1], qkv[None, s, 2]))[0])
+    np.testing.assert_allclose(np.asarray(out), np.concatenate(want),
+                               atol=1e-5)
+
+
+def test_flashmask_attention_causal_lt():
+    rng = np.random.default_rng(16)
+    b, s, h, d = 1, 8, 1, 4
+    q, k, v = [rng.normal(size=(b, s, h, d)).astype(np.float32)
+               for _ in range(3)]
+    # LTS=4 for every key: queries 4.. cannot see anything below the
+    # diagonal beyond row 3 -> same as causal with keys masked for rows>=4
+    start = np.full((b, 1, s, 1), 4, np.int32)
+    out = F.flashmask_attention(q, k, v, start, causal=True)
+    mask = np.tril(np.ones((s, s), bool)) & (np.arange(s)[:, None] < 4)
+    mask[np.arange(4, s), np.arange(4, s)] = True  # keep self unmasked? no
+    # golden without the self-unmask assumption:
+    mask = np.tril(np.ones((s, s), bool)) & (np.arange(s)[:, None] < 4)
+    logits = np.einsum('bqhd,bkhd->bhqk', q / np.sqrt(d), k)
+    logits = np.where(mask[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum('bhqk,bkhd->bqhd', p, v)
+    rows_valid = mask.any(-1)
+    want = np.where(rows_valid[None, :, None, None], want, 0.0)
+    got = np.asarray(out)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_sparse_attention_matches_dense_on_full_pattern():
+    rng = np.random.default_rng(17)
+    b, h, s, d = 1, 2, 4, 8
+    q, k, v = [rng.normal(size=(b, h, s, d)).astype(np.float32)
+               for _ in range(3)]
+    # full pattern: every row attends everywhere -> equals dense
+    offset = np.tile(np.arange(0, s * s + 1, s, dtype=np.int32), (b, h, 1))
+    columns = np.tile(np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1))
+    out = F.sparse_attention(q, k, v, offset, columns)
+    want = F.scaled_dot_product_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3)).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+    # banded pattern differs from dense
+    off2 = np.tile(np.arange(0, s + 1, dtype=np.int32), (b, h, 1))
+    col2 = np.tile(np.arange(s, dtype=np.int32), (b, h, 1))
+    out2 = F.sparse_attention(q, k, v, off2, col2)  # diagonal only -> v
+    np.testing.assert_allclose(np.asarray(out2), v, atol=1e-5)
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]])
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]])
+    got = np.asarray(F.gather_tree(ids, parents))
+    # reference doc example (paddle.nn.functional.gather_tree)
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_softmax2d_silu_featurealpha():
+    x = np.random.default_rng(18).normal(size=(2, 3, 4, 5)).astype(np.float32)
+    out = nn.Softmax2D()(x)
+    np.testing.assert_allclose(np.asarray(out).sum(1), np.ones((2, 4, 5)),
+                               rtol=1e-6)
+    assert nn.Silu is nn.SiLU
+    drop = nn.FeatureAlphaDropout(0.5)
+    drop.eval()
+    np.testing.assert_array_equal(np.asarray(drop(x)), x)
+
+
+def test_margin_cross_entropy_class_parallel():
+    """The group=axis path must match the single-device result when the
+    class dim is sharded over a shard_map axis (global labels)."""
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    rng = np.random.default_rng(19)
+    n, c = 8, 16
+    feats = rng.normal(size=(n, 4)); feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+    w = rng.normal(size=(4, c)); w /= np.linalg.norm(w, axis=0, keepdims=True)
+    cos = (feats @ w).astype(np.float32)
+    y = rng.integers(0, c, n).astype(np.int32)
+    want = F.margin_cross_entropy(cos, y, reduction='none')
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ('tp',))
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None, 'tp'), P()), out_specs=P(),
+             check_rep=False)
+    def sharded(local_logits, label):
+        return F.margin_cross_entropy(local_logits, label, group='tp',
+                                      reduction='none')
+
+    got = sharded(jnp.asarray(cos), jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_return_mask_integer_exact():
+    # values above 2^24 must not round through float32 on the mask path
+    base = 16777216  # 2^24
+    x = np.array([[[[base + 1, base], [base - 1, base + 3]]]], np.int32)
+    out, idx = F.max_pool2d(x, 2, 2, 0, return_mask=True)
+    assert int(np.asarray(out)[0, 0, 0, 0]) == base + 3
+    assert int(np.asarray(idx)[0, 0, 0, 0]) == 3
+
+
+def test_flash_attn_varlen_return_softmax():
+    rng = np.random.default_rng(20)
+    lens = [3, 5]
+    qkv = rng.normal(size=(8, 3, 1, 8)).astype(np.float32)
+    cu = np.array([0, 3, 8], np.int32)
+    out, sm = F.flash_attn_varlen_qkvpacked(qkv, cu, cu, 5, 5,
+                                            scale=1.0 / np.sqrt(8),
+                                            return_softmax=True)
+    assert np.asarray(sm).shape == (1, 8, 8)
+    # cross-sequence probabilities are exactly zero
+    assert np.asarray(sm)[0, :3, 3:].max() == 0
+    assert np.asarray(sm)[0, 3:, :3].max() == 0
+    out2, _ = F.flash_attn_varlen_qkvpacked(qkv, cu, cu, 5, 5,
+                                            scale=1.0 / np.sqrt(8))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)

@@ -1,0 +1,90 @@
+"""LBFGS: convergence on quadratic (closed form), Rosenbrock, and a
+Layer model least-squares fit (ref: python/paddle/optimizer/lbfgs.py
+semantics; test strategy per test/legacy_test/test_lbfgs.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.optimizer import LBFGS
+
+
+class _Params(pt.nn.Layer):
+    def __init__(self, init):
+        super().__init__()
+        from paddle_tpu.nn.layer.base import Parameter
+        self.w = Parameter(jnp.asarray(init))
+
+    def forward(self):
+        return self.w
+
+
+def _quad_problem(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(n, n))
+    A = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    b = rng.normal(size=(n,)).astype(np.float32)
+    x_star = np.linalg.solve(A, b)
+    return A, b, x_star
+
+
+@pytest.mark.parametrize('line_search', [None, 'strong_wolfe'])
+def test_lbfgs_quadratic(line_search):
+    A, b, x_star = _quad_problem()
+    model = _Params(np.zeros(6, np.float32))
+    opt = LBFGS(learning_rate=0.9 if line_search is None else 1.0,
+                max_iter=50, line_search_fn=line_search)
+
+    def closure(m):
+        x = m.w
+        return 0.5 * x @ jnp.asarray(A) @ x - jnp.asarray(b) @ x
+
+    for _ in range(4):
+        loss, model = opt.step(closure, model)
+    np.testing.assert_allclose(np.asarray(model.w), x_star,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_lbfgs_rosenbrock():
+    model = _Params(np.array([-1.2, 1.0], np.float32))
+    opt = LBFGS(learning_rate=1.0, max_iter=100,
+                line_search_fn='strong_wolfe')
+
+    def closure(m):
+        x, y = m.w[0], m.w[1]
+        return (1 - x) ** 2 + 100 * (y - x * x) ** 2
+
+    for _ in range(5):
+        loss, model = opt.step(closure, model)
+    np.testing.assert_allclose(np.asarray(model.w), [1.0, 1.0], atol=1e-3)
+
+
+def test_lbfgs_layer_least_squares():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(32, 4)).astype(np.float32)
+    w_true = rng.normal(size=(4, 1)).astype(np.float32)
+    y = X @ w_true
+    model = pt.nn.Linear(4, 1)
+    opt = LBFGS(line_search_fn='strong_wolfe', max_iter=40)
+
+    def closure(m):
+        return jnp.mean((m(jnp.asarray(X)) - jnp.asarray(y)) ** 2)
+
+    loss0, model = opt.step(closure, model)
+    loss1, model = opt.step(closure, model)
+    assert float(closure(model)) < 1e-6
+    assert float(loss1) <= float(loss0)
+
+
+def test_lbfgs_tolerance_exit():
+    # already at the optimum: returns immediately, no nan
+    model = _Params(np.zeros(3, np.float32))
+    opt = LBFGS(line_search_fn='strong_wolfe')
+    loss, model = opt.step(lambda m: jnp.sum(m.w ** 2), model)
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(np.asarray(model.w), np.zeros(3), atol=1e-7)
+
+
+def test_lbfgs_rejects_bad_line_search():
+    with pytest.raises(ValueError):
+        LBFGS(line_search_fn='backtracking')

@@ -1,0 +1,359 @@
+"""Round-3 namespace completions: linalg/fft/io/jit/autograd/initializer/
+incubate/amp/metric/distribution extras (ref: matching paddle modules)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+torch = pytest.importorskip('torch')
+
+
+def test_linalg_extras():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(4, 4)).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    l = np.linalg.cholesky(spd)
+    inv = np.asarray(pt.linalg.cholesky_inverse(l))
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    inv_u = np.asarray(pt.linalg.cholesky_inverse(l.T.copy(), upper=True))
+    np.testing.assert_allclose(inv_u, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+    m = rng.normal(size=(3, 3)).astype(np.float32) * 0.3
+    np.testing.assert_allclose(np.asarray(pt.linalg.matrix_exp(m)),
+                               torch.matrix_exp(torch.from_numpy(m)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+    # lu_unpack round-trip: P @ L @ U == A
+    A = rng.normal(size=(4, 4)).astype(np.float32)
+    lu, piv = pt.linalg.lu(jnp.asarray(A))
+    p, lo, up = pt.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(np.asarray(p) @ np.asarray(lo) @ np.asarray(up),
+                               A, rtol=1e-4, atol=1e-4)
+
+    big = rng.normal(size=(12, 6)).astype(np.float32)
+    u, s, v = pt.linalg.svd_lowrank(big, q=6, niter=4)
+    np.testing.assert_allclose(
+        np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T, big,
+        rtol=1e-2, atol=1e-3)
+
+    # ormqr: apply Q from a LAPACK-layout QR (torch.geqrf golden)
+    x = rng.normal(size=(4, 3)).astype(np.float32)
+    y = rng.normal(size=(4, 2)).astype(np.float32)
+    h, tau = torch.geqrf(torch.from_numpy(x))
+    want = torch.ormqr(h, tau, torch.from_numpy(y)).numpy()
+    got = np.asarray(pt.linalg.ormqr(h.numpy(), tau.numpy(), y))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fft_hermitian():
+    import paddle_tpu.fft as pf
+
+    rng = np.random.default_rng(1)
+    real = rng.normal(size=(4, 6))
+    np.testing.assert_allclose(np.asarray(pf.hfftn(pf.ihfftn(real), s=(4, 6))),
+                               real, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pf.hfft2(pf.ihfft2(real), s=(4, 6))),
+                               real, atol=1e-4)
+    want = np.fft.ifft(np.fft.ihfft(real, axis=-1), axis=0)
+    np.testing.assert_allclose(np.asarray(pf.ihfftn(real)), want, atol=1e-6)
+
+
+def test_io_extras():
+    from paddle_tpu.io import SubsetRandomSampler, get_worker_info
+
+    s = SubsetRandomSampler([3, 5, 7])
+    assert sorted(s) == [3, 5, 7] and len(s) == 3
+    assert get_worker_info() is None  # main process
+
+
+def test_jit_extras():
+    pt.jit.set_verbosity(3)
+    pt.jit.set_code_level(50)
+    assert pt.jit.TranslatedLayer is not None
+
+
+def test_autograd_extras():
+    from paddle_tpu.autograd import PyLayer, PyLayerContext, saved_tensors_hooks
+
+    assert PyLayerContext is PyLayer._Ctx
+    packed, unpacked = [], []
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return 2 * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return 2 * g
+
+    with saved_tensors_hooks(lambda x: (packed.append(1), x)[1],
+                             lambda x: (unpacked.append(1), x)[1]):
+        g = jax.grad(lambda x: Double.apply(x).sum())(jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(g), 2 * np.ones(3))
+    assert packed and unpacked
+
+
+def test_initializer_extras():
+    from paddle_tpu.nn import initializer as I
+
+    assert I.calculate_gain('relu') == pytest.approx(np.sqrt(2))
+    assert I.calculate_gain('tanh') == pytest.approx(5.0 / 3)
+    assert I.calculate_gain('leaky_relu', 0.2) == pytest.approx(
+        np.sqrt(2 / 1.04))
+    with pytest.raises(ValueError):
+        I.calculate_gain('nope')
+
+    d = np.asarray(I.Dirac()((4, 4, 3, 3), 'float32'))
+    want = torch.empty(4, 4, 3, 3)
+    torch.nn.init.dirac_(want)
+    np.testing.assert_array_equal(d, want.numpy())
+
+    b = np.asarray(I.Bilinear()((1, 1, 4, 4), 'float32'))
+    # bilinear upsampling kernel: symmetric, positive, center-heavy
+    assert b.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(b[0, 0], b[0, 0].T, atol=1e-6)
+    assert b[0, 0, 1, 1] == b[0, 0].max()
+
+    I.set_global_initializer(I.Constant(0.5))
+    try:
+        layer = pt.nn.Linear(3, 3)
+        np.testing.assert_allclose(np.asarray(layer.weight),
+                                   np.full((3, 3), 0.5))
+    finally:
+        I.set_global_initializer(None)
+
+
+def test_incubate_extras():
+    import paddle_tpu.incubate as inc
+
+    x = np.random.default_rng(2).normal(size=(2, 4, 4)).astype(np.float32)
+    mask = np.zeros((2, 4, 4), np.float32)
+    mask[:, :, 2:] = -1e30
+    got = np.asarray(inc.softmax_mask_fuse(x, mask))
+    assert got[..., 2:].max() < 1e-6
+    np.testing.assert_allclose(got.sum(-1), np.ones((2, 4)), rtol=1e-5)
+
+    tri = np.asarray(inc.softmax_mask_fuse_upper_triangle(x))
+    assert tri[0, 0, 1:].max() < 1e-6  # first row sees only col 0
+    np.testing.assert_allclose(tri.sum(-1), np.ones((2, 4)), rtol=1e-5)
+
+    assert float(inc.identity_loss(jnp.ones(4), 'sum')) == 4.0
+    assert float(inc.identity_loss(jnp.ones(4), 'mean')) == 1.0
+
+    # graph ops: star graph 0 <- {1, 2, 3} in CSC (row=src, colptr over dst)
+    row = np.array([1, 2, 3], np.int64)
+    colptr = np.array([0, 3, 3, 3, 3], np.int64)
+    neigh, counts = inc.graph_sample_neighbors(row, colptr, np.array([0]),
+                                               sample_size=2)
+    assert counts[0] == 2 and set(neigh) <= {1, 2, 3}
+    src, dst, nodes, _ = inc.graph_khop_sampler(row, colptr, np.array([0]),
+                                                [3])
+    assert len(src) == 3 and (np.asarray(nodes)[dst] == 0).all()
+    reindex, dst2, nodes2 = inc.graph_reindex(
+        np.array([0]), np.array([1, 2, 3]), np.array([3]))
+    assert nodes2.tolist() == [0, 1, 2, 3] and reindex.tolist() == [1, 2, 3]
+
+    # segment aliases point at geometric
+    np.testing.assert_allclose(
+        np.asarray(inc.segment_sum(jnp.ones((4, 2)),
+                                   jnp.asarray([0, 0, 1, 1]))),
+        np.full((2, 2), 2.0))
+
+
+def test_model_average():
+    import paddle_tpu.incubate as inc
+
+    model = pt.nn.Linear(2, 2)
+    ma = inc.ModelAverage(0.5)
+    ma.update(model)
+    avg = ma.apply(model)
+    assert avg is not None
+    assert ma.restore(model) is model
+
+
+def test_distribution_extras():
+    from paddle_tpu.distribution import ContinuousBernoulli, LKJCholesky
+
+    pt.seed(3)
+    for p in (0.2, 0.5, 0.7):
+        cb = ContinuousBernoulli(np.float32(p))
+        tcb = torch.distributions.ContinuousBernoulli(torch.tensor(p))
+        for x in (0.1, 0.5, 0.9):
+            np.testing.assert_allclose(
+                float(cb.log_prob(np.float32(x))),
+                float(tcb.log_prob(torch.tensor(x))), rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(float(cb.mean), float(tcb.mean),
+                                   rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(float(cb.variance), float(tcb.variance),
+                                   rtol=2e-3, atol=1e-6)
+        np.testing.assert_allclose(
+            float(cb.entropy()), float(tcb.entropy()), rtol=1e-3, atol=1e-5)
+    s = np.asarray(cb.sample((2000,)))
+    assert s.min() >= 0 and s.max() <= 1
+
+    lkj = LKJCholesky(3, 1.5)
+    arr = np.asarray(lkj.sample((4,)))
+    np.testing.assert_allclose((arr ** 2).sum(-1), np.ones((4, 3)), atol=1e-5)
+    assert np.allclose(np.triu(arr, 1), 0)
+    tl = torch.distributions.LKJCholesky(3, 1.5, validate_args=False)
+    for i in range(4):
+        np.testing.assert_allclose(
+            float(lkj.log_prob(arr[i])),
+            float(tl.log_prob(torch.from_numpy(arr[i].copy()).double())),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_amp_and_metric_extras():
+    assert pt.amp.is_bfloat16_supported() and pt.amp.is_float16_supported()
+    acc = pt.metric.accuracy(np.array([[0.1, 0.9], [0.8, 0.2]]),
+                             np.array([1, 1]))
+    assert float(acc) == pytest.approx(0.5)
+    acc2 = pt.metric.accuracy(np.array([[0.1, 0.9, 0.0], [0.8, 0.2, 0.1]]),
+                              np.array([0, 1]), k=2)
+    assert float(acc2) == pytest.approx(1.0)
+
+
+def test_vision_detection_extras(tmp_path):
+    import paddle_tpu.vision as V
+    from paddle_tpu.vision.ops import (decode_jpeg, distribute_fpn_proposals,
+                                       generate_proposals, read_file)
+
+    # fpn distribution: one small roi (level 2 at refer 4/224) + one large
+    rois = np.array([[0, 0, 10, 10], [0, 0, 300, 300]], np.float32)
+    multi, restore, nums = distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=np.array([2]))
+    assert len(multi) == 4
+    assert np.asarray(multi[0]).shape[0] == 1    # small box -> min level
+    sizes = [np.asarray(m).shape[0] for m in multi]
+    assert sum(sizes) == 2
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([np.asarray(m) for m in multi if len(m)])
+    np.testing.assert_array_equal(cat[np.asarray(restore)], rois)
+
+    # generate_proposals on a tiny RPN head
+    rng = np.random.default_rng(4)
+    h = w = 4
+    anchors = np.zeros((h, w, 2, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 16, i * 8 + 16]
+            anchors[i, j, 1] = [j * 8, i * 8, j * 8 + 32, i * 8 + 32]
+    scores = rng.uniform(size=(1, 2, h, w)).astype(np.float32)
+    deltas = rng.normal(size=(1, 8, h, w)).astype(np.float32) * 0.1
+    variances = np.ones_like(anchors)
+    rois_out, sc_out, n_out = generate_proposals(
+        scores, deltas, np.array([[32, 32]], np.float32), anchors, variances,
+        pre_nms_top_n=16, post_nms_top_n=8, return_rois_num=True)
+    assert np.asarray(rois_out).shape[1] == 4
+    assert int(n_out[0]) == np.asarray(rois_out).shape[0] <= 8
+    assert (np.asarray(rois_out) >= 0).all()
+    assert (np.asarray(rois_out)[:, 2] <= 32).all()
+
+    # image io round trip through PIL
+    from PIL import Image
+
+    img = Image.fromarray(
+        rng.integers(0, 255, (8, 6, 3)).astype(np.uint8))
+    p = tmp_path / 'x.jpg'
+    img.save(p, quality=95)
+    raw = read_file(str(p))
+    assert raw.dtype == jnp.uint8 and raw.shape[0] > 100
+    dec = decode_jpeg(raw, mode='rgb')
+    assert np.asarray(dec).shape == (3, 8, 6)
+    V.set_image_backend('pil')
+    loaded = V.image_load(str(p))
+    assert loaded.size == (6, 8)
+    assert V.get_image_backend() == 'pil'
+    with pytest.raises(ValueError):
+        V.set_image_backend('tf')
+
+
+def test_review_fixes_round3b():
+    # batched lu_unpack
+    rng = np.random.default_rng(9)
+    A = rng.normal(size=(2, 4, 4)).astype(np.float32)
+    lu, piv = pt.linalg.lu(jnp.asarray(A))
+    p, lo, up = pt.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(np.asarray(p @ lo @ up), A, rtol=1e-4,
+                               atol=1e-4)
+    # batched svd_lowrank
+    B = rng.normal(size=(3, 10, 5)).astype(np.float32)
+    u, s, v = pt.linalg.svd_lowrank(B, q=5, niter=3)
+    recon = np.einsum('bik,bk,bjk->bij', np.asarray(u), np.asarray(s),
+                      np.asarray(v))
+    np.testing.assert_allclose(recon, B, rtol=5e-2, atol=5e-3)
+    # ormqr right/transpose variants vs torch
+    x = rng.normal(size=(5, 3)).astype(np.float32)
+    y = rng.normal(size=(4, 5)).astype(np.float32)
+    h, tau = torch.geqrf(torch.from_numpy(x))
+    for left, tr, ty in [(False, False, y), (False, True, y),
+                         (True, True, y.T.copy())]:
+        want = torch.ormqr(h, tau, torch.from_numpy(ty), left=left,
+                           transpose=tr).numpy()
+        got = np.asarray(pt.linalg.ormqr(h.numpy(), tau.numpy(), ty,
+                                         left=left, transpose=tr))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # graph sampling is actually stochastic across calls
+    import paddle_tpu.incubate as inc
+    row = np.arange(100, dtype=np.int64)
+    colptr = np.array([0, 100], np.int64)
+    draws = {tuple(inc.graph_sample_neighbors(row, colptr, np.array([0]),
+                                              sample_size=5)[0])
+             for _ in range(6)}
+    assert len(draws) > 1
+    # dirac leaves extra out-channels zero (per reference min_shape clamp)
+    from paddle_tpu.nn import initializer as I
+    d = np.asarray(I.Dirac()((4, 2, 3, 3), 'float32'))
+    want = torch.empty(4, 2, 3, 3)
+    torch.nn.init.dirac_(want)
+    np.testing.assert_array_equal(d, want.numpy())
+    assert d[2:].sum() == 0
+
+
+def test_saved_tensors_hooks_after_block():
+    # backward AFTER the with-block must still unpack (reference example 2)
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return 2 * x * g
+
+    import numpy as _np
+
+    def pack(x):
+        return _np.asarray(x)          # simulate host offload
+
+    def unpack(x):
+        return jnp.asarray(x)
+
+    with saved_tensors_hooks(pack, unpack):
+        fn = lambda x: Square.apply(x).sum()
+    # grad runs outside the context; saved residual must be unpacked
+    g = jax.grad(fn)(jnp.full((3,), 3.0))
+    np.testing.assert_allclose(np.asarray(g), np.full(3, 6.0))
+
+
+def test_image_load_cv2_grayscale(tmp_path):
+    from PIL import Image
+
+    import paddle_tpu.vision as V
+
+    img = Image.fromarray(np.random.default_rng(5).integers(
+        0, 255, (6, 7)).astype(np.uint8), mode='L')
+    p = tmp_path / 'g.png'
+    img.save(p)
+    arr = V.image_load(str(p), backend='cv2')
+    assert arr.shape == (6, 7, 3)

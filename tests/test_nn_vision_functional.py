@@ -1,0 +1,132 @@
+"""nn.functional vision ops: grid_sample / affine_grid / channel_shuffle /
+temporal_shift / sequence_mask vs torch goldens (ref semantics:
+python/paddle/nn/functional/vision.py, extension.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip('torch')
+
+
+def _tgrid_sample(x, grid, mode, padding_mode, align_corners):
+    return torch.nn.functional.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid), mode=mode,
+        padding_mode=padding_mode, align_corners=align_corners).numpy()
+
+
+@pytest.mark.parametrize('mode', ['bilinear', 'nearest'])
+@pytest.mark.parametrize('padding_mode', ['zeros', 'border', 'reflection'])
+@pytest.mark.parametrize('align_corners', [True, False])
+def test_grid_sample_2d(mode, padding_mode, align_corners):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 5, 7)).astype(np.float32)
+    # grid straddling in-range and far out-of-range
+    grid = (rng.uniform(-1.6, 1.6, size=(2, 4, 6, 2))).astype(np.float32)
+    want = _tgrid_sample(x, grid, mode, padding_mode, align_corners)
+    got = np.asarray(F.grid_sample(x, grid, mode, padding_mode, align_corners))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('padding_mode', ['zeros', 'border', 'reflection'])
+def test_grid_sample_3d(padding_mode):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 2, 3, 4, 5)).astype(np.float32)
+    grid = rng.uniform(-1.4, 1.4, size=(2, 2, 3, 4, 3)).astype(np.float32)
+    want = _tgrid_sample(x, grid, 'bilinear', padding_mode, True)
+    got = np.asarray(F.grid_sample(x, grid, 'bilinear', padding_mode, True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('align_corners', [True, False])
+def test_affine_grid_matches_torch(align_corners):
+    rng = np.random.default_rng(2)
+    theta = rng.normal(size=(2, 2, 3)).astype(np.float32)
+    want = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta), [2, 3, 4, 5],
+        align_corners=align_corners).numpy()
+    got = np.asarray(F.affine_grid(theta, [2, 3, 4, 5], align_corners))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_affine_grid_3d_then_sample():
+    rng = np.random.default_rng(3)
+    theta = np.concatenate(
+        [np.tile(np.eye(3, dtype=np.float32)[None], (2, 1, 1)),
+         np.zeros((2, 3, 1), np.float32)], axis=-1)
+    grid = np.asarray(F.affine_grid(theta, [2, 1, 3, 4, 5], True))
+    want = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta), [2, 1, 3, 4, 5], align_corners=True).numpy()
+    np.testing.assert_allclose(grid, want, atol=1e-6)
+    # identity theta => identity resample
+    x = rng.normal(size=(2, 1, 3, 4, 5)).astype(np.float32)
+    y = np.asarray(F.grid_sample(x, grid, align_corners=True))
+    np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('data_format', ['NCHW', 'NHWC'])
+def test_channel_shuffle(data_format):
+    x = np.arange(2 * 8 * 3 * 3, dtype=np.float32).reshape(2, 8, 3, 3)
+    want = torch.nn.functional.channel_shuffle(torch.from_numpy(x), 4).numpy()
+    if data_format == 'NHWC':
+        got = np.asarray(F.channel_shuffle(
+            x.transpose(0, 2, 3, 1), 4, 'NHWC')).transpose(0, 3, 1, 2)
+    else:
+        got = np.asarray(F.channel_shuffle(x, 4, 'NCHW'))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_channel_shuffle_layer():
+    import paddle_tpu.nn as nn
+    x = np.arange(1 * 6 * 2 * 2, dtype=np.float32).reshape(1, 6, 2, 2)
+    layer = nn.ChannelShuffle(3)
+    np.testing.assert_array_equal(
+        np.asarray(layer(x)), np.asarray(F.channel_shuffle(x, 3)))
+
+
+@pytest.mark.parametrize('data_format', ['NCHW', 'NHWC'])
+def test_temporal_shift(data_format):
+    rng = np.random.default_rng(4)
+    n, t, c, h, w = 2, 3, 8, 2, 2
+    x = rng.normal(size=(n * t, c, h, w)).astype(np.float32)
+    # golden: explicit pad-and-slice in numpy on (N, T, C, H, W)
+    xt = x.reshape(n, t, c, h, w)
+    fold = c // 4
+    want = np.zeros_like(xt)
+    want[:, :-1, :fold] = xt[:, 1:, :fold]          # from t+1
+    want[:, 1:, fold:2 * fold] = xt[:, :-1, fold:2 * fold]  # from t-1
+    want[:, :, 2 * fold:] = xt[:, :, 2 * fold:]
+    want = want.reshape(n * t, c, h, w)
+    if data_format == 'NHWC':
+        got = np.asarray(F.temporal_shift(
+            x.transpose(0, 2, 3, 1), t, 0.25, 'NHWC')).transpose(0, 3, 1, 2)
+    else:
+        got = np.asarray(F.temporal_shift(x, t, 0.25, 'NCHW'))
+    np.testing.assert_allclose(got, want, atol=0)
+
+
+def test_sequence_mask():
+    x = np.array([3, 1, 1, 0])
+    got = np.asarray(F.sequence_mask(x, maxlen=4, dtype='int32'))
+    want = np.array([[1, 1, 1, 0], [1, 0, 0, 0], [1, 0, 0, 0], [0, 0, 0, 0]])
+    np.testing.assert_array_equal(got, want)
+    # maxlen inferred from data
+    got2 = np.asarray(F.sequence_mask(np.array([[2], [3]])))
+    assert got2.shape == (2, 1, 3)
+    np.testing.assert_array_equal(got2[1, 0], [1, 1, 1])
+
+
+def test_grid_sample_grad():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(1, 2, 4, 4)).astype(np.float32))
+    grid = jnp.asarray(rng.uniform(-1, 1, size=(1, 3, 3, 2)).astype(np.float32))
+    g = jax.grad(lambda a, b: F.grid_sample(a, b).sum(), argnums=(0, 1))(x, grid)
+    tx = torch.from_numpy(np.asarray(x)).requires_grad_(True)
+    tg = torch.from_numpy(np.asarray(grid)).requires_grad_(True)
+    torch.nn.functional.grid_sample(tx, tg, align_corners=True).sum().backward()
+    np.testing.assert_allclose(np.asarray(g[0]), tx.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), tg.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)

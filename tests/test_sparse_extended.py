@@ -1,0 +1,156 @@
+"""Extended sparse namespace: CSR, unary/binary value ops, SDDMM,
+mask_as, reshape/slice, sparse.nn (ref: python/paddle/sparse)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sp
+
+
+def _coo_from_dense(d):
+    return sp.dense_to_coo(np.asarray(d))
+
+
+def test_csr_roundtrip_and_coo_conversion():
+    d = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    csr = sp.sparse_csr_tensor([0, 1, 3, 3], [1, 0, 2], [1, 2, 3], (3, 3))
+    np.testing.assert_array_equal(np.asarray(csr.to_dense()), d)
+    assert csr.nnz() == 3
+    coo = csr.to_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(coo.to_dense()), d)
+    back = sp.dense_to_csr(d)
+    np.testing.assert_array_equal(np.asarray(back.crows), [0, 1, 3, 3])
+    np.testing.assert_array_equal(np.asarray(back.cols), [1, 0, 2])
+
+
+def test_unary_ops_preserve_sparsity():
+    d = np.array([[0.0, 0.5], [-0.25, 0.0]], np.float32)
+    coo = _coo_from_dense(d)
+    for name in ['sin', 'tan', 'asin', 'atan', 'sinh', 'tanh', 'asinh',
+                 'square', 'expm1', 'neg', 'abs', 'deg2rad', 'rad2deg']:
+        got = getattr(sp, name)(coo)
+        want = getattr(np, {'asin': 'arcsin', 'atan': 'arctan',
+                            'asinh': 'arcsinh', 'neg': 'negative'
+                            }.get(name, name))(d)
+        np.testing.assert_allclose(np.asarray(got.to_dense()), want,
+                                   rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(sp.pow(coo, 2).to_dense()), d ** 2, rtol=1e-6)
+    assert sp.cast(coo, value_dtype='float16').dtype == jnp.float16
+    # sqrt/log1p on non-negative pattern
+    pos = _coo_from_dense(np.abs(d))
+    np.testing.assert_allclose(np.asarray(sp.sqrt(pos).to_dense()),
+                               np.sqrt(np.abs(d)), rtol=1e-6)
+    assert not bool(np.asarray(sp.isnan(pos).values).any())
+
+
+def test_binary_ops():
+    a = np.array([[1.0, 0], [0, 2.0]], np.float32)
+    b = np.array([[3.0, 0], [0, 4.0]], np.float32)
+    ca, cb = _coo_from_dense(a), _coo_from_dense(b)
+    np.testing.assert_array_equal(
+        np.asarray(sp.multiply(ca, cb).to_dense()), a * b)
+    np.testing.assert_array_equal(
+        np.asarray(sp.subtract(ca, cb).to_dense()), a - b)
+    np.testing.assert_allclose(
+        np.asarray(sp.divide(ca, cb).to_dense()), np.where(b != 0, a / np.where(b != 0, b, 1), 0), rtol=1e-6)
+    # mismatched patterns fall back to dense
+    c = np.array([[0, 5.0], [0, 0]], np.float32)
+    out = sp.subtract(ca, _coo_from_dense(c))
+    np.testing.assert_array_equal(np.asarray(out), a - c)
+
+
+def test_mv_addmm_masked_matmul():
+    rng = np.random.default_rng(0)
+    a = np.array([[1.0, 0, 2], [0, 3, 0]], np.float32)
+    coo = _coo_from_dense(a)
+    v = rng.normal(size=(3,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sp.mv(coo, v)), a @ v, rtol=1e-5)
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    inp = rng.normal(size=(2, 3)).astype(np.float32)
+    y = rng.normal(size=(3, 3)).astype(np.float32)
+    got = sp.addmm(_coo_from_dense(inp), _coo_from_dense(x), y, 0.5, 2.0)
+    np.testing.assert_allclose(np.asarray(got), 0.5 * inp + 2.0 * (x @ y),
+                               rtol=1e-5)
+    # SDDMM: values only where mask is nonzero
+    q = rng.normal(size=(2, 4)).astype(np.float32)
+    k = rng.normal(size=(4, 2)).astype(np.float32)
+    mask = _coo_from_dense(np.array([[1.0, 0], [1.0, 1.0]], np.float32))
+    got = sp.masked_matmul(q, k, mask)
+    full = q @ k
+    want = np.where(np.asarray(mask.to_dense()) != 0, full, 0)
+    np.testing.assert_allclose(np.asarray(got.to_dense()), want, rtol=1e-5)
+
+
+def test_mask_as_sum_reshape_slice():
+    d = np.arange(6, dtype=np.float32).reshape(2, 3)
+    pattern = _coo_from_dense(np.array([[1.0, 0, 1.0], [0, 0, 1.0]], np.float32))
+    masked = sp.mask_as(d, pattern)
+    want = np.where(np.asarray(pattern.to_dense()) != 0, d, 0)
+    np.testing.assert_array_equal(np.asarray(masked.to_dense()), want)
+    coo = _coo_from_dense(d)
+    assert float(np.asarray(sp.sum(coo))) == d.sum()
+    np.testing.assert_allclose(np.asarray(sp.to_dense(sp.sum(coo, axis=1))),
+                               d.sum(1))
+    r = sp.reshape(coo, (3, 2))
+    np.testing.assert_array_equal(np.asarray(r.to_dense()), d.reshape(3, 2))
+    r2 = sp.reshape(coo, (-1,))
+    np.testing.assert_array_equal(np.asarray(r2.to_dense()), d.ravel())
+    sl = sp.slice(coo, [1], [1], [3])
+    np.testing.assert_array_equal(np.asarray(sl.to_dense()), d[:, 1:3])
+    assert sp.is_same_shape(coo, coo) and not sp.is_same_shape(coo, r)
+
+
+def test_sparse_nn_activations_and_softmax():
+    import paddle_tpu.sparse.nn as snn
+
+    d = np.array([[0, -1.0, 2.0], [3.0, 0, -4.0]], np.float32)
+    coo = _coo_from_dense(d)
+    np.testing.assert_array_equal(
+        np.asarray(snn.ReLU()(coo).to_dense()), np.maximum(d, 0))
+    got6 = np.asarray(snn.ReLU6()(_coo_from_dense(d * 3)).to_dense())
+    np.testing.assert_array_equal(got6, np.clip(d * 3, 0, 6) * (d != 0))
+    lr = np.asarray(snn.LeakyReLU(0.1)(coo).to_dense())
+    np.testing.assert_allclose(lr, np.where(d >= 0, d, 0.1 * d), rtol=1e-6)
+
+    csr = sp.dense_to_csr(np.array([[1.0, 2.0, 0], [0, 0, 3.0]], np.float32))
+    sm = snn.Softmax()(csr)
+    vals = np.asarray(sm.values)
+    # row 0 has two nonzeros summing to 1; row 1 one nonzero == 1
+    np.testing.assert_allclose(vals[0] + vals[1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(vals[2], 1.0, rtol=1e-6)
+
+
+def test_sparse_subm_conv3d():
+    import paddle_tpu.sparse.nn as snn
+
+    rng = np.random.default_rng(1)
+    # (N, D, H, W, C) single active site in the middle
+    dense = np.zeros((1, 5, 5, 5, 2), np.float32)
+    dense[0, 2, 2, 2] = rng.normal(size=2)
+    dense[0, 1, 3, 2] = rng.normal(size=2)
+    coo = sp.nn._site_coo(jnp.asarray(dense))
+    conv = snn.SubmConv3D(2, 4, 3, padding=1)
+    out = conv(coo)
+    # submanifold: same active sites
+    np.testing.assert_array_equal(np.asarray(out.indices),
+                                  np.asarray(coo.indices))
+    want = conv._conv(jnp.asarray(dense))
+    np.testing.assert_allclose(np.asarray(out.values),
+                               np.asarray(want)[tuple(np.asarray(coo.indices))],
+                               rtol=1e-5)
+    bn = snn.BatchNorm(4)
+    normed = bn(out)
+    assert isinstance(normed, sp.SparseCooTensor)
+    pool = snn.MaxPool3D(2)
+    pooled = pool(sp.nn._site_coo(jnp.asarray(np.abs(dense))))
+    assert np.asarray(pooled.to_dense()).shape[1:4] == (2, 2, 2)
+
+
+def test_pca_lowrank_dense_fallback():
+    rng = np.random.default_rng(2)
+    d = rng.normal(size=(8, 5)).astype(np.float32)
+    d[np.abs(d) < 0.5] = 0
+    u, s, v = sp.pca_lowrank(_coo_from_dense(d), q=3)
+    assert np.asarray(u).shape == (8, 3) and np.asarray(s).shape == (3,)

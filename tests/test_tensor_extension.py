@@ -1,0 +1,274 @@
+"""Long-tail tensor ops (tensor/extension.py + random extras + framework
+compat) vs numpy/torch goldens."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+torch = pytest.importorskip('torch')
+
+
+def test_block_diag_and_stacks():
+    a = np.ones((2, 2)); b = np.full((1, 3), 2.0)
+    got = np.asarray(pt.block_diag([a, b]))
+    want = torch.block_diag(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+    np.testing.assert_array_equal(got, want)
+    xs = [np.arange(3.0), np.arange(3.0) + 1]
+    np.testing.assert_array_equal(np.asarray(pt.hstack(xs)), np.hstack(xs))
+    np.testing.assert_array_equal(np.asarray(pt.vstack(xs)), np.vstack(xs))
+    np.testing.assert_array_equal(np.asarray(pt.dstack(xs)), np.dstack(xs))
+    np.testing.assert_array_equal(np.asarray(pt.column_stack(xs)),
+                                  np.column_stack(xs))
+    np.testing.assert_array_equal(np.asarray(pt.row_stack(xs)), np.vstack(xs))
+
+
+def test_splits():
+    x = np.arange(7.0)
+    got = pt.tensor_split(x, 3)
+    want = np.array_split(x, 3)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), w)
+    m = np.arange(24.0).reshape(4, 6)
+    for g, w in zip(pt.hsplit(m, 2), np.hsplit(m, 2)):
+        np.testing.assert_array_equal(np.asarray(g), w)
+    for g, w in zip(pt.vsplit(m, 2), np.vsplit(m, 2)):
+        np.testing.assert_array_equal(np.asarray(g), w)
+    t = np.arange(24.0).reshape(2, 3, 4)
+    for g, w in zip(pt.dsplit(t, 2), np.dsplit(t, 2)):
+        np.testing.assert_array_equal(np.asarray(g), w)
+    parts = pt.unstack(t, axis=1)
+    assert len(parts) == 3 and np.asarray(parts[0]).shape == (2, 4)
+
+
+def test_atleast():
+    a, b = pt.atleast_2d(np.float32(5), np.arange(3.0))
+    assert np.asarray(a).shape == (1, 1) and np.asarray(b).shape == (1, 3)
+    assert np.asarray(pt.atleast_3d(np.arange(3.0))).shape == (1, 3, 1)
+    assert np.asarray(pt.atleast_1d(np.float32(2))).shape == (1,)
+
+
+@pytest.mark.parametrize('offset,dim1,dim2', [(0, -2, -1), (1, -2, -1),
+                                              (-1, 0, 2)])
+def test_diag_embed(offset, dim1, dim2):
+    x = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    got = np.asarray(pt.diag_embed(x, offset, dim1, dim2))
+    want = torch.diag_embed(torch.from_numpy(x), offset, dim1, dim2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_diagonal_scatter_select_slice_index_fill():
+    x = np.zeros((3, 3), np.float32)
+    got = np.asarray(pt.diagonal_scatter(x, np.ones(3, np.float32)))
+    np.testing.assert_array_equal(got, np.eye(3))
+    got2 = np.asarray(pt.select_scatter(np.zeros((2, 3), np.float32),
+                                        np.ones(3, np.float32), 0, 1))
+    np.testing.assert_array_equal(got2, [[0, 0, 0], [1, 1, 1]])
+    got3 = np.asarray(pt.slice_scatter(
+        np.zeros((4, 4), np.float32), np.ones((2, 4), np.float32),
+        axes=[0], starts=[1], ends=[3], strides=[1]))
+    assert got3.sum() == 8 and got3[1:3].all()
+    got4 = np.asarray(pt.index_fill(np.zeros((3, 3), np.float32),
+                                    np.array([0, 2]), 0, 7.0))
+    np.testing.assert_array_equal(got4[[0, 2]], np.full((2, 3), 7.0))
+    assert got4[1].sum() == 0
+
+
+def test_take_modes():
+    x = np.arange(12.0).reshape(3, 4)
+    idx = np.array([[0, 13], [-2, 5]])
+    np.testing.assert_array_equal(
+        np.asarray(pt.take(x, idx, mode='wrap')),
+        np.take(x, idx, mode='wrap'))
+    np.testing.assert_array_equal(
+        np.asarray(pt.take(x, np.array([0, 5, 11]))),
+        [0.0, 5.0, 11.0])
+    # negative indices count from the end (paddle semantics)
+    np.testing.assert_array_equal(np.asarray(pt.take(x, np.array([-1]))),
+                                  [11.0])
+
+
+def test_unfold_unflatten_view_as_reverse():
+    x = np.arange(9.0)
+    got = np.asarray(pt.unfold(x, 0, 2, 4))
+    want = torch.from_numpy(x).unfold(0, 2, 4).numpy()
+    np.testing.assert_array_equal(got, want)
+    m = np.arange(24.0).reshape(4, 6)
+    got2 = np.asarray(pt.unfold(m, 1, 3, 2))
+    want2 = torch.from_numpy(m).unfold(1, 3, 2).numpy()
+    np.testing.assert_array_equal(got2, want2)
+    assert np.asarray(pt.unflatten(m, 1, (2, 3))).shape == (4, 2, 3)
+    assert np.asarray(pt.view_as(m, np.zeros((2, 12)))).shape == (2, 12)
+    np.testing.assert_array_equal(np.asarray(pt.reverse(x, 0)), x[::-1])
+
+
+def test_complex_views():
+    x = np.random.default_rng(1).normal(size=(3, 2)).astype(np.float32)
+    c = np.asarray(pt.as_complex(x))
+    np.testing.assert_allclose(c.real, x[:, 0])
+    np.testing.assert_allclose(c.imag, x[:, 1])
+    back = np.asarray(pt.as_real(c))
+    np.testing.assert_allclose(back, x)
+    assert pt.isreal(np.array([1.0])).all()
+
+
+def test_cartesian_prod_combinations():
+    a, b = np.array([1, 2]), np.array([3, 4, 5])
+    got = np.asarray(pt.cartesian_prod([a, b]))
+    want = torch.cartesian_prod(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+    np.testing.assert_array_equal(got, want)
+    x = np.array([1, 2, 3, 4])
+    got2 = np.asarray(pt.combinations(x, 2))
+    want2 = torch.combinations(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_array_equal(got2, want2)
+    got3 = np.asarray(pt.combinations(x, 2, with_replacement=True))
+    want3 = torch.combinations(torch.from_numpy(x), 2,
+                               with_replacement=True).numpy()
+    np.testing.assert_array_equal(got3, want3)
+
+
+def test_math_long_tail():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    y = rng.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pt.logaddexp(x, y)),
+                               np.logaddexp(x, y), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.floor_mod(x, 2.0)),
+                               np.mod(x, 2.0), rtol=1e-5)
+    assert pt.isposinf(np.array([np.inf]))[0] and pt.isneginf(np.array([-np.inf]))[0]
+    np.testing.assert_array_equal(np.asarray(pt.isin(np.array([1, 2, 3]),
+                                                     np.array([2]))),
+                                  [False, True, False])
+    np.testing.assert_array_equal(np.asarray(pt.signbit(np.array([-1.0, 2.0]))),
+                                  [True, False])
+    np.testing.assert_allclose(np.asarray(pt.sinc(x)), np.sinc(x), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.add_n([x, y, x])), x + y + x,
+                               rtol=1e-6)
+    xn = x.copy(); xn[0, 0] = np.nan
+    np.testing.assert_allclose(np.asarray(pt.nanmedian(xn)),
+                               np.nanmedian(xn), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.nanquantile(xn, 0.3)),
+                               np.nanquantile(xn, 0.3), rtol=1e-5)
+
+
+def test_sgn_complex_and_real():
+    z = np.array([3 + 4j, 0j], np.complex64)
+    got = np.asarray(pt.sgn(z))
+    np.testing.assert_allclose(got, [0.6 + 0.8j, 0], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pt.sgn(np.array([-2.0, 5.0]))),
+                                  [-1.0, 1.0])
+
+
+def test_renorm_reduce_as_pdist():
+    x = np.random.default_rng(3).normal(size=(3, 4, 5)).astype(np.float32)
+    got = np.asarray(pt.renorm(x, 2.0, 0, 1.0))
+    want = torch.renorm(torch.from_numpy(x), 2.0, 0, 1.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    big = np.random.default_rng(4).normal(size=(2, 3, 4)).astype(np.float32)
+    tgt = np.zeros((1, 3, 1), np.float32)
+    np.testing.assert_allclose(np.asarray(pt.reduce_as(big, tgt)),
+                               big.sum((0, 2), keepdims=True)[..., :],
+                               rtol=1e-5)
+    pts = np.random.default_rng(5).normal(size=(5, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pt.pdist(pts)),
+        torch.nn.functional.pdist(torch.from_numpy(pts)).numpy(), rtol=1e-4)
+
+
+def test_trapezoid_vander_frexp():
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(float(pt.trapezoid(y)), np.trapezoid(y))
+    np.testing.assert_allclose(
+        np.asarray(pt.cumulative_trapezoid(y)),
+        torch.cumulative_trapezoid(torch.from_numpy(y)).numpy(), rtol=1e-6)
+    x = np.array([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(pt.vander(x, 3)), np.vander(x, 3))
+    m, e = pt.frexp(np.array([8.0, 0.5]))
+    np.testing.assert_allclose(np.asarray(m) * 2.0 ** np.asarray(e),
+                               [8.0, 0.5])
+
+
+def test_bit_shifts():
+    x = np.array([16, -16], np.int32)
+    np.testing.assert_array_equal(np.asarray(pt.bitwise_left_shift(x, 2)),
+                                  x << 2)
+    np.testing.assert_array_equal(np.asarray(pt.bitwise_right_shift(x, 2)),
+                                  x >> 2)
+    logical = np.asarray(pt.bitwise_right_shift(x, 2, is_arithmetic=False))
+    assert logical[0] == 4 and logical[1] == (np.uint32(-16 & 0xFFFFFFFF) >> 2).astype(np.int32)
+
+
+def test_special_functions():
+    from scipy import special as sp
+    x = np.array([0.5, 1.5, 3.0], np.float32)
+    np.testing.assert_allclose(np.asarray(pt.gammaln(x)), sp.gammaln(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.gammainc(x, x)), sp.gammainc(x, x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.gammaincc(x, x)), sp.gammaincc(x, x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.multigammaln(np.array([5.0]), 2)),
+                               sp.multigammaln(5.0, 2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.i0e(x)), sp.i0e(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.i1(x)), sp.i1(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.i1e(x)), sp.i1e(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.polygamma(x, 1)),
+                               sp.polygamma(1, x), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pt.polygamma(x, 0)),
+                               sp.digamma(x), rtol=1e-5)
+
+
+def test_histogram_helpers():
+    x = np.random.default_rng(6).normal(size=100).astype(np.float32)
+    edges = np.asarray(pt.histogram_bin_edges(x, bins=10))
+    assert edges.shape == (11,)
+    np.testing.assert_allclose(edges[0], x.min(), rtol=1e-5)
+    pts = np.random.default_rng(7).normal(size=(50, 2)).astype(np.float32)
+    hist, e = pt.histogramdd(pts, bins=4)
+    assert np.asarray(hist).shape == (4, 4)
+    assert float(np.asarray(hist).sum()) == 50
+
+
+def test_random_extras_and_inplace_aliases():
+    pt.seed(11)
+    draws = np.asarray(pt.binomial(np.full((2000,), 10), np.full((2000,), 0.5)))
+    assert 4.5 < draws.mean() < 5.5 and draws.max() <= 10 and draws.min() >= 0
+    ln = np.asarray(pt.log_normal(0.0, 0.25, (2000,)))
+    assert (ln > 0).all()
+    c = pt.cauchy_(np.zeros(64, np.float32))
+    g = pt.geometric_(np.zeros((2000,), np.float32), 0.5)
+    assert np.asarray(g).min() >= 1 and 1.5 < np.asarray(g).mean() < 2.5
+    assert np.asarray(c).shape == (64,)
+    # aliases
+    assert pt.tanh_ is pt.tanh
+    np.testing.assert_allclose(np.asarray(pt.sqrt_(np.array([4.0]))), [2.0])
+
+
+def test_framework_compat():
+    assert pt.in_dynamic_mode()
+    pt.enable_static()
+    assert not pt.in_dynamic_mode()
+    pt.disable_static()
+    assert pt.in_dynamic_mode()
+    with pt.LazyGuard():
+        pass
+    pa = pt.ParamAttr(initializer=None, learning_rate=0.5)
+    assert pa.learning_rate == 0.5
+    p = pt.create_parameter([3, 4], 'float32')
+    assert tuple(p.value.shape) == (3, 4)
+    reader = pt.batch(lambda: iter(range(7)), 3)
+    assert [len(b) for b in reader()] == [3, 3, 1]
+    assert [len(b) for b in pt.batch(lambda: iter(range(7)), 3,
+                                     drop_last=True)()] == [3, 3]
+    state = pt.get_cuda_rng_state()
+    pt.set_cuda_rng_state(state)
+    with pt.set_grad_enabled(False):
+        assert not pt.is_grad_enabled()
+    assert pt.is_grad_enabled()
+    assert pt.rank(np.zeros((2, 3))) == 2
+    np.testing.assert_array_equal(np.asarray(pt.shape(np.zeros((2, 3)))),
+                                  [2, 3])
+    assert pt.tolist(np.array([1, 2])) == [1, 2]
+    assert pt.bool is not None and pt.dtype is not None
+    pt.set_printoptions(precision=4)
+    pt.disable_signal_handler()
+    pt.check_shape([1, None, 3])
+    with pytest.raises(TypeError):
+        pt.check_shape([1, 'x'])

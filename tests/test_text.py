@@ -1,0 +1,122 @@
+"""paddle_tpu.text: viterbi_decode vs brute force; dataset stubs;
+distributed.recompute grad equivalence."""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import Imdb, Imikolov, UCIHousing, ViterbiDecoder, viterbi_decode
+
+
+def _brute_force(potentials, trans, lengths, bos_eos):
+    """Enumerate every tag sequence; return best scores and paths."""
+    b, t, n = potentials.shape
+    start, stop = n - 1, n - 2
+    scores, paths = [], []
+    for i in range(b):
+        best, best_path = -np.inf, None
+        L = int(lengths[i])
+        for seq in itertools.product(range(n), repeat=L):
+            s = potentials[i, 0, seq[0]]
+            if bos_eos:
+                s += trans[start, seq[0]]
+            for j in range(1, L):
+                s += trans[seq[j - 1], seq[j]] + potentials[i, j, seq[j]]
+            if bos_eos:
+                s += trans[seq[-1], stop]
+            if s > best:
+                best, best_path = s, seq
+        scores.append(best)
+        paths.append(list(best_path) + [0] * (int(lengths.max()) - L))
+    return np.asarray(scores), np.asarray(paths)
+
+
+@pytest.mark.parametrize('bos_eos', [True, False])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.default_rng(0)
+    b, t, n = 3, 4, 4
+    pots = rng.normal(size=(b, t, n)).astype(np.float32)
+    trans = rng.normal(size=(n, n)).astype(np.float32)
+    lengths = np.array([4, 2, 3], np.int64)
+    scores, paths = viterbi_decode(pots, trans, lengths, bos_eos)
+    want_s, want_p = _brute_force(pots, trans, lengths, bos_eos)
+    np.testing.assert_allclose(np.asarray(scores), want_s, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(paths), want_p)
+
+
+def test_viterbi_decoder_class_and_jit():
+    rng = np.random.default_rng(1)
+    pots = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    trans = rng.normal(size=(3, 3)).astype(np.float32)
+    lengths = np.array([5, 5], np.int64)
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+    s1, p1 = dec(pots, lengths)
+    s2, p2 = jax.jit(lambda p, l: viterbi_decode(p, trans, l, False))(
+        jnp.asarray(pots), jnp.asarray(lengths))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def test_viterbi_seq_len_one():
+    pots = np.array([[[0.5, 2.0, 0.1]]], np.float32)
+    trans = np.zeros((3, 3), np.float32)
+    s, p = viterbi_decode(pots, trans, np.array([1]), False)
+    assert float(s[0]) == pytest.approx(2.0)
+    assert int(p[0, 0]) == 1
+
+
+def test_text_datasets_offline():
+    train = UCIHousing(mode='train')
+    test = UCIHousing(mode='test')
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(train) + len(test) == 506
+
+    imdb = Imdb(mode='train', size=32)
+    doc, lab = imdb[0]
+    assert doc.dtype == np.int64 and lab in (0, 1)
+    assert len(imdb) == 32
+
+    ng = Imikolov(data_type='NGRAM', window_size=5, size=16)
+    assert ng[0].shape == (5,)
+
+
+def test_recompute_grad_equivalence():
+    from paddle_tpu.distributed import recompute, recompute_sequential
+
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(8, 8)),
+                    jnp.float32)
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def f(w):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(recompute(lambda a: jnp.tanh(a @ w), h,
+                                 policy='dots'))
+
+    def f_plain(w):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(jnp.tanh(h @ w))
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(w)),
+                               np.asarray(jax.grad(f_plain)(w)), rtol=1e-5)
+
+    fns = [lambda a: jnp.tanh(a @ w), lambda a: a * 2, lambda a: a + 1]
+    want = fns[2](fns[1](fns[0](x)))
+    for segments in (1, 2, 3):
+        got = recompute_sequential({'segments': segments}, fns, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+
+def test_recompute_bad_policy():
+    from paddle_tpu.distributed import recompute
+    with pytest.raises(ValueError):
+        recompute(lambda a: a, jnp.ones(3), policy='not-a-policy')
+
+
+def test_text_namespace_export():
+    assert hasattr(pt, 'text')
+    assert pt.text.viterbi_decode is viterbi_decode
