@@ -217,13 +217,17 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
 
     def place_params(lyr, prefix=''):
         if shard_fn is not None:
+            # the user's shard_fn assigns placements itself (the
+            # reference's contract) — do NOT re-place afterwards, that
+            # would clobber its shardings with replication
             shard_fn(prefix.rstrip('.'), lyr, process_mesh)
         for name, value in list(getattr(lyr, '__dict__', {}).items()):
             from ..nn.layer.base import Layer
 
             if isinstance(value, Layer):
                 place_params(value, f'{prefix}{name}.')
-            elif name in getattr(lyr, '_param_meta', {}):
+            elif (shard_fn is None
+                  and name in getattr(lyr, '_param_meta', {})):
                 lyr.__dict__[name] = jax.device_put(
                     value, NamedSharding(jm, P()))
         return lyr

@@ -191,14 +191,18 @@ def send(tensor, dst=0, group=None, sync_op=True):
     returns the value that the (src -> dst) ring shift delivers. Use
     `collective.send_recv` / `ppermute` for pipeline exchanges."""
     shift = dst - get_rank()
-    return collective.send_recv(tensor, group=_axis(group) or 'pp',
+    axis = getattr(group, 'axis', None) or (group if isinstance(group, str)
+                                            else 'pp')
+    return collective.send_recv(tensor, group=axis,
                                 shift=shift if shift else 1)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     """ref: paddle.distributed.recv — see `send`."""
     shift = get_rank() - src
-    return collective.send_recv(tensor, group=_axis(group) or 'pp',
+    axis = getattr(group, 'axis', None) or (group if isinstance(group, str)
+                                            else 'pp')
+    return collective.send_recv(tensor, group=axis,
                                 shift=shift if shift else 1)
 
 

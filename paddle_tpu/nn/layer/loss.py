@@ -281,24 +281,30 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
             (in_features, shortlist + n_clusters))
         self.head_bias = (self.create_parameter(
             (shortlist + n_clusters,), is_bias=True) if head_bias else None)
-        self.tail_weights = []
+        # tails live ONLY as registered tail_proj_i/tail_out_i attributes
+        # (a plain-list copy would land in the pytree's static aux as
+        # unhashable arrays and break treedef equality under jit)
         for i in range(n_clusters):
             hsz = max(1, int(in_features // (div_value ** (i + 1))))
             osz = self.cutoffs[i + 1] - self.cutoffs[i]
-            proj = self.create_parameter((in_features, hsz))
-            out = self.create_parameter((hsz, osz))
-            self.add_parameter(f'tail_proj_{i}', proj)
-            self.add_parameter(f'tail_out_{i}', out)
-            self.tail_weights.append([proj, out])
+            self.add_parameter(f'tail_proj_{i}',
+                               self.create_parameter((in_features, hsz)))
+            self.add_parameter(f'tail_out_{i}',
+                               self.create_parameter((hsz, osz)))
 
     def _tails(self):
         # read through the registered attributes so jit/pytree updates
-        # (which rebind attributes, not the cached list) are respected
+        # (which rebind attributes, not a cached list) are respected
         out = []
         for i in range(len(self.cutoffs) - 1):
             out.append([getattr(self, f'tail_proj_{i}'),
                         getattr(self, f'tail_out_{i}')])
         return out
+
+    @property
+    def tail_weights(self):
+        """Reference-compatible view of the tail cluster parameters."""
+        return self._tails()
 
     def forward(self, input, label):
         return F.adaptive_log_softmax_with_loss(

@@ -76,6 +76,12 @@ class BatchNorm(Layer):
         if self.training:
             mean = jnp.mean(vals, axis=0)
             var = jnp.var(vals, axis=0)
+            # fold into the running stats like the dense BatchNorm
+            m = self.momentum
+            object.__setattr__(self, '_mean',
+                               m * self._mean + (1 - m) * mean)
+            object.__setattr__(self, '_variance',
+                               m * self._variance + (1 - m) * var)
         else:
             mean, var = self._mean, self._variance
         out = ((vals - mean) / jnp.sqrt(var + self.epsilon)

@@ -141,3 +141,42 @@ def test_alltoall_under_shard_map():
     np.testing.assert_array_equal(out, want)
     with pytest.raises(NotImplementedError):
         dist.alltoall_single(x, in_split_sizes=[1, 2, 3, 10])
+
+
+def test_shard_layer_respects_user_shard_fn(pmesh):
+    """A shard_fn's placements must survive (no replication clobber)."""
+    placed_specs = {}
+
+    def shard_fn(name, layer, mesh):
+        if hasattr(layer, 'weight') and layer.weight is not None \
+                and getattr(layer.weight, 'ndim', 0) == 2:
+            layer.weight = dist.shard_tensor(
+                layer.weight, mesh, [dist.Replicate(), dist.Shard(1)])
+            placed_specs[name] = layer.weight.sharding.spec
+
+    layer = pt.nn.Linear(8, 8)
+    out = dist.shard_layer(layer, pmesh, shard_fn=shard_fn)
+    assert placed_specs, 'shard_fn ran'
+    # Shard(1) on mesh dim 1 ('y') -> tensor dim 1 split over 'y'
+    assert out.weight.sharding.spec == P(None, 'y'), \
+        'user placement was clobbered'
+
+
+def test_send_recv_default_rides_pp_axis():
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ('pp',))
+    x = jnp.arange(4.0)
+
+    @partial(shard_map, mesh=mesh, in_specs=P('pp'), out_specs=P('pp'),
+             check_rep=False)
+    def ring(v):
+        return dist.send(v, dst=1)      # group=None -> 'pp' axis
+
+    out = np.asarray(ring(x))
+    assert not np.array_equal(out, np.asarray(x)), \
+        'default send must actually shift over pp'

@@ -426,3 +426,20 @@ def test_flash_attn_varlen_return_softmax():
     out2, _ = F.flash_attn_varlen_qkvpacked(qkv, cu, cu, 5, 5,
                                             scale=1.0 / np.sqrt(8))
     np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+def test_adaptive_log_softmax_layer_under_jit_twice():
+    # the tail parameters must flatten as pytree leaves, not static aux
+    layer = nn.AdaptiveLogSoftmaxWithLoss(8, 20, [4, 12], div_value=2.0)
+    x = jnp.ones((3, 8))
+    y = jnp.asarray([1, 6, 15])
+
+    @jax.jit
+    def f(m, a, b):
+        out, loss = m(a, b)
+        return loss
+
+    l1 = float(f(layer, x, y))
+    l2 = float(f(layer, x, y))   # second call: jit cache lookup must work
+    assert np.isfinite(l1) and l1 == l2
+    assert isinstance(layer.tail_weights, list)  # reference-compatible view

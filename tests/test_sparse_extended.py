@@ -154,3 +154,23 @@ def test_pca_lowrank_dense_fallback():
     d[np.abs(d) < 0.5] = 0
     u, s, v = sp.pca_lowrank(_coo_from_dense(d), q=3)
     assert np.asarray(u).shape == (8, 3) and np.asarray(s).shape == (3,)
+
+
+def test_sparse_batchnorm_running_stats():
+    import paddle_tpu.sparse.nn as snn
+
+    rng = np.random.default_rng(6)
+    bn = snn.BatchNorm(3, momentum=0.5)
+    # site-based COO: values carry the channel vector (nnz, C)
+    d = np.zeros((1, 2, 2, 1, 3), np.float32)
+    d[0, :, :, 0, :] = rng.normal(loc=5.0, scale=2.0, size=(2, 2, 3))
+    coo = sp.nn._site_coo(jnp.asarray(d))
+    bn.train()
+    for _ in range(8):
+        bn(coo)
+    # running mean moved toward the data mean (~5), variance toward ~4
+    assert float(np.asarray(bn._mean).mean()) > 2.0
+    bn.eval()
+    out = bn(coo)
+    # eval uses the learned stats: output roughly standardized
+    assert abs(float(np.asarray(out.values).mean())) < 2.0
