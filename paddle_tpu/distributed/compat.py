@@ -154,12 +154,12 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return out
 
 
-def _axis(group):
+def _axis(group, default='dp'):
     if group is None:
-        return 'dp'
+        return default
     if isinstance(group, str):
         return group
-    return getattr(group, 'axis', None) or 'dp'
+    return getattr(group, 'axis', None) or default
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -191,18 +191,14 @@ def send(tensor, dst=0, group=None, sync_op=True):
     returns the value that the (src -> dst) ring shift delivers. Use
     `collective.send_recv` / `ppermute` for pipeline exchanges."""
     shift = dst - get_rank()
-    axis = getattr(group, 'axis', None) or (group if isinstance(group, str)
-                                            else 'pp')
-    return collective.send_recv(tensor, group=axis,
+    return collective.send_recv(tensor, group=_axis(group, 'pp'),
                                 shift=shift if shift else 1)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     """ref: paddle.distributed.recv — see `send`."""
     shift = get_rank() - src
-    axis = getattr(group, 'axis', None) or (group if isinstance(group, str)
-                                            else 'pp')
-    return collective.send_recv(tensor, group=axis,
+    return collective.send_recv(tensor, group=_axis(group, 'pp'),
                                 shift=shift if shift else 1)
 
 

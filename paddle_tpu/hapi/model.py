@@ -194,9 +194,12 @@ class Model:
         for m in self._metrics:
             names = m.name()
             if isinstance(names, list):
-                for n in names:
-                    logs[n] = float(np.asarray(vals[i]).reshape(-1)[0])
-                    i += 1
+                # one accumulated array per metric: component j belongs
+                # to name j (e.g. Accuracy(topk=(1, 5)) -> 2 entries)
+                v = np.asarray(vals[i]).reshape(-1)
+                for j, n in enumerate(names):
+                    logs[n] = float(v[j])
+                i += 1
             else:
                 v = vals[i]
                 logs[names] = float(np.asarray(v).reshape(-1)[0])

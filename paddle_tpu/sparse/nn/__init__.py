@@ -76,12 +76,15 @@ class BatchNorm(Layer):
         if self.training:
             mean = jnp.mean(vals, axis=0)
             var = jnp.var(vals, axis=0)
-            # fold into the running stats like the dense BatchNorm
+            # fold into the running stats like the dense BatchNorm —
+            # including its unbiased-variance correction (norm.py)
+            n = vals.shape[0]
+            unbiased = var * n / max(n - 1, 1)
             m = self.momentum
             object.__setattr__(self, '_mean',
                                m * self._mean + (1 - m) * mean)
             object.__setattr__(self, '_variance',
-                               m * self._variance + (1 - m) * var)
+                               m * self._variance + (1 - m) * unbiased)
         else:
             mean, var = self._mean, self._variance
         out = ((vals - mean) / jnp.sqrt(var + self.epsilon)

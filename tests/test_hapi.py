@@ -87,3 +87,25 @@ class TestMetrics:
         labels = np.asarray([1, 1, 0, 0])
         auc.update(preds, labels)
         assert auc.accumulate() > 0.99
+
+
+def test_fit_with_multi_topk_accuracy():
+    """Accuracy(topk=(1, 5)) logs one entry per k (regression: the log
+    builder used to read one vals slot per name and ran off the end)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Flatten(), pt.nn.Linear(16, 8))
+    model = Model(net)
+    model.prepare(pt.optimizer.SGD(learning_rate=0.1),
+                  pt.nn.CrossEntropyLoss(), Accuracy(topk=(1, 5)))
+    x = np.random.default_rng(0).normal(size=(32, 16)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 8, (32, 1))
+    out = model.train_batch(x, y)
+    logs = model._logs(out)
+    assert 'acc_top1' in logs and 'acc_top5' in logs
+    assert 0 <= logs['acc_top1'] <= logs['acc_top5'] <= 1
