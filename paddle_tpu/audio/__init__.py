@@ -1,7 +1,10 @@
 """paddle_tpu.audio (ref: python/paddle/audio) — feature extraction
-(Spectrogram/Mel/LogMel/MFCC) + functional helpers over jnp/signal.stft.
-Backends/datasets (file IO, download) are out of scope per SURVEY §6.
+(Spectrogram/Mel/LogMel/MFCC) + functional helpers over jnp/signal.stft,
+stdlib-wave file IO (load/save/info), and download-free datasets.
 """
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

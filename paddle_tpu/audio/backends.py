@@ -1,0 +1,102 @@
+"""Audio file IO (ref: python/paddle/audio/backends — wave_backend).
+
+The reference's default backend decodes PCM WAV with the stdlib `wave`
+module (soundfile being optional); this implements exactly that, so
+`load/save/info` work with no extra dependency. No downloads here —
+datasets read local files (SURVEY §6 scope).
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+
+class AudioInfo:
+    """ref: paddle.audio.backends.backend.AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding='PCM_S'):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f'AudioInfo(sample_rate={self.sample_rate}, '
+                f'num_samples={self.num_samples}, '
+                f'num_channels={self.num_channels}, '
+                f'bits_per_sample={self.bits_per_sample})')
+
+
+def info(filepath):
+    """ref: paddle.audio.info — WAV header metadata."""
+    with _wave.open(str(filepath), 'rb') as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """ref: paddle.audio.load — (waveform, sample_rate). normalize=True
+    scales int PCM to [-1, 1] float32; channels_first gives (C, T)."""
+    with _wave.open(str(filepath), 'rb') as f:
+        sr = f.getframerate()
+        n_ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+    if width == 1:  # 8-bit WAV is unsigned
+        data = data.astype(np.int16) - 128
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+    if normalize:
+        wavf = (data.astype(np.float32) / scale)
+    else:
+        wavf = data
+    if channels_first:
+        wavf = wavf.T
+    import jax.numpy as jnp
+
+    return jnp.asarray(wavf), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding='PCM_S', bits_per_sample=16):
+    """ref: paddle.audio.save — float waveform in [-1, 1] -> PCM WAV."""
+    arr = np.asarray(src)
+    if channels_first:
+        arr = arr.T                           # -> (T, C)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    width = bits_per_sample // 8
+    if width not in (2, 4):
+        raise ValueError('bits_per_sample must be 16 or 32')
+    scale = 2 ** (bits_per_sample - 1) - 1
+    pcm = np.clip(arr, -1.0, 1.0) * scale
+    pcm = pcm.astype(np.int16 if width == 2 else np.int32)
+    with _wave.open(str(filepath), 'wb') as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())
+
+
+def list_available_backends():
+    return ['wave_backend']
+
+
+def get_current_backend():
+    return 'wave_backend'
+
+
+def set_backend(backend_name):
+    if backend_name != 'wave_backend':
+        raise NotImplementedError(
+            'only the stdlib wave backend ships here (soundfile is an '
+            'optional extra in the reference too)')

@@ -1,0 +1,97 @@
+"""Audio datasets (ref: python/paddle/audio/datasets — TESS, ESC50).
+
+Download-free like vision/text datasets: read local archives when a
+path is given, deterministic synthetic audio otherwise.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticAudio(Dataset):
+    n_classes = 2
+    sample_rate = 16000
+
+    def __init__(self, mode='train', feat_type='raw', archive_dir=None,
+                 size=64, duration=0.5, seed=0, **feat_kwargs):
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        if archive_dir is not None:
+            self._load_local(archive_dir, mode)
+            return
+        rng = np.random.default_rng(seed if mode == 'train' else seed + 1)
+        t = int(self.sample_rate * duration)
+        self.labels = rng.integers(0, self.n_classes, size).astype(np.int64)
+        freqs = 220.0 * (1 + self.labels)
+        ts = np.arange(t) / self.sample_rate
+        self.waves = (np.sin(2 * np.pi * freqs[:, None] * ts[None])
+                      + 0.05 * rng.normal(size=(size, t))).astype(np.float32)
+
+    def _label_of(self, filename):
+        """Class id from the dataset's filename convention (ESC50:
+        '<fold>-<id>-<take>-<target>.wav'; TESS: emotion word)."""
+        stem = os.path.splitext(os.path.basename(filename))[0]
+        last = stem.split('-')[-1].split('_')[-1]
+        if last.isdigit():
+            return int(last) % self.n_classes
+        return abs(hash(last)) % self.n_classes
+
+    def _load_local(self, archive_dir, mode):
+        from .backends import load as load_wav
+
+        files = sorted(
+            os.path.join(root, f)
+            for root, _, names in os.walk(archive_dir)
+            for f in names if f.lower().endswith('.wav'))
+        if not files:
+            raise FileNotFoundError(
+                f'no .wav files under {archive_dir!r}')
+        waves, labels, max_t = [], [], 0
+        for f in files:
+            wav, _ = load_wav(f, channels_first=True)
+            mono = np.asarray(wav).mean(0)
+            waves.append(mono.astype(np.float32))
+            labels.append(self._label_of(f))
+            max_t = max(max_t, mono.shape[0])
+        self.waves = np.stack([np.pad(w, (0, max_t - len(w)))
+                               for w in waves])
+        self.labels = np.asarray(labels, np.int64)
+
+    def _features(self, wav):
+        if self.feat_type == 'raw':
+            return wav
+        from . import features as F
+
+        cls = {'spectrogram': F.Spectrogram,
+               'melspectrogram': F.MelSpectrogram,
+               'logmelspectrogram': F.LogMelSpectrogram,
+               'mfcc': F.MFCC}[self.feat_type]
+        kwargs = dict(self.feat_kwargs)
+        if self.feat_type != 'spectrogram':
+            kwargs.setdefault('sr', self.sample_rate)  # Spectrogram has no sr
+        return np.asarray(cls(**kwargs)(wav[None])[0])
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self._features(self.waves[i]), self.labels[i]
+
+
+class TESS(_SyntheticAudio):
+    """ref: paddle.audio.datasets.TESS (speech emotion, 7 classes)."""
+
+    n_classes = 7
+    sample_rate = 24414
+
+
+class ESC50(_SyntheticAudio):
+    """ref: paddle.audio.datasets.ESC50 (environmental sounds, 50
+    classes)."""
+
+    n_classes = 50
+    sample_rate = 44100

@@ -168,3 +168,149 @@ class EarlyStopping(Callback):
                 self.stopped = True
                 if self.model is not None:
                     self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """ref: paddle.callbacks.ReduceLROnPlateau — shrink the lr when a
+    monitored metric stops improving."""
+
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == 'auto':
+            mode = 'min' if 'loss' in monitor or 'err' in monitor else 'max'
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+        self._saw_eval = False
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == 'min':
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        # once eval logs exist they are the single metric stream; the
+        # epoch hook stands down (hooking both would double-count
+        # patience and mix train/eval values for the same monitor key)
+        self._saw_eval = True
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self._saw_eval:
+            self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(logs[self.monitor])
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, '_optimizer', None)
+            if opt is None:
+                return
+            lr = opt._lr
+            if callable(lr):
+                # evaluate the schedule at the CURRENT step, not step 0 —
+                # replacing a decayed schedule with lr(0)*factor could
+                # INCREASE the rate late in training
+                state = getattr(opt, 'state', None)
+                step = (int(state['step']) if isinstance(state, dict)
+                        and 'step' in state else 0)
+                cur_lr = float(lr(step))
+            else:
+                cur_lr = float(lr)
+            new_lr = max(cur_lr * self.factor, self.min_lr)
+            if new_lr < cur_lr:
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f'ReduceLROnPlateau: lr -> {new_lr:.3e}')
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """ref: paddle.callbacks.VisualDL — metric scalars to a log dir.
+    The visualdl package is CUDA-ecosystem tooling not shipped here;
+    scalars land in a JSONL file a notebook (or TensorBoard via
+    jax.profiler traces) can plot."""
+
+    def __init__(self, log_dir='./vdl_log'):
+        import os
+
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = None
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+
+        if self._f is None:
+            self._f = open(os.path.join(self.log_dir, 'scalars.jsonl'), 'a')
+        for k, v in (logs or {}).items():
+            try:
+                self._f.write(json.dumps(
+                    {'tag': f'{tag}/{k}', 'step': self._step,
+                     'value': float(v)}) + '\n')
+            except (TypeError, ValueError):
+                continue
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write('train', logs)
+
+    def on_eval_end(self, logs=None):
+        self._write('eval', logs)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class WandbCallback(Callback):
+    """ref: paddle.callbacks.WandbCallback — requires the wandb package
+    (not shipped); degrades to the VisualDL JSONL logger."""
+
+    def __init__(self, project=None, dir=None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+
+            self._wandb = wandb
+            self._run = wandb.init(project=project, dir=dir, **kwargs)
+        except ImportError:
+            self._wandb = None
+            self._fallback = VisualDL(log_dir=dir or './wandb_log')
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb:
+            self._wandb.log(dict(logs or {}), step=step)
+        else:
+            self._fallback.model = getattr(self, 'model', None)
+            self._fallback.on_train_batch_end(step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._wandb:
+            self._run.finish()
+        else:
+            self._fallback.on_train_end(logs)

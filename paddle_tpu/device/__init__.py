@@ -134,3 +134,135 @@ def synchronize():
     import jax.numpy as jnp
 
     jnp.zeros(()).block_until_ready()
+
+
+# ---- feature probes & stream compat (ref: python/paddle/device) -----------
+# CUDA/ROCm/IPU/CINN probes answer honestly for a TPU/XLA build; the
+# stream API maps onto XLA's implicit async dispatch (one compute stream
+# per device, synchronization via block_until_ready).
+
+
+def get_cudnn_version():
+    """ref: paddle.device.get_cudnn_version — None: no cuDNN here."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """CINN's role (graph compilation) is played by XLA, but the CINN
+    binary itself is not present."""
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    """Distributed is always available (XLA collectives are built in)."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+IPUPlace = CPUPlace  # accepted for script compat; degrades to host
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {'cpu'})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+
+    return [f'{d.platform}:{d.id}' for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+class Stream:
+    """ref: paddle.device.Stream. XLA runs one ordered async compute
+    stream per device; this object names it for API compatibility and
+    `synchronize` drains it."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self):
+        return True
+
+
+class Event:
+    """ref: paddle.device.Event — completion marker on the XLA stream."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = [None]
+
+
+def current_stream(device=None):
+    if _current_stream[0] is None:
+        _current_stream[0] = Stream(device)
+    return _current_stream[0]
+
+
+def set_stream(stream):
+    prev = current_stream()
+    _current_stream[0] = stream
+    return prev
+
+
+class stream_guard:
+    """ref: paddle.device.stream_guard — context switching the current
+    stream (a no-op ordering-wise: XLA keeps program order)."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

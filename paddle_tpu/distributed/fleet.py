@@ -112,3 +112,175 @@ def worker_index():
 
 def barrier_worker():
     return None
+
+
+class Fleet:
+    """ref: paddle.distributed.fleet.Fleet — the stateful facade object.
+    Module-level fleet.init/distributed_model/... already implement the
+    behavior; this class binds them for scripts that instantiate or
+    type-check `fleet.Fleet`."""
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level='INFO'):
+        return init(role_maker, is_collective, strategy, log_level)
+
+    def distributed_model(self, model, **kw):
+        return distributed_model(model, **kw)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+class UtilBase:
+    """ref: fleet.UtilBase — small cross-worker utilities. Under SPMD
+    every worker holds the same host values, so the reductions are
+    element-wise over the provided list."""
+
+    def all_reduce(self, input, mode='sum', comm_world='worker'):
+        import numpy as np
+
+        arr = np.asarray(input)
+        return arr  # one program: the value is already the reduction
+
+    def all_gather(self, input, comm_world='worker'):
+        from .mesh import get_world_size
+
+        return [input] * get_world_size()
+
+    def barrier(self, comm_world='worker'):
+        from .collective import barrier
+
+        barrier()
+
+    def get_file_shard(self, files):
+        from .mesh import get_rank, get_world_size
+
+        n = get_world_size()
+        r = get_rank()
+        return [f for i, f in enumerate(files) if i % n == r]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .mesh import get_rank
+
+        if get_rank() == rank_id:
+            print(message)
+
+
+class HybridCommunicateGroup:
+    """ref: fleet.HybridCommunicateGroup — the topology view the
+    meta-parallel wrappers query. Backed by the live Mesh axes."""
+
+    def __init__(self, topology=None):
+        self._topo = topology
+
+    def _axis(self, name):
+        m = get_mesh()
+        return m.shape.get(name, 1) if m is not None else 1
+
+    def get_data_parallel_world_size(self):
+        return self._axis('dp') * self._axis('fsdp')
+
+    def get_model_parallel_world_size(self):
+        return self._axis('tp')
+
+    def get_pipe_parallel_world_size(self):
+        return self._axis('pp')
+
+    def get_sharding_parallel_world_size(self):
+        return self._axis('fsdp')
+
+    def get_data_parallel_rank(self):
+        return 0  # SPMD: one program, rank view is per-shard inside jit
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def topology(self):
+        return self._topo
+
+
+class CommunicateTopology:
+    """ref: fleet.CommunicateTopology — named axes + degrees."""
+
+    def __init__(self, hybrid_group_names=('data', 'pipe', 'sharding',
+                                           'model'), dims=(1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    def world_size(self):
+        out = 1
+        for d in self._dims:
+            out *= d
+        return out
+
+
+class Role:
+    """ref: fleet.base.role_maker.Role."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """ref: fleet.PaddleCloudRoleMaker — env-var cluster discovery. The
+    SPMD runtime discovers topology from jax.distributed instead; this
+    records the collective flag for fleet.init."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+    def _role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """ref: fleet.UserDefinedRoleMaker."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+
+
+def _ps_generator(name):
+    class _Gen:
+        """Parameter-server data generators are ps-mode machinery
+        (excluded — SURVEY §6); io.DataLoader is the input path here."""
+
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f'{name} belongs to ps mode (excluded on TPU — SURVEY '
+                f'§6); use io.DataLoader')
+
+    _Gen.__name__ = name
+    return _Gen
+
+
+MultiSlotDataGenerator = _ps_generator('MultiSlotDataGenerator')
+MultiSlotStringDataGenerator = _ps_generator('MultiSlotStringDataGenerator')

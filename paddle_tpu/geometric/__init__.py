@@ -112,3 +112,84 @@ def send_uv(x, y, src_index, dst_index, message_op='add'):
     yd = y[dst_index]
     return {'add': xs + yd, 'sub': xs - yd, 'mul': xs * yd,
             'div': xs / yd}[message_op]
+
+
+# ---- graph sampling / reindex (ref: python/paddle/geometric/sampling,
+# reindex). Host-side: neighbour sampling is data-dependent control flow
+# the reference also runs as a host-orchestrated kernel.
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """ref: paddle.geometric.reindex_graph — relabel nodes+neighbors to
+    contiguous local ids. Returns (reindex_src, reindex_dst, out_nodes)."""
+    from ..incubate import graph_reindex
+
+    return graph_reindex(x, neighbors, count, value_buffer, index_buffer)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None):
+    """ref: paddle.geometric.reindex_heter_graph — like reindex_graph
+    with per-edge-type neighbor/count lists sharing one node table."""
+    import numpy as np
+
+    x = np.asarray(x).reshape(-1)
+    neigh_list = [np.asarray(n).reshape(-1) for n in neighbors]
+    count_list = [np.asarray(c).reshape(-1) for c in count]
+    nodes = list(dict.fromkeys(
+        x.tolist() + [int(v) for n in neigh_list for v in n]))
+    lut = {int(n): i for i, n in enumerate(nodes)}
+    reindex_src = np.concatenate(
+        [np.asarray([lut[int(v)] for v in n], np.int64)
+         for n in neigh_list]) if neigh_list else np.zeros(0, np.int64)
+    reindex_dst = np.concatenate(
+        [np.repeat(np.arange(len(x), dtype=np.int64), c)
+         for c in count_list]) if count_list else np.zeros(0, np.int64)
+    return reindex_src, reindex_dst, np.asarray(nodes, np.int64)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None):
+    """ref: paddle.geometric.sample_neighbors (CSC graph)."""
+    from ..incubate import graph_sample_neighbors
+
+    return graph_sample_neighbors(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, perm_buffer)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False):
+    """ref: paddle.geometric.weighted_sample_neighbors — sampling
+    probability proportional to edge weight."""
+    import numpy as np
+
+    from ..incubate import _rng
+
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    w = np.asarray(edge_weight, np.float64)
+    rng = _rng()
+    out_neigh, out_count, out_eids = [], [], []
+    eids_arr = None if eids is None else np.asarray(eids)
+    for v in np.asarray(input_nodes).reshape(-1):
+        lo, hi = int(colptr[v]), int(colptr[v + 1])
+        pos = np.arange(lo, hi)
+        wv = w[lo:hi]
+        if sample_size >= 0 and len(pos) > sample_size:
+            if wv.sum() > 0:
+                p = wv / wv.sum()
+                # replace=False cannot draw more than the positive-weight
+                # support; cap like the reference's kernel does
+                k = min(sample_size, int((wv > 0).sum()))
+            else:
+                p, k = None, sample_size
+            pos = pos[rng.choice(len(pos), k, replace=False, p=p)]
+        out_neigh.extend(row[pos].tolist())
+        out_count.append(len(pos))
+        if return_eids:
+            chosen = (eids_arr[pos] if eids_arr is not None else pos)
+            out_eids.extend(np.asarray(chosen).tolist())
+    result = (np.asarray(out_neigh, np.int64),
+              np.asarray(out_count, np.int64))
+    if return_eids:
+        return result + (np.asarray(out_eids, np.int64),)
+    return result

@@ -50,19 +50,48 @@ class Model:
         return self
 
     def _build_steps(self):
+        import inspect
+
         opt = self._optimizer
         loss_fn = self._loss
+        # thread lr as a traced argument ONLY for optimizers whose
+        # apply_gradients accepts it (the base Optimizer family); wrapper
+        # optimizers (GradientMerge/LookAhead/sharding) keep their own
+        # signature and stored rate
+        self._lr_threaded = False
+        if opt is not None:
+            try:
+                params = inspect.signature(opt.apply_gradients).parameters
+                self._lr_threaded = ('lr' in params
+                                     and hasattr(opt, 'get_lr'))
+            except (TypeError, ValueError):
+                pass
 
-        def train_step(network, opt_state, inputs, labels):
-            def compute(m):
-                preds = m(*inputs)
-                loss = loss_fn(preds, *labels)
-                return loss, (m, preds)
+        if self._lr_threaded:
+            def train_step(network, opt_state, inputs, labels, lr):
+                def compute(m):
+                    preds = m(*inputs)
+                    loss = loss_fn(preds, *labels)
+                    return loss, (m, preds)
 
-            (loss, (m, preds)), grads = autograd.value_and_grad(
-                compute, has_aux=True)(network)
-            m, opt_state = opt.apply_gradients(m, grads, opt_state)
-            return m, opt_state, loss, preds
+                (loss, (m, preds)), grads = autograd.value_and_grad(
+                    compute, has_aux=True)(network)
+                # lr arrives traced so host-side set_lr / scheduler steps
+                # take effect without retracing
+                m, opt_state = opt.apply_gradients(m, grads, opt_state,
+                                                   lr=lr)
+                return m, opt_state, loss, preds
+        else:
+            def train_step(network, opt_state, inputs, labels):
+                def compute(m):
+                    preds = m(*inputs)
+                    loss = loss_fn(preds, *labels)
+                    return loss, (m, preds)
+
+                (loss, (m, preds)), grads = autograd.value_and_grad(
+                    compute, has_aux=True)(network)
+                m, opt_state = opt.apply_gradients(m, grads, opt_state)
+                return m, opt_state, loss, preds
 
         def eval_step(network, inputs, labels):
             preds = network(*inputs)
@@ -78,8 +107,17 @@ class Model:
         inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
         labels = tuple(jnp.asarray(x) for x in _to_list(labels))
         self.network.train()
-        net, self._opt_state, loss, preds = self._train_step(
-            self.network, self._opt_state, inputs, labels)
+        if self._lr_threaded:
+            opt = self._optimizer
+            state = self._opt_state
+            step_no = (int(state['step']) + 1
+                       if isinstance(state, dict) and 'step' in state else 1)
+            lr_now = jnp.asarray(opt.get_lr(step_no), jnp.float32)
+            net, self._opt_state, loss, preds = self._train_step(
+                self.network, self._opt_state, inputs, labels, lr_now)
+        else:
+            net, self._opt_state, loss, preds = self._train_step(
+                self.network, self._opt_state, inputs, labels)
         self.network = net
         metrics = self._update_metrics(preds, labels)
         return [float(loss)] + metrics

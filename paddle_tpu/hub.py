@@ -1,0 +1,51 @@
+"""paddle_tpu.hub (ref: python/paddle/hub.py — list/help/load).
+
+Local-directory sources only: this environment has no network egress,
+and the reference's github/gitee fetch is transport, not semantics. A
+hubconf.py in the source directory declares entrypoints exactly as the
+reference expects.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ['list', 'help', 'load']
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, 'hubconf.py')
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f'no hubconf.py in {repo_dir!r} (hub sources must be local '
+            f'directories — no network egress on this build)')
+    spec = importlib.util.spec_from_file_location('hubconf', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source='local', force_reload=False):
+    """ref: paddle.hub.list — entrypoint names exposed by hubconf.py."""
+    if source != 'local':
+        raise ValueError("only source='local' is supported (no egress)")
+    mod = _load_hubconf(repo_dir)
+    return _builtin_list(
+        n for n in dir(mod)
+        if callable(getattr(mod, n)) and not n.startswith('_'))
+
+
+def help(repo_dir, model, source='local', force_reload=False):
+    """ref: paddle.hub.help — the entrypoint's docstring."""
+    if source != 'local':
+        raise ValueError("only source='local' is supported (no egress)")
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source='local', force_reload=False, **kwargs):
+    """ref: paddle.hub.load — call the entrypoint."""
+    if source != 'local':
+        raise ValueError("only source='local' is supported (no egress)")
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -115,7 +115,10 @@ def save(obj, path, input_spec=None, **config):
             fn = obj._fn if isinstance(obj, StaticFunction) else obj
             exported = jax.export.export(jax.jit(fn))(*structs)
         with open(path + '.mlir', 'wb') as f:
-            f.write(exported.mlir_module_serialized)
+            # the FULL Exported flatbuffer (what jax.export.deserialize
+            # reads back) — not just mlir_module_serialized, which loses
+            # the calling convention and cannot be restored
+            f.write(exported.serialize())
         with open(path + '.pdmodel.txt', 'w') as f:
             f.write(str(exported.mlir_module()))
 

@@ -13,6 +13,14 @@ from .resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
 )
 from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
@@ -26,6 +34,18 @@ from .moe_lm import MoEConfig, MoEForCausalLM  # noqa: F401
 from .vision import (  # noqa: F401
     AlexNet,
     DenseNet,
+    MobileNetV3Large,
+    MobileNetV3Small,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+    shufflenet_v2_swish,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
     GoogLeNet,
     InceptionV3,
     LeNet,

@@ -42,16 +42,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=None, data_format='NHWC'):
+                 norm_layer=None, data_format='NHWC', groups=1,
+                 base_width=64):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False,
+        # ResNeXt/wide variants widen the 3x3 stage: width scales with
+        # base_width and splits into `groups` cardinality paths
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
                                data_format=data_format)
-        self.bn1 = norm_layer(planes, data_format=data_format)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False, data_format=data_format)
-        self.bn2 = norm_layer(planes, data_format=data_format)
-        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
+        self.bn1 = norm_layer(width, data_format=data_format)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False,
+                               data_format=data_format)
+        self.bn2 = norm_layer(width, data_format=data_format)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
                                bias_attr=False, data_format=data_format)
         self.bn3 = norm_layer(planes * self.expansion, data_format=data_format)
         self.relu = nn.ReLU()
@@ -71,8 +76,11 @@ class ResNet(nn.Layer):
     """ref: paddle.vision.models.ResNet(Block, depth, num_classes)."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, data_format='NHWC'):
+                 with_pool=True, data_format='NHWC', groups=1,
+                 width_per_group=64):
         super().__init__()
+        self._groups = groups
+        self._base_width = width_per_group
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
@@ -104,11 +112,14 @@ class ResNet(nn.Layer):
                           stride=stride, bias_attr=False, data_format=data_format),
                 nn.BatchNorm2D(planes * block.expansion, data_format=data_format),
             )
+        extra = ({'groups': self._groups, 'base_width': self._base_width}
+                 if block.expansion == 4 else {})
         seq = [block(self.inplanes, planes, stride, downsample,
-                     data_format=data_format)]
+                     data_format=data_format, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            seq.append(block(self.inplanes, planes, data_format=data_format))
+            seq.append(block(self.inplanes, planes, data_format=data_format,
+                             **extra))
         return nn.Sequential(*seq)
 
     def forward(self, x):
@@ -144,3 +155,37 @@ def resnet101(**kw):
 
 def resnet152(**kw):
     return _resnet(BottleneckBlock, 152, **kw)
+
+
+def resnext50_32x4d(**kw):
+    """ref: paddle.vision.models.resnext50_32x4d."""
+    return ResNet(BottleneckBlock, 50, groups=32, width_per_group=4, **kw)
+
+
+def resnext50_64x4d(**kw):
+    return ResNet(BottleneckBlock, 50, groups=64, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return ResNet(BottleneckBlock, 101, groups=32, width_per_group=4, **kw)
+
+
+def resnext101_64x4d(**kw):
+    return ResNet(BottleneckBlock, 101, groups=64, width_per_group=4, **kw)
+
+
+def resnext152_32x4d(**kw):
+    return ResNet(BottleneckBlock, 152, groups=32, width_per_group=4, **kw)
+
+
+def resnext152_64x4d(**kw):
+    return ResNet(BottleneckBlock, 152, groups=64, width_per_group=4, **kw)
+
+
+def wide_resnet50_2(**kw):
+    """ref: paddle.vision.models.wide_resnet50_2 (2x-wide 3x3 stage)."""
+    return ResNet(BottleneckBlock, 50, width_per_group=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return ResNet(BottleneckBlock, 101, width_per_group=128, **kw)

@@ -314,6 +314,20 @@ class MobileNetV3(nn.Layer):
         return self.classifier(_flat(self.pool(self.features(x))))
 
 
+class MobileNetV3Large(MobileNetV3):
+    """ref: paddle.vision.models.MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, **kw):
+        super().__init__('large', scale, num_classes, **kw)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """ref: paddle.vision.models.MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, **kw):
+        super().__init__('small', scale, num_classes, **kw)
+
+
 def mobilenet_v3_large(scale=1.0, **kw):
     return MobileNetV3('large', scale, **kw)
 
@@ -403,7 +417,7 @@ def channel_shuffle(x, groups, data_format='NHWC'):
 
 
 class ShuffleUnit(nn.Layer):
-    def __init__(self, cin, cout, stride, data_format='NHWC'):
+    def __init__(self, cin, cout, stride, data_format='NHWC', act='relu'):
         super().__init__()
         self.stride = stride
         self.data_format = data_format
@@ -413,17 +427,17 @@ class ShuffleUnit(nn.Layer):
             self.branch1 = nn.Sequential(
                 ConvBNAct(cin, cin, 3, stride, 1, groups=cin, act=None,
                           data_format=data_format),
-                ConvBNAct(cin, branch, 1, data_format=data_format),
+                ConvBNAct(cin, branch, 1, act=act, data_format=data_format),
             )
             b2_in = cin
         else:
             self.branch1 = None
             b2_in = cin // 2
         self.branch2 = nn.Sequential(
-            ConvBNAct(b2_in, branch, 1, data_format=data_format),
+            ConvBNAct(b2_in, branch, 1, act=act, data_format=data_format),
             ConvBNAct(branch, branch, 3, stride, 1, groups=branch, act=None,
                       data_format=data_format),
-            ConvBNAct(branch, branch, 1, data_format=data_format),
+            ConvBNAct(branch, branch, 1, act=act, data_format=data_format),
         )
 
     def forward(self, x):
@@ -437,23 +451,28 @@ class ShuffleUnit(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, data_format='NHWC'):
+    def __init__(self, scale=1.0, num_classes=1000, data_format='NHWC',
+                 act='relu'):
         super().__init__()
-        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+        stage_out = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+                     0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
                      1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}[scale]
         repeats = [4, 8, 4]
-        self.conv1 = ConvBNAct(3, 24, 3, 2, 1, data_format=data_format)
+        self.conv1 = ConvBNAct(3, 24, 3, 2, 1, act=act,
+                               data_format=data_format)
         self.maxpool = nn.MaxPool2D(3, 2, padding=1, data_format=data_format)
         cin = 24
         stages = []
         for i, r in enumerate(repeats):
-            units = [ShuffleUnit(cin, stage_out[i], 2, data_format)]
+            units = [ShuffleUnit(cin, stage_out[i], 2, data_format, act)]
             for _ in range(r - 1):
-                units.append(ShuffleUnit(stage_out[i], stage_out[i], 1, data_format))
+                units.append(ShuffleUnit(stage_out[i], stage_out[i], 1,
+                                         data_format, act))
             stages.append(nn.Sequential(*units))
             cin = stage_out[i]
         self.stages = nn.Sequential(*stages)
-        self.conv_last = ConvBNAct(cin, stage_out[3], 1, data_format=data_format)
+        self.conv_last = ConvBNAct(cin, stage_out[3], 1, act=act,
+                                   data_format=data_format)
         self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
         self.fc = nn.Linear(stage_out[3], num_classes)
 
@@ -464,6 +483,32 @@ class ShuffleNetV2(nn.Layer):
 
 def shufflenet_v2_x1_0(**kw):
     return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw):
+    """ref: paddle.vision.models.shufflenet_v2_swish — x1.0 channels
+    with swish activations in place of relu."""
+    return ShuffleNetV2(1.0, act='swish', **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -506,9 +551,10 @@ class DenseNet(nn.Layer):
                  data_format='NHWC'):
         super().__init__()
         blocks = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
-                  169: [6, 12, 32, 32], 201: [6, 12, 48, 32]}[layers]
+                  169: [6, 12, 32, 32], 201: [6, 12, 48, 32],
+                  264: [6, 12, 64, 48]}[layers]
         df = data_format
-        cin = 64
+        cin = 96 if layers == 161 else 64  # 161 doubles the stem too
         feats = [ConvBNAct(3, cin, 7, 2, 3, data_format=df),
                  nn.MaxPool2D(3, 2, padding=1, data_format=df)]
         for i, n in enumerate(blocks):
@@ -529,6 +575,23 @@ class DenseNet(nn.Layer):
 
 def densenet121(**kw):
     return DenseNet(121, **kw)
+
+
+def densenet161(**kw):
+    """ref: paddle.vision.models.densenet161 (growth 48, 96-wide stem)."""
+    return DenseNet(161, growth=48, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)
 
 
 # ---------------------------------------------------------------------------

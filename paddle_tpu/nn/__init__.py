@@ -2,6 +2,7 @@
 from . import decode  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm,

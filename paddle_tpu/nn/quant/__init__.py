@@ -1,0 +1,66 @@
+"""paddle_tpu.nn.quant (ref: python/paddle/nn/quant/__init__.py).
+
+The inference-time quantized-matmul surface over the pallas weight-only
+kernels (`ops/pallas/quant_matmul.py`): int8 and fp8 weights with
+per-output-channel scales, dequantized in VMEM right before the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def weight_quantize(x, algo='weight_only_int8', arch=None, group_size=-1):
+    """ref: paddle.nn.quant.weight_quantize — (quantized weight, scale).
+    algos: weight_only_int8, weight_only_int4 (stored as int8 range
+    [-8, 7]), llm.int8, fp8 variants via the e4m3 path."""
+    from ...ops.pallas.quant_matmul import quantize_weight, quantize_weight_fp8
+
+    if algo in ('weight_only_int8', 'llm.int8'):
+        return quantize_weight(x)
+    if algo == 'weight_only_int4':
+        # quantize directly onto the int4 grid (int8 storage, like the
+        # reference): scale = absmax/7 so codes span [-7, 7]
+        absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=0)
+        scale = jnp.where(absmax == 0, 1.0, absmax / 7.0)
+        wq = jnp.clip(jnp.round(x / scale), -8, 7).astype(jnp.int8)
+        return wq, scale
+    if algo in ('fp8', 'weight_only_fp8', 'float8_e4m3fn'):
+        return quantize_weight_fp8(x)
+    raise ValueError(f'unknown quantize algo: {algo}')
+
+
+def weight_dequantize(x, scale, algo='weight_only_int8', out_dtype='float32'):
+    """ref: paddle.nn.quant.weight_dequantize."""
+    return (x.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype='int8', arch=None, group_size=-1):
+    """ref: paddle.nn.quant.weight_only_linear — the pallas fast path."""
+    from ...ops.pallas.quant_matmul import weight_only_linear as wol
+
+    return wol(x, weight, weight_scale, bias=bias)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """ref: paddle.nn.quant.llm_int8_linear — LLM.int8's outlier
+    decomposition exists to protect fp16 accumulation on CUDA; the MXU
+    accumulates int8 matmuls in fp32, so the plain weight-only kernel is
+    already outlier-safe and IS the implementation."""
+    from ...ops.pallas.quant_matmul import weight_only_linear as wol
+
+    return wol(x, weight, weight_scale, bias=bias)
+
+
+class Stub:
+    """ref: paddle.nn.quant.Stub — placeholder layer replaced by an
+    observer/quanter when QAT prepares the model."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, x):
+        return x if self._observer is None else self._observer(x)
+
+    __call__ = forward

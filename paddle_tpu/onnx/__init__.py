@@ -1,0 +1,22 @@
+"""paddle_tpu.onnx (ref: python/paddle/onnx/__init__.py — `export`).
+
+The reference exports through paddle2onnx to the ONNX graph IR. The
+TPU-native interchange format is StableHLO (via `jax.export`), which is
+what every XLA consumer loads; `export` therefore produces a
+`.mlir`+weights pair through `jit.save` and says so, rather than
+pretending to emit ONNX protobufs.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref: paddle.onnx.export — here: StableHLO export.
+
+    Writes `path + '.mlir'` (serialized StableHLO) and
+    `path + '.pdiparams'` (weights), the same artifacts `jit.save`
+    produces and `jit.load` restores.
+    """
+    from ..jit import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec, **configs)
+    return path + '.mlir'

@@ -77,15 +77,19 @@ class Optimizer:
     def update_param(self, p, g, slots, lr, step):  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def apply_gradients(self, model, grads, state=None):
+    def apply_gradients(self, model, grads, state=None, lr=None):
         """Returns (new_model, new_state). `grads` is the tree returned by
-        autograd.value_and_grad (trainable-shaped)."""
+        autograd.value_and_grad (trainable-shaped). `lr` overrides the
+        stored rate for this step — pass it as a TRACED argument when the
+        update runs under jit and the rate must change between calls
+        without retracing (hapi does this so set_lr / ReduceLROnPlateau
+        take effect inside the compiled step)."""
         state = state if state is not None else self.state
         t, f = split_trainable(model)
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         step = state['step'] + 1
-        lr = self.get_lr(step)
+        lr = self.get_lr(step) if lr is None else jnp.asarray(lr, jnp.float32)
         master = state.get('master')
 
         # coupled L2 (SGD/Momentum-style regularizer): g += wd * p

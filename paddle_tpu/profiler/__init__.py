@@ -157,3 +157,97 @@ class StepTimer:
 
     def info(self):
         return self._p.step_info()
+
+
+class ProfilerState:
+    """ref: paddle.profiler.ProfilerState."""
+
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys:
+    """ref: paddle.profiler.SortedKeys (summary ordering)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """ref: paddle.profiler.SummaryView."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """ref: paddle.profiler.make_scheduler — step -> ProfilerState
+    callable driving window-based capture."""
+    cycle = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """ref: paddle.profiler.export_chrome_tracing — on_trace_ready
+    callback. jax.profiler already writes TensorBoard/Perfetto traces
+    into the profiler's log dir; this returns a callback that records
+    where."""
+
+    def handler(prof):
+        prof.exported_to = dir_name
+        return dir_name
+
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """ref: paddle.profiler.export_protobuf — same artifact family
+    (jax traces are already protobuf-based under the hood)."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename):
+    """ref: paddle.profiler.load_profiler_result — load an exported
+    chrome trace JSON for programmatic inspection."""
+    import gzip
+    import json
+
+    opener = gzip.open if str(filename).endswith('.gz') else open
+    with opener(filename, 'rt') as f:
+        return json.load(f)
+
+
+__all__ += ['ProfilerState', 'SortedKeys', 'SummaryView', 'make_scheduler',
+            'export_chrome_tracing', 'export_protobuf',
+            'load_profiler_result']

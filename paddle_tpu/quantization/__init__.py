@@ -60,3 +60,152 @@ class PTQ:
 
     def quantize(self, model, inplace=False):
         return quantize_model(model, inplace=inplace)
+
+
+class BaseObserver:
+    """ref: paddle.quantization.BaseObserver — watches activations /
+    weights to derive quant params (scale, zero point). State only
+    updates from CONCRETE values: under jit tracing the batch statistic
+    is a tracer that must not be stored (it would leak out of the trace)
+    — the per-call scale below is a pure function of x, so correctness
+    inside jit never depends on this running state."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = None
+
+    def observe(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        m = jnp.max(jnp.abs(x))
+        if not isinstance(m, jax.core.Tracer):
+            self._absmax = m if self._absmax is None else jnp.maximum(
+                self._absmax, m)
+        return x
+
+    def scales(self):
+        if self._absmax is None:
+            return None
+        return self._absmax / (2 ** (self.quant_bits - 1) - 1)
+
+
+class BaseQuanter(BaseObserver):
+    """ref: paddle.quantization.BaseQuanter — fake-quantizes in forward
+    (straight-through estimator). The quant scale is computed from the
+    CURRENT tensor (pure, jit-safe); eager calls additionally fold the
+    statistic into the running observer state for `convert`."""
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        self.observe(x)
+        absmax = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+        scale = absmax / (2 ** (self.quant_bits - 1) - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.round(x / scale)
+        q = jnp.clip(q, -(2 ** (self.quant_bits - 1)),
+                     2 ** (self.quant_bits - 1) - 1)
+        # straight-through: quantized value, identity gradient
+        return x + jax.lax.stop_gradient(q * scale - x)
+
+
+def quanter(cls):
+    """ref: paddle.quantization.quanter — class decorator registering a
+    custom quanter type."""
+    _QUANTER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+_QUANTER_REGISTRY = {}
+
+
+class QuantConfig:
+    """ref: paddle.quantization.QuantConfig — which layers get which
+    activation/weight quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = []
+        self._type_configs = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        self._layer_configs.append((layer, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs.append((t, activation, weight))
+
+    def _for_layer(self, layer):
+        for lyr, a, w in self._layer_configs:
+            if lyr is layer:
+                return a, w
+        for t, a, w in self._type_configs:
+            if isinstance(layer, t):
+                return a, w
+        return self.activation, self.weight
+
+
+class QAT:
+    """Quantization-aware training (ref: paddle.quantization.QAT):
+    wraps Linear layers so forward fake-quantizes weights and
+    activations with straight-through gradients — the model learns
+    around the rounding it will see at int8 inference, then `convert`
+    hands the observed scales to the PTQ weight-only path."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        from ..nn.layer.common import Linear
+
+        def wrap(layer):
+            for name, child in list(layer.__dict__.items()):
+                if isinstance(child, Linear):
+                    a_cls, w_cls = self.config._for_layer(child)
+                    layer.__dict__[name] = _QATLinear(
+                        child,
+                        (a_cls or BaseQuanter)(),
+                        (w_cls or BaseQuanter)())
+                elif isinstance(child, Layer):
+                    wrap(child)
+            return layer
+
+        return wrap(model)
+
+    def convert(self, model, inplace=False):
+        """Swap QAT wrappers for the int8 weight-only inference path."""
+
+        def unwrap(layer):
+            for name, child in list(layer.__dict__.items()):
+                if isinstance(child, _QATLinear):
+                    layer.__dict__[name] = quantize_layer(child.inner)
+                elif isinstance(child, Layer):
+                    unwrap(child)
+            return layer
+
+        return unwrap(model)
+
+
+def quantize_layer(linear):
+    """One Linear -> QuantizedLinear (int8 weight-only)."""
+    return QuantizedLinear(linear)
+
+
+class _QATLinear(Layer):
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self._act_q = act_quanter
+        self._weight_q = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        x = self._act_q(x)
+        w = self._weight_q(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)

@@ -71,7 +71,53 @@ def switch_case(branch_index, branch_fns, default=None):
     return lax.switch(branch_index, fns)
 
 
-# data/name parity shims
-def data(name, shape, dtype='float32', lod_level=0):
-    """ref: paddle.static.data — returns an InputSpec (tracing world)."""
-    return InputSpec(shape, dtype, name)
+# program / executor / inference-io compatibility (see compat.py)
+from .compat import (  # noqa: F401,E402
+    BuildStrategy,
+    CompiledProgram,
+    Executor,
+    ExecutionStrategy,
+    IpuCompiledProgram,
+    IpuStrategy,
+    Print,
+    Program,
+    WeightNormParamAttr,
+    append_backward,
+    data,
+    default_main_program,
+    default_startup_program,
+    deserialize_persistables,
+    deserialize_program,
+    global_scope,
+    gradients,
+    ipu_shard_guard,
+    load_from_file,
+    load_inference_model,
+    name_scope,
+    normalize_program,
+    program_guard,
+    py_func,
+    save_inference_model,
+    save_to_file,
+    scope_guard,
+    serialize_persistables,
+    serialize_program,
+)
+from .compat import load, save  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    Variable,
+    accuracy,
+    auc,
+    cpu_places,
+    create_global_var,
+    create_parameter,
+    ctr_metric_bundle,
+    cuda_places,
+    device_guard,
+    load_program_state,
+    set_ipu_shard,
+    set_program_state,
+    xpu_places,
+)
+from ..optimizer.wrappers import ExponentialMovingAverage  # noqa: F401,E402
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,547 @@
+"""Static-graph program/executor compatibility
+(ref: python/paddle/static/__init__.py — Program, Executor, scopes,
+inference-model io).
+
+What is REAL here: the deployment path. `load_inference_model` restores
+a StableHLO export as a callable Program and `Executor.run` feeds it —
+the pattern every reference inference script uses — and the serialize/
+deserialize helpers shuttle the same artifacts. Programs can also wrap
+any Python callable (`Program.from_callable`), which is how `to_static`
+output plugs in.
+
+What is NOT here: build-block graph capture (`with program_guard(...):`
+executing symbolic Variables). jax traces *functions*, not with-block
+bodies; the migration guide maps that pattern to `jit.to_static`.
+`append_backward`/`gradients` therefore raise with pointers instead of
+silently mis-computing.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+class Scope:
+    """ref: paddle.static.global_scope — a name -> array variable store
+    (also backs static.nn's lazily-created parameters)."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.get(name)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
+        return value
+
+    def get_or_create(self, name, factory):
+        if name not in self.vars:
+            self.vars[name] = factory()
+        return self.vars[name]
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield scope
+    finally:
+        _scope_stack.pop()
+
+
+class Program:
+    """ref: paddle.static.Program — here a callable-backed program:
+    `fn(feed_dict) -> list of fetches` plus declared feed/fetch names."""
+
+    def __init__(self):
+        self._fn = None
+        self._feed_names = []
+        self._fetch_names = []
+        self._state = None
+        self.random_seed = 0
+
+    @classmethod
+    def from_callable(cls, fn, feed_names=(), fetch_names=(), state=None):
+        p = cls()
+        p._fn = fn
+        p._feed_names = list(feed_names)
+        p._fetch_names = list(fetch_names)
+        p._state = state
+        return p
+
+    def clone(self, for_test=False):
+        return Program.from_callable(self._fn, self._feed_names,
+                                     self._fetch_names, self._state)
+
+    def state_dict(self, mode='all', scope=None):
+        return dict(self._state or {})
+
+    def set_state_dict(self, state_dict, scope=None):
+        self._state = dict(state_dict)
+
+    def global_block(self):
+        return _Block(self)
+
+    def list_vars(self):
+        return list(self._feed_names) + list(self._fetch_names)
+
+    def __repr__(self):
+        return (f'Program(feeds={self._feed_names}, '
+                f'fetches={self._fetch_names})')
+
+
+class _Block:
+    def __init__(self, program):
+        self.program = program
+
+    def var(self, name):
+        return name if name in self.program.list_vars() else None
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[-1]
+
+
+def default_startup_program():
+    return _default_startup[-1]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """ref: paddle.static.program_guard. Declarations inside the block
+    (static.data, py_func) register on `main_program`; symbolic op
+    capture is NOT performed (see module docstring)."""
+    _default_main.append(main_program)
+    _default_startup.append(startup_program or Program())
+    try:
+        yield
+    finally:
+        _default_main.pop()
+        _default_startup.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: paddle.static.name_scope — prefixes generated names."""
+    from ..utils import unique_name
+
+    with unique_name.guard((prefix or '') + '/' if prefix else None):
+        yield
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """ref: paddle.static.data — a named feed declaration. Returns an
+    InputSpec (the shape/dtype handle `to_static` consumes) and records
+    the name on the current main program."""
+    from ..jit import InputSpec
+
+    spec = InputSpec(tuple(shape), dtype, name=name)
+    prog = default_main_program()
+    if name not in prog._feed_names:
+        prog._feed_names.append(name)
+    return spec
+
+
+def py_func(func, x=None, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """ref: paddle.static.py_func — install a Python callable as the
+    current program's body."""
+    prog = default_main_program()
+    prog._fn = func
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    """ref: paddle.static.Print — debug-print a value (works under jit
+    via jax.debug.print) and pass it through."""
+    import jax
+
+    jax.debug.print((message or 'Print') + ': {x}', x=input)
+    return input
+
+
+class Executor:
+    """ref: paddle.static.Executor — feeds a callable-backed Program."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        if program._fn is None:
+            # the reference's `exe.run(startup_program)` initializes
+            # parameters; ours are initialized at construction — no-op
+            return []
+        out = program._fn(feed) if _wants_dict(program._fn) else \
+            program._fn(*[feed[n] for n in program._feed_names])
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        if fetch_list:
+            outs = outs[:len(fetch_list)]
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+def _wants_dict(fn):
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    return len(params) == 1 and params[0].name in ('feed', 'feed_dict')
+
+
+class BuildStrategy:
+    """ref: paddle.static.BuildStrategy — pass toggles; XLA owns fusion
+    here, so these record intent."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """ref: paddle.static.CompiledProgram — jit the program's callable."""
+
+    def __init__(self, program, build_strategy=None):
+        import jax
+
+        self._program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+        if program._fn is not None:
+            self._program = program.clone()
+            self._program._fn = jax.jit(program._fn)
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(
+        'IPU support is out of scope on the TPU build (SURVEY §6); '
+        'device placement is mesh sharding — see distributed.ProcessMesh')
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        ipu_shard_guard()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        ipu_shard_guard()
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    raise NotImplementedError(
+        'append_backward needs symbolic graph capture, which jax tracing '
+        'replaces: express the step as a function and use '
+        'autograd.value_and_grad (or hapi Model / dist.to_static), '
+        'then Executor.run the jitted result')
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        'static.gradients needs symbolic graph capture; use jax-style '
+        'autograd.grad over a function of `inputs` '
+        '(docs/migration.md §2-3 shows the pattern)')
+
+
+# ---- inference-model io -----------------------------------------------------
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """ref: paddle.static.save_inference_model — StableHLO + weights via
+    jit.save. `feed_vars` may be InputSpecs (from static.data) or a
+    Layer is passed via kwargs['layer']."""
+    from ..jit import save as jit_save
+
+    layer = kwargs.get('layer')
+    prog = program or default_main_program()
+    target = layer if layer is not None else prog._fn
+    if target is None:
+        raise ValueError('nothing to export: pass layer=<Layer> or a '
+                         'program built from a callable')
+    jit_save(target, path_prefix, input_spec=list(feed_vars))
+    # sidecar: the feed/fetch NAMES, so load_inference_model can hand
+    # back the same name-keyed interface the export declared
+    import json
+
+    feed_names = [getattr(s, 'name', None) or f'x{i}'
+                  for i, s in enumerate(feed_vars)]
+    fetch_names = ([getattr(v, 'name', str(v)) for v in fetch_vars]
+                   if fetch_vars else ['out'])
+    with open(path_prefix + '.pdmodel.json', 'w') as f:
+        json.dump({'feed_names': feed_names, 'fetch_names': fetch_names}, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """ref: paddle.static.load_inference_model — returns
+    [program, feed_names, fetch_names]; run it with Executor.run."""
+    import json
+    import os
+
+    from ..jit import load as jit_load
+
+    loaded = jit_load(path_prefix)
+
+    def fn(*args):
+        return loaded(*args)
+
+    feed_names, fetch_names = ['x'], ['out']
+    meta_path = path_prefix + '.pdmodel.json'
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        feed_names = meta.get('feed_names', feed_names)
+        fetch_names = meta.get('fetch_names', fetch_names)
+    state = loaded.state_dict() if hasattr(loaded, 'state_dict') else None
+    prog = Program.from_callable(fn, feed_names=feed_names,
+                                 fetch_names=fetch_names, state=state)
+    prog._loaded = loaded
+    return [prog, prog._feed_names, prog._fetch_names]
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    """ref: paddle.static.serialize_program — the portable program bytes
+    (serialized StableHLO export of the program's callable)."""
+    import jax
+
+    prog = program or default_main_program()
+    if getattr(prog, '_loaded', None) is not None:
+        raise ValueError('already a deserialized program')
+    if prog._fn is None or not feed_vars:
+        raise ValueError('need a callable program and feed specs')
+    structs = [s.to_shape_struct() for s in feed_vars]
+    exported = jax.export.export(jax.jit(prog._fn))(*structs)
+    return exported.serialize()
+
+
+def deserialize_program(data):
+    import jax
+
+    exported = jax.export.deserialize(bytearray(data))
+    return Program.from_callable(lambda *a: exported.call(*a))
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    """Weights as npz bytes."""
+    import io
+
+    prog = program or default_main_program()
+    buf = io.BytesIO()
+    state = prog.state_dict()
+    np.savez(buf, **{k: np.asarray(v) for k, v in state.items()})
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    import io
+
+    loaded = np.load(io.BytesIO(data))
+    program.set_state_dict({k: loaded[k] for k in loaded.files})
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """ref: paddle.static.save — the program's parameter state to
+    `model_path + '.pdparams'`."""
+    from ..framework.io import save as save_state
+
+    save_state(program.state_dict(), model_path + '.pdparams')
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """ref: paddle.static.load — restore parameter state into the
+    program."""
+    from ..framework.io import load as load_state
+
+    program.set_state_dict(load_state(model_path + '.pdparams'))
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, 'wb') as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """ref: paddle.static.normalize_program — prune to the feed->fetch
+    subgraph; XLA's export already dead-code-eliminates, so this is the
+    identity on callable-backed programs."""
+    return program
+
+
+class WeightNormParamAttr:
+    """ref: paddle.static.WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def load_program_state(model_path, var_list=None):
+    """ref: paddle.static.load_program_state — dict of arrays."""
+    from ..framework.io import load as load_state
+
+    return load_state(model_path + '.pdparams')
+
+
+def set_program_state(program, state_dict):
+    program.set_state_dict(state_dict)
+
+
+def cpu_places(device_count=None):
+    """ref: paddle.static.cpu_places."""
+    from ..device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDA name kept for script compat)."""
+    import jax
+
+    from ..device import TPUPlace
+
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """ref: paddle.static.device_guard — XLA owns placement; sharding
+    annotations are the placement mechanism. Records intent only."""
+    yield
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    ipu_shard_guard()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """ref: paddle.static.create_global_var — a named scope variable."""
+    import jax.numpy as jnp
+
+    from ..utils import unique_name
+
+    name = name or unique_name.generate('global_var')
+    return global_scope().set(name, jnp.full(tuple(shape), value, dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: paddle.static.create_parameter — scope-registered parameter
+    (value array; see framework.compat.create_parameter for the
+    Parameter-object form)."""
+    from ..framework.compat import create_parameter as mk
+
+    from ..utils import unique_name
+
+    name = name or unique_name.generate('parameter')
+    p = mk(shape, dtype, name, attr, is_bias, default_initializer)
+    return global_scope().set(name, p.value)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """ref: paddle.static.accuracy — same math as metric.accuracy."""
+    from ..metric import accuracy as acc
+
+    return acc(input, label, k, correct, total)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """ref: paddle.static.auc — batch AUC via the metric implementation."""
+    import jax.numpy as jnp
+
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    import numpy as _np
+
+    preds = _np.asarray(input)
+    if preds.ndim == 1:
+        preds = _np.stack([1 - preds, preds], axis=1)
+    m.update(preds, _np.asarray(label))
+    val = m.accumulate()
+    return (jnp.asarray(val), jnp.asarray(val), [])
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """ref: paddle.static.ctr_metric_bundle (ps-mode CTR metrics) —
+    out of scope with parameter-server mode (SURVEY §6); the dynamic
+    metric namespace covers AUC."""
+    raise NotImplementedError(
+        'ctr_metric_bundle belongs to the reference\'s parameter-server '
+        'mode (excluded on TPU — SURVEY §6); use metric.Auc')
+
+
+class Variable:
+    """ref: paddle.static.Variable — the symbolic graph handle. Jax
+    tracing has no user-visible symbolic variables; InputSpec (shapes)
+    and jax tracers (values) play this role. Kept as an isinstance
+    target for reference scripts."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            'static.Variable is a symbolic-graph handle; under tracing '
+            'use static.data (InputSpec) and plain arrays — '
+            'docs/migration.md §3')

@@ -1,0 +1,571 @@
+"""static.nn — functional layers with scope-backed parameters
+(ref: python/paddle/static/nn/__init__.py, common.py, sequence_lod.py).
+
+These are REAL ops: each creates (or reuses, keyed by name in the
+current `static.global_scope()`) its parameters and computes eagerly /
+under tracing through the same jnp paths as the dynamic layers. That
+reproduces the reference's program-scope parameter model closely enough
+that repeated calls share weights, while staying a pure function of
+(input, scope) for XLA.
+
+Sequence (LoD) ops take explicit per-sequence lengths instead of the
+reference's implicit LoD metadata — TPU static shapes need the lengths
+anyway, and every reference call site has them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compat import global_scope
+from ..utils import unique_name
+
+# re-exported control flow (already TPU-native here)
+from . import cond, case, switch_case, while_loop  # noqa: F401
+from .compat import py_func  # noqa: F401
+
+
+def _param(name, shape, init=None, is_bias=False, dtype='float32'):
+    from ..nn import initializer as I
+
+    def factory():
+        initializer = init
+        if initializer is None:
+            initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+        return initializer(tuple(shape), dtype)
+
+    return global_scope().get_or_create(name, factory)
+
+
+def _name(prefix, given=None):
+    return given or unique_name.generate(prefix)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """ref: static.nn.fc — flatten trailing dims, affine, activation."""
+    from ..nn import functional as F
+
+    base = _name('fc', name)
+    lead = x.shape[:num_flatten_dims]
+    flat = int(np.prod(x.shape[num_flatten_dims:]))
+    x2 = jnp.reshape(x, lead + (flat,))
+    w = _param(base + '.w_0', (flat, size),
+               getattr(weight_attr, 'initializer', None))
+    out = x2 @ w
+    if bias_attr is not False:
+        out = out + _param(base + '.b_0', (size,),
+                           getattr(bias_attr, 'initializer', None),
+                           is_bias=True)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32', name=None):
+    """ref: static.nn.embedding."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    base = _name('embedding', name)
+    table = _param(base + '.w_0', tuple(size),
+                   getattr(param_attr, 'initializer', None)
+                   or I.Normal(0.0, 1.0), dtype=dtype)
+    return F.embedding(jnp.asarray(input), table, padding_idx=padding_idx)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class='MemorySparseTable',
+                     param_attr=None, dtype='float32', slot=None):
+    """ref: static.nn.sparse_embedding (ps-mode distributed table) —
+    the dense mesh-sharded table stands in (VocabParallelEmbedding for
+    the sharded case)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """ref: static.nn.batch_norm — scope-backed scale/shift + running
+    stats (updated in place in the scope during training)."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    c_axis = 1 if data_layout == 'NCHW' else -1
+    c = input.shape[c_axis]
+    base = _name('batch_norm', name)
+    scale = _param(base + '.w_0', (c,), I.Constant(1.0))
+    shift = _param(base + '.b_0', (c,), None, is_bias=True)
+    mean = global_scope().get_or_create(
+        moving_mean_name or base + '.mean', lambda: jnp.zeros((c,)))
+    var = global_scope().get_or_create(
+        moving_variance_name or base + '.var', lambda: jnp.ones((c,)))
+    training = not is_test and not use_global_stats
+    out, new_mean, new_var = F.batch_norm(
+        input, mean, var, scale, shift, training=training,
+        momentum=momentum, epsilon=epsilon, data_format=data_layout)
+    if training:
+        global_scope().set(moving_mean_name or base + '.mean', new_mean)
+        global_scope().set(moving_variance_name or base + '.var', new_var)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """ref: static.nn.data_norm — normalization by accumulated batch
+    statistics (no learned scale unless enabled)."""
+    from ..nn import functional as F
+
+    c = input.shape[-1]
+    base = _name('data_norm', name)
+    ssum = global_scope().get_or_create(base + '.sum', lambda: jnp.zeros((c,)))
+    ssqsum = global_scope().get_or_create(base + '.sqsum',
+                                          lambda: jnp.zeros((c,)))
+    cnt = global_scope().get_or_create(base + '.count',
+                                       lambda: jnp.zeros(()))
+    x = jnp.asarray(input)
+    n = x.reshape(-1, c).shape[0]
+    ssum = ssum + x.reshape(-1, c).sum(0)
+    ssqsum = ssqsum + (x.reshape(-1, c) ** 2).sum(0)
+    cnt = cnt + n
+    global_scope().set(base + '.sum', ssum)
+    global_scope().set(base + '.sqsum', ssqsum)
+    global_scope().set(base + '.count', cnt)
+    mean = ssum / jnp.maximum(cnt, 1)
+    var = ssqsum / jnp.maximum(cnt, 1) - mean ** 2
+    out = (x - mean) / jnp.sqrt(jnp.maximum(var, epsilon))
+    if enable_scale_and_shift:
+        scale = _param(base + '.w_0', (c,))
+        bias = _param(base + '.b_0', (c,), is_bias=True)
+        out = out * (1.0 + scale) + bias
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def _conv(input, num_filters, filter_size, nd, transpose=False, stride=1,
+          padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None,
+          act=None, data_format=None, name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    base = _name('conv', name)
+    c_in = input.shape[1 if (data_format or 'NC').startswith('NC') else -1]
+    ks = (filter_size,) * nd if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    if transpose:
+        wshape = (c_in, num_filters // groups) + ks
+    else:
+        wshape = (num_filters, c_in // groups) + ks
+    w = _param(base + '.w_0', wshape,
+               getattr(param_attr, 'initializer', None) or I.XavierNormal())
+    fn = getattr(F, f'conv{nd}d_transpose' if transpose else f'conv{nd}d')
+    out = fn(input, w, None, stride=stride, padding=padding,
+             dilation=dilation, groups=groups,
+             data_format=data_format or ('NCHW' if nd == 2 else 'NCDHW'))
+    if bias_attr is not False:
+        b = _param(base + '.b_0', (num_filters,), is_bias=True)
+        shape = [1] * out.ndim
+        shape[1 if (data_format or 'NC').startswith('NC') else -1] = -1
+        out = out + b.reshape(shape)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format='NCHW'):
+    """ref: static.nn.conv2d."""
+    return _conv(input, num_filters, filter_size, 2, False, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format='NCHW'):
+    return _conv(input, num_filters, filter_size, 2, True, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format='NCDHW'):
+    return _conv(input, num_filters, filter_size, 3, False, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format='NCDHW'):
+    return _conv(input, num_filters, filter_size, 3, True, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """ref: static.nn.deform_conv2d — scope-parameterized wrapper over
+    the vision op."""
+    from ..nn import initializer as I
+    from ..vision.ops import deform_conv2d as dcv
+
+    base = _name('deform_conv', name)
+    c_in = input.shape[1]
+    ks = (filter_size,) * 2 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = _param(base + '.w_0', (num_filters, c_in // groups) + ks,
+               getattr(param_attr, 'initializer', None) or I.XavierNormal())
+    b = None if bias_attr is False else _param(base + '.b_0',
+                                               (num_filters,), is_bias=True)
+    return dcv(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    c = input.shape[1 if data_layout == 'NCHW' else -1]
+    base = _name('group_norm', name)
+    w = _param(base + '.w_0', (c,), I.Constant(1.0))
+    b = _param(base + '.b_0', (c,), is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    c = input.shape[1]
+    base = _name('instance_norm', name)
+    w = _param(base + '.scale', (c,), I.Constant(1.0))
+    b = _param(base + '.bias', (c,), is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, epsilon=epsilon)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    shape = tuple(input.shape[begin_norm_axis:])
+    base = _name('layer_norm', name)
+    w = _param(base + '.w_0', shape, I.Constant(1.0)) if scale else None
+    b = _param(base + '.b_0', shape, is_bias=True) if shift else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode='all', param_attr=None, data_format='NCHW', name=None):
+    """ref: static.nn.prelu — modes all/channel/element."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    base = _name('prelu', name)
+    if mode == 'all':
+        shape = (1,)
+    elif mode == 'channel':
+        shape = (x.shape[1 if data_format == 'NCHW' else -1],)
+    else:
+        shape = tuple(x.shape[1:])
+    alpha = _param(base + '.w_0', shape,
+                   getattr(param_attr, 'initializer', None)
+                   or I.Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """ref: static.nn.bilinear_tensor_product."""
+    from ..nn import functional as F
+
+    base = _name('bilinear', name)
+    w = _param(base + '.w_0', (size, x.shape[-1], y.shape[-1]),
+               getattr(param_attr, 'initializer', None))
+    out = jnp.einsum('bi,oij,bj->bo', x, w, y)
+    if bias_attr is not False:
+        out = out + _param(base + '.b_0', (size,), is_bias=True)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: static.nn.spectral_norm — normalize by the leading singular
+    value (power iteration each call)."""
+    w = jnp.moveaxis(jnp.asarray(weight), dim, 0)
+    mat = w.reshape(w.shape[0], -1)
+    base = _name('spectral_norm', name)
+    u = global_scope().get_or_create(
+        base + '.u', lambda: jnp.ones((mat.shape[0],)) / np.sqrt(mat.shape[0]))
+    # v derives from the stored u even with power_iters=0 (the reference
+    # allows 0: reuse the converged direction without refining)
+    v = mat.T @ u
+    v = v / (jnp.linalg.norm(v) + eps)
+    for _ in range(power_iters):
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+    global_scope().set(base + '.u', u)
+    sigma = u @ mat @ v
+    return (jnp.moveaxis(w, 0, dim) / (sigma + eps)).reshape(weight.shape)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler='uniform', custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref: static.nn.nce): one
+    positive + k uniform negatives per example, logistic loss."""
+    from ..framework import random as random_mod
+
+    base = _name('nce', name)
+    d = input.shape[-1]
+    w = _param(base + '.w_0', (num_total_classes, d))
+    b = _param(base + '.b_0', (num_total_classes,), is_bias=True)
+    label = jnp.asarray(label).reshape(-1)
+    x = jnp.asarray(input)
+    key = random_mod.split_key()
+    neg = jax.random.randint(key, (x.shape[0], num_neg_samples), 0,
+                             num_total_classes)
+    pos_logit = jnp.einsum('bd,bd->b', x, w[label]) + b[label]
+    neg_logit = jnp.einsum('bd,bkd->bk', x, w[neg]) + b[neg]
+    loss = (jax.nn.softplus(-pos_logit)
+            + jax.nn.softplus(neg_logit).sum(-1))
+    return loss[:, None]
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """ref: static.nn.row_conv — lookahead row convolution over time:
+    out[t] = sum_{j=0..k} x[t+j] * w[j]."""
+    from ..nn import functional as F
+
+    base = _name('row_conv', None)
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = _param(base + '.w_0', (k, d),
+               getattr(param_attr, 'initializer', None))
+    x = jnp.asarray(input)            # (B, T, D)
+    pad = jnp.pad(x, ((0, 0), (0, future_context_size), (0, 0)))
+    out = sum(pad[:, j:j + x.shape[1]] * w[j] for j in range(k))
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """ref: static.nn.static_pylayer — custom forward/backward pair
+    (jax.custom_vjp under the hood)."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+
+    @jax.custom_vjp
+    def op(*args):
+        return forward_fn(*args)
+
+    def fwd(*args):
+        return forward_fn(*args), args
+
+    def bwd(res, g):
+        out = backward_fn(g)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    op.defvjp(fwd, bwd)
+    return op(*inputs)
+
+
+# ---- sequence (LoD) ops -----------------------------------------------------
+# Padded layout (B, T, ...) + explicit `lengths` replaces LoD metadata.
+
+
+def _time_mask(lengths, t):
+    return (jnp.arange(t)[None] < jnp.asarray(lengths)[:, None])
+
+
+def sequence_conv(input, lengths=None, num_filters=None, filter_size=3,
+                  filter_stride=1, padding=True, padding_start=None,
+                  bias_attr=None, param_attr=None, act=None, name=None):
+    """ref: static.nn.sequence_conv — 1-D context conv over time."""
+    from ..nn import functional as F
+
+    base = _name('sequence_conv', name)
+    b, t, d = input.shape
+    w = _param(base + '.w_0', (filter_size * d, num_filters),
+               getattr(param_attr, 'initializer', None))
+    start = padding_start if padding_start is not None \
+        else -((filter_size - 1) // 2)
+    cols = []
+    x = jnp.asarray(input)
+    for j in range(filter_size):
+        off = start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        idx = jnp.arange(t) + off
+        valid = (idx >= 0) & (idx < t)
+        cols.append(jnp.where(valid[None, :, None], shifted, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)          # (B, T, k*D)
+    out = ctx @ w
+    if bias_attr is not False:
+        out = out + _param(base + '.b_0', (num_filters,), is_bias=True)
+    if lengths is not None:
+        out = out * _time_mask(lengths, t)[..., None]
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):
+    """ref: static.nn.sequence_softmax — softmax within each sequence."""
+    x = jnp.asarray(input)
+    if lengths is None:
+        return jax.nn.softmax(x, axis=1)
+    mask = _time_mask(lengths, x.shape[1])
+    logits = jnp.where(mask if x.ndim == 2 else mask[..., None],
+                       x, -1e30)
+    return jax.nn.softmax(logits, axis=1)
+
+
+def sequence_pool(input, pool_type, lengths=None, is_test=False, pad_value=0.0):
+    """ref: static.nn.sequence_pool — sum/average/sqrt/max/last/first."""
+    x = jnp.asarray(input)
+    b, t = x.shape[:2]
+    if lengths is None:
+        lengths = jnp.full((b,), t)
+    lengths = jnp.asarray(lengths)
+    mask = _time_mask(lengths, t)
+    m = mask[..., None] if x.ndim == 3 else mask
+    pool_type = pool_type.lower()
+    if pool_type == 'sum':
+        return jnp.sum(x * m, axis=1)
+    if pool_type == 'average':
+        return jnp.sum(x * m, axis=1) / jnp.maximum(
+            lengths[:, None].astype(x.dtype), 1)
+    if pool_type == 'sqrt':
+        return jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            lengths[:, None].astype(x.dtype), 1))
+    if pool_type == 'max':
+        return jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+    if pool_type == 'first':
+        return x[:, 0]
+    if pool_type == 'last':
+        idx = jnp.maximum(lengths - 1, 0)
+        return x[jnp.arange(b), idx]
+    raise ValueError(f'bad pool_type {pool_type}')
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, 'first', lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, 'last', lengths)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """ref: static.nn.sequence_slice — per-sequence [offset, offset+len)
+    window, re-padded to max(length)."""
+    x = jnp.asarray(input)
+    offset = jnp.asarray(offset).reshape(-1)
+    length = jnp.asarray(length).reshape(-1)
+    t = x.shape[1]
+    out_t = int(np.max(np.asarray(length)))
+    idx = offset[:, None] + jnp.arange(out_t)[None]
+    take = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, t - 1)[..., None] if x.ndim == 3 else jnp.clip(idx, 0, t - 1),
+        axis=1)
+    mask = jnp.arange(out_t)[None] < length[:, None]
+    return take * (mask[..., None] if x.ndim == 3 else mask)
+
+
+def sequence_expand(x, y_lengths, ref_level=-1, name=None):
+    """ref: static.nn.sequence_expand — repeat row i of x `y_lengths[i]`
+    times (static output uses max length with zero padding)."""
+    x = jnp.asarray(x)
+    reps = np.asarray(y_lengths).reshape(-1)
+    pieces = [np.repeat(np.asarray(x[i:i + 1]), int(reps[i]), axis=0)
+              for i in range(x.shape[0])]
+    return jnp.asarray(np.concatenate(pieces, axis=0))
+
+
+def sequence_expand_as(x, y, name=None):
+    """ref: static.nn.sequence_expand_as — expand x rows to y's row
+    count (uniform factor)."""
+    x = jnp.asarray(x)
+    factor = jnp.asarray(y).shape[0] // x.shape[0]
+    return jnp.repeat(x, factor, axis=0)
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """ref: static.nn.sequence_pad — (packed rows, lengths) -> padded
+    (B, T, ...) + lengths."""
+    x = np.asarray(x)
+    lengths = np.asarray(lengths).reshape(-1)
+    t = int(maxlen or lengths.max())
+    feat = x.shape[1:]
+    out = np.full((len(lengths), t) + feat, np.asarray(pad_value),
+                  dtype=x.dtype)
+    off = 0
+    for i, n in enumerate(lengths):
+        out[i, :n] = x[off:off + n]
+        off += n
+    return jnp.asarray(out), jnp.asarray(lengths)
+
+
+def sequence_unpad(x, length, name=None):
+    """ref: static.nn.sequence_unpad — padded -> packed rows."""
+    x = np.asarray(x)
+    length = np.asarray(length).reshape(-1)
+    return jnp.asarray(np.concatenate(
+        [x[i, :n] for i, n in enumerate(length)], axis=0))
+
+
+def sequence_reshape(input, new_dim, lengths=None):
+    """ref: static.nn.sequence_reshape — refold the feature dim of
+    packed rows."""
+    x = jnp.asarray(input)
+    return x.reshape(-1, new_dim)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """ref: static.nn.sequence_scatter — add updates at (seq, idx)."""
+    x = jnp.asarray(input)
+    index = np.asarray(index).reshape(len(x), -1)
+    updates = jnp.asarray(updates).reshape(index.shape)
+    rows = np.repeat(np.arange(index.shape[0]), index.shape[1])
+    return x.at[rows, index.reshape(-1)].add(updates.reshape(-1))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """ref: static.nn.sequence_enumerate — sliding windows of ids."""
+    x = jnp.asarray(input)
+    b, t = x.shape[:2]
+    pad = jnp.pad(x, ((0, 0), (0, win_size - 1)),
+                  constant_values=pad_value)
+    return jnp.stack([pad[:, j:j + t] for j in range(win_size)], axis=-1)

@@ -29,3 +29,43 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to='', since='', reason='', level=0):
+    """ref: paddle.utils.deprecated — decorator emitting a
+    DeprecationWarning on first call."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f'API {fn.__name__} is deprecated'
+            if since:
+                msg += f' since {since}'
+            if update_to:
+                msg += f'; use {update_to} instead'
+            if reason:
+                msg += f' ({reason})'
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """ref: paddle.utils.require_version — version gate against this
+    package's version."""
+    from .. import __version__ as ver
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split('.')[:3] if p.isdigit())
+
+    cur = parse(ver)
+    if parse(min_version) > cur:
+        raise RuntimeError(f'requires version >= {min_version}, have {ver}')
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(f'requires version <= {max_version}, have {ver}')
+    return True

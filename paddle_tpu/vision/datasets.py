@@ -130,3 +130,161 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     n_classes = 100
+
+
+class FashionMNIST(MNIST):
+    """ref: paddle.vision.datasets.FashionMNIST — same idx-ubyte format
+    as MNIST, clothing classes."""
+
+
+class DatasetFolder(Dataset):
+    """ref: paddle.vision.datasets.DatasetFolder — class-per-subdirectory
+    layout; real directory walker (PIL decodes)."""
+
+    IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.bmp', '.ppm', '.webp')
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f'no class directories under {root!r}')
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(base, f)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else f.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f'no images under {root!r}')
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert('RGB'))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        sample = self.loader(path)
+        if self.transform:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """ref: paddle.vision.datasets.ImageFolder — unlabeled flat/recursive
+    image directory."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        exts = tuple(e.lower() for e in
+                     (extensions or DatasetFolder.IMG_EXTENSIONS))
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(base, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise FileNotFoundError(f'no images under {root!r}')
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        sample = self.loader(self.samples[i])
+        if self.transform:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(Dataset):
+    """ref: paddle.vision.datasets.Flowers (102 classes) — reads the
+    local image directory when given, synthetic fallback otherwise."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode='train', transform=None, download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.isdir(data_file):
+            inner = DatasetFolder(data_file, transform=None)
+            self._images = None
+            self._folder = inner
+            self._labels = None
+        else:
+            fake = FakeData(size=128 if mode == 'train' else 32,
+                            image_shape=(64, 64, 3), num_classes=102,
+                            seed=2 if mode == 'train' else 3)
+            self._folder = None
+            self._images = fake._images
+            self._labels = fake._labels
+
+    def __len__(self):
+        return len(self._folder) if self._folder else len(self._images)
+
+    def __getitem__(self, i):
+        if self._folder:
+            img, label = self._folder[i]
+        else:
+            img, label = self._images[i], self._labels[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class VOC2012(Dataset):
+    """ref: paddle.vision.datasets.VOC2012 (segmentation pairs) — reads
+    a local VOCdevkit layout when given, synthetic (image, mask) pairs
+    otherwise."""
+
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self.pairs = []
+        if data_file and os.path.isdir(data_file):
+            img_dir = os.path.join(data_file, 'JPEGImages')
+            seg_dir = os.path.join(data_file, 'SegmentationClass')
+            names = sorted(os.path.splitext(f)[0]
+                           for f in os.listdir(seg_dir)) \
+                if os.path.isdir(seg_dir) else []
+            for n in names:
+                self.pairs.append((os.path.join(img_dir, n + '.jpg'),
+                                   os.path.join(seg_dir, n + '.png')))
+        if not self.pairs:
+            rng = np.random.default_rng(4 if mode == 'train' else 5)
+            self._images = rng.integers(0, 256, (32, 64, 64, 3)).astype(np.uint8)
+            self._masks = rng.integers(0, 21, (32, 64, 64)).astype(np.uint8)
+
+    def __len__(self):
+        return len(self.pairs) if self.pairs else len(self._images)
+
+    def __getitem__(self, i):
+        if self.pairs:
+            from PIL import Image
+
+            ip, mp = self.pairs[i]
+            img = np.asarray(Image.open(ip).convert('RGB'))
+            mask = np.asarray(Image.open(mp))
+        else:
+            img, mask = self._images[i], self._masks[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, mask

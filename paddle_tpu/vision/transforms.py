@@ -289,3 +289,321 @@ def crop(img, top, left, height, width):
 
 def pad(img, padding, fill=0, padding_mode='constant'):
     return Pad(padding, fill, padding_mode)(img)
+
+
+# ---- functional color / geometry (ref: vision/transforms/functional.py) ----
+# numpy implementations over HWC uint8/float arrays — the host side of
+# the input pipeline, like the reference's cv2/PIL backends.
+
+
+def adjust_brightness(img, brightness_factor):
+    """ref: transforms.adjust_brightness — scale toward black."""
+    arr = np.asarray(img).astype(np.float32)
+    out = arr * brightness_factor
+    return _like(img, out)
+
+
+def adjust_contrast(img, contrast_factor):
+    """ref: transforms.adjust_contrast — blend with the gray mean."""
+    arr = np.asarray(img).astype(np.float32)
+    mean = _gray(arr).mean()
+    out = (arr - mean) * contrast_factor + mean
+    return _like(img, out)
+
+
+def adjust_hue(img, hue_factor):
+    """ref: transforms.adjust_hue — rotate hue by hue_factor in [-0.5, 0.5]
+    via RGB->HSV->RGB."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError('hue_factor must be in [-0.5, 0.5]')
+    arr = np.asarray(img).astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    rgb = arr / scale
+    import colorsys
+
+    # vectorized rgb->hsv
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = rgb.max(-1)
+    minc = rgb.min(-1)
+    v = maxc
+    spread = maxc - minc
+    s = np.where(maxc > 0, spread / np.maximum(maxc, 1e-12), 0)
+    rc = (maxc - r) / np.maximum(spread, 1e-12)
+    gc = (maxc - g) / np.maximum(spread, 1e-12)
+    bc = (maxc - b) / np.maximum(spread, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(spread == 0, 0.0, (h / 6.0) % 1.0)
+    h = (h + hue_factor) % 1.0
+    # hsv->rgb
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = (i.astype(np.int32) % 6)[..., None]   # broadcast over channels
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return _like(img, out * scale)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ref: transforms.to_grayscale."""
+    arr = np.asarray(img).astype(np.float32)
+    g = _gray(arr)[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return _like(img, g)
+
+
+def _gray(arr):
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return arr.reshape(arr.shape[:2])
+    return (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])
+
+
+def _like(img, out):
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def _affine_grid_np(h, w, matrix):
+    """Inverse-map sampling grid for a 3x3 (or 2x3) affine matrix in
+    pixel coordinates (center-origin, like the reference)."""
+    m = np.eye(3, dtype=np.float64)
+    m[:2] = np.asarray(matrix, np.float64).reshape(2, 3)
+    inv = np.linalg.inv(m)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing='ij')
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    coords = np.stack([xs - cx, ys - cy, np.ones_like(xs)], axis=-1)
+    src = coords @ inv.T
+    return src[..., 0] + cx, src[..., 1] + cy
+
+
+def _sample_np(arr, sx, sy, fill=0):
+    h, w = arr.shape[:2]
+    x0 = np.clip(np.round(sx).astype(int), 0, w - 1)
+    y0 = np.clip(np.round(sy).astype(int), 0, h - 1)
+    out = arr[y0, x0]
+    valid = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) & (sy <= h - 0.5)
+    if arr.ndim == 3:
+        valid = valid[..., None]
+    return np.where(valid, out, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation='nearest',
+           fill=0, center=None):
+    """ref: transforms.affine — rotate/translate/scale/shear about the
+    center (nearest sampling; the pipeline's augmentation fidelity, not
+    a resampling kernel benchmark)."""
+    arr = np.asarray(img)
+    a = np.deg2rad(angle)
+    sx_deg, sy_deg = (tuple(shear) if isinstance(shear, (list, tuple))
+                      else (shear, 0.0))
+    sxr, syr = np.deg2rad(sx_deg), np.deg2rad(sy_deg)
+    # forward matrix: scale * R(angle) @ Shear, then translation —
+    # Shear = [[1, tan(sx)], [tan(sy), 1]] (x-shear tilts vertical
+    # lines; det stays ~1, matching the reference's RSS composition)
+    rot = np.array([[np.cos(a), -np.sin(a)], [np.sin(a), np.cos(a)]])
+    sh = np.array([[1.0, np.tan(sxr)], [np.tan(syr), 1.0]])
+    lin = scale * (rot @ sh)
+    m = np.array([
+        [lin[0, 0], lin[0, 1], translate[0]],
+        [lin[1, 0], lin[1, 1], translate[1]],
+    ])
+    sx, sy = _affine_grid_np(arr.shape[0], arr.shape[1], m)
+    return _like(img, _sample_np(arr.astype(np.float32), sx, sy, fill))
+
+
+def rotate(img, angle, interpolation='nearest', expand=False, center=None,
+           fill=0):
+    """ref: transforms.rotate."""
+    return affine(img, angle, (0, 0), 1.0, 0.0, interpolation, fill, center)
+
+
+def perspective(img, startpoints, endpoints, interpolation='nearest',
+                fill=0):
+    """ref: transforms.perspective — warp by the homography mapping
+    endpoints back to startpoints."""
+    arr = np.asarray(img)
+    a = []
+    bvec = []
+    for (sx_, sy_), (ex_, ey_) in zip(startpoints, endpoints):
+        a.append([ex_, ey_, 1, 0, 0, 0, -sx_ * ex_, -sx_ * ey_])
+        a.append([0, 0, 0, ex_, ey_, 1, -sy_ * ex_, -sy_ * ey_])
+        bvec += [sx_, sy_]
+    coef, *_ = np.linalg.lstsq(np.asarray(a, np.float64),
+                               np.asarray(bvec, np.float64), rcond=None)
+    hmat = np.append(coef, 1.0).reshape(3, 3)
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing='ij')
+    coords = np.stack([xs, ys, np.ones_like(xs)], axis=-1) @ hmat.T
+    sx = coords[..., 0] / np.maximum(np.abs(coords[..., 2]), 1e-9) \
+        * np.sign(coords[..., 2])
+    sy = coords[..., 1] / np.maximum(np.abs(coords[..., 2]), 1e-9) \
+        * np.sign(coords[..., 2])
+    return _like(img, _sample_np(arr.astype(np.float32), sx, sy, fill))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """ref: transforms.erase — fill the (i, j, h, w) window with v."""
+    arr = np.array(img, copy=True)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+class SaturationTransform(BaseTransform):
+    """ref: transforms.SaturationTransform — blend with grayscale."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        arr = np.asarray(img).astype(np.float32)
+        g = _gray(arr)[..., None]
+        return _like(img, arr * f + g * (1 - f))
+
+
+class HueTransform(BaseTransform):
+    """ref: transforms.HueTransform."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError('hue value must be in [0, 0.5]')
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class RandomResizedCrop(BaseTransform):
+    """ref: transforms.RandomResizedCrop — random area/aspect crop then
+    resize to `size`."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation='bilinear', keys=None):
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = arr[top:top + ch, left:left + cw]
+                return _resize_np(patch, *self.size)
+        return _resize_np(arr, *self.size)         # fallback: full image
+
+
+class RandomAffine(BaseTransform):
+    """ref: transforms.RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation='nearest', fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _draw_shear(self):
+        """scalar s -> x-shear in [-s, s]; (min, max) -> x-shear range;
+        (xmin, xmax, ymin, ymax) -> both axes (reference convention)."""
+        sh = self.shear
+        if sh is None:
+            return 0.0
+        if np.isscalar(sh):
+            return np.random.uniform(-sh, sh) if sh else 0.0
+        sh = tuple(sh)
+        if len(sh) == 2:
+            return np.random.uniform(sh[0], sh[1])
+        return (np.random.uniform(sh[0], sh[1]),
+                np.random.uniform(sh[2], sh[3]))
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        return affine(img, angle, (tx, ty), sc, self._draw_shear(),
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """ref: transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation='nearest', fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.random() >= self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        jitter = lambda lo, hi: np.random.randint(lo, hi + 1)
+        end = [(jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), h - 1 - jitter(0, dy)),
+               (jitter(0, dx), h - 1 - jitter(0, dy))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """ref: transforms.RandomErasing."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                top = np.random.randint(0, h - eh)
+                left = np.random.randint(0, w - ew)
+                v = (np.random.normal(size=(eh, ew) + arr.shape[2:])
+                     if self.value == 'random' else self.value)
+                return erase(arr, top, left, eh, ew, v)
+        return arr

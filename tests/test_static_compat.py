@@ -1,0 +1,239 @@
+"""static-graph compat shell: Program/Executor, inference-model io,
+scopes, static.nn scope-parameterized layers (ref: python/paddle/static)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+def test_program_executor_py_func():
+    main = static.Program()
+    with static.program_guard(main):
+        static.data('x', [None, 4], 'float32')
+        static.py_func(lambda x: [x @ jnp.ones((4, 2)), x.sum()])
+    exe = static.Executor()
+    assert exe.run(static.Program()) == []          # startup no-op
+    out, total = exe.run(main, feed={'x': np.ones((3, 4), np.float32)},
+                         fetch_list=['out', 'total'])
+    assert out.shape == (3, 2) and float(total) == 12.0
+    clone = main.clone(for_test=True)
+    assert clone._feed_names == ['x']
+    # CompiledProgram jits the callable
+    compiled = static.CompiledProgram(main)
+    out2, _ = exe.run(compiled._program,
+                      feed={'x': np.ones((3, 4), np.float32)},
+                      fetch_list=[0, 1])
+    np.testing.assert_allclose(out2, out)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    from paddle_tpu.jit import InputSpec
+
+    model = pt.nn.Linear(4, 3).eval()
+    path = str(tmp_path / 'infer')
+    static.save_inference_model(path, [InputSpec((2, 4), 'float32')],
+                                None, layer=model)
+    prog, feeds, fetches = static.load_inference_model(path)
+    exe = static.Executor()
+    x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+    (out,) = exe.run(prog, feed={feeds[0]: jnp.asarray(x)},
+                     fetch_list=fetches)
+    np.testing.assert_allclose(out, np.asarray(model(jnp.asarray(x))),
+                               rtol=1e-5)
+
+
+def test_program_state_save_load(tmp_path):
+    prog = static.Program.from_callable(lambda x: x,
+                                        state={'w': np.ones((2, 2))})
+    path = str(tmp_path / 'st')
+    static.save(prog, path)
+    prog2 = static.Program.from_callable(lambda x: x)
+    static.load(prog2, path)
+    np.testing.assert_array_equal(prog2.state_dict()['w'], np.ones((2, 2)))
+    state = static.load_program_state(path)
+    assert 'w' in state
+    static.set_program_state(prog2, state)
+
+
+def test_scope_guard_and_helpers():
+    s = static.compat.Scope()
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+        static.create_global_var([2], 3.0, 'float32', name='gv')
+        assert float(np.asarray(s.var('gv'))[0]) == 3.0
+        static.create_parameter([2, 2], 'float32', name='pw')
+        assert s.var('pw').shape == (2, 2)
+    assert static.global_scope() is not s
+    with static.name_scope('blk'):
+        pass
+    assert static.cpu_places(2)[1] is not None
+    assert static.cuda_places([0])
+    with static.device_guard('gpu'):
+        pass
+    with pytest.raises(NotImplementedError):
+        static.append_backward(None)
+    with pytest.raises(NotImplementedError):
+        static.gradients(None, None)
+    with pytest.raises(NotImplementedError):
+        static.Variable()
+    with pytest.raises(NotImplementedError):
+        static.ipu_shard_guard()
+
+
+def test_static_accuracy_auc():
+    preds = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    labels = np.array([[1], [0], [0]])
+    acc = static.accuracy(preds, labels.reshape(-1))
+    assert 0.0 <= float(acc) <= 1.0
+    auc_val, _, _ = static.auc(preds, labels)
+    assert 0.0 <= float(auc_val) <= 1.0
+
+
+class TestStaticNN:
+    def setup_method(self, _):
+        # isolate scope-backed parameters per test
+        self._scope = static.compat.Scope()
+        self._guard = static.scope_guard(self._scope)
+        self._guard.__enter__()
+        pt.seed(0)
+
+    def teardown_method(self, _):
+        self._guard.__exit__(None, None, None)
+
+    def test_fc_shares_parameters_by_name(self):
+        x = jnp.ones((2, 4))
+        a = static.nn.fc(x, 3, name='shared')
+        b = static.nn.fc(x, 3, name='shared')
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = static.nn.fc(x, 3, activation='relu')
+        assert (np.asarray(c) >= 0).all()
+
+    def test_embedding_and_conv(self):
+        ids = jnp.asarray([[1, 2], [3, 0]])
+        emb = static.nn.embedding(ids, (8, 6))
+        assert emb.shape == (2, 2, 6)
+        img = jnp.ones((1, 3, 8, 8))
+        out = static.nn.conv2d(img, 4, 3, padding=1, act='relu')
+        assert out.shape == (1, 4, 8, 8) and (np.asarray(out) >= 0).all()
+        out_t = static.nn.conv2d_transpose(img, 4, filter_size=3, stride=2)
+        assert out_t.shape[1] == 4
+        vol = jnp.ones((1, 2, 4, 4, 4))
+        assert static.nn.conv3d(vol, 3, 3, padding=1).shape == (1, 3, 4, 4, 4)
+
+    def test_norms(self):
+        x = jnp.asarray(np.random.default_rng(1).normal(
+            size=(4, 6, 5, 5)).astype(np.float32))
+        bn = static.nn.batch_norm(x)
+        assert bn.shape == x.shape
+        # running stats updated in scope
+        mean_keys = [k for k in static.global_scope().vars if '.mean' in k]
+        assert mean_keys
+        gn = static.nn.group_norm(x, groups=2)
+        assert gn.shape == x.shape
+        inorm = static.nn.instance_norm(x)
+        assert inorm.shape == x.shape
+        ln = static.nn.layer_norm(x, begin_norm_axis=1)
+        assert ln.shape == x.shape
+        dn = static.nn.data_norm(jnp.asarray(
+            np.random.default_rng(2).normal(size=(8, 6)).astype(np.float32)))
+        assert dn.shape == (8, 6)
+
+    def test_prelu_bilinear_spectral(self):
+        x = jnp.asarray(np.random.default_rng(3).normal(
+            size=(2, 3, 4, 4)).astype(np.float32))
+        assert static.nn.prelu(x, mode='channel').shape == x.shape
+        a = jnp.ones((2, 3))
+        b = jnp.ones((2, 5))
+        assert static.nn.bilinear_tensor_product(a, b, 4).shape == (2, 4)
+        w = jnp.asarray(np.random.default_rng(4).normal(
+            size=(6, 8)).astype(np.float32))
+        wn = static.nn.spectral_norm(w, power_iters=5)
+        s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+        assert s[0] == pytest.approx(1.0, abs=0.05)
+
+    def test_nce_row_conv_static_pylayer(self):
+        x = jnp.asarray(np.random.default_rng(5).normal(
+            size=(4, 8)).astype(np.float32))
+        loss = static.nn.nce(x, jnp.asarray([0, 1, 2, 3]), 10,
+                             num_neg_samples=3)
+        assert loss.shape == (4, 1) and (np.asarray(loss) > 0).all()
+        seq = jnp.ones((2, 5, 4))
+        rc = static.nn.row_conv(seq, 2)
+        assert rc.shape == (2, 5, 4)
+        out = static.nn.static_pylayer(
+            lambda v: v * 2, [jnp.ones(3)],
+            backward_fn=lambda g: g * 10)
+        np.testing.assert_array_equal(np.asarray(out), [2, 2, 2])
+
+    def test_sequence_ops(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 4, 3))
+        lengths = jnp.asarray([4, 2])
+        sm = static.nn.sequence_softmax(x[..., 0], lengths)
+        np.testing.assert_allclose(np.asarray(sm).sum(1), [1, 1], rtol=1e-5)
+        assert float(np.asarray(sm)[1, 3]) == 0.0  # beyond length
+        pooled = static.nn.sequence_pool(x, 'average', lengths)
+        np.testing.assert_allclose(np.asarray(pooled)[1],
+                                   np.asarray(x)[1, :2].mean(0), rtol=1e-5)
+        np.testing.assert_array_equal(
+            np.asarray(static.nn.sequence_last_step(x, lengths))[1],
+            np.asarray(x)[1, 1])
+        np.testing.assert_array_equal(
+            np.asarray(static.nn.sequence_first_step(x))[0],
+            np.asarray(x)[0, 0])
+        conv = static.nn.sequence_conv(x, lengths, num_filters=5,
+                                       filter_size=3)
+        assert conv.shape == (2, 4, 5)
+        assert float(np.abs(np.asarray(conv)[1, 2:]).sum()) == 0.0
+
+        packed = jnp.asarray(np.arange(10, dtype=np.float32).reshape(5, 2))
+        padded, lens = static.nn.sequence_pad(packed, 0.0, [3, 2])
+        assert padded.shape == (2, 3, 2)
+        back = static.nn.sequence_unpad(padded, lens)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(packed))
+        assert static.nn.sequence_reshape(packed, 5).shape == (2, 5)
+        ids = jnp.asarray([[1, 2, 3]])
+        en = static.nn.sequence_enumerate(ids, 2, pad_value=0)
+        np.testing.assert_array_equal(np.asarray(en)[0],
+                                      [[1, 2], [2, 3], [3, 0]])
+        sc = static.nn.sequence_scatter(
+            jnp.zeros((2, 4)), [[1], [2]], [[5.0], [7.0]])
+        assert float(sc[0, 1]) == 5.0 and float(sc[1, 2]) == 7.0
+        sl = static.nn.sequence_slice(x, [1, 0], [2, 2])
+        np.testing.assert_array_equal(np.asarray(sl)[0],
+                                      np.asarray(x)[0, 1:3])
+        ex = static.nn.sequence_expand(jnp.asarray([[1.0], [2.0]]), [2, 3])
+        assert np.asarray(ex).ravel().tolist() == [1, 1, 2, 2, 2]
+        ex2 = static.nn.sequence_expand_as(jnp.asarray([[1.0], [2.0]]),
+                                           np.zeros((4, 1)))
+        assert len(ex2) == 4
+
+
+def test_inference_model_named_feeds(tmp_path):
+    """feed names from save-time InputSpecs survive the round trip."""
+    from paddle_tpu.jit import InputSpec
+
+    model = pt.nn.Linear(4, 3).eval()
+    path = str(tmp_path / 'named')
+    static.save_inference_model(
+        path, [InputSpec((2, 4), 'float32', name='image')], None,
+        layer=model)
+    prog, feeds, fetches = static.load_inference_model(path)
+    assert feeds == ['image']
+    exe = static.Executor()
+    x = np.ones((2, 4), np.float32)
+    (out,) = exe.run(prog, feed={'image': jnp.asarray(x)},
+                     fetch_list=fetches)
+    assert out.shape == (2, 3)
+
+
+def test_spectral_norm_zero_iters():
+    w = jnp.asarray(np.random.default_rng(7).normal(size=(4, 6)),
+                    jnp.float32)
+    scope = static.compat.Scope()
+    with static.scope_guard(scope):
+        out = static.nn.spectral_norm(w, power_iters=0, name='sn0')
+    assert np.isfinite(np.asarray(out)).all()

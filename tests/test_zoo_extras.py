@@ -1,0 +1,235 @@
+"""Vision zoo variants, transforms extras, dataset folders, fleet
+classes, nn.quant (ref: matching paddle modules)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision import transforms as T
+
+torch = pytest.importorskip('torch')
+
+
+def _n_params(m):
+    return sum(int(np.prod(p.shape)) for p in m.parameters())
+
+
+def test_resnext_and_wide_param_counts():
+    pt.seed(0)
+    # published param counts (1000-class ImageNet heads)
+    rx = M.resnext50_32x4d()
+    assert abs(_n_params(rx) - 25.03e6) / 25.03e6 < 0.02
+    wr = M.wide_resnet50_2()
+    assert abs(_n_params(wr) - 68.88e6) / 68.88e6 < 0.02
+    x = jnp.ones((1, 32, 32, 3))
+    assert rx.eval()(x).shape == (1, 1000)
+
+
+def test_densenet_shufflenet_mbv3_variants():
+    pt.seed(0)
+    x = jnp.ones((1, 32, 32, 3))
+    d161 = M.densenet161(num_classes=7)
+    assert d161.eval()(x).shape == (1, 7)
+    for ctor in (M.shufflenet_v2_x0_25, M.shufflenet_v2_x0_33,
+                 M.shufflenet_v2_x1_5, M.shufflenet_v2_swish):
+        assert ctor(num_classes=5).eval()(x).shape == (1, 5)
+    assert M.MobileNetV3Small(num_classes=4).eval()(x).shape == (1, 4)
+    assert M.MobileNetV3Large(num_classes=4).eval()(x).shape == (1, 4)
+    assert M.densenet264(num_classes=3).eval()(x).shape == (1, 3)
+
+
+def test_transform_color_functionals():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (8, 10, 3)).astype(np.uint8)
+
+    # brightness: pure scaling with uint8 clipping
+    got = T.adjust_brightness(img, 0.5)
+    np.testing.assert_allclose(got.astype(int),
+                               np.clip(img * 0.5, 0, 255).astype(int),
+                               atol=1)
+    assert T.adjust_brightness(img, 2.5).max() == 255
+    # contrast: blend toward the gray mean; factor 1 is identity
+    np.testing.assert_array_equal(T.adjust_contrast(img, 1.0), img)
+    low = T.adjust_contrast(img, 0.0).astype(np.float32)
+    assert low.std() < 1.0  # collapsed to the mean
+    # hue: rotating by h then -h returns the original (up to rounding);
+    # rotating by 0.5 on a pure red pixel lands on cyan
+    red = np.zeros((1, 1, 3), np.uint8)
+    red[..., 0] = 200
+    cyan = T.adjust_hue(red, 0.5)
+    assert cyan[0, 0, 0] < 10 and cyan[0, 0, 1] > 190 and cyan[0, 0, 2] > 190
+    back = T.adjust_hue(T.adjust_hue(img, 0.2), -0.2)
+    np.testing.assert_allclose(back.astype(int), img.astype(int), atol=3)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape and (g[..., 0] == g[..., 1]).all()
+
+
+def test_transform_geometry():
+    img = np.zeros((9, 9), np.uint8)
+    img[4, 6] = 255
+    rot = T.rotate(img, 90)
+    # 90° about the center moves (r=4, c=6) -> (r=2, c=4)... verify via
+    # the one nonzero pixel relocating with value preserved
+    assert rot.max() == 255 and rot[4, 6] == 0
+    ident = T.affine(img, 0, (0, 0), 1.0, 0.0)
+    np.testing.assert_array_equal(ident, img)
+    shifted = T.affine(img, 0, (1, 0), 1.0, 0.0)
+    assert shifted[4, 7] == 255
+    pts = [(0, 0), (8, 0), (8, 8), (0, 8)]
+    same = T.perspective(img, pts, pts)
+    np.testing.assert_array_equal(same, img)
+    er = T.erase(img, 3, 5, 3, 3, 0)
+    assert er[4, 6] == 0
+    np.random.seed(0)
+    rrc = T.RandomResizedCrop(6)(np.ones((12, 12, 3), np.uint8) * 7)
+    assert rrc.shape[:2] == (6, 6)
+    out = T.RandomErasing(prob=1.0)(np.ones((10, 10, 3), np.float32))
+    assert out.min() == 0.0
+    ra = T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1))(
+        np.ones((10, 10, 3), np.uint8))
+    assert ra.shape == (10, 10, 3)
+    rp = T.RandomPerspective(prob=1.0)(np.ones((10, 10, 3), np.uint8))
+    assert rp.shape == (10, 10, 3)
+    st = T.SaturationTransform(0.4)(np.ones((6, 6, 3), np.uint8) * 100)
+    assert st.shape == (6, 6, 3)
+    ht = T.HueTransform(0.3)(np.ones((6, 6, 3), np.uint8) * 100)
+    assert ht.shape == (6, 6, 3)
+
+
+def test_dataset_folders(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import (DatasetFolder, FashionMNIST,
+                                            Flowers, ImageFolder, VOC2012)
+
+    for cls in ('cat', 'dog'):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            Image.fromarray(np.full((4, 5, 3), i * 40, np.uint8)).save(
+                d / f'{i}.png')
+    df = DatasetFolder(str(tmp_path))
+    assert df.classes == ['cat', 'dog'] and len(df) == 4
+    img, label = df[0]
+    assert img.shape == (4, 5, 3) and label == 0
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 4 and flat[0][0].shape == (4, 5, 3)
+
+    fm = FashionMNIST(mode='train')
+    img, label = fm[0]
+    assert img.shape == (28, 28, 1)
+    fl = Flowers(mode='test')
+    img, label = fl[0]
+    assert img.shape == (64, 64, 3) and 0 <= int(label) < 102
+    voc = VOC2012(mode='train')
+    img, mask = voc[0]
+    assert img.shape == (64, 64, 3) and mask.shape == (64, 64)
+
+
+def test_fleet_classes():
+    from paddle_tpu.distributed import fleet
+
+    f = fleet.Fleet()
+    assert f.worker_num() >= 1 and f.is_first_worker()
+    util = f.util
+    assert util.get_file_shard(['a', 'b', 'c'])
+    assert util.all_gather(5)
+    topo = fleet.CommunicateTopology(dims=(2, 1, 2, 2))
+    assert topo.world_size() == 8 and topo.get_dim('model') == 2
+    hcg = fleet.HybridCommunicateGroup()
+    assert hcg.get_model_parallel_rank() == 0
+    rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+    assert rm._role() == fleet.Role.WORKER
+    fleet.UserDefinedRoleMaker()
+    with pytest.raises(NotImplementedError):
+        fleet.MultiSlotDataGenerator()
+
+
+def test_nn_quant():
+    from paddle_tpu.nn import quant as Q
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    wq, scale = Q.weight_quantize(jnp.asarray(w))
+    assert wq.dtype == jnp.int8
+    back = np.asarray(Q.weight_dequantize(wq, scale))
+    np.testing.assert_allclose(back, w, atol=np.abs(w).max() / 100)
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    # reference signature: (x, weight, bias=None, weight_scale=None)
+    out = np.asarray(Q.weight_only_linear(jnp.asarray(x), wq,
+                                          weight_scale=scale))
+    np.testing.assert_allclose(out, x @ w, rtol=0.05, atol=0.1)
+    out8 = np.asarray(Q.llm_int8_linear(jnp.asarray(x), wq,
+                                        weight_scale=scale))
+    np.testing.assert_allclose(out8, out, atol=1e-5)
+    w4, s4 = Q.weight_quantize(jnp.asarray(w), algo='weight_only_int4')
+    assert int(np.asarray(w4).max()) <= 7 and int(np.asarray(w4).min()) >= -8
+    back4 = np.asarray(Q.weight_dequantize(w4, s4, algo='weight_only_int4'))
+    np.testing.assert_allclose(back4, w, atol=np.abs(w).max() / 6)
+    assert Q.Stub()(jnp.ones(3)).shape == (3,)
+
+
+def test_hapi_wrapper_optimizer_still_works():
+    """Regression: lr threading must not break wrapper optimizers whose
+    apply_gradients lacks the lr kwarg (GradientMerge etc.)."""
+    from paddle_tpu.optimizer import SGD, GradientMerge
+
+    pt.seed(0)
+    net = pt.nn.Linear(3, 1, bias_attr=False)
+    model = pt.hapi.Model(net)
+    model.prepare(GradientMerge(SGD(learning_rate=0.5), k_steps=2),
+                  pt.nn.MSELoss())
+    x = np.ones((4, 3), np.float32)
+    y = np.zeros((4, 1), np.float32)
+    w0 = np.asarray(model.network.weight).copy()
+    model.train_batch(x, y)   # accumulate only
+    model.train_batch(x, y)   # apply
+    assert not np.allclose(np.asarray(model.network.weight), w0)
+
+
+def test_affine_shear_semantics():
+    img = np.zeros((11, 11), np.uint8)
+    img[:, 5] = 255                      # a vertical line
+    sheared = T.affine(img, 0, (0, 0), 1.0, 30)
+    # x-shear: the vertical line must TILT (different columns lit per row)
+    cols = [np.argmax(sheared[r]) for r in range(11) if sheared[r].max()]
+    assert len(set(cols)) > 1, 'vertical line did not tilt under x-shear'
+    # area-ish preservation: shear keeps most mass (no det shrink)
+    assert sheared.sum() > 0.7 * img.sum()
+    # tuple shear draws from the range
+    np.random.seed(1)
+    ra = T.RandomAffine(0, shear=(29.9, 30.1))(img)
+    cols2 = [np.argmax(ra[r]) for r in range(11) if ra[r].max()]
+    assert len(set(cols2)) > 1
+
+
+def test_reduce_lr_plateau_uses_current_schedule_step():
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.optimizer.lr import ExponentialDecay
+
+    opt = SGD(learning_rate=ExponentialDecay(1.0, gamma=0.5))
+    opt.state = {'step': 4}              # schedule has decayed to 0.0625
+    cb = pt.callbacks.ReduceLROnPlateau(monitor='loss', factor=0.5,
+                                        patience=1, verbose=0)
+
+    class FakeModel:
+        _optimizer = opt
+
+    cb.model = FakeModel()
+    cb.on_epoch_end(0, {'loss': 1.0})
+    cb.on_epoch_end(1, {'loss': 1.0})
+    new_lr = opt._lr if not callable(opt._lr) else None
+    assert new_lr is not None and new_lr < 0.1, \
+        f'plateau lr {new_lr} must come from the decayed schedule'
+
+
+def test_weighted_sampler_few_positive_weights():
+    row = np.arange(5, dtype=np.int64)
+    colptr = np.array([0, 5], np.int64)
+    w = np.array([1.0, 1.0, 1.0, 0.0, 0.0])
+    n, c = pt.geometric.weighted_sample_neighbors(
+        row, colptr, w, np.array([0]), 4)
+    assert c[0] == 3 and set(n) <= {0, 1, 2}
