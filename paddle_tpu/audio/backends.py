@@ -48,13 +48,25 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         f.setpos(frame_offset)
         n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(n)
-    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
-    if width == 1:  # 8-bit WAV is unsigned
-        data = data.astype(np.int16) - 128
-        scale = 128.0
+    if width == 3:
+        # 24-bit PCM: widen each little-endian 3-byte sample to int32
+        b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+        data = (b[:, 0].astype(np.int32)
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = ((data << 8) >> 8).reshape(-1, n_ch)  # sign-extend
+        scale = float(2 ** 23)
     else:
-        scale = float(2 ** (8 * width - 1))
+        if width not in (1, 2, 4):
+            raise ValueError(f'unsupported PCM sample width {width} bytes '
+                             f'(1, 2, 3, and 4 are handled)')
+        dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+        if width == 1:  # 8-bit WAV is unsigned
+            data = data.astype(np.int16) - 128
+            scale = 128.0
+        else:
+            scale = float(2 ** (8 * width - 1))
     if normalize:
         wavf = (data.astype(np.float32) / scale)
     else:

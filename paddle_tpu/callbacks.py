@@ -215,8 +215,14 @@ class ReduceLROnPlateau(Callback):
             return
         cur = float(logs[self.monitor])
         if self._cooldown_left > 0:
+            # in cooldown: track the best but do NO patience accounting
+            # (otherwise patience drains during the window and the lr
+            # collapses once per epoch instead of once per window)
             self._cooldown_left -= 1
             self._wait = 0
+            if self._better(cur):
+                self._best = cur
+            return
         if self._better(cur):
             self._best = cur
             self._wait = 0

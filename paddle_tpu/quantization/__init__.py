@@ -163,6 +163,12 @@ class QAT:
     def quantize(self, model, inplace=False):
         from ..nn.layer.common import Linear
 
+        if not inplace:
+            import jax
+
+            leaves, treedef = jax.tree.flatten(model)
+            model = jax.tree.unflatten(treedef, leaves)  # structural copy
+
         def wrap(layer):
             for name, child in list(layer.__dict__.items()):
                 if isinstance(child, Linear):
@@ -179,6 +185,11 @@ class QAT:
 
     def convert(self, model, inplace=False):
         """Swap QAT wrappers for the int8 weight-only inference path."""
+        if not inplace:
+            import jax
+
+            leaves, treedef = jax.tree.flatten(model)
+            model = jax.tree.unflatten(treedef, leaves)  # structural copy
 
         def unwrap(layer):
             for name, child in list(layer.__dict__.items()):

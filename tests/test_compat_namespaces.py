@@ -280,3 +280,70 @@ def test_hapi_set_lr_takes_effect_in_jitted_step():
     small_delta = np.abs(np.asarray(model.network.weight) - w1).max()
     assert small_delta < big_delta * 1e-3, \
         'set_lr had no effect inside the jitted train step'
+
+
+def test_reduce_lr_cooldown_window():
+    """One reduction per cooldown window, not one per epoch."""
+    cb = pt.callbacks.ReduceLROnPlateau(monitor='loss', factor=0.5,
+                                        patience=1, cooldown=3, verbose=0)
+
+    class FakeOpt:
+        _lr = 1.0
+
+        def set_lr(self, v):
+            self._lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb.model = FakeModel()
+    for _ in range(6):
+        cb.on_epoch_end(0, {'loss': 1.0})
+    # epochs: reduce @1, cooldown 2-4, reduce @5 (wait rebuilt) -> max 2
+    assert FakeModel._optimizer._lr >= 0.25, \
+        f'lr collapsed through cooldown: {FakeModel._optimizer._lr}'
+
+
+def test_geometric_sample_neighbors_eids():
+    row = np.array([1, 2, 3], np.int64)
+    colptr = np.array([0, 3, 3, 3, 3], np.int64)
+    n, c, e = pt.geometric.sample_neighbors(
+        row, colptr, np.array([0]), 2, eids=np.array([10, 20, 30]),
+        return_eids=True)
+    assert len(e) == 2 and set(np.asarray(e).tolist()) <= {10, 20, 30}
+
+
+def test_qat_not_inplace():
+    from paddle_tpu.quantization import QAT, QuantConfig
+    from paddle_tpu.quantization import _QATLinear
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 4))
+    qat = QAT(QuantConfig())
+    qnet = qat.quantize(net)
+    # original keeps its plain Linear; wrapped copy got the QAT layer
+    from paddle_tpu.nn.layer.common import Linear
+
+    assert isinstance(net._modules_list()[0] if hasattr(net, '_modules_list')
+                      else list(net._children())[0][1], Linear)
+    assert any(isinstance(v, _QATLinear)
+               for _, v in qnet._children())
+
+
+def test_audio_24bit_wav(tmp_path):
+    import struct
+    import wave
+
+    sr = 8000
+    samples = np.array([0, 2 ** 22, -2 ** 22, 2 ** 23 - 1], np.int32)
+    p = str(tmp_path / 'w24.wav')
+    with wave.open(p, 'wb') as f:
+        f.setnchannels(1)
+        f.setsampwidth(3)
+        f.setframerate(sr)
+        raw = b''.join(struct.pack('<i', int(v))[:3] for v in samples)
+        f.writeframes(raw)
+    wav, sr2 = pt.audio.load(p)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(wav)[0],
+                               samples / 2 ** 23, atol=1e-6)

@@ -10,8 +10,6 @@ import paddle_tpu as pt
 from paddle_tpu.vision import models as M
 from paddle_tpu.vision import transforms as T
 
-torch = pytest.importorskip('torch')
-
 
 def _n_params(m):
     return sum(int(np.prod(p.shape)) for p in m.parameters())
