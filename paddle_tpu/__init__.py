@@ -56,8 +56,13 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
+from . import dataset  # noqa: F401
 from . import hub  # noqa: F401
+from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401
 from . import sparse  # noqa: F401

@@ -1,0 +1,26 @@
+"""Legacy paddle.dataset namespace (ref: python/paddle/dataset) — the
+pre-2.0 downloadable dataset helpers. Superseded by vision.datasets /
+text / audio.datasets (all download-free here); this shim routes the
+commonly-imported names to their modern homes so old scripts import.
+"""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    routes = {
+        'mnist': 'paddle_tpu.vision.datasets (MNIST)',
+        'cifar': 'paddle_tpu.vision.datasets (Cifar10/Cifar100)',
+        'flowers': 'paddle_tpu.vision.datasets (Flowers)',
+        'imdb': 'paddle_tpu.text (Imdb)',
+        'imikolov': 'paddle_tpu.text (Imikolov)',
+        'uci_housing': 'paddle_tpu.text (UCIHousing)',
+        'conll05': 'paddle_tpu.text datasets',
+        'movielens': 'paddle_tpu.text datasets',
+        'wmt14': 'paddle_tpu.text datasets',
+        'wmt16': 'paddle_tpu.text datasets',
+    }
+    if name in routes:
+        raise ImportError(
+            f'paddle.dataset.{name} is the deprecated pre-2.0 API; use '
+            f'{routes[name]} — same data, Dataset/DataLoader interface')
+    raise AttributeError(name)

@@ -149,33 +149,12 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                      return_eids=False, perm_buffer=None):
-    """ref: paddle.geometric.sample_neighbors (CSC graph). With
-    `return_eids`, a third array of sampled edge ids comes back (the
-    positions sampled, mapped through `eids` when given)."""
-    import numpy as np
+    """ref: paddle.geometric.sample_neighbors (CSC graph) — one shared
+    implementation with the incubate alias, including eids support."""
+    from ..incubate import graph_sample_neighbors
 
-    from ..incubate import _rng
-
-    row = np.asarray(row)
-    colptr = np.asarray(colptr)
-    eids_arr = None if eids is None else np.asarray(eids)
-    rng = _rng()
-    out_neigh, out_count, out_eids = [], [], []
-    for v in np.asarray(input_nodes).reshape(-1):
-        lo, hi = int(colptr[v]), int(colptr[v + 1])
-        pos = np.arange(lo, hi)
-        if sample_size >= 0 and len(pos) > sample_size:
-            pos = pos[rng.choice(len(pos), sample_size, replace=False)]
-        out_neigh.extend(row[pos].tolist())
-        out_count.append(len(pos))
-        if return_eids:
-            chosen = eids_arr[pos] if eids_arr is not None else pos
-            out_eids.extend(np.asarray(chosen).tolist())
-    result = (np.asarray(out_neigh, np.int64),
-              np.asarray(out_count, np.int64))
-    if return_eids:
-        return result + (np.asarray(out_eids, np.int64),)
-    return result
+    return graph_sample_neighbors(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, perm_buffer)
 
 
 def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,

@@ -108,22 +108,31 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            eids=None, return_eids=False, perm_buffer=None,
                            flag_perm_buffer=False):
-    """ref: paddle.incubate.graph_sample_neighbors — one-hop sampling."""
+    """ref: paddle.incubate.graph_sample_neighbors — one-hop sampling
+    over a CSC graph. With `return_eids`, a third array of sampled edge
+    ids (positions, or `eids` mapped through them) is returned."""
     import numpy as np
 
     row = np.asarray(row)
     colptr = np.asarray(colptr)
+    eids_arr = None if eids is None else np.asarray(eids)
     rng = _rng()
-    out_neigh, out_count = [], []
+    out_neigh, out_count, out_eids = [], [], []
     for v in np.asarray(input_nodes).reshape(-1):
         lo, hi = int(colptr[v]), int(colptr[v + 1])
-        neigh = row[lo:hi]
-        if sample_size >= 0 and len(neigh) > sample_size:
-            neigh = rng.choice(neigh, sample_size, replace=False)
-        out_neigh.extend(neigh.tolist())
-        out_count.append(len(neigh))
-    return (np.asarray(out_neigh, np.int64),
-            np.asarray(out_count, np.int64))
+        pos = np.arange(lo, hi)
+        if sample_size >= 0 and len(pos) > sample_size:
+            pos = pos[rng.choice(len(pos), sample_size, replace=False)]
+        out_neigh.extend(row[pos].tolist())
+        out_count.append(len(pos))
+        if return_eids:
+            chosen = eids_arr[pos] if eids_arr is not None else pos
+            out_eids.extend(np.asarray(chosen).tolist())
+    result = (np.asarray(out_neigh, np.int64),
+              np.asarray(out_count, np.int64))
+    if return_eids:
+        return result + (np.asarray(out_eids, np.int64),)
+    return result
 
 
 def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,

@@ -1,0 +1,261 @@
+"""paddle_tpu.inference (ref: python/paddle/inference) — the Predictor
+deployment API.
+
+The reference's Predictor wraps the C++ AnalysisPredictor over a saved
+inference model; here it wraps the StableHLO export the same
+`save_inference_model` produces, executed by XLA. TensorRT/IR-pass
+knobs on Config are accepted and recorded (XLA owns optimization).
+Mixed precision needs no graph rewrite on TPU — the MXU computes fp32
+matmuls with bf16 multiplicands natively — so
+`convert_to_mixed_precision` is a relabeling copy (see its docstring).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataType:
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT32 = 2
+    INT64 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class XpuConfig:
+    def __init__(self):
+        self.device_id = 0
+
+
+class Config:
+    """ref: paddle.inference.Config(prog_file_or_prefix[, params_file])."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # accept either a path prefix (our artifact layout) or the
+        # reference's (model, params) pair — strip known suffixes
+        prefix = prog_file or ''
+        for suffix in ('.pdmodel', '.mlir', '.json'):
+            if prefix.endswith(suffix):
+                prefix = prefix[:-len(suffix)]
+        self._prefix = prefix
+        self._use_accelerator = True
+        self._precision = PrecisionType.Float32
+        self._enabled_flags = {}
+
+    def model_dir(self):
+        import os
+
+        return os.path.dirname(self._prefix)
+
+    def prog_file(self):
+        return self._prefix + '.mlir'
+
+    def params_file(self):
+        return self._prefix + '.pdiparams'
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision_mode=PrecisionType.Float32):
+        self._use_accelerator = True
+        self._precision = precision_mode
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def use_gpu(self):
+        return self._use_accelerator
+
+    def enable_memory_optim(self, *a):
+        self._enabled_flags['memory_optim'] = True
+
+    def enable_mkldnn(self):
+        self._enabled_flags['mkldnn'] = True
+
+    def switch_ir_optim(self, x=True):
+        self._enabled_flags['ir_optim'] = x
+
+    def enable_tensorrt_engine(self, *a, **k):
+        # TensorRT is CUDA machinery; XLA compiles the same graph here
+        self._enabled_flags['tensorrt_requested'] = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._enabled_flags['cpu_threads'] = n
+
+    def summary(self):
+        return (f'Config(prefix={self._prefix!r}, '
+                f'accelerator={self._use_accelerator}, '
+                f'precision={self._precision})')
+
+
+class Tensor:
+    """ref: paddle.inference.Tensor — named IO handle on a Predictor."""
+
+    def __init__(self, name, predictor, is_input):
+        self._name = name
+        self._predictor = predictor
+        self._is_input = is_input
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        self._predictor._feeds[self._name] = np.asarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._outputs[self._name])
+
+    def reshape(self, shape):
+        pass  # shapes come from the export; kept for API parity
+
+    def shape(self):
+        src = (self._predictor._feeds if self._is_input
+               else self._predictor._outputs)
+        v = src.get(self._name)
+        return list(np.asarray(v).shape) if v is not None else []
+
+
+class Predictor:
+    """ref: paddle.inference.Predictor — run the exported program."""
+
+    def __init__(self, config):
+        from ..static import load_inference_model
+
+        self._config = config
+        prog, feeds, fetches = load_inference_model(config._prefix)
+        self._program = prog
+        self._feed_names = feeds
+        self._fetch_names = fetches
+        self._feeds = {}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return Tensor(name, self, True)
+
+    def get_output_handle(self, name):
+        return Tensor(name, self, False)
+
+    def run(self, inputs=None):
+        """Positional-list form returns outputs directly (new API);
+        handle form stores them for copy_to_cpu (classic API)."""
+        import jax.numpy as jnp
+
+        if inputs is not None:
+            args = [jnp.asarray(x) for x in inputs]
+        else:
+            args = [jnp.asarray(self._feeds[n]) for n in self._feed_names]
+        # PrecisionType.Bfloat16/Half need no input cast: the exported
+        # program's signature is fixed, and the TPU MXU already computes
+        # fp32 matmuls with bf16 multiplicands — reduced precision is
+        # the hardware default, not a graph rewrite
+        out = self._program._fn(*args)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return outs if inputs is not None else None
+
+    def try_shrink_memory(self):
+        pass
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config):
+    """ref: paddle.inference.create_predictor."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """ref: paddle.inference.PredictorPool — N independent predictors.
+    XLA executables are thread-safe; the pool exists for API parity."""
+
+    def __init__(self, config, size=1):
+        self._preds = [Predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx):
+        return self._preds[idx % len(self._preds)]
+
+
+def get_version():
+    from ..version import full_version
+
+    return f'paddle_tpu {full_version} (XLA inference)'
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)   # no TensorRT in the XLA build
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name     # Phi is replaced by XLA; identity for tooling
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT32: 4,
+             DataType.INT64: 8, DataType.UINT8: 1, DataType.INT8: 1,
+             DataType.BOOL: 1, DataType.BFLOAT16: 2}
+    return sizes[dtype]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """ref: paddle.inference.convert_to_mixed_precision.
+
+    On TPU this is a relabeling copy, not a graph rewrite: the MXU
+    already multiplies in bf16 for fp32 programs, so the exported
+    StableHLO runs at mixed precision as-is. The copied artifacts gain a
+    'precision' metadata tag purely as a record for tooling."""
+    import json
+    import os
+    import shutil
+
+    prefix = model_file
+    for suffix in ('.pdmodel', '.mlir'):
+        if prefix.endswith(suffix):
+            prefix = prefix[:-len(suffix)]
+    out_prefix = mixed_model_file
+    for suffix in ('.pdmodel', '.mlir'):
+        if out_prefix.endswith(suffix):
+            out_prefix = out_prefix[:-len(suffix)]
+    os.makedirs(os.path.dirname(os.path.abspath(out_prefix)), exist_ok=True)
+    for ext in ('.mlir', '.pdiparams', '.pdmodel.json', '.pdmodel.txt'):
+        src = prefix + ext
+        if os.path.exists(src):
+            shutil.copy(src, out_prefix + ext)
+    meta_path = out_prefix + '.pdmodel.json'
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    meta['precision'] = 'bfloat16'
+    with open(meta_path, 'w') as f:
+        json.dump(meta, f)
+    return out_prefix

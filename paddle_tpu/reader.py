@@ -1,0 +1,131 @@
+"""Legacy reader decorators (ref: python/paddle/reader/decorator.py) —
+kept for old training scripts; io.DataLoader is the modern input path.
+A "reader" is a zero-arg callable returning an iterator of samples.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ['cache', 'map_readers', 'buffered', 'compose', 'chain',
+           'shuffle', 'firstn', 'xmap_readers', 'multiprocess_reader']
+
+
+def cache(reader):
+    """ref: paddle.reader.cache — materialize once, replay from memory."""
+    data = list(reader())
+
+    def rd():
+        return iter(data)
+
+    return rd
+
+
+def map_readers(func, *readers):
+    """ref: paddle.reader.map_readers — zip readers through func."""
+
+    def rd():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    """ref: paddle.reader.shuffle — windowed shuffle."""
+
+    def rd():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    """ref: paddle.reader.chain — concatenate readers."""
+
+    def rd():
+        return itertools.chain(*[r() for r in readers])
+
+    return rd
+
+
+def compose(*readers, check_alignment=True):
+    """ref: paddle.reader.compose — tuple-zip outputs of readers."""
+
+    def _flatten(item):
+        return item if isinstance(item, tuple) else (item,)
+
+    def rd():
+        for items in zip(*[r() for r in readers]):
+            yield sum((_flatten(i) for i in items), ())
+
+    return rd
+
+
+def buffered(reader, size):
+    """ref: paddle.reader.buffered — background-thread prefetch queue."""
+    import queue
+    import threading
+
+    def rd():
+        q = queue.Queue(maxsize=size)
+        end = object()
+
+        def fill():
+            # the sentinel must reach the consumer even when the reader
+            # raises, or q.get() blocks forever; ship the exception so
+            # the consumer fails loudly instead of freezing
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(end)
+            except BaseException as e:  # noqa: BLE001
+                q.put(e)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    return rd
+
+
+def firstn(reader, n):
+    """ref: paddle.reader.firstn."""
+
+    def rd():
+        return itertools.islice(reader(), n)
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """ref: paddle.reader.xmap_readers — parallel map via threads."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def rd():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            yield from pool.map(mapper, reader())
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """ref: paddle.reader.multiprocess_reader — here a sequential chain
+    (the heavy-worker input path is io.DataLoader's process pool)."""
+    return chain(*readers)

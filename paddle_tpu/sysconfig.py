@@ -1,0 +1,15 @@
+"""paddle_tpu.sysconfig (ref: python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+
+def get_include():
+    """ref: paddle.sysconfig.get_include — C headers directory (the
+    native helpers' sources live under _native)."""
+    return os.path.join(os.path.dirname(__file__), '_native')
+
+
+def get_lib():
+    """ref: paddle.sysconfig.get_lib — built native libraries cache."""
+    return os.path.join(os.path.dirname(__file__), '_native')

@@ -1,0 +1,45 @@
+"""paddle_tpu.version (ref: python/paddle/version) — build metadata."""
+from __future__ import annotations
+
+# single source of truth: the package __version__ (defined before this
+# module is imported by paddle_tpu/__init__.py)
+from paddle_tpu import __version__ as full_version
+
+major, minor, patch = (full_version.split('.') + ['0', '0', '0'])[:3]
+rc = '0'
+commit = 'tpu-native'
+cuda_version = 'False'       # the reference reports the CUDA toolkit; N/A
+cudnn_version = 'False'
+istaged = False
+with_pip_cuda_libraries = 'OFF'
+xpu_version = 'False'
+
+
+def show():
+    """ref: paddle.version.show()."""
+    print(f'full_version: {full_version}')
+    print(f'major: {major}')
+    print(f'minor: {minor}')
+    print(f'patch: {patch}')
+    print(f'commit: {commit}')
+    print('backend: XLA:TPU (jax)')
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version
+
+
+def nccl():
+    return 'False'
+
+
+def cinn():
+    return 'False'

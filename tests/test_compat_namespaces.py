@@ -347,3 +347,97 @@ def test_audio_24bit_wav(tmp_path):
     assert sr2 == sr
     np.testing.assert_allclose(np.asarray(wav)[0],
                                samples / 2 ** 23, atol=1e-6)
+
+
+def test_version_sysconfig_reader():
+    assert pt.version.full_version == pt.__version__
+    pt.version.show()
+    assert pt.version.cuda() == 'False'
+    import os
+    assert os.path.isdir(pt.sysconfig.get_include())
+
+    r = pt.reader.cache(lambda: iter(range(5)))
+    assert list(r()) == [0, 1, 2, 3, 4] and list(r()) == [0, 1, 2, 3, 4]
+    m = pt.reader.map_readers(lambda a, b: a + b,
+                              lambda: iter([1, 2]), lambda: iter([10, 20]))
+    assert list(m()) == [11, 22]
+    s = pt.reader.shuffle(lambda: iter(range(10)), 4)
+    assert sorted(s()) == list(range(10))
+    c = pt.reader.chain(lambda: iter([1]), lambda: iter([2]))
+    assert list(c()) == [1, 2]
+    comp = pt.reader.compose(lambda: iter([1, 2]), lambda: iter(['a', 'b']))
+    assert list(comp()) == [(1, 'a'), (2, 'b')]
+    assert list(pt.reader.firstn(lambda: iter(range(100)), 3)()) == [0, 1, 2]
+    assert list(pt.reader.buffered(lambda: iter(range(4)), 2)()) == [0, 1, 2, 3]
+    assert sorted(pt.reader.xmap_readers(lambda v: v * 2,
+                                         lambda: iter([1, 2]), 2, 2)()) == [2, 4]
+    with pytest.raises(ImportError):
+        pt.dataset.mnist
+
+
+def test_inference_predictor(tmp_path):
+    import os
+
+    from paddle_tpu import inference, static
+    from paddle_tpu.jit import InputSpec
+
+    pt.seed(0)
+    net = pt.nn.Linear(4, 2).eval()
+    prefix = str(tmp_path / 'm')
+    static.save_inference_model(
+        prefix, [InputSpec((3, 4), 'float32', name='x')], None, layer=net)
+
+    config = inference.Config(prefix)
+    assert 'm' in config.summary()
+    pred = inference.create_predictor(config)
+    assert pred.get_input_names() == ['x']
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+
+    # classic handle API
+    h = pred.get_input_handle('x')
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, np.asarray(net(jnp.asarray(x))),
+                               rtol=1e-5)
+    # list API
+    outs = pred.run([x])
+    np.testing.assert_allclose(np.asarray(outs[0]), out, rtol=1e-6)
+
+    # bf16 conversion path
+    mixed = str(tmp_path / 'mixed' / 'm')
+    inference.convert_to_mixed_precision(prefix + '.pdmodel', '',
+                                         mixed + '.pdmodel', '')
+    cfg2 = inference.Config(mixed)
+    cfg2.enable_use_gpu(precision_mode=inference.PrecisionType.Bfloat16)
+    pred2 = inference.create_predictor(cfg2)
+    outs2 = pred2.run([x])
+    np.testing.assert_allclose(np.asarray(outs2[0]).astype(np.float32),
+                               out, rtol=1e-5)
+    pool = inference.PredictorPool(config, 2)
+    assert pool.retrieve(1) is not None
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.BFLOAT16) == 2
+    assert 'paddle_tpu' in inference.get_version()
+
+
+def test_reader_buffered_propagates_errors():
+    def bad():
+        yield 1
+        raise RuntimeError('corrupt sample')
+
+    it = pt.reader.buffered(lambda: bad(), 2)()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match='corrupt sample'):
+        list(it)
+
+
+def test_incubate_sample_neighbors_eids():
+    row = np.array([1, 2, 3], np.int64)
+    colptr = np.array([0, 3, 3, 3, 3], np.int64)
+    import paddle_tpu.incubate as inc
+
+    n, c, e = inc.graph_sample_neighbors(
+        row, colptr, np.array([0]), 2, eids=np.array([7, 8, 9]),
+        return_eids=True)
+    assert len(e) == 2 and set(np.asarray(e).tolist()) <= {7, 8, 9}
