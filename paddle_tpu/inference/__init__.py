@@ -65,6 +65,16 @@ class Config:
 
         return os.path.dirname(self._prefix)
 
+    def set_model(self, model_path, params_path=None):
+        """ref: Config.set_model — path prefix (or dir) of the export."""
+        self.__init__(model_path, params_path)
+
+    def set_prog_file(self, path):
+        self.__init__(path)
+
+    def set_params_file(self, path):
+        pass  # params live beside the program under our prefix layout
+
     def prog_file(self):
         return self._prefix + '.mlir'
 
@@ -134,14 +144,28 @@ class Tensor:
 class Predictor:
     """ref: paddle.inference.Predictor — run the exported program."""
 
-    def __init__(self, config):
+    def __init__(self, config, _shared=None):
+        import os
+
         from ..static import load_inference_model
 
         self._config = config
-        prog, feeds, fetches = load_inference_model(config._prefix)
+        if not config._prefix:
+            raise ValueError(
+                'Config has no model path: pass Config(path_prefix) or '
+                'call config.set_model(path_prefix) before '
+                'create_predictor')
+        if not os.path.exists(config.prog_file()):
+            raise FileNotFoundError(
+                f'{config.prog_file()!r} not found — the prefix should '
+                f'point at a save_inference_model/jit.save export')
+        if _shared is not None:
+            prog, feeds, fetches = _shared
+        else:
+            prog, feeds, fetches = load_inference_model(config._prefix)
         self._program = prog
-        self._feed_names = feeds
-        self._fetch_names = fetches
+        self._feed_names = list(feeds)
+        self._fetch_names = list(fetches)
         self._feeds = {}
         self._outputs = {}
 
@@ -172,6 +196,13 @@ class Predictor:
         # the hardware default, not a graph rewrite
         out = self._program._fn(*args)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(outs) > len(self._fetch_names):
+            # the export produced more outputs than declared names:
+            # extend rather than silently dropping the tail
+            base = self._fetch_names[-1] if self._fetch_names else 'out'
+            self._fetch_names = self._fetch_names + [
+                f'{base}_{i}' for i in range(1, len(outs)
+                                             - len(self._fetch_names) + 1)]
         self._outputs = dict(zip(self._fetch_names, outs))
         return outs if inputs is not None else None
 
@@ -188,11 +219,16 @@ def create_predictor(config):
 
 
 class PredictorPool:
-    """ref: paddle.inference.PredictorPool — N independent predictors.
-    XLA executables are thread-safe; the pool exists for API parity."""
+    """ref: paddle.inference.PredictorPool — N predictors over ONE
+    loaded program (XLA executables are thread-safe, so the pool shares
+    the artifact instead of parsing and holding the weights N times)."""
 
     def __init__(self, config, size=1):
-        self._preds = [Predictor(config) for _ in range(max(1, size))]
+        from ..static import load_inference_model
+
+        shared = load_inference_model(config._prefix)
+        self._preds = [Predictor(config, _shared=shared)
+                       for _ in range(max(1, size))]
 
     def retrieve(self, idx):
         return self._preds[idx % len(self._preds)]
@@ -255,7 +291,9 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
-    meta['precision'] = 'bfloat16'
+    names = {PrecisionType.Float32: 'float32', PrecisionType.Half: 'float16',
+             PrecisionType.Int8: 'int8', PrecisionType.Bfloat16: 'bfloat16'}
+    meta['precision'] = names.get(mixed_precision, 'bfloat16')
     with open(meta_path, 'w') as f:
         json.dump(meta, f)
     return out_prefix

@@ -59,14 +59,29 @@ def chain(*readers):
     return rd
 
 
+class ComposeNotAligned(ValueError):
+    """ref: paddle.reader.ComposeNotAligned."""
+
+
 def compose(*readers, check_alignment=True):
-    """ref: paddle.reader.compose — tuple-zip outputs of readers."""
+    """ref: paddle.reader.compose — tuple-zip outputs of readers;
+    with check_alignment (the default) uneven readers RAISE instead of
+    silently truncating the longer ones."""
+    import itertools as _it
 
     def _flatten(item):
         return item if isinstance(item, tuple) else (item,)
 
+    _end = object()
+
     def rd():
-        for items in zip(*[r() for r in readers]):
+        its = [r() for r in readers]
+        for items in _it.zip_longest(*its, fillvalue=_end):
+            if _end in items:
+                if check_alignment and any(i is not _end for i in items):
+                    raise ComposeNotAligned(
+                        'readers produced different numbers of samples')
+                return
             yield sum((_flatten(i) for i in items), ())
 
     return rd
@@ -115,12 +130,23 @@ def firstn(reader, n):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """ref: paddle.reader.xmap_readers — parallel map via threads."""
+    """ref: paddle.reader.xmap_readers — parallel map via threads with a
+    BOUNDED in-flight window (Executor.map would pull the whole reader
+    up front and OOM on streaming datasets)."""
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     def rd():
+        window = max(int(buffer_size), process_num, 1)
         with ThreadPoolExecutor(max_workers=process_num) as pool:
-            yield from pool.map(mapper, reader())
+            pending = deque()
+            it = reader()
+            for item in it:
+                pending.append(pool.submit(mapper, item))
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
 
     return rd
 

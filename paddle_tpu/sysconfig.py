@@ -11,5 +11,10 @@ def get_include():
 
 
 def get_lib():
-    """ref: paddle.sysconfig.get_lib — built native libraries cache."""
-    return os.path.join(os.path.dirname(__file__), '_native')
+    """ref: paddle.sysconfig.get_lib — directory holding the BUILT
+    native libraries (the same cache _native compiles into)."""
+    cache = os.environ.get(
+        'PADDLE_TPU_NATIVE_CACHE',
+        os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu'))
+    os.makedirs(cache, exist_ok=True)
+    return cache

@@ -441,3 +441,94 @@ def test_incubate_sample_neighbors_eids():
         row, colptr, np.array([0]), 2, eids=np.array([7, 8, 9]),
         return_eids=True)
     assert len(e) == 2 and set(np.asarray(e).tolist()) <= {7, 8, 9}
+
+
+def test_reader_compose_alignment_and_xmap_streaming():
+    with pytest.raises(pt.reader.ComposeNotAligned):
+        list(pt.reader.compose(lambda: iter([1, 2, 3]),
+                               lambda: iter(['a', 'b']))())
+    ok = pt.reader.compose(lambda: iter([1, 2, 3]),
+                           lambda: iter(['a', 'b']),
+                           check_alignment=False)
+    assert list(ok()) == [(1, 'a'), (2, 'b')]
+
+    # xmap keeps a bounded window: track peak in-flight count
+    import threading
+    import time
+
+    in_flight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def slow_mapper(v):
+        with lock:
+            in_flight[0] += 1
+            peak[0] = max(peak[0], in_flight[0])
+        time.sleep(0.005)
+        with lock:
+            in_flight[0] -= 1
+        return v * 2
+
+    out = list(pt.reader.xmap_readers(slow_mapper,
+                                      lambda: iter(range(40)), 2, 4)())
+    assert out == [v * 2 for v in range(40)]
+    assert peak[0] <= 6  # bounded by the window, not the dataset size
+
+
+def test_predictor_pool_and_config_mutators(tmp_path):
+    from paddle_tpu import inference, static
+    from paddle_tpu.jit import InputSpec
+
+    pt.seed(1)
+    net = pt.nn.Linear(3, 2).eval()
+    prefix = str(tmp_path / 'p')
+    static.save_inference_model(
+        prefix, [InputSpec((2, 3), 'float32', name='x')], None, layer=net)
+    cfg = inference.Config()
+    with pytest.raises(ValueError):
+        inference.create_predictor(cfg)
+    cfg.set_model(prefix)
+    pool = inference.PredictorPool(cfg, 3)
+    x = np.ones((2, 3), np.float32)
+    outs = [np.asarray(pool.retrieve(i).run([x])[0]) for i in range(3)]
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-6)
+    with pytest.raises(FileNotFoundError):
+        inference.create_predictor(inference.Config(str(tmp_path / 'nope')))
+
+    # multi-output exports keep every output reachable by handle
+    class TwoOut(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = pt.nn.Linear(3, 2)
+
+        def forward(self, v):
+            out = self.lin(v)
+            return out, out.sum()
+
+    prefix2 = str(tmp_path / 'two')
+    static.save_inference_model(
+        prefix2, [InputSpec((2, 3), 'float32', name='x')], None,
+        layer=TwoOut().eval())
+    pred = inference.create_predictor(inference.Config(prefix2))
+    outs = pred.run([x])
+    assert len(outs) == 2
+    h = pred.get_input_handle('x'); h.copy_from_cpu(x); pred.run()
+    names = pred.get_output_names()
+    assert len(names) == 2
+    np.testing.assert_allclose(
+        pred.get_output_handle(names[1]).copy_to_cpu(),
+        np.asarray(outs[1]), rtol=1e-6)
+
+    # precision arg lands in the metadata
+    import json as _json
+
+    mixed = str(tmp_path / 'mx' / 'p')
+    inference.convert_to_mixed_precision(
+        prefix + '.pdmodel', '', mixed + '.pdmodel', '',
+        mixed_precision=inference.PrecisionType.Half)
+    meta = _json.loads(open(mixed + '.pdmodel.json').read())
+    assert meta['precision'] == 'float16'
+
+    import os
+
+    assert os.path.isdir(pt.sysconfig.get_lib())
