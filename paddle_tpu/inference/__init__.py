@@ -51,11 +51,7 @@ class Config:
     def __init__(self, prog_file=None, params_file=None):
         # accept either a path prefix (our artifact layout) or the
         # reference's (model, params) pair — strip known suffixes
-        prefix = prog_file or ''
-        for suffix in ('.pdmodel', '.mlir', '.json'):
-            if prefix.endswith(suffix):
-                prefix = prefix[:-len(suffix)]
-        self._prefix = prefix
+        self._set_prefix(prog_file or '')
         self._use_accelerator = True
         self._precision = PrecisionType.Float32
         self._enabled_flags = {}
@@ -65,12 +61,19 @@ class Config:
 
         return os.path.dirname(self._prefix)
 
+    def _set_prefix(self, prefix):
+        for suffix in ('.pdmodel', '.mlir', '.json'):
+            if prefix.endswith(suffix):
+                prefix = prefix[:-len(suffix)]
+        self._prefix = prefix
+
     def set_model(self, model_path, params_path=None):
-        """ref: Config.set_model — path prefix (or dir) of the export."""
-        self.__init__(model_path, params_path)
+        """ref: Config.set_model — sets ONLY the path; accelerator /
+        precision / pass flags the user already chose are preserved."""
+        self._set_prefix(model_path or '')
 
     def set_prog_file(self, path):
-        self.__init__(path)
+        self._set_prefix(path or '')
 
     def set_params_file(self, path):
         pass  # params live beside the program under our prefix layout
@@ -224,11 +227,13 @@ class PredictorPool:
     the artifact instead of parsing and holding the weights N times)."""
 
     def __init__(self, config, size=1):
-        from ..static import load_inference_model
-
-        shared = load_inference_model(config._prefix)
-        self._preds = [Predictor(config, _shared=shared)
-                       for _ in range(max(1, size))]
+        # build the first member normally so its path validation (clear
+        # ValueError / FileNotFoundError) runs, then share its loaded
+        # program with the rest
+        first = Predictor(config)
+        shared = (first._program, first._feed_names, first._fetch_names)
+        self._preds = [first] + [Predictor(config, _shared=shared)
+                                 for _ in range(max(1, size) - 1)]
 
     def retrieve(self, idx):
         return self._preds[idx % len(self._preds)]

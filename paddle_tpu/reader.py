@@ -77,7 +77,9 @@ def compose(*readers, check_alignment=True):
     def rd():
         its = [r() for r in readers]
         for items in _it.zip_longest(*its, fillvalue=_end):
-            if _end in items:
+            # identity check, not `in`: ndarray samples overload == and
+            # would raise 'truth value of an array is ambiguous'
+            if any(i is _end for i in items):
                 if check_alignment and any(i is not _end for i in items):
                     raise ComposeNotAligned(
                         'readers produced different numbers of samples')

@@ -14,7 +14,7 @@ def get_lib():
     """ref: paddle.sysconfig.get_lib — directory holding the BUILT
     native libraries (the same cache _native compiles into)."""
     cache = os.environ.get(
-        'PADDLE_TPU_NATIVE_CACHE',
+        'PADDLE_TPU_CACHE',   # the SAME var _native/__init__.py honors
         os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu'))
     os.makedirs(cache, exist_ok=True)
     return cache

@@ -452,27 +452,31 @@ def test_reader_compose_alignment_and_xmap_streaming():
                            check_alignment=False)
     assert list(ok()) == [(1, 'a'), (2, 'b')]
 
-    # xmap keeps a bounded window: track peak in-flight count
-    import threading
-    import time
+    # xmap keeps a bounded window: the SOURCE must not be consumed far
+    # ahead of what has been yielded (an eager Executor.map would pull
+    # the whole reader before the first yield)
+    consumed = [0]
+    yielded = [0]
+    max_lead = [0]
 
-    in_flight = [0]
-    peak = [0]
-    lock = threading.Lock()
+    def counting_reader():
+        for v in range(40):
+            consumed[0] += 1
+            max_lead[0] = max(max_lead[0], consumed[0] - yielded[0])
+            yield v
 
-    def slow_mapper(v):
-        with lock:
-            in_flight[0] += 1
-            peak[0] = max(peak[0], in_flight[0])
-        time.sleep(0.005)
-        with lock:
-            in_flight[0] -= 1
-        return v * 2
-
-    out = list(pt.reader.xmap_readers(slow_mapper,
-                                      lambda: iter(range(40)), 2, 4)())
+    gen = pt.reader.xmap_readers(lambda v: v * 2, counting_reader, 2, 4)()
+    out = []
+    for v in gen:
+        out.append(v)
+        yielded[0] += 1
     assert out == [v * 2 for v in range(40)]
-    assert peak[0] <= 6  # bounded by the window, not the dataset size
+    assert max_lead[0] <= 4 + 2, \
+        f'source ran {max_lead[0]} samples ahead of consumption'
+    # ndarray samples work through compose (identity sentinel check)
+    pair = list(pt.reader.compose(lambda: iter([np.zeros(3)]),
+                                  lambda: iter([np.ones(3)]))())
+    assert len(pair) == 1 and len(pair[0]) == 2
 
 
 def test_predictor_pool_and_config_mutators(tmp_path):
@@ -532,3 +536,16 @@ def test_predictor_pool_and_config_mutators(tmp_path):
     import os
 
     assert os.path.isdir(pt.sysconfig.get_lib())
+
+
+def test_inference_config_set_model_preserves_flags(tmp_path):
+    from paddle_tpu import inference
+
+    cfg = inference.Config()
+    cfg.disable_gpu()
+    cfg.enable_memory_optim()
+    cfg.set_model(str(tmp_path / 'x'))
+    assert not cfg.use_gpu(), 'set_model reset the accelerator choice'
+    assert cfg._enabled_flags.get('memory_optim'), \
+        'set_model dropped user flags'
+    assert cfg.prog_file().endswith('x.mlir')
