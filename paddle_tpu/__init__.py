@@ -158,3 +158,12 @@ for _name in [
     if _fn is not None and not hasattr(_self, _name + '_'):
         setattr(_self, _name + '_', _fn)
 del _sys, _self, _name, _fn
+
+# Bind the paddle Tensor method surface (x.unsqueeze / x.numpy / x.add ...)
+# onto jax array + tracer classes — ref tensor/__init__.py:459,
+# base/dygraph/tensor_patch_methods.py:86. Must run after the namespaces
+# above exist.
+from .tensor import methods as _tensor_methods  # noqa: E402
+
+_tensor_methods.monkey_patch_tensor()
+del _tensor_methods

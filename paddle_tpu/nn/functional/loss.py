@@ -207,13 +207,17 @@ def square_error_cost(input, label):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction='mean', norm_by_times=False):
     """CTC via the standard dynamic program in log space (lax.scan over time).
-    ref: nn/functional/loss.py::ctc_loss. log_probs: (T, B, C) after
-    log_softmax."""
+
+    ref: nn/functional/loss.py::ctc_loss ("aliased as softmax with CTC"):
+    `log_probs` is the UNSCALED logit sequence, shape (T, B, C) — softmax
+    is applied internally, matching warp-ctc. `norm_by_times` scales the
+    gradient (not the value) by 1/T_i per sequence, as warp-ctc does.
+    """
     T, B, C = log_probs.shape
     L = labels.shape[1]
     S = 2 * L + 1
     ninf = jnp.float32(-1e30)
-    lp = log_probs.astype(jnp.float32)
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
 
     ext = jnp.full((B, S), blank, dtype=labels.dtype)
     ext = ext.at[:, 1::2].set(labels)
@@ -258,6 +262,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     ll = m_safe + jnp.log(jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe))
     loss = -ll
+    if norm_by_times:
+        # warp-ctc semantics: divide the GRADIENT by the sequence length,
+        # leaving the loss value unchanged (the reference forwards the
+        # flag to warpctc for every reduction mode)
+        t = jnp.clip(input_lengths.astype(jnp.float32), 1, None)
+        loss = loss / t + jax.lax.stop_gradient(loss - loss / t)
     if reduction == 'mean':
         return jnp.mean(loss / jnp.clip(label_lengths.astype(jnp.float32), 1, None))
     return _reduce(loss, reduction)

@@ -136,3 +136,10 @@ def numel(x):
 
 def one_hot(x, num_classes):
     return jax.nn.one_hot(x, num_classes)
+
+
+def create_tensor(dtype='float32', name=None, persistable=False):
+    """ref: tensor/creation.py::create_tensor — an empty, typed tensor
+    placeholder (the reference returns an uninitialised variable)."""
+    from ..framework import dtype as dtype_mod
+    return jnp.zeros((0,), dtype=dtype_mod.convert_dtype(dtype or 'float32'))

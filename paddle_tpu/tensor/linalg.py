@@ -269,3 +269,8 @@ def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
     elif activation in ('relu',):
         out = jnp.maximum(out, 0)
     return out.astype(output_dtype)
+
+
+def inverse(x, name=None):
+    """ref: tensor/math.py::inverse — alias of linalg.inv."""
+    return inv(x)

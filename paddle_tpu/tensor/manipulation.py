@@ -7,11 +7,17 @@ import numpy as np
 
 
 def reshape(x, shape):
+    # paddle semantics (ref tensor/manipulation.py::reshape): an entry of
+    # 0 copies the input dim at the same index; -1 infers (jnp native)
+    if not isinstance(shape, (list, tuple)):
+        return jnp.reshape(x, shape)  # bare int / array shape
+    shape = [x.shape[i] if s == 0 and i < x.ndim else s
+             for i, s in enumerate(shape)]
     return jnp.reshape(x, shape)
 
 
 def reshape_(x, shape):
-    return jnp.reshape(x, shape)
+    return reshape(x, shape)
 
 
 def transpose(x, perm=None):
@@ -350,6 +356,12 @@ def as_strided(x, shape, stride, offset=0):
 def view(x, shape_or_dtype):
     if isinstance(shape_or_dtype, (list, tuple)):
         return jnp.reshape(x, shape_or_dtype)
+    # dtype reinterpret: use jax's original .view — the method itself is
+    # rebound to this function, so calling x.view here would recurse
+    from .methods import _ORIGINALS
+    orig = _ORIGINALS.get('view')
+    if orig is not None:
+        return orig(x, shape_or_dtype)
     return x.view(shape_or_dtype)
 
 

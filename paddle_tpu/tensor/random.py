@@ -136,3 +136,48 @@ def geometric_(x, probs):
     x = jnp.asarray(x)
     key = random_mod.split_key()
     return jax.random.geometric(key, probs, x.shape).astype(x.dtype)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode='truncated'):
+    """Nucleus sampling over a [batch, vocab] probability tensor.
+
+    ref: tensor/random.py::top_p_sampling (GPU kernel there; jnp here):
+    keeps the smallest prefix of descending-sorted probs whose mass
+    exceeds ``ps`` (per row), renormalises, samples one token. ``k > 0``
+    additionally truncates to the top-k tokens; ``seed >= 0`` (or
+    ``topp_seed``) makes the draw reproducible; ``mode='non-truncated'``
+    skips the ``threshold`` floor (per the reference, threshold only
+    applies in truncated mode). Returns (sampled probability, sampled
+    index), both shaped [batch, 1].
+    """
+    x = jnp.asarray(x)
+    ps = jnp.reshape(jnp.asarray(ps, dtype=x.dtype), (-1, 1))
+    order = jnp.argsort(-x, axis=-1)
+    sorted_p = jnp.take_along_axis(x, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep token i if the mass strictly before it is < ps (always keeps
+    # the top-1 token); optional threshold floor mirrors the reference.
+    keep = (cum - sorted_p) < ps
+    if k:
+        keep = keep & (jnp.arange(x.shape[-1])[None, :] < k)
+        keep = keep.at[:, 0].set(True)
+    if threshold is not None and mode == 'truncated':
+        keep = keep & (sorted_p >= jnp.reshape(
+            jnp.asarray(threshold, dtype=x.dtype), (-1, 1)))
+        keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, sorted_p, 0.0)
+    probs = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    if topp_seed is not None:
+        seed = topp_seed
+    if seed is not None and not isinstance(seed, int):
+        seed = int(jnp.reshape(seed, ()))  # tensor seed
+    if seed is not None and seed >= 0:
+        key = jax.random.PRNGKey(seed)
+    else:
+        key = random_mod.split_key()
+    choice = jax.random.categorical(key, jnp.log(probs + 1e-30), axis=-1)
+    choice = jnp.reshape(choice, (-1, 1))
+    ids = jnp.take_along_axis(order, choice, axis=-1)
+    vals = jnp.take_along_axis(x, ids, axis=-1)
+    return vals, ids

@@ -1,6 +1,8 @@
 """nn.functional vision ops: grid_sample / affine_grid / channel_shuffle /
 temporal_shift / sequence_mask vs torch goldens (ref semantics:
 python/paddle/nn/functional/vision.py, extension.py)."""
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -130,3 +132,106 @@ def test_grid_sample_grad():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(g[1]), tg.grad.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+# --- ctc_loss (ref nn/functional/loss.py:1922: unscaled logits in, softmax
+# applied internally — "aliased as softmax with CTC") -----------------------
+
+def _ctc_brute_force(log_probs_sm, label, T, blank=0):
+    """Independent golden: enumerate every length-T alignment, sum the
+    probability of those that collapse (dedupe + strip blanks) to label."""
+    import itertools
+    C = log_probs_sm.shape[1]
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                collapsed.append(s)
+            prev = s
+        if collapsed == list(label):
+            p = 1.0
+            for t, s in enumerate(path):
+                p *= np.exp(log_probs_sm[t, s])
+            total += p
+    return -np.log(total)
+
+
+def test_ctc_loss_brute_force_golden():
+    rng = np.random.RandomState(0)
+    T, B, C = 4, 2, 3
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 1]], dtype=np.int32)
+    ilen = np.array([T, T], dtype=np.int64)
+    llen = np.array([2, 2], dtype=np.int64)
+    out = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                     jnp.asarray(ilen), jnp.asarray(llen), reduction='none')
+    lsm = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    for b in range(B):
+        want = _ctc_brute_force(lsm[:, b], labels[b, :llen[b]], T)
+        np.testing.assert_allclose(float(out[b]), want, rtol=1e-4)
+
+
+def test_ctc_loss_vs_torch():
+    import torch
+    rng = np.random.RandomState(7)
+    T, B, C, L = 12, 3, 6, 4
+    logits = (3.0 * rng.randn(T, B, C)).astype(np.float32)
+    labels = rng.randint(1, C, size=(B, L)).astype(np.int32)
+    ilen = np.array([12, 9, 7], dtype=np.int64)
+    llen = np.array([4, 3, 2], dtype=np.int64)
+    ours = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                      jnp.asarray(ilen), jnp.asarray(llen), reduction='none')
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(ilen), torch.tensor(llen), blank=0, reduction='none')
+    np.testing.assert_allclose(np.asarray(ours), tl.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    # mean reduction divides by label_lengths then averages (ref docstring)
+    ours_m = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                        jnp.asarray(ilen), jnp.asarray(llen), reduction='mean')
+    want_m = float(np.mean(tl.numpy() / llen))
+    np.testing.assert_allclose(float(ours_m), want_m, rtol=1e-4)
+
+
+def test_ctc_loss_nonnegative_and_finite_grads():
+    rng = np.random.RandomState(3)
+    T, B, C = 8, 4, 5
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, size=(B, 3)).astype(np.int32)
+    ilen = np.full((B,), T, dtype=np.int64)
+    llen = np.full((B,), 3, dtype=np.int64)
+    loss = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                      jnp.asarray(ilen), jnp.asarray(llen), reduction='none')
+    assert bool(jnp.all(loss >= 0)), np.asarray(loss)
+
+    def scalar_loss(lg):
+        return F.ctc_loss(lg, jnp.asarray(labels), jnp.asarray(ilen),
+                          jnp.asarray(llen), reduction='sum')
+
+    g = jax.grad(scalar_loss)(jnp.asarray(logits))
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_ctc_loss_norm_by_times_scales_grad_not_value():
+    rng = np.random.RandomState(5)
+    T, B, C = 6, 2, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 1]], dtype=np.int32)
+    ilen = np.array([6, 4], dtype=np.int64)
+    llen = np.array([2, 2], dtype=np.int64)
+    args = (jnp.asarray(labels), jnp.asarray(ilen), jnp.asarray(llen))
+    v0 = F.ctc_loss(jnp.asarray(logits), *args, reduction='none')
+    v1 = F.ctc_loss(jnp.asarray(logits), *args, reduction='none',
+                    norm_by_times=True)
+    np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-6)
+    g0 = jax.grad(lambda lg: F.ctc_loss(lg, *args, reduction='sum'))(
+        jnp.asarray(logits))
+    g1 = jax.grad(lambda lg: F.ctc_loss(lg, *args, reduction='sum',
+                                        norm_by_times=True))(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g1[:, 0]), np.asarray(g0[:, 0]) / 6,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[:, 1]), np.asarray(g0[:, 1]) / 4,
+                               rtol=1e-5)

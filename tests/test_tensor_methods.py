@@ -1,0 +1,212 @@
+"""Tensor method-surface parity (ref tensor/__init__.py:459 tensor_method_func,
+base/dygraph/tensor_patch_methods.py:86 monkey_patch_tensor)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.tensor._method_list import MAGIC_METHODS, TENSOR_METHOD_NAMES
+from paddle_tpu.tensor.methods import unbound_methods
+
+
+def test_full_method_list_parity():
+    """Automated diff: every reference tensor_method_func name is
+    reachable on a concrete jax array (method or equivalent property)."""
+    x = jnp.ones((2, 2))
+    missing = [n for n in TENSOR_METHOD_NAMES if not hasattr(x, n)]
+    assert missing == [], f'{len(missing)} missing: {missing}'
+
+
+def test_magic_methods():
+    a = jnp.array([True, False])
+    b = jnp.array([True, True])
+    assert bool((a & b)[0]) and bool((a | b)[1]) and not bool((a ^ b)[0])
+    assert bool((~a)[1])
+    assert [m for m, _ in MAGIC_METHODS] == [
+        '__and__', '__or__', '__xor__', '__invert__']
+
+
+def test_methods_work_under_tracer():
+    x = jnp.ones((2, 3))
+
+    @jax.jit
+    def f(t):
+        return t.unsqueeze(0).add(1.0).multiply(2.0).sum(axis=-1, keepdim=True)
+
+    out = f(x)
+    assert out.shape == (1, 2, 1)
+    np.testing.assert_allclose(np.asarray(out), 12.0)
+
+
+def test_numpy_item_cast():
+    x = jnp.full((2, 2), 3.5)
+    n = x.numpy()
+    assert isinstance(n, np.ndarray) and n.shape == (2, 2)
+    assert x.cast('int32').dtype == jnp.int32
+    assert x.cast(pt.float64).dtype.name in ('float64', 'float32')  # x64 off
+    assert x[0, 0].item() == 3.5
+
+
+def test_shape_manipulation_methods():
+    x = jnp.arange(6, dtype=jnp.float32).reshape((2, 3))
+    assert x.unsqueeze(0).shape == (1, 2, 3)
+    assert x.unsqueeze(0).squeeze(0).shape == (2, 3)
+    assert x.tile([2, 1]).shape == (4, 3)
+    assert x.expand([4, 2, 3]).shape == (4, 2, 3)
+    assert x.flatten().shape == (6,)
+    assert x.transpose([1, 0]).shape == (3, 2)
+    assert x.reshape([3, 2]).shape == (3, 2)
+    assert x.reshape(3, 2).shape == (3, 2)  # torch-habit varargs
+
+
+def test_math_methods():
+    x = jnp.full((2, 2), 2.0)
+    y = jnp.full((2, 2), 3.0)
+    np.testing.assert_allclose(np.asarray(x.add(y)), 5.0)
+    np.testing.assert_allclose(np.asarray(x.subtract(y)), -1.0)
+    np.testing.assert_allclose(np.asarray(x.multiply(y)), 6.0)
+    np.testing.assert_allclose(np.asarray(x.divide(y)), 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(x.pow(3)), 8.0)
+    np.testing.assert_allclose(np.asarray(x.scale(2.0, bias=1.0)), 5.0)
+    np.testing.assert_allclose(np.asarray(x.matmul(y)), 12.0)
+    np.testing.assert_allclose(float(x.norm()), 4.0)
+    np.testing.assert_allclose(float(x.abs().sqrt().max()), np.sqrt(2),
+                               rtol=1e-6)
+
+
+def test_reduction_keepdim_both_spellings():
+    x = jnp.ones((2, 3))
+    assert x.sum(axis=1, keepdim=True).shape == (2, 1)
+    assert x.sum(axis=1, keepdims=True).shape == (2, 1)
+    assert x.mean(axis=0).shape == (3,)
+    assert x.max(axis=1, keepdim=True).shape == (2, 1)
+
+
+def test_detach_clone_inplace_alias():
+    x = jnp.ones((3,))
+
+    def f(t):
+        return (t.detach() * t).sum()
+
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)  # detach stops one factor
+    c = x.clone()
+    assert c is not x and np.allclose(np.asarray(c), 1.0)
+    np.testing.assert_allclose(np.asarray(x.add_(1.0)), 2.0)
+    np.testing.assert_allclose(np.asarray(x.zero_()), 0.0)
+
+
+def test_properties_and_introspection():
+    x = jnp.ones((2, 3))
+    assert x.stop_gradient is True
+    assert x.grad is None
+    assert x.dim() == 2 and x.ndimension() == 2
+    assert x.numel() == 6
+    assert x.element_size() == 4
+    assert 'cpu' in str(x.place).lower() or 'tpu' in str(x.place).lower()
+    with pytest.warns(UserWarning):
+        x.stop_gradient = False
+
+
+def test_device_motion_noops():
+    x = jnp.ones((2,))
+    assert np.allclose(np.asarray(x.cpu()), 1.0)
+    assert x.cuda() is x and x.pin_memory() is x
+    y = x.to('float16')
+    assert y.dtype == jnp.float16
+    z = x.to('cpu', 'float16')
+    assert z.dtype == jnp.float16
+
+
+def test_backward_raises_actionable():
+    x = jnp.ones(())
+    with pytest.raises(RuntimeError, match='value_and_grad'):
+        x.backward()
+    with pytest.raises(RuntimeError, match='PyLayer'):
+        x.register_hook(lambda g: g)
+    with pytest.raises(RuntimeError, match='state_dict'):
+        x.set_value(np.zeros(2))
+
+
+def test_apply_value_and_misc():
+    x = jnp.full((2,), 4.0)
+    np.testing.assert_allclose(np.asarray(x.apply(lambda t: t * 2)), 8.0)
+    assert x.value() is x
+    assert x.unbind()[0].shape == ()
+    assert len(x._md5sum()) == 32
+
+
+def test_unbound_map_covers_list():
+    m = unbound_methods()
+    assert len(m) >= len(TENSOR_METHOD_NAMES)
+    # spot-check a few obscure resolutions are callables
+    for n in ('inverse', 'sigmoid', 'stft', 'top_p_sampling',
+              'create_tensor', 'lstsq', 'histogramdd'):
+        assert callable(m[n]), n
+
+
+def test_top_p_sampling_behavior():
+    pt.seed(7)
+    probs = jnp.array([[0.96, 0.02, 0.01, 0.01]])
+    vals, ids = pt.tensor.random.top_p_sampling(probs, 0.9)
+    assert ids.shape == (1, 1) and int(ids[0, 0]) == 0
+    np.testing.assert_allclose(float(vals[0, 0]), 0.96, rtol=1e-6)
+
+
+def test_descriptor_attrs_not_shadowed():
+    x = jnp.ones((2, 3))
+    assert x.shape == (2, 3)          # property, not a bound method
+    assert isinstance(x.ndim, int)
+    assert x.T.shape == (3, 2)
+    assert x.real.shape == (2, 3)
+
+
+def test_view_shape_and_dtype():
+    x = jnp.arange(6, dtype=jnp.float32)
+    assert x.view([3, 2]).shape == (3, 2)
+    assert x.view(3, 2).shape == (3, 2)
+    assert x.view('int32').dtype == jnp.int32  # byte reinterpret
+    assert x.view('int32').shape == (6,)
+
+
+def test_to_accepts_place_objects():
+    x = jnp.ones((2,))
+    y = x.to(pt.CPUPlace())
+    assert np.allclose(np.asarray(y), 1.0)
+    z = x.to(device=pt.CPUPlace(), dtype='float16')
+    assert z.dtype == jnp.float16
+
+
+def test_reshape_bare_int_and_zero_dim():
+    x = jnp.ones((2, 3))
+    assert pt.reshape(x, -1).shape == (6,)
+    assert pt.reshape(x, [0, 3]).shape == (2, 3)  # 0 copies input dim
+    assert x.reshape_(6).shape == (6,)
+
+
+def test_top_p_sampling_seed_and_k():
+    probs = jnp.full((1, 8), 1 / 8.0)
+    v1, i1 = pt.tensor.random.top_p_sampling(probs, 1.0, seed=42)
+    v2, i2 = pt.tensor.random.top_p_sampling(probs, 1.0, seed=42)
+    assert int(i1[0, 0]) == int(i2[0, 0])  # reproducible
+    # k=1 forces the argmax
+    skew = jnp.array([[0.5, 0.2, 0.3]])
+    _, ik = pt.tensor.random.top_p_sampling(skew, 1.0, k=1, seed=0)
+    assert int(ik[0, 0]) == 0
+
+
+def test_ctc_norm_by_times_applies_under_mean():
+    rng = np.random.RandomState(11)
+    import paddle_tpu.nn.functional as F
+    T, B, C = 5, 2, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 1]], dtype=np.int32)
+    args = (jnp.asarray(labels), jnp.asarray(np.array([5, 5])),
+            jnp.asarray(np.array([2, 2])))
+    g_plain = jax.grad(lambda lg: F.ctc_loss(lg, *args, reduction='mean'))(
+        jnp.asarray(logits))
+    g_norm = jax.grad(lambda lg: F.ctc_loss(lg, *args, reduction='mean',
+                                            norm_by_times=True))(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g_norm), np.asarray(g_plain) / 5,
+                               rtol=1e-5)
