@@ -39,7 +39,9 @@ from .mp_layers import (  # noqa: F401
 )
 from .parallel import (  # noqa: F401
     DataParallel,
+    activation_batch_constraint,
     apply_rules,
+    embedding_lookup,
     model_shardings,
     parallelize,
     shard_batch,

@@ -95,6 +95,12 @@ def shard_model(model, mesh: Mesh | None = None, fsdp_axis=None):
 def _add_fsdp(spec, shape, mesh, axis):
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         return spec
+    # leave small 1-D params (norm scales, biases) replicated: sharding a
+    # few hundred floats saves nothing, and a hidden-sharded norm weight
+    # makes GSPMD reshard every batch-sharded activation it touches (the
+    # spmd_partitioner "involuntary full rematerialization" warning)
+    if len(shape) <= 1:
+        return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     used = set()
     for e in entries:
@@ -112,6 +118,52 @@ def _add_fsdp(spec, shape, mesh, axis):
         return spec
     entries[best] = axis
     return P(*entries)
+
+
+def activation_batch_constraint(x, axes=('dp', 'fsdp')):
+    """Constrain an activation to batch-dim sharding over the data axes.
+
+    No-op without a mesh / data axes / divisible batch.
+    """
+    mesh = get_mesh()
+    if mesh is None or not hasattr(x, 'ndim'):
+        return x
+    present = tuple(a for a in axes
+                    if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not present:
+        return x
+    size = 1
+    for a in present:
+        size *= mesh.shape[a]
+    if x.ndim == 0 or x.shape[0] % size != 0:
+        return x
+    spec = P(present if len(present) > 1 else present[0],
+             *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def embedding_lookup(table, ids):
+    """Mesh-friendly embedding lookup.
+
+    A plain ``table[ids]`` gather propagates the (tp, fsdp) table
+    sharding into the activation, which GSPMD can only undo by a full
+    rematerialization (spmd_partitioner warning). Under a sharded mesh,
+    lower to one_hot @ table instead — GSPMD partitions the contraction
+    cleanly (vocab-tp -> psum; the MXU eats the extra FLOPs), the
+    standard TPU recipe. Single-device / no-mesh keeps the O(B·S·H)
+    gather.
+    """
+    mesh = get_mesh()
+    # only when the axes that actually shard tables (tp/fsdp, per
+    # LLAMA_TP_RULES/_add_fsdp) are active: under dp/pp-only meshes the
+    # table is replicated and the gather is cheap and remat-free
+    sharded = mesh is not None and any(
+        a in mesh.axis_names and mesh.shape[a] > 1 for a in ('tp', 'fsdp'))
+    if not sharded:
+        return table[ids]
+    oh = jax.nn.one_hot(ids, table.shape[0], dtype=table.dtype)
+    out = jnp.einsum('...v,vh->...h', oh, table)
+    return activation_batch_constraint(out)
 
 
 def model_shardings(model, mesh: Mesh | None = None):

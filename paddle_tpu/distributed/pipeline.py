@@ -30,6 +30,13 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 
+def _pvary(x, axis):
+    """jax.lax.pvary is deprecated; pcast(..., to='varying') replaces it."""
+    if hasattr(lax, 'pcast'):
+        return lax.pcast(x, axis, to='varying')
+    return lax.pvary(x, axis)
+
+
 def stack_stage_params(stage_models: typing.Sequence, axis=0):
     """Stack N same-structure stage pytrees into one pytree with a leading
     stage axis (shard it over 'pp')."""
@@ -55,7 +62,7 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
         # microbatches: (n_micro, mb, ...) identical on every rank;
         # promote to pp-varying so the vma types line up with the
         # per-rank compute (check_vma=True)
-        microbatches = lax.pvary(microbatches, axis)
+        microbatches = _pvary(microbatches, axis)
         rank = lax.axis_index(axis)
         n_ticks = n_microbatches + n_stages - 1
         mb_shape = microbatches.shape[1:]
@@ -87,8 +94,8 @@ def pipeline_spmd(stage_fn, n_stages: int, n_microbatches: int, axis='pp'):
             buf = lax.ppermute(y, axis, perm)
             return (buf, outputs), None
 
-        buf0 = lax.pvary(jnp.zeros(mb_shape, microbatches.dtype), axis)
-        outs0 = lax.pvary(
+        buf0 = _pvary(jnp.zeros(mb_shape, microbatches.dtype), axis)
+        outs0 = _pvary(
             jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype), axis)
         # scan (not fori_loop): reverse-differentiable, so the 1F1B/GPipe
         # backward falls out of jax.grad through the schedule
@@ -266,13 +273,13 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
         local = jax.tree.map(lambda x: x[0], params)   # strip stage axis
         # replicated inputs → pp-varying so vma types line up with the
         # per-rank compute (check_vma=True)
-        pv = lambda t: jax.tree.map(lambda x: lax.pvary(x, axis), t)
+        pv = lambda t: jax.tree.map(lambda x: _pvary(x, axis), t)
         mbs, tgts, extra = pv(mbs), pv(tgts), pv(extra)
 
-        zeros_mb = lax.pvary(jnp.zeros(mb_shape, mb_dtype), axis)
+        zeros_mb = _pvary(jnp.zeros(mb_shape, mb_dtype), axis)
         zeros_p = jax.tree.map(jnp.zeros_like, local)
         zeros_e = jax.tree.map(jnp.zeros_like, extra)
-        zeros_t = lax.pvary(jnp.zeros(targets.shape[1:], targets.dtype),
+        zeros_t = _pvary(jnp.zeros(targets.shape[1:], targets.dtype),
                             axis)
 
         def tick(carry, t):
@@ -327,13 +334,13 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                             return loss_fn(ex, stage_fn(par, xx), tt)
 
                         lval, vjp = jax.vjp(f, local, extra, x, tgt)
-                        dpar, dex, dx, dt = vjp(lax.pvary(jnp.ones((), lval.dtype), axis))
+                        dpar, dex, dx, dt = vjp(_pvary(jnp.ones((), lval.dtype), axis))
                     else:
                         def f(par, ex, xx):
                             return loss_fn(ex, stage_fn(par, xx), tgt)
 
                         lval, vjp = jax.vjp(f, local, extra, x)
-                        dpar, dex, dx = vjp(lax.pvary(jnp.ones((), lval.dtype), axis))
+                        dpar, dex, dx = vjp(_pvary(jnp.ones((), lval.dtype), axis))
                         dt = zeros_t
                     return dpar, dex, dx, dt, lval.astype(jnp.float32)
 
@@ -342,7 +349,7 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                                      local, x)
                     dpar, dx = vjp(g_in)
                     return (dpar, zeros_e, dx, zeros_t,
-                            lax.pvary(jnp.zeros((), jnp.float32), axis))
+                            _pvary(jnp.zeros((), jnp.float32), axis))
 
                 dpar, dex, dx, dt, lval = lax.cond(
                     rank == p - 1, last_stage, mid_stage, None)
@@ -374,14 +381,14 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
                     pgrad, egrad, dmbs, dtgts, loss_acc), None
 
         init = (
-            lax.pvary(jnp.zeros((Qa,) + mb_shape, mb_dtype), axis),
-            lax.pvary(jnp.zeros((Qg,) + mb_shape, mb_dtype), axis),
-            lax.pvary(jnp.zeros((S,) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros((Qa,) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros((Qg,) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros((S,) + mb_shape, mb_dtype), axis),
             zeros_mb, zeros_mb,
             zeros_p, zeros_e,
-            lax.pvary(jnp.zeros((M,) + mb_shape, mb_dtype), axis),
-            lax.pvary(jnp.zeros(targets.shape, targets.dtype), axis),
-            lax.pvary(jnp.zeros((), jnp.float32), axis),
+            _pvary(jnp.zeros((M,) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros(targets.shape, targets.dtype), axis),
+            _pvary(jnp.zeros((), jnp.float32), axis),
         )
         carry, _ = lax.scan(tick, init, jnp.arange(T))
         (_, _, _, _, _, pgrad, egrad, dmbs, dtgts, loss_acc) = carry
@@ -446,6 +453,400 @@ def pipeline_1f1b_loss(stacked_params, extra_params, microbatches, targets,
     return f(stacked_params, extra_params, microbatches, targets)
 
 
+def build_interleaved_1f1b_schedule(n_stages: int, n_micro: int,
+                                    n_virtual: int):
+    """Interleaved (virtual-stage) 1F1B timetable.
+
+    ref: distributed/fleet/meta_parallel/pipeline_parallel.py:1143
+    (``PipelineParallelWithInterleave``): the model is cut into
+    p·v virtual stages; chunk c lives on rank c % p, so each rank holds
+    v non-contiguous chunks and a microbatch makes v sweeps around the
+    ring. The classic ordering (microbatches grouped in blocks of p per
+    chunk, warmup depth (p-r-1)·2 + (v-1)·p per rank) brings the bubble
+    from 2(p-1) full-stage ticks down to 2(p-1) CHUNK ticks — a 1/v
+    bubble fraction, the whole point of interleaving.
+
+    Simulated deterministically with blocking deps (1-tick ppermute
+    latency between consecutive virtual stages; one compute per rank
+    per tick). Requires n_micro % n_stages == 0 (the reference has the
+    same constraint).
+
+    Returns int32 tables shaped (T, n_stages): fwd_m/fwd_c, bwd_m/bwd_c
+    (microbatch and LOCAL chunk handled at each tick, -1 = none),
+    recv_act_m/_c, recv_grad_m/_c (message arriving at tick start), and
+    scalar queue depths act_q / grad_q / stash (per chunk) validated
+    collision-free, plus 'ticks'.
+    """
+    p, M, v = n_stages, n_micro, n_virtual
+    if M % p:
+        raise ValueError(
+            f'interleaved 1F1B needs n_micro % n_stages == 0, got {M} % {p}')
+    V = p * v
+    nops = v * M
+    INF = 1 << 30
+
+    def fop(r, k):   # k-th chunk-forward on rank r -> (vstage, micro)
+        return ((k // p) % v) * p + r, (k // (p * v)) * p + (k % p)
+
+    def bop(r, k):
+        return (v - 1 - (k // p) % v) * p + r, (k // (p * v)) * p + (k % p)
+
+    fwd_done = [[INF] * M for _ in range(V)]
+    bwd_done = [[INF] * M for _ in range(V)]
+    kf, kb = [0] * p, [0] * p
+    warm = [min((p - r - 1) * 2 + (v - 1) * p, nops) for r in range(p)]
+    nxt_fwd = [True] * p
+    fwd_m_rows, fwd_c_rows, bwd_m_rows, bwd_c_rows = [], [], [], []
+    t = 0
+    while any(kb[r] < nops for r in range(p)):
+        fm_row, fc_row = [-1] * p, [-1] * p
+        bm_row, bc_row = [-1] * p, [-1] * p
+        for r in range(p):
+            if kb[r] >= nops:
+                continue
+
+            def try_f():
+                vs, m = fop(r, kf[r])
+                if vs == 0 or fwd_done[vs - 1][m] < t:
+                    fwd_done[vs][m] = t
+                    fm_row[r], fc_row[r] = m, vs // p
+                    kf[r] += 1
+                    return True
+                return False
+
+            def try_b():
+                vs, m = bop(r, kb[r])
+                if fwd_done[vs][m] < t and (
+                        vs == V - 1 or bwd_done[vs + 1][m] < t):
+                    bwd_done[vs][m] = t
+                    bm_row[r], bc_row[r] = m, vs // p
+                    kb[r] += 1
+                    return True
+                return False
+
+            if kf[r] < warm[r]:
+                try_f()
+            elif kf[r] >= nops:
+                try_b()
+            elif nxt_fwd[r]:
+                if try_f():
+                    nxt_fwd[r] = False
+            else:
+                if try_b():
+                    nxt_fwd[r] = True
+        fwd_m_rows.append(fm_row)
+        fwd_c_rows.append(fc_row)
+        bwd_m_rows.append(bm_row)
+        bwd_c_rows.append(bc_row)
+        t += 1
+        if t > 16 * (nops + V) + 64:
+            raise RuntimeError('interleaved 1f1b schedule did not converge')
+    T = t
+    fwd_m = np.asarray(fwd_m_rows, np.int32)
+    fwd_c = np.asarray(fwd_c_rows, np.int32)
+    bwd_m = np.asarray(bwd_m_rows, np.int32)
+    bwd_c = np.asarray(bwd_c_rows, np.int32)
+
+    # message-arrival tables: rank r's act at tick t came from rank r-1's
+    # fwd at t-1 of vstage vs; it targets vs+1 (local chunk (vs+1)//p on
+    # r). The last vstage's output and vstage 0's grad are dropped.
+    recv_act_m = np.full((T, p), -1, np.int32)
+    recv_act_c = np.full((T, p), -1, np.int32)
+    recv_grad_m = np.full((T, p), -1, np.int32)
+    recv_grad_c = np.full((T, p), -1, np.int32)
+    for t0 in range(T - 1):
+        for r in range(p):
+            m, c = fwd_m[t0, r], fwd_c[t0, r]
+            if m >= 0:
+                vs = c * p + r
+                if vs + 1 < V:
+                    recv_act_m[t0 + 1, (r + 1) % p] = m
+                    recv_act_c[t0 + 1, (r + 1) % p] = (vs + 1) // p
+            m, c = bwd_m[t0, r], bwd_c[t0, r]
+            if m >= 0:
+                vs = c * p + r
+                if vs - 1 >= 0:
+                    recv_grad_m[t0 + 1, (r - 1) % p] = m
+                    recv_grad_c[t0 + 1, (r - 1) % p] = (vs - 1) // p
+
+    def _min_depth(store_tick, consume_tick):
+        # per-chunk queues indexed m % Q: smallest Q with no slot
+        # overwritten while the previous occupant is still unread
+        for Q in range(1, M + 1):
+            ok = True
+            for vs in range(V):
+                for m in range(M - Q):
+                    st2 = store_tick(vs, m + Q)
+                    if st2 is not None and st2 <= consume_tick(vs, m):
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if ok:
+                return Q
+        return M
+
+    act_depth = _min_depth(
+        lambda vs, m: fwd_done[vs - 1][m] + 1 if vs >= 1 else None,
+        lambda vs, m: fwd_done[vs][m])
+    grad_depth = _min_depth(
+        lambda vs, m: bwd_done[vs + 1][m] + 1 if vs < V - 1 else None,
+        lambda vs, m: bwd_done[vs][m])
+    stash_depth = _min_depth(
+        lambda vs, m: fwd_done[vs][m],
+        lambda vs, m: bwd_done[vs][m])
+    return {
+        'fwd_m': fwd_m, 'fwd_c': fwd_c, 'bwd_m': bwd_m, 'bwd_c': bwd_c,
+        'recv_act_m': recv_act_m, 'recv_act_c': recv_act_c,
+        'recv_grad_m': recv_grad_m, 'recv_grad_c': recv_grad_c,
+        'act_q': act_depth, 'grad_q': grad_depth, 'stash': stash_depth,
+        'ticks': T,
+    }
+
+
+def pipeline_interleaved_1f1b(stacked_params, extra_params, microbatches,
+                              targets, stage_fn, loss_fn, mesh: Mesh,
+                              n_microbatches: int, n_virtual: int,
+                              axis='pp'):
+    """Interleaved 1F1B fused forward+backward over virtual stages.
+
+    ref: pipeline_parallel.py:1143 (PipelineParallelWithInterleave).
+    ``stacked_params`` carries a leading axis of p·v chunk pytrees in
+    VIRTUAL-STAGE order (chunk vs applies vs-th in the model); chunk vs
+    executes on rank vs % p. stage_fn(chunk_params, x) -> y applies ONE
+    chunk; loss_fn(extra_params, y, target) -> scalar runs on the last
+    virtual stage. Returns (loss, d_stacked, d_extra, d_microbatches)
+    with d_stacked in the same virtual-stage order.
+    """
+    p = mesh.shape[axis]
+    v = n_virtual
+    M = n_microbatches
+    V = p * v
+    if microbatches.shape[0] != M or targets.shape[0] != M:
+        raise ValueError(
+            f'microbatches/targets leading dim ({microbatches.shape[0]}/'
+            f'{targets.shape[0]}) must equal n_microbatches ({M})')
+    sched = build_interleaved_1f1b_schedule(p, M, v)
+    tabs = {k: jnp.asarray(sched[k]) for k in
+            ('fwd_m', 'fwd_c', 'bwd_m', 'bwd_c', 'recv_act_m', 'recv_act_c',
+             'recv_grad_m', 'recv_grad_c')}
+    Qa, Qg, S = sched['act_q'], sched['grad_q'], sched['stash']
+    T = sched['ticks']
+    perm_f = [(i, (i + 1) % p) for i in range(p)]
+    perm_b = [(i, (i - 1) % p) for i in range(p)]
+
+    mb_shape = microbatches.shape[1:]
+    mb_dtype = microbatches.dtype
+    diff_targets = jnp.issubdtype(targets.dtype, jnp.inexact)
+
+    # virtual-stage-major (V, ...) -> rank-major (p, v, ...) so the pp
+    # shard gives each rank its v chunks
+    def to_rank_major(t):
+        return jax.tree.map(
+            lambda a: jnp.swapaxes(
+                a.reshape((v, p) + a.shape[1:]), 0, 1), t)
+
+    def to_vstage_major(t):
+        return jax.tree.map(
+            lambda a: jnp.swapaxes(a, 0, 1).reshape((V,) + a.shape[2:]), t)
+
+    rank_params = to_rank_major(stacked_params)
+
+    def body(params, extra, mbs, tgts):
+        rank = lax.axis_index(axis)
+        local = jax.tree.map(lambda x: x[0], params)   # (v, ...) chunks
+        pv = lambda t: jax.tree.map(lambda x: _pvary(x, axis), t)
+        mbs, tgts, extra = pv(mbs), pv(tgts), pv(extra)
+
+        zeros_mb = _pvary(jnp.zeros(mb_shape, mb_dtype), axis)
+        zeros_p = jax.tree.map(jnp.zeros_like, local)   # per-chunk grads
+        zeros_e = jax.tree.map(jnp.zeros_like, extra)
+        zeros_t = _pvary(jnp.zeros(targets.shape[1:], targets.dtype),
+                            axis)
+
+        def chunk_of(tree, c):
+            return jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                tree)
+
+        def add_at_chunk(tree, c, delta):
+            def upd(a, d):
+                cur = lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
+                return lax.dynamic_update_index_in_dim(a, cur + d, c, 0)
+            return jax.tree.map(upd, tree, delta)
+
+        def tick(carry, t):
+            (act_q, grad_q, stash, act_msg, grad_msg,
+             pgrad, egrad, dmbs, dtgts, loss_acc) = carry
+            fm, fc = tabs['fwd_m'][t, rank], tabs['fwd_c'][t, rank]
+            bm, bc = tabs['bwd_m'][t, rank], tabs['bwd_c'][t, rank]
+            ram, rac = tabs['recv_act_m'][t, rank], tabs['recv_act_c'][t, rank]
+            rgm, rgc = tabs['recv_grad_m'][t, rank], tabs['recv_grad_c'][t, rank]
+
+            # 1. receive into per-chunk queues (store precedes compute)
+            def store(q, msg, c, m, Q):
+                row = lax.dynamic_index_in_dim(q, jnp.clip(c, 0), 0,
+                                               keepdims=False)
+                row = lax.dynamic_update_index_in_dim(
+                    row, msg, jnp.clip(m, 0) % Q, 0)
+                return lax.dynamic_update_index_in_dim(
+                    q, row, jnp.clip(c, 0), 0)
+
+            act_q = lax.cond(
+                ram >= 0, lambda q: store(q, act_msg, rac, ram, Qa),
+                lambda q: q, act_q)
+            grad_q = lax.cond(
+                rgm >= 0, lambda q: store(q, grad_msg, rgc, rgm, Qg),
+                lambda q: q, grad_q)
+
+            def fetch(q, c, m, Q):
+                row = lax.dynamic_index_in_dim(q, jnp.clip(c, 0), 0,
+                                               keepdims=False)
+                return lax.dynamic_index_in_dim(
+                    row, jnp.clip(m, 0) % Q, 0, keepdims=False)
+
+            # 2. forward of (chunk fc, micro fm)
+            def do_fwd(stash):
+                fresh = lax.dynamic_index_in_dim(
+                    mbs, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+                queued = fetch(act_q, fc, fm, Qa)
+                x = jnp.where((rank == 0) & (fc == 0), fresh, queued)
+                y = stage_fn(chunk_of(local, jnp.clip(fc, 0)), x)
+                stash = store(stash, x, fc, fm, S)
+                return stash, y
+
+            stash, act_out = lax.cond(
+                fm >= 0, do_fwd, lambda st: (st, zeros_mb), stash)
+
+            # 3. backward of (chunk bc, micro bm): recompute-vjp
+            def do_bwd(args):
+                pgrad, egrad, dmbs, dtgts, loss_acc = args
+                cpar = chunk_of(local, jnp.clip(bc, 0))
+                x = fetch(stash, bc, bm, S)
+                g_in = fetch(grad_q, bc, bm, Qg)
+                tgt = lax.dynamic_index_in_dim(
+                    tgts, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+
+                def last_stage(_):
+                    if diff_targets:
+                        def f(par, ex, xx, tt):
+                            return loss_fn(ex, stage_fn(par, xx), tt)
+
+                        lval, vjp = jax.vjp(f, cpar, extra, x, tgt)
+                        dpar, dex, dx, dt = vjp(
+                            _pvary(jnp.ones((), lval.dtype), axis))
+                    else:
+                        def f(par, ex, xx):
+                            return loss_fn(ex, stage_fn(par, xx), tgt)
+
+                        lval, vjp = jax.vjp(f, cpar, extra, x)
+                        dpar, dex, dx = vjp(
+                            _pvary(jnp.ones((), lval.dtype), axis))
+                        dt = zeros_t
+                    return dpar, dex, dx, dt, lval.astype(jnp.float32)
+
+                def mid_stage(_):
+                    _, vjp = jax.vjp(lambda par, xx: stage_fn(par, xx),
+                                     cpar, x)
+                    dpar, dx = vjp(g_in)
+                    return (dpar, zeros_e, dx, zeros_t,
+                            _pvary(jnp.zeros((), jnp.float32), axis))
+
+                dpar, dex, dx, dt, lval = lax.cond(
+                    (rank == p - 1) & (bc == v - 1), last_stage, mid_stage,
+                    None)
+                pgrad = add_at_chunk(pgrad, jnp.clip(bc, 0), dpar)
+                egrad = jax.tree.map(jnp.add, egrad, dex)
+                dmbs = lax.cond(
+                    (rank == 0) & (bc == 0),
+                    lambda d: lax.dynamic_update_index_in_dim(
+                        d, dx.astype(d.dtype), jnp.clip(bm, 0, M - 1), 0),
+                    lambda d: d, dmbs)
+                if diff_targets:
+                    dtgts = lax.cond(
+                        (rank == p - 1) & (bc == v - 1),
+                        lambda d: lax.dynamic_update_index_in_dim(
+                            d, dt.astype(d.dtype), jnp.clip(bm, 0, M - 1), 0),
+                        lambda d: d, dtgts)
+                return (pgrad, egrad, dmbs, dtgts, loss_acc + lval), dx
+
+            (pgrad, egrad, dmbs, dtgts, loss_acc), grad_out = lax.cond(
+                bm >= 0, do_bwd,
+                lambda args: (args, zeros_mb),
+                (pgrad, egrad, dmbs, dtgts, loss_acc))
+
+            # 4. rotate: activations ride +1, gradients ride -1
+            act_msg = lax.ppermute(act_out, axis, perm_f)
+            grad_msg = lax.ppermute(grad_out, axis, perm_b)
+            return (act_q, grad_q, stash, act_msg, grad_msg,
+                    pgrad, egrad, dmbs, dtgts, loss_acc), None
+
+        init = (
+            _pvary(jnp.zeros((v, Qa) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros((v, Qg) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros((v, S) + mb_shape, mb_dtype), axis),
+            zeros_mb, zeros_mb,
+            zeros_p, zeros_e,
+            _pvary(jnp.zeros((M,) + mb_shape, mb_dtype), axis),
+            _pvary(jnp.zeros(targets.shape, targets.dtype), axis),
+            _pvary(jnp.zeros((), jnp.float32), axis),
+        )
+        carry, _ = lax.scan(tick, init, jnp.arange(T))
+        (_, _, _, _, _, pgrad, egrad, dmbs, dtgts, loss_acc) = carry
+        loss = lax.psum(loss_acc, axis) / M
+        egrad = jax.tree.map(lambda g: lax.psum(g, axis) / M, egrad)
+        dmbs = lax.psum(dmbs, axis) / M
+        if diff_targets:
+            dtgts = lax.psum(dtgts, axis) / M
+        else:
+            dtgts = lax.psum(dtgts, axis)
+        pgrad = jax.tree.map(lambda g: g[None] / M, pgrad)  # (1, v, ...)
+        return loss, pgrad, egrad, dmbs, dtgts
+
+    param_specs = jax.tree.map(lambda _: P(axis), rank_params)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P(), P(), P()),
+        out_specs=(P(), param_specs, P(), P(), P()),
+        axis_names={axis},
+        check_vma=True,
+    )
+    loss, pgrad, egrad, dmbs, dtgts = fn(rank_params, extra_params,
+                                         microbatches, targets)
+    pgrad = to_vstage_major(pgrad)
+    return loss, pgrad, egrad, dmbs, (dtgts if diff_targets else None)
+
+
+def pipeline_interleaved_1f1b_loss(stacked_params, extra_params,
+                                   microbatches, targets, stage_fn, loss_fn,
+                                   mesh: Mesh, n_microbatches: int,
+                                   n_virtual: int, axis='pp'):
+    """Differentiable scalar interleaved-1F1B loss (outer-grad composable),
+    same custom_vjp pattern as pipeline_1f1b_loss."""
+    def run(stacked, extra, mbs, tgts):
+        return pipeline_interleaved_1f1b(
+            stacked, extra, mbs, tgts, stage_fn, loss_fn, mesh,
+            n_microbatches, n_virtual, axis)
+
+    @jax.custom_vjp
+    def f(stacked, extra, mbs, tgts):
+        loss, _, _, _, _ = run(stacked, extra, mbs, tgts)
+        return loss
+
+    def f_fwd(stacked, extra, mbs, tgts):
+        loss, dp, de, dm, dt = run(stacked, extra, mbs, tgts)
+        return loss, (dp, de, dm, dt)
+
+    def f_bwd(res, g):
+        dp, de, dm, dt = res
+        scale = lambda t: jax.tree.map(lambda x: x * g, t)
+        return (scale(dp), scale(de), scale(dm),
+                scale(dt) if dt is not None else None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(stacked_params, extra_params, microbatches, targets)
+
+
 class PipelineLayer:
     """ref: paddle.distributed.fleet.meta_parallel.PipelineLayer —
     user-facing wrapper: partition a LayerList of blocks into pp stages.
@@ -455,20 +856,30 @@ class PipelineLayer:
     """
 
     def __init__(self, blocks, mesh: Mesh, n_microbatches: int = 4,
-                 block_fn=None, axis='pp', schedule='gpipe'):
-        if schedule not in ('gpipe', '1f1b'):
-            raise ValueError(f"schedule must be 'gpipe'|'1f1b', got {schedule}")
-        self.schedule = schedule
-        n_stages = mesh.shape[axis]
-        if len(blocks) % n_stages:
+                 block_fn=None, axis='pp', schedule='gpipe', n_virtual=1):
+        if schedule not in ('gpipe', '1f1b', 'interleaved'):
             raise ValueError(
-                f'{len(blocks)} blocks not divisible into {n_stages} stages')
-        per = len(blocks) // n_stages
+                f"schedule must be 'gpipe'|'1f1b'|'interleaved', "
+                f'got {schedule}')
+        if n_virtual > 1 and schedule != 'interleaved':
+            raise ValueError("n_virtual > 1 requires schedule='interleaved'")
+        if schedule == 'interleaved' and n_virtual < 1:
+            raise ValueError('n_virtual must be >= 1')
+        self.schedule = schedule
+        self.n_virtual = n_virtual
+        n_stages = mesh.shape[axis]
+        n_parts = n_stages * (n_virtual if schedule == 'interleaved' else 1)
+        if len(blocks) % n_parts:
+            raise ValueError(
+                f'{len(blocks)} blocks not divisible into {n_parts} '
+                f'{"virtual " if n_parts != n_stages else ""}stages')
+        per = len(blocks) // n_parts
         self.mesh, self.axis, self.n_microbatches = mesh, axis, n_microbatches
         self.block_fn = block_fn or (lambda blk, x: blk(x))
-        # group blocks into stages, stack stages on leading axis
+        # group blocks into (virtual) stages, stack on the leading axis —
+        # virtual-stage order; chunk vs runs on rank vs % n_stages
         stages = []
-        for s in range(n_stages):
+        for s in range(n_parts):
             stage_blocks = blocks[s * per:(s + 1) * per]
             stages.append(stage_blocks)
         self.stacked = stack_stage_params(stages)
@@ -485,6 +896,17 @@ class PipelineLayer:
         def stage_fn(params, x):
             return self._stage_fn(params, x)
 
+        if self.schedule == 'interleaved':
+            # forward/inference: scan the virtual-stage chunk stack in
+            # order (pipelining only pays during fused train steps)
+            def chunk_step(x, chunk_params):
+                return stage_fn(chunk_params, x), None
+
+            def run_one(mb):
+                y, _ = lax.scan(chunk_step, mb, self.stacked)
+                return y
+
+            return jax.vmap(run_one)(microbatches)
         return pipeline_apply(self.stacked, microbatches, stage_fn, self.mesh,
                               self.n_microbatches, self.axis)
 
@@ -502,6 +924,11 @@ class PipelineLayer:
         def stage_fn(params, x):
             return self._stage_fn(params, x)
 
+        if self.schedule == 'interleaved':
+            return pipeline_interleaved_1f1b_loss(
+                self.stacked, extra, microbatches, targets, stage_fn,
+                loss_fn, self.mesh, self.n_microbatches, self.n_virtual,
+                self.axis)
         if self.schedule == '1f1b':
             return pipeline_1f1b_loss(
                 self.stacked, extra, microbatches, targets, stage_fn,

@@ -220,7 +220,11 @@ class LlamaModel(Layer):
             base = 0 if cache_index is None else cache_index
             positions = base + jnp.arange(S)[None, :].astype(jnp.int32)
             positions = jnp.broadcast_to(positions, (B, S))
-        x = self.embed_tokens[input_ids]
+        # mesh-aware lookup: one_hot matmul under a sharded mesh so the
+        # (tp, fsdp) table sharding doesn't force an activation remat
+        # (see distributed.embedding_lookup)
+        from ..distributed import embedding_lookup
+        x = embedding_lookup(self.embed_tokens, input_ids)
         new_caches = [] if caches is not None else None
         use_remat = self.config.remat and caches is None
         for i, layer in enumerate(self.layers):

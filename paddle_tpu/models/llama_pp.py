@@ -16,7 +16,9 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
-from ..distributed.pipeline import (pipeline_1f1b_loss, pipeline_apply,
+from ..distributed.pipeline import (pipeline_1f1b_loss,
+                                    pipeline_apply,
+                                    pipeline_interleaved_1f1b_loss,
                                     stack_stage_params)
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
@@ -31,18 +33,24 @@ class LlamaForCausalLMPipelined(Layer):
     """
 
     def __init__(self, config: LlamaConfig, mesh, n_microbatches=2,
-                 schedule='gpipe'):
+                 schedule='gpipe', n_virtual=1):
         super().__init__()
-        if schedule not in ('gpipe', '1f1b'):
-            raise ValueError(f"schedule must be 'gpipe'|'1f1b', got {schedule}")
+        if schedule not in ('gpipe', '1f1b', 'interleaved'):
+            raise ValueError(
+                f"schedule must be 'gpipe'|'1f1b'|'interleaved', "
+                f'got {schedule}')
+        if n_virtual > 1 and schedule != 'interleaved':
+            raise ValueError("n_virtual > 1 requires schedule='interleaved'")
         self.schedule = schedule
+        self.n_virtual = n_virtual if schedule == 'interleaved' else 1
         self.config = config
         n_stages = mesh.shape['pp']
-        if config.num_hidden_layers % n_stages:
+        n_parts = n_stages * self.n_virtual
+        if config.num_hidden_layers % n_parts:
             raise ValueError(
                 f'{config.num_hidden_layers} layers not divisible into '
-                f'{n_stages} pp stages')
-        self.per_stage = config.num_hidden_layers // n_stages
+                f'{n_parts} pp (virtual) stages')
+        self.per_stage = config.num_hidden_layers // n_parts
         self.n_stages = n_stages
         self._mesh = mesh
         self._n_micro = n_microbatches
@@ -52,7 +60,7 @@ class LlamaForCausalLMPipelined(Layer):
         blocks = [LlamaDecoderLayer(config)
                   for _ in range(config.num_hidden_layers)]
         stages = [blocks[s * self.per_stage:(s + 1) * self.per_stage]
-                  for s in range(n_stages)]
+                  for s in range(n_parts)]
         # list of `per_stage` block-pytrees, leaves stacked (n_stages, ...)
         self.stage_blocks = nn.LayerList(stack_stage_params(stages))
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
@@ -67,8 +75,18 @@ class LlamaForCausalLMPipelined(Layer):
         x = self.embed_tokens[input_ids]                     # (B, S, H)
         mbs = x.reshape(n, B // n, S, -1)
 
-        out = pipeline_apply(list(self.stage_blocks), mbs, self._stage_fn(),
-                             self._mesh, n, axis='pp')
+        if self.schedule == 'interleaved':
+            # inference path: chunks applied in virtual-stage order
+            stage_fn = self._stage_fn()
+            out = mbs
+            V = self.n_stages * self.n_virtual
+            for vs in range(V):
+                chunk = jax.tree.map(lambda a: a[vs],
+                                     list(self.stage_blocks))
+                out = jax.vmap(lambda mb, c=chunk: stage_fn(c, mb))(out)
+        else:
+            out = pipeline_apply(list(self.stage_blocks), mbs,
+                                 self._stage_fn(), self._mesh, n, axis='pp')
         hidden = self.norm(out.reshape(B, S, -1))
         return hidden @ self.lm_head
 
@@ -91,7 +109,7 @@ class LlamaForCausalLMPipelined(Layer):
         if labels is None:
             labels = input_ids[:, 1:]
             input_ids = input_ids[:, :-1]
-        if self.schedule == '1f1b':
+        if self.schedule in ('1f1b', 'interleaved'):
             return self._loss_1f1b(input_ids, labels)
         logits = self(input_ids)
         return softmax_cross_entropy(logits, labels).mean()
@@ -116,6 +134,10 @@ class LlamaForCausalLMPipelined(Layer):
             logits = hidden @ extra['head']
             return softmax_cross_entropy(logits, tgt).mean()
 
+        if self.schedule == 'interleaved':
+            return pipeline_interleaved_1f1b_loss(
+                list(self.stage_blocks), extra, mbs, tgts, self._stage_fn(),
+                loss_fn, self._mesh, n, self.n_virtual, axis='pp')
         return pipeline_1f1b_loss(
             list(self.stage_blocks), extra, mbs, tgts, self._stage_fn(),
             loss_fn, self._mesh, n, axis='pp')

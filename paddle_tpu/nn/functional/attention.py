@@ -90,10 +90,9 @@ def scaled_dot_product_attention(
                                    scale=scale, segment_ids=segment_ids,
                                    kv_segment_ids=kv_segment_ids)
         except Exception as e:
-            import warnings
+            from ...ops import pallas_failed
 
-            warnings.warn(f'pallas flash attention unavailable, using lax '
-                          f'reference: {e!r}', stacklevel=2)
+            pallas_failed('flash_attention', e)
     if segment_ids is not None:
         qseg = jnp.asarray(segment_ids)
         kseg = jnp.asarray(kv_segment_ids)

@@ -6,7 +6,11 @@ other in tests/test_pallas.py.
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
+
+_kernel_warned = set()
 
 
 def _on_tpu():
@@ -23,6 +27,30 @@ def use_pallas():
         'FLAGS_use_pallas_kernels']
 
 
+def pallas_failed(kernel_name, exc):
+    """A pallas kernel raised while use_pallas() was true.
+
+    Strict mode (``FLAGS_pallas_strict``) re-raises — a broken kernel is
+    a perf cliff that should fail loudly in CI. Otherwise warn ONCE per
+    kernel and let the caller fall back to the lax reference.
+    """
+    from ..framework.flags import get_flags
+
+    if get_flags(['FLAGS_pallas_strict'])['FLAGS_pallas_strict']:
+        raise RuntimeError(
+            f'pallas kernel {kernel_name!r} failed and FLAGS_pallas_strict '
+            f'is set (lax fallback suppressed): {exc!r}'
+        ) from exc
+    if kernel_name not in _kernel_warned:
+        _kernel_warned.add(kernel_name)
+        warnings.warn(
+            f'pallas kernel {kernel_name!r} failed ({exc!r}); falling back '
+            f'to the lax reference implementation. This is a large perf '
+            f'cliff on TPU — set FLAGS_pallas_strict=True to make it fatal.',
+            stacklevel=3,
+        )
+
+
 def rms_norm(x, weight=None, epsilon=1e-6):
     """Fused RMSNorm; pallas kernel on TPU (ops/pallas/rms_norm.py)."""
     if use_pallas() and x.shape[-1] % 128 == 0 and x.dtype != jax.numpy.float64:
@@ -30,8 +58,8 @@ def rms_norm(x, weight=None, epsilon=1e-6):
             from .pallas.rms_norm import rms_norm as _k
 
             return _k(x, weight, epsilon)
-        except Exception:
-            pass
+        except Exception as e:
+            pallas_failed('rms_norm', e)
     from ..nn.functional.norm import rms_norm as _ref
 
     return _ref(x, weight, epsilon)
@@ -50,7 +78,7 @@ def softmax_cross_entropy(logits, labels):
             from .pallas.softmax_xent import softmax_cross_entropy_with_logits
 
             return softmax_cross_entropy_with_logits(logits, labels)
-        except Exception:
-            pass
+        except Exception as e:
+            pallas_failed('softmax_cross_entropy', e)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

@@ -8,9 +8,11 @@ from .math import *  # noqa: F401,F403
 from . import random  # noqa: F401
 from .random import (  # noqa: F401
     bernoulli,
+    bernoulli_,
     exponential_,
     multinomial,
     normal,
+    normal_,
     poisson,
     rand,
     randint,
@@ -20,6 +22,7 @@ from .random import (  # noqa: F401
     standard_gamma,
     standard_normal,
     uniform,
+    uniform_,
 )
 
 import jax.numpy as _jnp

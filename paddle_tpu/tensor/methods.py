@@ -110,6 +110,11 @@ def _to(self, *args, **kwargs):
     for a in args:
         if isinstance(a, _Place):
             device = _place_to_str(a)
+        elif isinstance(a, jax.Array):
+            # .to(other): adopt the other tensor's dtype. Must precede the
+            # hasattr(a, 'name') dtype test — patched arrays carry a
+            # `name` property
+            dtype = a.dtype
         elif isinstance(a, str):
             # 'cpu', 'gpu', 'gpu:0', 'tpu', or a dtype string
             if a.split(':')[0] in ('cpu', 'gpu', 'tpu', 'xpu', 'npu'):
@@ -118,8 +123,6 @@ def _to(self, *args, **kwargs):
                 dtype = a
         elif isinstance(a, (jnp.dtype, np.dtype, type)) or hasattr(a, 'name'):
             dtype = a
-        elif isinstance(a, jax.Array):
-            dtype = a.dtype
     out = self
     if dtype is not None:
         out = _cast(out, dtype)
@@ -369,7 +372,7 @@ def monkey_patch_tensor():
     special = _special_table()
     targets = _patch_targets()
 
-    for _n in ('view', 'clone', 'take', 'sort'):
+    for _n in ('view',):   # consumed by tensor.manipulation.view
         orig = getattr(targets[0], _n, None)
         if orig is not None and _n not in _ORIGINALS:
             _ORIGINALS[_n] = orig

@@ -138,6 +138,31 @@ def geometric_(x, probs):
     return jax.random.geometric(key, probs, x.shape).astype(x.dtype)
 
 
+def uniform_(x, min=-1.0, max=1.0, seed=0):
+    """ref: Tensor.uniform_ — fill with U(min, max) samples of x's
+    shape/dtype (seed=0: draw from the global generator)."""
+    x = jnp.asarray(x)
+    key = jax.random.PRNGKey(seed) if seed else random_mod.split_key()
+    return jax.random.uniform(
+        key, x.shape, minval=min, maxval=max).astype(x.dtype)
+
+
+def normal_(x, mean=0.0, std=1.0):
+    """ref: Tensor.normal_ — fill with N(mean, std) samples."""
+    x = jnp.asarray(x)
+    key = random_mod.split_key()
+    return (mean + std * jax.random.normal(key, x.shape)).astype(x.dtype)
+
+
+def bernoulli_(x, p=0.5):
+    """ref: Tensor.bernoulli_ — fill with Bernoulli(p) samples (p is a
+    scalar probability, unlike paddle.bernoulli(x) where x IS the
+    probability tensor)."""
+    x = jnp.asarray(x)
+    key = random_mod.split_key()
+    return jax.random.bernoulli(key, p, x.shape).astype(x.dtype)
+
+
 def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
                    mode='truncated'):
     """Nucleus sampling over a [batch, vocab] probability tensor.

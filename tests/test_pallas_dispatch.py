@@ -1,0 +1,116 @@
+"""Kernel-dispatch observability: the pallas path must actually be taken
+when use_pallas() is true, a failing kernel must warn once (not silently
+degrade), and FLAGS_pallas_strict must make it fatal."""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.ops as ops
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dispatch_state(monkeypatch):
+    monkeypatch.setattr(ops, '_kernel_warned', set())
+    pt.set_flags({'FLAGS_pallas_strict': False,
+                  'FLAGS_use_pallas_kernels': True})
+    yield
+    pt.set_flags({'FLAGS_pallas_strict': False})
+
+
+def test_rms_norm_dispatches_to_pallas(monkeypatch):
+    from paddle_tpu.nn.functional.norm import rms_norm as ref
+    from paddle_tpu.ops.pallas import rms_norm as kmod
+    calls = []
+
+    def fake(x, weight, eps):
+        calls.append('rms_norm')
+        return ref(x, weight, eps)
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'rms_norm', fake)
+    x = jnp.ones((2, 128))
+    out = ops.rms_norm(x)
+    assert calls == ['rms_norm']
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x)), rtol=1e-6)
+
+
+def test_softmax_xent_dispatches_to_pallas(monkeypatch):
+    from paddle_tpu.ops.pallas import softmax_xent as kmod
+    calls = []
+    orig = kmod.softmax_cross_entropy_with_logits
+
+    def fake(logits, labels):
+        calls.append('xent')
+        return orig(logits, labels)
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'softmax_cross_entropy_with_logits', fake)
+    logits = jnp.zeros((4, 256))
+    labels = jnp.zeros((4,), dtype=jnp.int32)
+    ops.softmax_cross_entropy(logits, labels)
+    assert calls == ['xent']
+
+
+def test_flash_attention_dispatches_to_pallas(monkeypatch):
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops.pallas import flash_attention as kmod
+    calls = []
+    orig = kmod.flash_attention
+
+    def fake(q, k, v, **kw):
+        calls.append('flash')
+        return orig(q, k, v, **kw)
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'flash_attention', fake)
+    q = jnp.ones((1, 128, 2, 8))
+    F.scaled_dot_product_attention(q, q, q)
+    assert calls == ['flash']
+
+
+def test_failing_kernel_warns_once_then_falls_back(monkeypatch):
+    from paddle_tpu.ops.pallas import rms_norm as kmod
+
+    def broken(x, weight, eps):
+        raise ValueError('kernel exploded')
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'rms_norm', broken)
+    x = jnp.ones((2, 128))
+    with pytest.warns(UserWarning, match='perf cliff'):
+        out = ops.rms_norm(x)
+    assert out.shape == (2, 128)  # lax fallback still computed
+    # second failure: warn-once means silence
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always')
+        ops.rms_norm(x)
+    assert not [w for w in rec if 'perf cliff' in str(w.message)]
+
+
+def test_strict_mode_raises(monkeypatch):
+    from paddle_tpu.ops.pallas import rms_norm as kmod
+
+    def broken(x, weight, eps):
+        raise ValueError('kernel exploded')
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'rms_norm', broken)
+    pt.set_flags({'FLAGS_pallas_strict': True})
+    with pytest.raises(RuntimeError, match='FLAGS_pallas_strict'):
+        ops.rms_norm(jnp.ones((2, 128)))
+
+
+def test_no_pallas_when_disabled(monkeypatch):
+    from paddle_tpu.ops.pallas import rms_norm as kmod
+
+    def fake(x, weight, eps):  # pragma: no cover - must not run
+        raise AssertionError('pallas path taken with flag off')
+
+    monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+    monkeypatch.setattr(kmod, 'rms_norm', fake)
+    pt.set_flags({'FLAGS_use_pallas_kernels': False})
+    out = ops.rms_norm(jnp.ones((2, 128)))
+    assert out.shape == (2, 128)

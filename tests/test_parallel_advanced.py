@@ -16,6 +16,8 @@ from paddle_tpu.distributed.pipeline import PipelineLayer
 from paddle_tpu.distributed.ring_attention import ring_attention_sharded
 from paddle_tpu.nn.functional.attention import _sdpa_reference
 
+pytestmark = pytest.mark.heavy  # deep-validation tier (see pyproject)
+
 
 def _mesh(**axes):
     names = tuple(axes)
@@ -625,3 +627,179 @@ class TestRingFlashComposed:
             q, q, q, is_causal=True) ** 2).sum())(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=5e-3, atol=5e-3)
+
+
+class TestInterleaved1F1B:
+    """Interleaved (virtual-stage) 1F1B — ref pipeline_parallel.py:1143
+    PipelineParallelWithInterleave: v chunks per rank cut the bubble to
+    ~1/v of flat 1F1B's."""
+
+    def test_schedule_wellformed_and_bubble_shrinks(self):
+        from paddle_tpu.distributed.pipeline import (
+            build_interleaved_1f1b_schedule)
+
+        for p, M, v in [(2, 4, 2), (4, 8, 2), (4, 8, 4), (8, 16, 2)]:
+            s1 = build_interleaved_1f1b_schedule(p, M, 1)
+            sv = build_interleaved_1f1b_schedule(p, M, v)
+            for r in range(p):
+                assert (sv['fwd_m'][:, r] >= 0).sum() == v * M
+                assert (sv['bwd_m'][:, r] >= 0).sum() == v * M
+            # the whole point: v chunk-ticks per flat tick, yet total
+            # ticks < v * flat ticks (the bubble shrank)
+            assert sv['ticks'] < v * s1['ticks'], (p, M, v)
+            # classic interleaved bubble: 2·v·M compute + 2(p-1) bubble
+            assert sv['ticks'] == 2 * v * M + 2 * (p - 1), (p, M, v)
+            # stash (live chunk inputs) stays O(p·v), not O(v·M)
+            assert sv['stash'] <= min(M, 2 * p)
+
+    def test_requires_divisible_microbatches(self):
+        from paddle_tpu.distributed.pipeline import (
+            build_interleaved_1f1b_schedule)
+
+        with pytest.raises(ValueError, match='n_micro'):
+            build_interleaved_1f1b_schedule(4, 6, 2)
+
+    def test_generic_matches_sequential(self):
+        from paddle_tpu.distributed.pipeline import (
+            pipeline_interleaved_1f1b, stack_stage_params)
+
+        pt.seed(33)
+        p, v, M = 2, 2, 4
+        V = p * v
+        mesh = _mesh(pp=p)
+        blocks = [nn.Linear(8, 8) for _ in range(V)]
+        stacked = stack_stage_params([[b] for b in blocks])
+        rng = np.random.default_rng(0)
+        mbs = jnp.asarray(rng.normal(size=(M, 2, 8)), jnp.float32)
+        tgts = jnp.asarray(rng.normal(size=(M, 2, 8)), jnp.float32)
+        extra = {'w': jnp.asarray(1.5)}
+
+        def stage_fn(params, x):
+            return params[0](x)
+
+        def loss_fn(extra, y, tgt):
+            return ((y * extra['w'] - tgt) ** 2).mean()
+
+        loss, dp, de, dm, dt = pipeline_interleaved_1f1b(
+            stacked, extra, mbs, tgts, stage_fn, loss_fn, mesh, M, v)
+
+        def ref_loss(blocks_list, extra, mbs, tgts):
+            tot = 0.0
+            for m in range(M):
+                y = mbs[m]
+                for b in blocks_list:
+                    y = b(y)
+                tot = tot + loss_fn(extra, y, tgts[m])
+            return tot / M
+
+        rl, (rgb, rge, rgm, rgt) = jax.value_and_grad(
+            ref_loss, argnums=(0, 1, 2, 3))(blocks, extra, mbs, tgts)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        ref_leaves = [jax.tree.leaves(b) for b in rgb]
+        got_leaves = jax.tree.leaves(dp)
+        for li in range(len(ref_leaves[0])):
+            for vs in range(V):
+                np.testing.assert_allclose(
+                    np.asarray(got_leaves[li][vs]),
+                    np.asarray(ref_leaves[vs][li]), rtol=1e-4, atol=1e-5,
+                    err_msg=f'chunk {vs} leaf {li}')
+        np.testing.assert_allclose(np.asarray(de['w']), np.asarray(rge['w']),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dm), np.asarray(rgm),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dt), np.asarray(rgt),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_matches_flat_1f1b(self):
+        """Same model partitioned flat (v=1 via interleaved path) must
+        reproduce build_1f1b_schedule's pipeline_1f1b numerics."""
+        from paddle_tpu.distributed.pipeline import (
+            pipeline_1f1b, pipeline_interleaved_1f1b, stack_stage_params)
+
+        pt.seed(37)
+        p, M = 4, 4
+        mesh = _mesh(pp=p)
+        blocks = [nn.Linear(6, 6) for _ in range(p)]
+        stacked = stack_stage_params([[b] for b in blocks])
+        rng = np.random.default_rng(2)
+        mbs = jnp.asarray(rng.normal(size=(M, 3, 6)), jnp.float32)
+        tgts = jnp.asarray(rng.normal(size=(M, 3, 6)), jnp.float32)
+        extra = {}
+
+        def stage_fn(params, x):
+            return params[0](x)
+
+        def loss_fn(extra, y, tgt):
+            return ((y - tgt) ** 2).mean()
+
+        l_flat, dp_f, _, dm_f, _ = pipeline_1f1b(
+            stacked, extra, mbs, tgts, stage_fn, loss_fn, mesh, M)
+        l_int, dp_i, _, dm_i, _ = pipeline_interleaved_1f1b(
+            stacked, extra, mbs, tgts, stage_fn, loss_fn, mesh, M, 1)
+        np.testing.assert_allclose(float(l_flat), float(l_int), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(dp_f), jax.tree.leaves(dp_i)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(dm_f), np.asarray(dm_i),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_llama_interleaved_matches_gpipe_and_trains(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_pp import LlamaForCausalLMPipelined
+        from paddle_tpu.optimizer import AdamW
+
+        mesh = _mesh(pp=2)
+        cfg = llama_tiny(vocab_size=64, hidden_size=32, layers=4, heads=2,
+                         kv_heads=2, intermediate_size=64, max_pos=32)
+        pt.seed(23)
+        m_g = LlamaForCausalLMPipelined(cfg, mesh, n_microbatches=4,
+                                        schedule='gpipe')
+        pt.seed(23)
+        m_i = LlamaForCausalLMPipelined(cfg, mesh, n_microbatches=4,
+                                        schedule='interleaved', n_virtual=2)
+        batch = jnp.asarray(np.random.default_rng(1).integers(0, 64, (8, 17)),
+                            jnp.int32)
+        lg, gg = pt.autograd.value_and_grad(lambda m: m.loss(batch))(m_g)
+        li, gi = pt.autograd.value_and_grad(lambda m: m.loss(batch))(m_i)
+        np.testing.assert_allclose(float(lg), float(li), rtol=1e-5)
+
+        def per_block(gmodel, per_stage):
+            # entry i leaf[s] belongs to original block s*per_stage + i
+            out = {}
+            entries = list(gmodel.stage_blocks)
+            n_stack = jax.tree.leaves(entries[0])[0].shape[0]
+            for i, entry in enumerate(entries):
+                for s in range(n_stack):
+                    out[s * per_stage + i] = jax.tree.map(
+                        lambda a: a[s], entry)
+            return out
+
+        bg, bi = per_block(gg, 2), per_block(gi, 1)
+        assert sorted(bg) == sorted(bi)
+        for blk in sorted(bg):
+            for a, b in zip(jax.tree.leaves(bg[blk]),
+                            jax.tree.leaves(bi[blk])):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-3, atol=1e-5,
+                                           err_msg=f'block {blk}')
+        for attr in ('embed_tokens', 'norm', 'lm_head'):
+            for a, b in zip(jax.tree.leaves(getattr(gg, attr)),
+                            jax.tree.leaves(getattr(gi, attr))):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-3, atol=1e-5,
+                                           err_msg=attr)
+
+        opt = AdamW(learning_rate=1e-2)
+        state = opt.init(m_i)
+
+        @jax.jit
+        def step(model, state, b):
+            loss, grads = pt.autograd.value_and_grad(
+                lambda m: m.loss(b))(model)
+            model, state = opt.apply_gradients(model, grads, state)
+            return model, state, loss
+
+        m, s, l0 = step(m_i, state, batch)
+        for _ in range(6):
+            m, s, loss = step(m, s, batch)
+        assert float(loss) < float(l0)

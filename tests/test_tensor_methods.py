@@ -210,3 +210,22 @@ def test_ctc_norm_by_times_applies_under_mean():
                                             norm_by_times=True))(jnp.asarray(logits))
     np.testing.assert_allclose(np.asarray(g_norm), np.asarray(g_plain) / 5,
                                rtol=1e-5)
+
+
+def test_inplace_random_fills_have_fill_semantics():
+    pt.seed(3)
+    x = jnp.zeros((4, 5))
+    u = x.uniform_(min=2.0, max=3.0)
+    assert u.shape == x.shape and bool((u >= 2.0).all() and (u < 3.0).all())
+    n = x.normal_(mean=10.0, std=0.1)
+    assert n.shape == x.shape and abs(float(n.mean()) - 10.0) < 1.0
+    b = jnp.zeros((100,)).bernoulli_(p=1.0)
+    np.testing.assert_allclose(np.asarray(b), 1.0)
+    e = x.exponential_(lam=1.0)
+    assert bool((e >= 0).all())
+
+
+def test_to_other_tensor_adopts_dtype():
+    x = jnp.ones((2,), dtype=jnp.float32)
+    y = jnp.ones((3,), dtype=jnp.float16)
+    assert x.to(y).dtype == jnp.float16
