@@ -142,6 +142,33 @@ def main():
     tokens = batch * seq
     tok_per_sec = tokens / dt
 
+    # -- decode path: steady-state single-token generation over a long KV
+    # cache (the inference-stack half of the reference's perf story) -----
+    def bench_decode(dec_batch, cache_len, dec_steps):
+        caches = model.init_cache(dec_batch, cache_len)
+
+        @jax.jit
+        def decode_step(tok, caches, i):
+            logits, caches = model(tok, caches=caches, cache_index=i)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+            return nxt, caches
+
+        tok = jnp.zeros((dec_batch, 1), jnp.int32)
+        base = jnp.asarray(cache_len - dec_steps - 2, jnp.int32)
+        tok, caches = decode_step(tok, caches, base)       # compile
+        float(tok[0, 0])
+        t0 = time.perf_counter()
+        for s in range(dec_steps):
+            tok, caches = decode_step(tok, caches, base + 1 + s)
+        float(tok[0, 0])
+        ddt = time.perf_counter() - t0 - sync_latency
+        return dec_batch * dec_steps / ddt
+
+    dec_cache = 2048 if on_tpu else 128
+    dec_steps = 48 if on_tpu else 8
+    decode_b1 = bench_decode(1, dec_cache, dec_steps)
+    decode_b8 = bench_decode(8, dec_cache, dec_steps)
+
     # FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     attn = 6 * cfg.num_hidden_layers * cfg.hidden_size * seq  # 12*L*h*S * 0.5 causal
@@ -157,6 +184,10 @@ def main():
         'detail': {
             'mfu': round(mfu, 4), 'loss': float(loss), 'step_ms': round(dt * 1e3, 2),
             'params': n_params, 'batch': batch, 'seq': seq,
+            'vocab_size': cfg.vocab_size,
+            'decode_tok_s_b1': round(decode_b1, 1),
+            'decode_tok_s_b8': round(decode_b8, 1),
+            'decode_cache_len': dec_cache,
             'backend': jax.default_backend(),
             'device': getattr(jax.devices()[0], 'device_kind', '?'),
         },

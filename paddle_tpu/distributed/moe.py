@@ -22,6 +22,33 @@ from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
 
 
+def _topk_gates(logits, k: int):
+    """Shared gating math for the dense and ragged dispatch paths:
+    softmax probs, top-k choice, per-token gate normalisation, and the
+    Switch/GShard load-balance aux loss E·sum(frac_tokens·frac_probs)."""
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)           # (T, k)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True),
+                                        1e-9)
+    me = probs.mean(axis=0)                                   # (E,)
+    ce = jax.nn.one_hot(expert_idx[:, 0], E).mean(axis=0)
+    aux_loss = E * jnp.sum(me * ce)
+    return probs, gate_vals, expert_idx, aux_loss
+
+
+def limit_by_capacity(topk_idx, num_expert, capacity):
+    """ref: incubate/.../moe/utils.py::limit_by_capacity — keep at most
+    ``capacity`` (token-order) routings per expert; dropped entries
+    become -1."""
+    flat = topk_idx.reshape(-1).astype(jnp.int32)
+    oh = jax.nn.one_hot(flat, num_expert, dtype=jnp.int32)
+    pos = jnp.cumsum(oh, axis=0) - oh
+    slot = (pos * oh).sum(-1)
+    keep = slot < capacity
+    return jnp.where(keep, flat, -1).reshape(topk_idx.shape)
+
+
 def top_k_gating(logits, k: int, capacity: int, jitter_key=None):
     """GShard-style top-k gating with capacity.
 
@@ -29,17 +56,7 @@ def top_k_gating(logits, k: int, capacity: int, jitter_key=None):
     combine (T, E, C) float, aux_loss scalar).
     """
     T, E = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-
-    gate_vals, expert_idx = jax.lax.top_k(probs, k)          # (T, k)
-    # normalise chosen gates
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
-
-    # load-balancing aux loss (Switch/GShard): E * mean(frac_tokens * frac_probs)
-    me = probs.mean(axis=0)                                   # (E,)
-    top1 = jax.nn.one_hot(expert_idx[:, 0], E)
-    ce = top1.mean(axis=0)
-    aux_loss = E * jnp.sum(me * ce)
+    probs, gate_vals, expert_idx, aux_loss = _topk_gates(logits, k)
 
     # position of each (token, choice) within its expert's capacity buffer
     dispatch = jnp.zeros((T, E, capacity), jnp.float32)
@@ -58,6 +75,153 @@ def top_k_gating(logits, k: int, capacity: int, jitter_key=None):
         combine = combine + upd * (gate_vals[:, choice] * keep)[:, None, None]
         fill = fill + onehot_e.sum(0)
     return dispatch, combine, aux_loss
+
+
+def ragged_expert_apply(tokens, expert_idx, gate_vals, w_gate, w_up, w_down,
+                        num_experts, act=F.silu):
+    """Dropless expert compute: sort tokens by expert, run grouped GEMMs.
+
+    ref: the reference's large-E MoE path (incubate/.../moe global_scatter
+    to per-expert buffers). TPU-native: a stable sort by expert id turns
+    the (token, choice) pairs into contiguous per-expert groups, and
+    `jax.lax.ragged_dot` runs every expert's GEMM in one MXU call —
+    O(T·k·H) memory instead of the GShard einsum's O(T·E·C), the right
+    shape for E >= ~16 (DeepSeek-style).
+
+    tokens (T, H); expert_idx/gate_vals (T, k). Returns (T, H).
+    """
+    T, H = tokens.shape
+    k = expert_idx.shape[1]
+    flat_e = expert_idx.reshape(-1).astype(jnp.int32)         # (T·k,)
+    flat_g = gate_vals.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)
+    tok_ids = order // k                                      # source token
+    x = jnp.take(tokens, tok_ids, axis=0)                     # (T·k, H)
+    group_sizes = jnp.bincount(flat_e, length=num_experts).astype(jnp.int32)
+    h = act(jax.lax.ragged_dot(x, w_gate, group_sizes))
+    h = h * jax.lax.ragged_dot(x, w_up, group_sizes)
+    y = jax.lax.ragged_dot(h, w_down, group_sizes)            # (T·k, H)
+    y = y * jnp.take(flat_g, order)[:, None].astype(y.dtype)
+    return jnp.zeros((T, H), y.dtype).at[tok_ids].add(y)
+
+
+# ---------------------------------------------------------------------------
+# Gate variants (ref: incubate/distributed/models/moe/gate/{base,naive,
+# switch,gshard}_gate.py — fastmoe lineage)
+# ---------------------------------------------------------------------------
+
+class BaseGate(Layer):
+    """ref: gate/base_gate.py — scoring module contract: forward(inp) ->
+    (topk_val, topk_idx); the load-balance loss is stashed on the gate."""
+
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.loss = jnp.zeros(())
+
+    def set_loss(self, loss):
+        object.__setattr__(self, 'loss', loss)
+
+    def get_loss(self, clear=True):
+        return self.loss
+
+
+class NaiveGate(BaseGate):
+    """ref: gate/naive_gate.py — plain linear scores, top-k, no balance
+    loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        from ..nn import Linear
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate = self.gate(inp)
+        val, idx = jax.lax.top_k(gate, self.top_k)
+        if return_all_scores:
+            return val, idx, gate
+        return val, idx
+
+
+class SwitchGate(NaiveGate):
+    """ref: gate/switch_gate.py — top-1 routing with train-time jitter
+    noise and the Switch load-balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4)):
+        if topk != 1:
+            raise ValueError('topk should be 1 in switch')
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, inp, jitter_key=None):
+        import math
+
+        score = self.gate(inp)
+        if self.training:
+            if jitter_key is None:
+                from ..framework import random as random_mod
+                jitter_key = random_mod.split_key()
+            noise = jax.random.uniform(jitter_key, score.shape,
+                                       dtype=score.dtype)
+            score = score + noise * 2 * self.switch_eps + 1.0 - self.switch_eps
+        probs = jax.nn.softmax(score.astype(jnp.float32), axis=-1)
+        top1_val, top1_idx = jax.lax.top_k(probs, 1)
+        # Switch balance loss: E * sum(frac_tokens_e * frac_prob_e)
+        E = self.tot_expert
+        ce = jax.nn.one_hot(top1_idx[:, 0], E).mean(axis=0)
+        me = probs.mean(axis=0)
+        self.set_loss(E * jnp.sum(ce * me))
+        # capacity pruning (ref switch_gate.py -> limit_by_capacity):
+        # per-expert budget from the train/eval capacity factor; dropped
+        # routings come back as -1
+        cap_rate = self.capacity[0 if self.training else 1]
+        cap = max(1, math.ceil(cap_rate * inp.shape[0] / self.tot_expert))
+        top1_idx = limit_by_capacity(top1_idx, self.tot_expert, cap)
+        return top1_val.astype(inp.dtype), top1_idx
+
+
+class GShardGate(NaiveGate):
+    """ref: gate/gshard_gate.py — top-2 routing + GShard balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True):
+        if topk != 2:
+            raise ValueError('topk should be 2 in gshard')
+        super().__init__(d_model, num_expert, world_size)
+        self.top_k = 2
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, inp, rng_key=None):
+        import math
+
+        val, idx, score = super().forward(inp, return_all_scores=True)
+        E = self.tot_expert
+        ce = jax.nn.one_hot(idx.reshape(-1), E).sum(axis=0) / score.shape[0]
+        me = jax.nn.softmax(score.astype(jnp.float32), axis=-1).mean(axis=0)
+        self.set_loss(jnp.mean(ce * me) * (self.num_expert ** 2))
+        # capacity pruning (ref gshard_gate.py -> limit_by_capacity)
+        cap_rate = self.capacity[0 if self.training else 1]
+        cap = max(1, math.ceil(cap_rate * inp.shape[0] / self.tot_expert))
+        idx = limit_by_capacity(idx, self.tot_expert, cap)
+        if self.random_routing:
+            # ref gshard_gate.py: keep the 2nd choice with probability
+            # proportional to its (doubled) gate value
+            if rng_key is None:
+                from ..framework import random as random_mod
+                rng_key = random_mod.split_key()
+            gate2 = jax.nn.softmax(score.astype(jnp.float32), axis=-1)
+            gate2 = jnp.take_along_axis(gate2, idx[:, 1:2].clip(0), axis=-1)
+            keep2 = (jax.random.uniform(rng_key, (score.shape[0], 1))
+                     < 2.0 * gate2)
+            idx = jnp.concatenate(
+                [idx[:, :1], jnp.where(keep2, idx[:, 1:2], -1)], axis=-1)
+        return val, idx
 
 
 class ExpertMLP(Layer):
@@ -91,11 +255,33 @@ class MoELayer(Layer):
 
     def __init__(self, hidden, intermediate, num_experts=8, top_k=2,
                  capacity_factor=1.25, num_shared_experts=0, gate_init=None,
-                 return_aux=False):
+                 return_aux=False, dispatch_mode='auto'):
         super().__init__()
+        if dispatch_mode not in ('auto', 'dense', 'ragged'):
+            raise ValueError(
+                f"dispatch_mode must be 'auto'|'dense'|'ragged', "
+                f'got {dispatch_mode}')
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        # 'dense' = GShard (T, E, C) einsum dispatch: best for small E,
+        # and the form GSPMD turns into the ep all-to-all. 'ragged' =
+        # DROPLESS sort + lax.ragged_dot grouped GEMM: O(T·k) memory,
+        # the right shape for E >= ~16 — note it ignores capacity_factor
+        # (no token dropping, DeepSeek-style). 'auto' preserves the
+        # historical dense numerics but nudges large-E users once.
+        if dispatch_mode == 'auto':
+            if num_experts >= 16:
+                import warnings
+
+                warnings.warn(
+                    f'MoELayer(num_experts={num_experts}) defaults to the '
+                    f'dense GShard dispatch, whose (tokens, E, C) tensors '
+                    f"are O(T²); pass dispatch_mode='ragged' for the "
+                    f'dropless grouped-GEMM path at this expert count.',
+                    stacklevel=3)
+            dispatch_mode = 'dense'
+        self.dispatch_mode = dispatch_mode
         init = gate_init or I.Normal(0.0, 0.02)
         self.gate = Parameter(init((hidden, num_experts), 'float32'))
         self.experts = ExpertMLP(num_experts, hidden, intermediate)
@@ -120,16 +306,28 @@ class MoELayer(Layer):
         B, S, H = x.shape
         tokens = x.reshape(B * S, H)
         T = B * S
-        capacity = int(self.capacity_factor * self.top_k * T / self.num_experts)
-        capacity = max(capacity, 1)
         logits = tokens @ self.gate
-        dispatch, combine, aux = top_k_gating(logits, self.top_k, capacity)
-        # (T,E,C)·(T,H) → (E,C,H): under GSPMD with 'ep'-sharded experts
-        # this einsum IS the all-to-all dispatch
-        expert_in = jnp.einsum('tec,th->ech', dispatch, tokens.astype(jnp.float32))
-        expert_out = self.experts(expert_in.astype(x.dtype))
-        out = jnp.einsum('tec,ech->th', combine, expert_out.astype(jnp.float32))
-        out = out.reshape(B, S, H).astype(x.dtype)
+        if self.dispatch_mode == 'ragged':
+            _, gate_vals, expert_idx, aux = _topk_gates(logits, self.top_k)
+            out = ragged_expert_apply(
+                tokens.astype(x.dtype), expert_idx, gate_vals,
+                self.experts.w_gate, self.experts.w_up, self.experts.w_down,
+                self.num_experts, act=self.experts.act)
+            out = out.reshape(B, S, H).astype(x.dtype)
+        else:
+            capacity = int(
+                self.capacity_factor * self.top_k * T / self.num_experts)
+            capacity = max(capacity, 1)
+            dispatch, combine, aux = top_k_gating(logits, self.top_k,
+                                                  capacity)
+            # (T,E,C)·(T,H) → (E,C,H): under GSPMD with 'ep'-sharded
+            # experts this einsum IS the all-to-all dispatch
+            expert_in = jnp.einsum('tec,th->ech', dispatch,
+                                   tokens.astype(jnp.float32))
+            expert_out = self.experts(expert_in.astype(x.dtype))
+            out = jnp.einsum('tec,ech->th', combine,
+                             expert_out.astype(jnp.float32))
+            out = out.reshape(B, S, H).astype(x.dtype)
         if self.shared is not None:
             shared_in = jnp.broadcast_to(
                 tokens[None], (self.num_shared, T, H)).astype(x.dtype)

@@ -2,6 +2,7 @@
 fused surfaces. LookAhead re-exported for API parity
 (paddle.incubate.LookAhead).
 """
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from ..optimizer.wrappers import LookAhead  # noqa: F401
 

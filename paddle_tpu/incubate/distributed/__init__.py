@@ -1,0 +1,2 @@
+"""ref: python/paddle/incubate/distributed."""
+from . import models  # noqa: F401

@@ -1,0 +1,2 @@
+"""ref: python/paddle/incubate/distributed/models."""
+from . import moe  # noqa: F401

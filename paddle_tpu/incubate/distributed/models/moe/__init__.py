@@ -1,0 +1,14 @@
+"""ref: python/paddle/incubate/distributed/models/moe — re-export of the
+TPU-native MoE (paddle_tpu.distributed.moe): GShard dense dispatch +
+ragged grouped-GEMM path + gate variants."""
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    BaseGate,
+    ExpertMLP,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+    ragged_expert_apply,
+    top_k_gating,
+)
+from . import gate  # noqa: F401

@@ -1,0 +1,8 @@
+"""ref: python/paddle/incubate/distributed/models/moe/gate — gate
+variants (fastmoe lineage): naive / switch / gshard."""
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)

@@ -1,12 +1,21 @@
 """nn.functional namespace (ref: python/paddle/nn/functional/__init__.py)."""
 from .activation import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    flash_attention,
     flash_attn_qkvpacked,
     flash_attn_varlen_qkvpacked,
     flashmask_attention,
     scaled_dot_product_attention,
     sparse_attention,
+)
+# import ORDER matters: pulling the names from the submodule registers
+# `nn.functional.flash_attention` as an importable module path (ref
+# scripts do `from paddle.nn.functional.flash_attention import ...`)
+# while the from-import keeps the attribute bound to the FUNCTION
+from .flash_attention import (  # noqa: F401
+    calc_reduced_attention_scores,
+    flash_attention,
+    flash_attn_unpadded,
+    sdp_kernel,
 )
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401

@@ -1,0 +1,112 @@
+"""Importable flash-attention module path.
+
+ref: python/paddle/nn/functional/flash_attention.py — scripts do
+``from paddle.nn.functional.flash_attention import flash_attention``;
+this module provides that path with the reference signatures (the
+compute dispatches to the pallas TPU kernel via
+scaled_dot_product_attention, lax reference elsewhere).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .attention import (  # noqa: F401
+    flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    scaled_dot_product_attention,
+)
+
+__all__ = [
+    'flash_attention',
+    'flash_attn_qkvpacked',
+    'flash_attn_unpadded',
+    'flash_attn_varlen_qkvpacked',
+    'flashmask_attention',
+    'scaled_dot_product_attention',
+    'sdp_kernel',
+    'calc_reduced_attention_scores',
+]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name='', training=True, name=None):
+    """ref: flash_attention.py::flash_attention — (B, S, H, D) inputs,
+    returns (out, softmax) where softmax is None unless requested."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout if training else 0.0,
+        is_causal=causal)
+    softmax = None
+    if return_softmax:
+        d = query.shape[-1]
+        s = jnp.einsum('bqhd,bkhd->bhqk',
+                       query.astype(jnp.float32),
+                       key.astype(jnp.float32)) / jnp.sqrt(
+                           jnp.asarray(d, jnp.float32))
+        if causal:
+            Sq, Sk = query.shape[1], key.shape[1]
+            mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+            s = jnp.where(mask, s, -jnp.inf)
+        softmax = jax.nn.softmax(s, axis=-1).astype(query.dtype)
+    return out, softmax
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, *,
+                        fixed_seed_offset=None, rng_name='', training=True,
+                        name=None):
+    """ref: flash_attention.py::flash_attn_unpadded — packed varlen
+    attention: (total_tokens, H, D) inputs, sequences delimited by
+    cu_seqlens. Mapped to segment-masked sdpa (block-diagonal within
+    each sequence — the flash kernel's packed fast path on TPU)."""
+    tq = query.shape[0]
+    tk = key.shape[0]
+
+    def seg_ids(total, cu):
+        # token i belongs to the sequence whose [cu[j], cu[j+1]) covers i
+        return (jnp.searchsorted(jnp.asarray(cu), jnp.arange(total),
+                                 side='right') - 1).astype(jnp.int32)
+
+    q_seg = seg_ids(tq, cu_seqlens_q)[None]
+    k_seg = seg_ids(tk, cu_seqlens_k)[None]
+    out = scaled_dot_product_attention(
+        query[None], key[None], value[None],
+        dropout_p=dropout if training else 0.0, is_causal=causal,
+        scale=scale, segment_ids=q_seg, kv_segment_ids=k_seg)
+    return out[0], None
+
+
+def sdp_kernel(enable_math=None, enable_flash=None, enable_mem_efficient=None):
+    """ref: flash_attention.py::sdp_kernel — backend-selection context.
+    On TPU the pallas flash kernel is governed by FLAGS_use_pallas_kernels;
+    this context flips it for the duration."""
+    import contextlib
+
+    from ...framework.flags import get_flags, set_flags
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = get_flags(['FLAGS_use_pallas_kernels'])[
+            'FLAGS_use_pallas_kernels']
+        if enable_flash is not None:
+            set_flags({'FLAGS_use_pallas_kernels': bool(enable_flash)})
+        try:
+            yield
+        finally:
+            set_flags({'FLAGS_use_pallas_kernels': prev})
+
+    return ctx()
+
+
+def calc_reduced_attention_scores(query, key, softmax_lse=None):
+    """ref: flash_attention.py::calc_reduced_attention_scores — per-query
+    attention mass summed over heads (used by sparse-attention tooling)."""
+    d = query.shape[-1]
+    s = jnp.einsum('bqhd,bkhd->bhqk', query.astype(jnp.float32),
+                   key.astype(jnp.float32)) / jnp.sqrt(
+                       jnp.asarray(d, jnp.float32))
+    p = jax.nn.softmax(s, axis=-1)
+    return p.sum(axis=1).astype(query.dtype)

@@ -111,8 +111,36 @@ class Profiler:
                 f'p50={np.percentile(t, 50) * 1e3:.2f}ms '
                 f'p99={np.percentile(t, 99) * 1e3:.2f}ms')
 
-    def summary(self, **kw):
-        print(self.step_info())
+    def summary(self, sorted_by=None, views=None, **kw):
+        """Formatted step-timing report (ref profiler.py summary tables;
+        per-op device timing lives in the exported trace — use
+        `profiler.op_summary(fn, *args)` for the compile-time view)."""
+        if not self._step_times:
+            print('no steps recorded')
+            return
+        import numpy as np
+
+        t = np.asarray(self._step_times) * 1e3
+        rows = [
+            ('steps', f'{len(t)}'),
+            ('avg', f'{t.mean():.2f} ms'),
+            ('p50', f'{np.percentile(t, 50):.2f} ms'),
+            ('p90', f'{np.percentile(t, 90):.2f} ms'),
+            ('p99', f'{np.percentile(t, 99):.2f} ms'),
+            ('min', f'{t.min():.2f} ms'),
+            ('max', f'{t.max():.2f} ms'),
+            ('total', f'{t.sum():.2f} ms'),
+        ]
+        w = max(len(k) for k, _ in rows)
+        sep = '-' * (w + 14)
+        print(sep)
+        print(f'{"step timing":<{w + 2}}')
+        print(sep)
+        for k, v in rows:
+            print(f'{k:<{w + 2}}{v}')
+        print(sep)
+        if not self.timer_only:
+            print(f'device trace: {self.log_dir} (TensorBoard / Perfetto)')
 
     def __enter__(self):
         return self.start()
@@ -237,6 +265,70 @@ def export_protobuf(dir_name, worker_name=None):
     return export_chrome_tracing(dir_name, worker_name)
 
 
+def op_summary(fn, *args, print_table=True, top=20, **kwargs):
+    """Per-op report for a jittable function (the reference's operator/
+    kernel summary views, rebuilt on XLA's compile-time analyses).
+
+    Compiles `fn(*args)` and reports: opcode histogram of the optimized
+    HLO (what XLA actually runs, post-fusion), total FLOPs and bytes
+    from `cost_analysis`, and the memory footprint split from
+    `memory_analysis`. Returns the stats dict (also printed as a table
+    unless print_table=False).
+    """
+    import collections
+    import re
+
+    import jax as _jax
+
+    compiled = _jax.jit(fn).lower(*args, **kwargs).compile()
+    hist = collections.Counter()
+    for mod in compiled.as_text().splitlines():
+        m = re.search(r'=\s+[\w\[\],{}() ]*?\s*([a-z][\w-]*)\(', mod)
+        if m and not mod.lstrip().startswith(('ROOT', '//')):
+            hist[m.group(1)] += 1
+        elif mod.lstrip().startswith('ROOT'):
+            m = re.search(r'=\s+\S+\s+([a-z][\w-]*)\(', mod)
+            if m:
+                hist[m.group(1)] += 1
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+    except Exception:
+        cost = {}
+    try:
+        mem = compiled.memory_analysis()
+        mem_stats = {
+            'argument_bytes': mem.argument_size_in_bytes,
+            'output_bytes': mem.output_size_in_bytes,
+            'temp_bytes': mem.temp_size_in_bytes,
+        }
+    except Exception:
+        mem_stats = {}
+    stats = {
+        'opcode_histogram': dict(hist.most_common()),
+        'flops': float(cost.get('flops', 0.0)) if cost else None,
+        'bytes_accessed': (float(cost.get('bytes accessed', 0.0))
+                           if cost else None),
+        'memory': mem_stats,
+    }
+    if print_table:
+        print('-' * 44)
+        print(f'{"opcode":<28}{"count":>8}')
+        print('-' * 44)
+        for op, n in hist.most_common(top):
+            print(f'{op:<28}{n:>8}')
+        print('-' * 44)
+        if stats['flops']:
+            print(f'{"total flops":<28}{stats["flops"]:>14.3e}')
+        if stats['bytes_accessed']:
+            print(f'{"bytes accessed":<28}{stats["bytes_accessed"]:>14.3e}')
+        for k, v in mem_stats.items():
+            print(f'{k:<28}{v:>14,}')
+        print('-' * 44)
+    return stats
+
+
 def load_profiler_result(filename):
     """ref: paddle.profiler.load_profiler_result — load an exported
     chrome trace JSON for programmatic inspection."""
@@ -248,6 +340,6 @@ def load_profiler_result(filename):
         return json.load(f)
 
 
-__all__ += ['ProfilerState', 'SortedKeys', 'SummaryView', 'make_scheduler',
+__all__ += ['ProfilerState', 'SortedKeys', 'SummaryView', 'make_scheduler', 'op_summary',
             'export_chrome_tracing', 'export_protobuf',
             'load_profiler_result']

@@ -52,14 +52,78 @@ def quantize_model(model, quantizable=('Linear',), inplace=False):
     return model
 
 
+def _replace_layers(model, match, build, inplace=False):
+    """Shared PTQ/QAT traversal: structural-copy (unless inplace), then
+    recursively swap every child where ``match(child)`` for
+    ``build(child)``."""
+    import jax
+
+    if not inplace:
+        leaves, treedef = jax.tree.flatten(model)
+        model = jax.tree.unflatten(treedef, leaves)   # structural copy
+
+    def walk(layer):
+        for name, child in list(layer.__dict__.items()):
+            if match(child):
+                layer.__dict__[name] = build(child)
+            elif isinstance(child, Layer):
+                walk(child)
+        return layer
+
+    return walk(model)
+
+
+class _ObservedLinear(Layer):
+    """Calibration wrapper: fp32 passthrough that feeds the activation
+    observer (ref quantization/ptq.py inserts observer hooks)."""
+
+    def __init__(self, inner, act_observer):
+        super().__init__()
+        self.inner = inner
+        self._obs = act_observer
+
+    def forward(self, x):
+        self._obs.observe(x)
+        return self.inner(x)
+
+
 class PTQ:
-    """ref: paddle.quantization.PTQ facade."""
+    """ref: paddle.quantization.PTQ — the full post-training flow:
+
+        ptq = PTQ(QuantConfig())
+        observed = ptq.quantize(model)       # insert observers
+        for batch in calib_loader:           # calibration (eager)
+            observed(batch)
+        infer_model = ptq.convert(observed)  # int8 weight-only Linears
+
+    `quantize` leaves the numerics untouched (observers are identity);
+    `convert` swaps each observed Linear for a QuantizedLinear, keeping
+    the observed activation scale on the layer for introspection /
+    static-quant consumers.
+    """
 
     def __init__(self, config=None):
-        self.config = config
+        self.config = config or QuantConfig()
 
     def quantize(self, model, inplace=False):
-        return quantize_model(model, inplace=inplace)
+        from ..nn.layer.common import Linear
+
+        def build(child):
+            a_cls, _ = self.config._for_layer(child)
+            return _ObservedLinear(child, (a_cls or BaseObserver)())
+
+        return _replace_layers(model, lambda c: isinstance(c, Linear),
+                               build, inplace)
+
+    def convert(self, model, inplace=False):
+        def build(child):
+            q = QuantizedLinear(child.inner)
+            object.__setattr__(q, 'act_scale', child._obs.scales())
+            return q
+
+        return _replace_layers(model,
+                               lambda c: isinstance(c, _ObservedLinear),
+                               build, inplace)
 
 
 class BaseObserver:
@@ -163,43 +227,19 @@ class QAT:
     def quantize(self, model, inplace=False):
         from ..nn.layer.common import Linear
 
-        if not inplace:
-            import jax
+        def build(child):
+            a_cls, w_cls = self.config._for_layer(child)
+            return _QATLinear(child, (a_cls or BaseQuanter)(),
+                              (w_cls or BaseQuanter)())
 
-            leaves, treedef = jax.tree.flatten(model)
-            model = jax.tree.unflatten(treedef, leaves)  # structural copy
-
-        def wrap(layer):
-            for name, child in list(layer.__dict__.items()):
-                if isinstance(child, Linear):
-                    a_cls, w_cls = self.config._for_layer(child)
-                    layer.__dict__[name] = _QATLinear(
-                        child,
-                        (a_cls or BaseQuanter)(),
-                        (w_cls or BaseQuanter)())
-                elif isinstance(child, Layer):
-                    wrap(child)
-            return layer
-
-        return wrap(model)
+        return _replace_layers(model, lambda c: isinstance(c, Linear),
+                               build, inplace)
 
     def convert(self, model, inplace=False):
         """Swap QAT wrappers for the int8 weight-only inference path."""
-        if not inplace:
-            import jax
-
-            leaves, treedef = jax.tree.flatten(model)
-            model = jax.tree.unflatten(treedef, leaves)  # structural copy
-
-        def unwrap(layer):
-            for name, child in list(layer.__dict__.items()):
-                if isinstance(child, _QATLinear):
-                    layer.__dict__[name] = quantize_layer(child.inner)
-                elif isinstance(child, Layer):
-                    unwrap(child)
-            return layer
-
-        return unwrap(model)
+        return _replace_layers(model,
+                               lambda c: isinstance(c, _QATLinear),
+                               lambda c: quantize_layer(c.inner), inplace)
 
 
 def quantize_layer(linear):

@@ -9,6 +9,7 @@ import itertools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import nn
@@ -31,6 +32,7 @@ def _brute_force_best(model, prefix, steps, V):
     return best, best_seq
 
 
+@pytest.mark.heavy
 class TestLlamaBeamSearch:
     def _model(self, V=8):
         pt.seed(3)

@@ -156,6 +156,7 @@ class TestLogProbVsScipy:
         _allclose(d.entropy(), st.entropy([0.2, 0.3, 0.5]))
 
 
+@pytest.mark.heavy
 class TestSampling:
     """Sample statistics converge to the distribution's moments, and
     rsample differentiates (reparameterization)."""

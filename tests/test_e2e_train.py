@@ -15,6 +15,8 @@ from paddle_tpu.models.resnet import resnet18
 from paddle_tpu.optimizer import AdamW
 from paddle_tpu.optimizer.lr import LinearWarmup
 
+pytestmark = pytest.mark.heavy  # deep-validation tier (see pyproject)
+
 
 def test_llama_e2e_convergence(tmp_path):
     """Tiny Llama memorises a repeating synthetic corpus; checkpoint at

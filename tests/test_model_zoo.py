@@ -13,6 +13,8 @@ from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
 from paddle_tpu.models.moe_lm import MoEForCausalLM, moe_tiny
 from paddle_tpu.optimizer import AdamW
 
+pytestmark = pytest.mark.heavy  # deep-validation tier (see pyproject)
+
 
 def _img(b, s, c=3, seed=0):
     return jnp.asarray(np.random.default_rng(seed).normal(size=(b, s, s, c)),

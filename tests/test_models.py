@@ -85,6 +85,7 @@ class TestLlama:
                                    rtol=1e-6)
 
 
+@pytest.mark.heavy
 class TestResNet:
     def test_resnet18_forward(self):
         model = resnet18(num_classes=10).eval()

@@ -76,17 +76,83 @@ class TestQuantization:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=0.05, atol=0.15)
 
-    def test_quantize_model_swaps_linears(self):
+    def test_ptq_quantize_observe_convert_flow(self):
+        """ref quantization/ptq.py: quantize inserts observers (identity
+        numerics), calibration feeds them, convert swaps int8 Linears."""
         pt.seed(0)
         net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
         x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 16)),
                         jnp.float32)
         ref = net(x)
-        qnet = PTQ().quantize(net)
+        ptq = PTQ()
+        observed = ptq.quantize(net)
+        np.testing.assert_allclose(np.asarray(observed(x)), np.asarray(ref))
+        qnet = ptq.convert(observed)
         out = qnet(x)
         # original untouched
         from paddle_tpu.nn.layer.common import Linear
+        from paddle_tpu.quantization import QuantizedLinear
 
         assert isinstance(net.sublayers()[0], Linear)
+        assert isinstance(qnet.sublayers()[0], QuantizedLinear)
+        # calibration stats were captured
+        assert qnet.sublayers()[0].act_scale is not None
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=0.1, atol=0.3)
+
+    def test_ptq_end_to_end_accuracy_drop_under_1pct(self):
+        """VERDICT r3 #10: train a small classifier on synthetic MNIST-like
+        data, PTQ-calibrate over a DataLoader, convert, and assert the
+        int8 weight-only model loses < 1% accuracy."""
+        from paddle_tpu.io import DataLoader, TensorDataset
+        from paddle_tpu.optimizer import Adam
+
+        pt.seed(7)
+        rng = np.random.default_rng(0)
+        n_cls, n_per, dim = 10, 40, 64
+        centers = rng.normal(size=(n_cls, dim)) * 3.0
+        xs = np.concatenate([
+            centers[c] + rng.normal(size=(n_per, dim)) * 0.7
+            for c in range(n_cls)]).astype(np.float32)
+        ys = np.repeat(np.arange(n_cls), n_per).astype(np.int32)
+        perm = rng.permutation(len(xs))
+        xs, ys = xs[perm], ys[perm]
+
+        net = nn.Sequential(nn.Linear(dim, 128), nn.ReLU(),
+                            nn.Linear(128, n_cls))
+        opt = Adam(learning_rate=5e-3)
+        state = opt.init(net)
+
+        import jax
+        import paddle_tpu.nn.functional as F
+
+        @jax.jit
+        def step(m, s, bx, by):
+            def lf(mm):
+                return F.cross_entropy(mm(bx), by.astype(jnp.int64)).mean()
+
+            loss, g = pt.autograd.value_and_grad(lf)(m)
+            m, s = opt.apply_gradients(m, g, s)
+            return m, s, loss
+
+        bx = jnp.asarray(xs)
+        by = jnp.asarray(ys)
+        for _ in range(60):
+            net, state, loss = step(net, state, bx, by)
+
+        def acc(m):
+            pred = np.asarray(jnp.argmax(m(bx), axis=-1))
+            return float((pred == ys).mean())
+
+        fp_acc = acc(net)
+        assert fp_acc > 0.9, fp_acc
+
+        # PTQ: observe over a calibration loader, then convert
+        ptq = PTQ()
+        observed = ptq.quantize(net)
+        loader = DataLoader(TensorDataset([bx]), batch_size=64)
+        for (batch,) in loader:
+            observed(batch)
+        qnet = ptq.convert(observed)
+        q_acc = acc(qnet)
+        assert fp_acc - q_acc < 0.01, (fp_acc, q_acc)

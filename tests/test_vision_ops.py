@@ -172,6 +172,7 @@ class TestPriorBox:
                                    [0.0, 0.0, 0.25, 0.25], atol=1e-6)
 
 
+@pytest.mark.heavy
 class TestDeformConv2D:
     def test_zero_offset_equals_conv(self):
         rng = np.random.default_rng(0)
@@ -259,6 +260,7 @@ class TestYoloBox:
         assert float(jnp.abs(scores).sum()) == 0.0
 
 
+@pytest.mark.heavy
 class TestYoloLoss:
     def _setup(self, N=2, S=2, nc=3, H=4, W=4, B=3, seed=0):
         rng = np.random.default_rng(seed)

@@ -10,6 +10,8 @@ import paddle_tpu as pt
 from paddle_tpu.vision import models as M
 from paddle_tpu.vision import transforms as T
 
+pytestmark = pytest.mark.heavy  # deep-validation tier (see pyproject)
+
 
 def _n_params(m):
     return sum(int(np.prod(p.shape)) for p in m.parameters())
