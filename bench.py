@@ -114,10 +114,15 @@ def main():
         return model, state, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
-    ids = jnp.asarray(
-        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq + 1)),
-        jnp.int32,
-    )
+    # distinct batches per step so the loss field reflects real training
+    # dynamics instead of one memorized batch
+    rng0 = np.random.default_rng(0)
+    batches = [
+        jnp.asarray(rng0.integers(0, cfg.vocab_size, (batch, seq + 1)),
+                    jnp.int32)
+        for _ in range(4)
+    ]
+    ids = batches[0]
 
     model, state, loss = step(model, state, ids)   # compile + warmup
     float(loss)
@@ -134,8 +139,8 @@ def main():
     sync_latency = (time.perf_counter() - t0) / 5
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        model, state, loss = step(model, state, ids)   # chained (donated)
+    for i in range(steps):
+        model, state, loss = step(model, state, batches[i % len(batches)])
     float(loss)                                        # one hard sync
     dt = (time.perf_counter() - t0 - sync_latency) / steps
 

@@ -852,11 +852,17 @@ class PipelineLayer:
     user-facing wrapper: partition a LayerList of blocks into pp stages.
 
     For jit-ability all stages must be structurally identical (the usual
-    transformer case). `forward` runs GPipe over the mesh 'pp' axis.
+    transformer case). `forward` (inference) runs the GPipe schedule;
+    `loss` (training) defaults to the fused 1F1B schedule — it computes
+    each stage only on its scheduled ticks and keeps live activations
+    O(n_stages), where GPipe's scan evaluates every stage every tick and
+    stashes O(n_microbatches) residuals. Pass schedule='gpipe' to get
+    the simpler reverse-differentiated scan, or 'interleaved' (+
+    n_virtual) for virtual-stage 1F1B.
     """
 
     def __init__(self, blocks, mesh: Mesh, n_microbatches: int = 4,
-                 block_fn=None, axis='pp', schedule='gpipe', n_virtual=1):
+                 block_fn=None, axis='pp', schedule='1f1b', n_virtual=1):
         if schedule not in ('gpipe', '1f1b', 'interleaved'):
             raise ValueError(
                 f"schedule must be 'gpipe'|'1f1b'|'interleaved', "

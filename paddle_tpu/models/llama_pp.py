@@ -3,7 +3,9 @@ applied to PaddleNLP Llama: PipelineLayer partitions the decoder stack).
 
 Composition story (SURVEY §2.7 hybrid): embedding + head are
 tp/replicated as usual; the decoder stack runs under the GPipe
-`shard_map` schedule over the 'pp' mesh axis, with tp sharding *inside*
+`shard_map` schedule over the 'pp' mesh axis for inference, and the
+fused 1F1B schedule (default; 'gpipe'/'interleaved' selectable) for the
+training loss, with tp sharding *inside*
 each stage handled by GSPMD — dp×tp×pp in one jitted train step.
 
 Stage parameters live in a `nn.LayerList` whose leaves carry a leading
@@ -33,7 +35,7 @@ class LlamaForCausalLMPipelined(Layer):
     """
 
     def __init__(self, config: LlamaConfig, mesh, n_microbatches=2,
-                 schedule='gpipe', n_virtual=1):
+                 schedule='1f1b', n_virtual=1):
         super().__init__()
         if schedule not in ('gpipe', '1f1b', 'interleaved'):
             raise ValueError(

@@ -3,3 +3,11 @@
 Each kernel ships a lax reference implementation and is verified
 against it in tests (interpret mode on CPU).
 """
+
+
+def interpret_mode():
+    """Shared dispatch predicate: pallas kernels run natively only on
+    TPU backends; everywhere else (CPU tests) use interpret mode."""
+    import jax
+
+    return jax.default_backend() not in ('tpu',)

@@ -1,0 +1,119 @@
+"""Decode attention (pallas, TPU): single-token query vs a long KV cache.
+
+ref (capability): the reference inference stack's fused decode/masked
+multi-head attention (paddle/phi fused attention kernels used by the
+generation loop). The XLA fallback path materialises q·Kᵀ, the mask,
+softmax, and the V contraction as separate HBM round trips; this kernel
+streams K and V exactly once per step — the whole op is
+memory-bandwidth-bound, so one fused pass is the ceiling.
+
+Layout: q (B, 1, Hq, D) against the cache's NATIVE (B, S, Hkv, D)
+layout — no per-step transpose of the (large) cache. GQA: all
+``group = Hq // Hkv`` query heads of one kv head are processed together
+so K/V blocks are read once per kv head. Inference-only (no VJP).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_S = 1024
+NEG_INF = -1e30
+
+
+def _interpret():
+    from . import interpret_mode
+
+    return interpret_mode()
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
+                   *, scale, ns, bs, S):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)                 # (G, D)
+    k = k_ref[0, :, 0].astype(jnp.float32)              # (bs, D)
+    v = v_ref[0, :, 0].astype(jnp.float32)
+    valid = kv_ref[0] > 0                               # (bs,)
+    if S % bs != 0:
+        # padded tail block reads unspecified memory: bound-mask from the
+        # static S (the padded kvalid rows are themselves unspecified)
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs,), 0)
+        inb = kpos < S
+        valid = valid & inb
+        v = jnp.where(inb[:, None], v, 0.0)
+
+    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (G, bs)
+    s = jnp.where(valid[None, :], s, NEG_INF)
+
+    m_prev = m_scr[:, 0]                                # (G,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.where(valid[None, :], jnp.exp(s - m_new[:, None]), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+    acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == ns - 1)
+    def _():
+        safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0, 0] = (acc[:] / safe[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
+                     block_s=DEFAULT_BLOCK_S):
+    """One fused decode-attention step.
+
+    q: (B, 1, Hq, D); k_cache/v_cache: (B, S, Hkv, D) in cache-native
+    layout; valid_len: scalar or (B,) — number of cache positions the
+    query may attend to (cache_index + 1). Returns (B, 1, Hq, D).
+    """
+    B, Sq, Hq, D = q.shape
+    if Sq != 1:
+        raise ValueError(f'decode_attention is single-token (Sq=1), got {Sq}')
+    _, S, Hkv, _ = k_cache.shape
+    group = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    bs = min(block_s, S)
+    ns = pl.cdiv(S, bs)
+
+    # per-position validity: padded tail blocks fold into the same mask
+    valid = jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1, 1))
+    kvalid = (jnp.arange(S)[None, :] < valid).astype(jnp.int32)
+    kvalid = jnp.broadcast_to(kvalid, (B, S))
+
+    # q as (B, 1, Hkv*group, D): kv head h owns q-head rows [h*group, ...)
+    kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
+                               S=S)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Hkv, ns),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D), lambda b, h, j: (b, 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bs, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bs), lambda b, h, j: (b, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D), lambda b, h, j: (b, 0, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1, Hq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, D), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k_cache, v_cache, kvalid)
+    return out

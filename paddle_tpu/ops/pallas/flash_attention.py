@@ -28,7 +28,9 @@ NEG_INF = -1e30
 
 
 def _interpret():
-    return jax.default_backend() not in ('tpu',)
+    from . import interpret_mode
+
+    return interpret_mode()
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret():
-    return jax.default_backend() not in ('tpu',)
+    from . import interpret_mode
+
+    return interpret_mode()
 
 
 def quantize_weight(w, axis=0):

@@ -26,7 +26,9 @@ def _block_rows(n_feat: int, n_rows: int) -> int:
 
 
 def _interpret():
-    return jax.default_backend() not in ('tpu',)
+    from . import interpret_mode
+
+    return interpret_mode()
 
 
 def _fwd_kernel(x_ref, w_ref, o_ref, r_ref, *, epsilon):

@@ -23,7 +23,9 @@ NEG_INF = -1e30
 
 
 def _interpret():
-    return jax.default_backend() not in ('tpu',)
+    from . import interpret_mode
+
+    return interpret_mode()
 
 
 def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,

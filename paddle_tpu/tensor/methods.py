@@ -363,11 +363,52 @@ def unbound_methods():
     return dict(_unbound)
 
 
+def _patch_trace_diagnostics():
+    """Migration-aware trace errors (ref jit/sot bytecode capture is
+    replaced by jax tracing — see docs/migration.md): when a ported
+    script branches on a tensor value inside ``to_static``/``jit``, the
+    stock TracerBoolConversionError doesn't say what the paddle-level
+    fix is. Append the playbook to the exception message."""
+    tracer = jax.core.Tracer
+    orig_bool = tracer.__bool__
+    if getattr(orig_bool, '_pt_patched', False):
+        return
+
+    def __bool__(self):
+        try:
+            return orig_bool(self)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            hint = (
+                '\n\n[paddle_tpu] A Python `if`/`while` branched on a '
+                'traced tensor inside jit/to_static. The reference '
+                'captures this with SOT bytecode translation; the '
+                'TPU-native fixes are:\n'
+                '  - value-based branch  -> paddle_tpu.static.nn.cond'
+                '(pred, true_fn, false_fn)\n'
+                '  - value-based loop    -> paddle_tpu.static.nn.'
+                'while_loop / lax.scan\n'
+                '  - elementwise select  -> paddle_tpu.where(cond, a, b)\n'
+                '  - shape/config branch -> hoist it out of the jitted '
+                'function (it is static)\n'
+                'See docs/migration.md ("control flow").')
+            e.args = (str(e.args[0]) + hint,) + e.args[1:] if e.args else (
+                hint,)
+            raise
+
+    __bool__._pt_patched = True
+    try:
+        tracer.__bool__ = __bool__
+    except (AttributeError, TypeError):
+        pass
+
+
 def monkey_patch_tensor():
     """Bind the paddle Tensor method surface onto jax array classes.
 
     Idempotent; called once from ``paddle_tpu/__init__``.
     """
+    _patch_trace_diagnostics()
     pt = _pt()
     special = _special_table()
     targets = _patch_targets()

@@ -64,6 +64,9 @@ def _rois_batch_index(boxes_num, num_rois):
 # RoI pooling family
 # ---------------------------------------------------------------------------
 
+_ADAPTIVE_MAX_SAMPLES = 8   # static cap for sampling_ratio=-1 grids
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """ref: paddle.vision.ops.roi_align (vision/ops.py:1705).
@@ -71,14 +74,18 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     x: (N, C, H, W); boxes: (num_rois, 4) [x1, y1, x2, y2]; boxes_num:
     (N,) rois per image. Returns (num_rois, C, ph, pw).
 
-    sampling_ratio=-1 (adaptive in the reference) uses a fixed 2×2
-    sample grid per bin — the data-dependent adaptive count would force
-    dynamic shapes under jit; 2 is the reference's effective value for
-    the common roi≈2×output regime.
+    sampling_ratio=-1 reproduces the reference's ADAPTIVE sampling —
+    per-ROI grid of ceil(bin_h)×ceil(bin_w) taps — with static shapes:
+    every ROI samples on a fixed max-size grid and the mean masks down
+    to its own ceil() count (exact match while the count stays ≤ the
+    cap, ``_ADAPTIVE_MAX_SAMPLES``; larger ROIs saturate at the cap,
+    a bounded approximation only for ROIs wider than cap·output_size
+    feature cells).
     """
     ph, pw = ((output_size, output_size) if isinstance(output_size, int)
               else tuple(output_size))
-    s = sampling_ratio if sampling_ratio > 0 else 2
+    adaptive = sampling_ratio <= 0
+    s = _ADAPTIVE_MAX_SAMPLES if adaptive else sampling_ratio
     num_rois = boxes.shape[0]
     bidx = _rois_batch_index(boxes_num, num_rois)
 
@@ -91,24 +98,40 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     bin_h = (y2 - y1) / ph
     bin_w = (x2 - x1) / pw
 
-    # sample grid: (num_rois, ph, s) y-coords × (num_rois, pw, s) x-coords
-    iy = (jnp.arange(s) + 0.5) / s                      # in-bin fractions
+    if adaptive:
+        # ref vision/ops.py:1705: roi_bin_grid = ceil(roi_size / bin)
+        ry = jnp.clip(jnp.ceil(bin_h), 1, s).astype(jnp.int32)  # (R,)
+        rx = jnp.clip(jnp.ceil(bin_w), 1, s).astype(jnp.int32)
+    else:
+        ry = jnp.full((num_rois,), s, jnp.int32)
+        rx = jnp.full((num_rois,), s, jnp.int32)
+
+    j = jnp.arange(s)
+    # in-bin fractions (j + .5)/ratio, masked beyond each ROI's own count
+    fy = (j[None, :] + 0.5) / ry[:, None]               # (R, s)
+    fx = (j[None, :] + 0.5) / rx[:, None]
+    wy = (j[None, :] < ry[:, None]).astype(jnp.float32) / ry[:, None]
+    wx = (j[None, :] < rx[:, None]).astype(jnp.float32) / rx[:, None]
+
     ys = (y1[:, None, None]
-          + (jnp.arange(ph)[None, :, None] + iy[None, None, :])
+          + (jnp.arange(ph)[None, :, None] + fy[:, None, :])
           * bin_h[:, None, None])                       # (R, ph, s)
     xs = (x1[:, None, None]
-          + (jnp.arange(pw)[None, :, None] + iy[None, None, :])
+          + (jnp.arange(pw)[None, :, None] + fx[:, None, :])
           * bin_w[:, None, None])                       # (R, pw, s)
 
-    def per_roi(feat, ys_r, xs_r):
+    def per_roi(feat, ys_r, xs_r, wy_r, wx_r):
         yy = ys_r[:, :, None, None]                     # (ph, s, 1, 1)
         xx = xs_r[None, None, :, :]                     # (1, 1, pw, s)
         yy, xx = jnp.broadcast_arrays(yy, xx)           # (ph, s, pw, s)
         vals = _bilinear_gather(feat, yy, xx)           # (ph, s, pw, s, C)
-        return jnp.mean(vals, axis=(1, 3)).transpose(2, 0, 1)  # (C, ph, pw)
+        w = wy_r[:, None, None] * wx_r[None, None, :]   # (s, 1, s) -> bcast
+        out = jnp.sum(vals * w[None, :, :, :, None], axis=(1, 3))
+        return out.transpose(2, 0, 1)                   # (C, ph, pw)
 
     feats = x[bidx]                                     # (R, C, H, W)
-    return jax.vmap(per_roi)(feats, ys, xs)
+    out = jax.vmap(per_roi)(feats, ys, xs, wy, wx)
+    return out.astype(x.dtype)                          # fp32 weights upcast
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):

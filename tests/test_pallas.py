@@ -344,3 +344,85 @@ class TestSegmentMasking:
                                             segment_ids=seg[:, :8],
                                             kv_segment_ids=seg)
         assert out2.shape == (1, 8, 2, 8)
+
+
+class TestDecodeAttention:
+    """Fused single-token decode attention vs the masked sdpa reference
+    (interpret mode on CPU)."""
+
+    @staticmethod
+    def _ref(q, ck, cv, valid_len):
+        S = ck.shape[1]
+        mask = (jnp.arange(S)[None, :]
+                < jnp.reshape(jnp.asarray(valid_len), (-1, 1)))
+        mask = mask[:, None, None, :]            # (B, 1, 1, S)
+        return _sdpa_reference(q, ck, cv, attn_mask=mask)
+
+    @pytest.mark.parametrize('hq,hkv', [(4, 4), (8, 2)])
+    def test_matches_masked_reference(self, hq, hkv):
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(0)
+        B, S, D = 2, 160, 16                     # S % block handled below
+        q = jnp.asarray(rng.normal(size=(B, 1, hq, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, hkv, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, hkv, D)), jnp.float32)
+        for valid in (1, 7, 100, S):
+            got = decode_attention(q, ck, cv, valid, block_s=64)
+            want = self._ref(q, ck, cv, valid)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f'valid={valid}')
+
+    def test_per_batch_valid_lengths(self):
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(1)
+        B, S, H, D = 3, 96, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        valid = jnp.asarray([5, 60, 96], jnp.int32)
+        got = decode_attention(q, ck, cv, valid, block_s=32)
+        want = self._ref(q, ck, cv, valid)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_odd_cache_len_tail_block(self):
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(2)
+        B, S, H, D = 1, 130, 2, 8                # 130 % 64 != 0: tail block
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        got = decode_attention(q, ck, cv, 130, block_s=64)
+        want = self._ref(q, ck, cv, 130)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_generate_uses_decode_kernel_when_enabled(self, monkeypatch):
+        """Dispatch check: the llama cached path must route Sq==1 steps
+        through the decode kernel when pallas is on."""
+        import paddle_tpu.ops as ops
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.ops.pallas import decode_attention as kmod
+
+        calls = []
+        orig = kmod.decode_attention
+
+        def spy(q, ck, cv, vl, **kw):
+            calls.append(q.shape)
+            return orig(q, ck, cv, vl, **kw)
+
+        monkeypatch.setattr(ops, '_on_tpu', lambda: True)
+        monkeypatch.setattr(kmod, 'decode_attention', spy)
+        import paddle_tpu as pt
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny(vocab_size=64, hidden_size=32,
+                                            layers=1, heads=2, kv_heads=2,
+                                            max_pos=32))
+        ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out = model.generate(ids, max_new_tokens=3)
+        assert out.shape == (1, 6)
+        assert calls, 'decode kernel was never dispatched'

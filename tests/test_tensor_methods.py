@@ -229,3 +229,17 @@ def test_to_other_tensor_adopts_dtype():
     x = jnp.ones((2,), dtype=jnp.float32)
     y = jnp.ones((3,), dtype=jnp.float16)
     assert x.to(y).dtype == jnp.float16
+
+
+def test_trace_branch_diagnostic():
+    """Data-dependent Python branching under jit gets migration guidance
+    appended to the TracerBoolConversionError (VERDICT r3 missing #7)."""
+    with pytest.raises(Exception, match='static.nn.cond'):
+        jax.jit(lambda t: 1 if t > 0 else 0)(jnp.ones(()))
+    # and while-loops too
+    def loop(t):
+        while t > 0:
+            t = t - 1
+        return t
+    with pytest.raises(Exception, match='while_loop'):
+        jax.jit(loop)(jnp.ones(()))

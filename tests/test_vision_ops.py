@@ -436,3 +436,105 @@ class TestMatrixNMSReference:
         out, num = V.matrix_nms(boxes, scores, score_threshold=0.1,
                                 keep_top_k=-1, background_label=-1)
         assert int(num[0]) == 3 and out.shape[0] == 3
+
+
+class TestRoIAlignAdaptive:
+    """sampling_ratio=-1 must reproduce the reference's per-ROI
+    ceil(bin)-tap adaptive grid (VERDICT r3 weak #5)."""
+
+    @staticmethod
+    def _numpy_roi_align_adaptive(x, boxes, bidx, out_hw, scale, aligned):
+        import math
+        N, C, H, W = x.shape
+        ph, pw = out_hw
+        R = boxes.shape[0]
+        out = np.zeros((R, C, ph, pw), np.float32)
+
+        def bil(feat, y, xq):
+            if y < -1.0 or y > H or xq < -1.0 or xq > W:
+                return np.zeros(C, np.float32)
+            y = min(max(y, 0.0), H - 1)
+            xq = min(max(xq, 0.0), W - 1)
+            y0, x0 = int(y), int(xq)
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            ly, lx = y - y0, xq - x0
+            return ((1 - ly) * (1 - lx) * feat[:, y0, x0]
+                    + (1 - ly) * lx * feat[:, y0, x1]
+                    + ly * (1 - lx) * feat[:, y1, x0]
+                    + ly * lx * feat[:, y1, x1])
+
+        off = 0.5 if aligned else 0.0
+        for r in range(R):
+            feat = x[bidx[r]]
+            x1b, y1b, x2b, y2b = boxes[r] * scale - off
+            if not aligned:
+                x2b = max(x2b, x1b + 1.0)
+                y2b = max(y2b, y1b + 1.0)
+            bh, bw = (y2b - y1b) / ph, (x2b - x1b) / pw
+            ry = max(1, math.ceil(bh))
+            rx = max(1, math.ceil(bw))
+            for i in range(ph):
+                for jj in range(pw):
+                    acc = np.zeros(C, np.float32)
+                    for sy in range(ry):
+                        for sx in range(rx):
+                            yq = y1b + (i + (sy + 0.5) / ry) * bh
+                            xq = x1b + (jj + (sx + 0.5) / rx) * bw
+                            acc += bil(feat, yq, xq)
+                    out[r, :, i, jj] = acc / (ry * rx)
+        return out
+
+    def test_adaptive_matches_reference_semantics(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.vision.ops import roi_align
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 24, 24)).astype(np.float32)
+        # varied roi sizes -> varied ceil() grid counts (2..6 per axis)
+        boxes = np.array([
+            [1.0, 1.0, 9.0, 13.0],
+            [2.5, 3.5, 20.0, 11.0],
+            [0.0, 0.0, 23.0, 23.0],
+            [5.0, 5.0, 7.5, 7.5],
+        ], np.float32)
+        bidx = np.array([0, 0, 1, 1])
+        boxes_num = np.array([2, 2], np.int32)
+        for aligned in (True, False):
+            got = np.asarray(roi_align(
+                jnp.asarray(x), jnp.asarray(boxes), jnp.asarray(boxes_num),
+                output_size=4, spatial_scale=1.0, sampling_ratio=-1,
+                aligned=aligned))
+            want = self._numpy_roi_align_adaptive(
+                x, boxes, bidx, (4, 4), 1.0, aligned)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                       err_msg=f'aligned={aligned}')
+
+    def test_fixed_ratio_unchanged(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.vision.ops import roi_align
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(1, 2, 16, 16)), jnp.float32)
+        boxes = jnp.asarray([[2.0, 2.0, 12.0, 12.0]], jnp.float32)
+        bn = jnp.asarray([1], jnp.int32)
+        out2 = roi_align(x, boxes, bn, 4, sampling_ratio=2)
+        assert out2.shape == (1, 2, 4, 4)
+        # grad flows
+        import jax as _jax
+        g = _jax.grad(lambda v: roi_align(v, boxes, bn, 4,
+                                          sampling_ratio=-1).sum())(x)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_roi_align_preserves_dtype():
+    import jax.numpy as jnp
+
+    from paddle_tpu.vision.ops import roi_align
+
+    x = jnp.ones((1, 2, 8, 8), jnp.bfloat16)
+    boxes = jnp.asarray([[1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    bn = jnp.asarray([1], jnp.int32)
+    assert roi_align(x, boxes, bn, 2, sampling_ratio=2).dtype == jnp.bfloat16
+    assert roi_align(x, boxes, bn, 2, sampling_ratio=-1).dtype == jnp.bfloat16
