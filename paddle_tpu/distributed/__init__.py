@@ -114,6 +114,8 @@ from . import fleet  # noqa: F401
 from . import moe  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import ring_attention  # noqa: F401
+from . import ulysses  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import checkpoint  # noqa: F401

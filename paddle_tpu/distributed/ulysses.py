@@ -1,0 +1,67 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+ref (capability): the reference's sequence-parallel utilities
+(distributed/fleet/layers/mpu/mp_layers.py + PaddleNLP's sep-parallel /
+DeepSpeed-Ulysses recipe): long sequences are sharded over a mesh axis;
+for attention, an all-to-all swaps the shard dimension from sequence to
+heads, every rank runs FULL-sequence attention for its head slice, and
+a second all-to-all swaps back.
+
+TPU-native: `lax.all_to_all` over the 'sp' axis lowers to the ICI
+all-to-all collective; the local full-sequence attention goes through
+`F.scaled_dot_product_attention`, i.e. the pallas flash kernel on TPU.
+Complements ring attention (ring_attention.py): Ulysses moves 2×
+activations twice but keeps ONE dense attention per rank (best when
+heads >= mesh axis and the sequence fits after gathering); the ring
+keeps sequence sharded throughout (best at extreme lengths).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def ulysses_attention(q, k, v, axis='sp', causal=False, scale=None):
+    """Run inside shard_map: local q/k/v are (B, S/n, H, D), sequence
+    sharded over `axis`; H (and kv heads) must be divisible by n.
+    Returns (B, S/n, H, D) sequence-sharded output.
+    """
+    n = lax.axis_size(axis)
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f'ulysses needs heads divisible by the axis size: '
+            f'q heads {q.shape[2]}, kv heads {k.shape[2]}, axis {n}')
+
+    def seq_to_heads(x):
+        # (B, S/n, H, D) -> (B, S, H/n, D)
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    from ..nn import functional as F
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = F.scaled_dot_product_attention(qg, kg, vg, is_causal=causal,
+                                         scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis='sp', causal=False,
+                              scale=None):
+    """Convenience wrapper: q/k/v are global arrays; shards seq over
+    `axis`, runs the all-to-all attention, returns the global output."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis=axis, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -49,7 +49,8 @@ class LlamaConfig:
     dtype: str = 'float32'                 # param dtype; compute follows
     remat: bool = False                    # jax.checkpoint each decoder layer
     remat_policy: str = 'dots'             # 'full' | 'dots' (save matmul outs)
-    sequence_parallel: bool = False        # ring attention over the 'sp' axis
+    sequence_parallel: bool = False        # shard seq over the 'sp' axis
+    sp_mode: str = 'ring'                  # 'ring' | 'ulysses' attention
 
     @property
     def head_dim(self) -> int:
@@ -109,6 +110,7 @@ class LlamaAttention(Layer):
         self.head_dim = config.head_dim
         self.rope_theta = config.rope_theta
         self.sequence_parallel = config.sequence_parallel
+        self.sp_mode = getattr(config, 'sp_mode', 'ring')
         init = I.Normal(0.0, config.initializer_range)
         h, d = config.hidden_size, self.head_dim
         self.q_proj = Parameter(init((h, self.num_heads * d), config.dtype), spec=P(None, 'tp'))
@@ -134,17 +136,31 @@ class LlamaAttention(Layer):
         if cache is None:
             out = None
             if self.sequence_parallel and attn_mask is None:
-                # long-context path: seq sharded over 'sp', KV blocks ring
-                # around the ICI via ppermute — no device holds full KV
                 from ..distributed.mesh import get_mesh
-                from ..distributed.ring_attention import ring_attention_sharded
 
                 mesh = get_mesh()
                 if (mesh is not None and 'sp' in mesh.axis_names
                         and mesh.shape['sp'] > 1
                         and S % mesh.shape['sp'] == 0):
-                    out = ring_attention_sharded(q, k, v, mesh, axis='sp',
-                                                 causal=True)
+                    n_sp = mesh.shape['sp']
+                    if (self.sp_mode == 'ulysses'
+                            and self.num_heads % n_sp == 0
+                            and self.num_kv_heads % n_sp == 0):
+                        # all-to-all swaps the shard dim seq->heads; each
+                        # rank runs full-seq flash for its head slice
+                        from ..distributed.ulysses import (
+                            ulysses_attention_sharded)
+
+                        out = ulysses_attention_sharded(
+                            q, k, v, mesh, axis='sp', causal=True)
+                    else:
+                        # KV blocks ring around the ICI via ppermute —
+                        # no device ever holds the full KV
+                        from ..distributed.ring_attention import (
+                            ring_attention_sharded)
+
+                        out = ring_attention_sharded(q, k, v, mesh,
+                                                     axis='sp', causal=True)
             if out is None:
                 out = F.scaled_dot_product_attention(
                     q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)

@@ -85,6 +85,9 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
     if Sq != 1:
         raise ValueError(f'decode_attention is single-token (Sq=1), got {Sq}')
     _, S, Hkv, _ = k_cache.shape
+    if Hq % Hkv:
+        raise ValueError(
+            f'query heads ({Hq}) must be a multiple of kv heads ({Hkv})')
     group = Hq // Hkv
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     bs = min(block_s, S)

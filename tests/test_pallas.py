@@ -426,3 +426,11 @@ class TestDecodeAttention:
         out = model.generate(ids, max_new_tokens=3)
         assert out.shape == (1, 6)
         assert calls, 'decode kernel was never dispatched'
+
+    def test_rejects_non_divisible_heads(self):
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        q = jnp.ones((1, 1, 6, 8))
+        c = jnp.ones((1, 16, 4, 8))
+        with pytest.raises(ValueError, match='multiple of kv heads'):
+            decode_attention(q, c, c, 16)
