@@ -71,3 +71,4 @@ from .vision import (  # noqa: F401
     vgg16,
     vgg19,
 )
+from . import convert  # noqa: F401

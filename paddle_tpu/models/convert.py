@@ -1,0 +1,118 @@
+"""Checkpoint converters for the model zoo.
+
+ref (capability): the reference ecosystem ships weight converters
+between frameworks (PaddleNLP's `convert_*` utilities for HF
+checkpoints). Here: HuggingFace Llama -> `LlamaForCausalLM`, which
+doubles as an end-to-end numerics validation of the flagship (RoPE
+rotate-half convention, GQA head layout, SwiGLU wiring) against the
+canonical implementation — see tests/test_hf_convert.py.
+"""
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.base import Parameter
+from .llama import LlamaConfig, LlamaForCausalLM
+
+
+def hf_llama_config(hf_config) -> LlamaConfig:
+    """Map a transformers LlamaConfig (object or dict) onto ours."""
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    scaling = get('rope_scaling')
+    if scaling and (not isinstance(scaling, dict)
+                    or scaling.get('rope_type', scaling.get('type',
+                                                            'default'))
+                    not in (None, 'default')):
+        raise ValueError(
+            f'rope_scaling={scaling!r} is not supported by this converter '
+            f'(plain rope_theta RoPE only) — converting would produce '
+            f'silently wrong logits at long positions')
+    act = get('hidden_act', 'silu')
+    if act not in ('silu', 'swish'):
+        raise ValueError(
+            f'hidden_act={act!r} unsupported: the model hardcodes SwiGLU')
+    return LlamaConfig(
+        vocab_size=get('vocab_size'),
+        hidden_size=get('hidden_size'),
+        intermediate_size=get('intermediate_size'),
+        num_hidden_layers=get('num_hidden_layers'),
+        num_attention_heads=get('num_attention_heads'),
+        num_key_value_heads=(get('num_key_value_heads')
+                             or get('num_attention_heads')),
+        max_position_embeddings=get('max_position_embeddings', 4096),
+        rms_norm_eps=get('rms_norm_eps', 1e-5),
+        rope_theta=get('rope_theta', 10000.0),
+        tie_word_embeddings=bool(get('tie_word_embeddings', False)),
+    )
+
+
+def from_hf_llama(state_dict, config, dtype=None):
+    """Build a LlamaForCausalLM from a HuggingFace Llama state dict.
+
+    state_dict: name -> array (torch tensors, numpy, or jax arrays;
+    the usual ``model.layers.N...`` HF names). config: our LlamaConfig
+    (use `hf_llama_config` to derive one). HF Linear weights are
+    (out, in) applied as x·Wᵀ; ours are (in, out) applied as x·W, so
+    every projection transposes.
+    """
+    def _np(v):
+        if hasattr(v, 'detach'):                      # torch tensor
+            v = v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    def arr(v):
+        a = jnp.asarray(_np(v))
+        return a.astype(dtype) if dtype else a
+
+    sd = {k: state_dict[k] for k in state_dict}
+    model = LlamaForCausalLM(config)
+
+    def assign(layer, name, value):
+        # keep the layer's registered PartitionSpec (tp/vocab sharding)
+        # — a bare Parameter would overwrite the meta and the converted
+        # model would silently lose tensor parallelism
+        meta = layer.meta_for(name)
+        layer.__setattr__(name, Parameter(
+            arr(value), spec=meta.spec if meta is not None else None))
+
+    m = model.model
+    assign(m, 'embed_tokens', sd.pop('model.embed_tokens.weight'))
+    for i, layer in enumerate(m.layers):
+        p = f'model.layers.{i}.'
+        attn = layer.self_attn
+        for w in ('q_proj', 'k_proj', 'v_proj', 'o_proj'):
+            assign(attn, w, np.asarray(_np(sd.pop(
+                p + f'self_attn.{w}.weight'))).T)
+        mlp = layer.mlp
+        for w in ('gate_proj', 'up_proj', 'down_proj'):
+            assign(mlp, w, np.asarray(_np(sd.pop(p + f'mlp.{w}.weight'))).T)
+        assign(layer.input_layernorm, 'weight',
+               sd.pop(p + 'input_layernorm.weight'))
+        assign(layer.post_attention_layernorm, 'weight',
+               sd.pop(p + 'post_attention_layernorm.weight'))
+    assign(m.norm, 'weight', sd.pop('model.norm.weight'))
+    if config.tie_word_embeddings:
+        sd.pop('lm_head.weight', None)
+    else:
+        assign(model, 'lm_head', np.asarray(_np(sd.pop('lm_head.weight'))).T)
+
+    leftovers = [k for k in sd
+                 if not re.search(r'rotary_emb|inv_freq|position_ids', k)]
+    if leftovers:
+        raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
+    return model
+
+
+def from_hf_llama_pretrained(model_or_path, dtype=None):
+    """Convenience: accept a transformers LlamaForCausalLM instance (or a
+    local path loadable by transformers) and convert it."""
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM as HFLlama
+
+        model_or_path = HFLlama.from_pretrained(model_or_path)
+    cfg = hf_llama_config(model_or_path.config)
+    return from_hf_llama(model_or_path.state_dict(), cfg, dtype=dtype)

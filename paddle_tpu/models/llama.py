@@ -110,7 +110,11 @@ class LlamaAttention(Layer):
         self.head_dim = config.head_dim
         self.rope_theta = config.rope_theta
         self.sequence_parallel = config.sequence_parallel
-        self.sp_mode = getattr(config, 'sp_mode', 'ring')
+        if config.sp_mode not in ('ring', 'ulysses'):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got "
+                f'{config.sp_mode!r}')
+        self.sp_mode = config.sp_mode
         init = I.Normal(0.0, config.initializer_range)
         h, d = config.hidden_size, self.head_dim
         self.q_proj = Parameter(init((h, self.num_heads * d), config.dtype), spec=P(None, 'tp'))
@@ -143,9 +147,18 @@ class LlamaAttention(Layer):
                         and mesh.shape['sp'] > 1
                         and S % mesh.shape['sp'] == 0):
                     n_sp = mesh.shape['sp']
-                    if (self.sp_mode == 'ulysses'
-                            and self.num_heads % n_sp == 0
-                            and self.num_kv_heads % n_sp == 0):
+                    use_ulysses = self.sp_mode == 'ulysses'
+                    if use_ulysses and (self.num_heads % n_sp
+                                        or self.num_kv_heads % n_sp):
+                        import warnings
+
+                        warnings.warn(
+                            f'sp_mode=ulysses needs heads divisible by the '
+                            f'sp axis ({self.num_heads}/{self.num_kv_heads} '
+                            f'heads vs sp={n_sp}); falling back to ring '
+                            f'attention', stacklevel=2)
+                        use_ulysses = False
+                    if use_ulysses:
                         # all-to-all swaps the shard dim seq->heads; each
                         # rank runs full-seq flash for its head slice
                         from ..distributed.ulysses import (
