@@ -11,6 +11,7 @@ reference's target efficiency on the same silicon.
 """
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -152,7 +153,7 @@ def main():
     def bench_decode(dec_batch, cache_len, dec_steps):
         caches = model.init_cache(dec_batch, cache_len)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def decode_step(tok, caches, i):
             logits, caches = model(tok, caches=caches, cache_index=i)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]

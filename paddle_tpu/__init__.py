@@ -41,6 +41,17 @@ from . import optimizer  # noqa: F401
 from . import framework  # noqa: F401
 from . import device  # noqa: F401
 from .device import CPUPlace, TPUPlace, get_device, set_device  # noqa: F401
+from .device import (  # noqa: F401
+    get_cudnn_version,
+    is_compiled_with_cinn,
+    is_compiled_with_cuda,
+    is_compiled_with_custom_device,
+    is_compiled_with_distribute,
+    is_compiled_with_ipu,
+    is_compiled_with_rocm,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+)
 from . import jit  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401

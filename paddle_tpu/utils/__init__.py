@@ -69,3 +69,5 @@ def require_version(min_version, max_version=None):
     if max_version is not None and parse(max_version) < cur:
         raise RuntimeError(f'requires version <= {max_version}, have {ver}')
     return True
+from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401

@@ -1,0 +1,37 @@
+"""Custom-op extension surface (ref: python/paddle/utils/cpp_extension).
+
+The reference builds CUDA/C++ custom operators against the Phi kernel
+ABI. On TPU that ABI does not exist: XLA owns code generation, so
+custom compute belongs in a pallas kernel (device) or a `jax.ffi` /
+ctypes-wrapped native library (host). These entry points keep ported
+build scripts importable and fail with the migration path instead of a
+missing-symbol error at runtime.
+"""
+from __future__ import annotations
+
+__all__ = ['CppExtension', 'CUDAExtension', 'load', 'setup']
+
+_GUIDE = (
+    'custom C++/CUDA operators target the reference\'s Phi kernel ABI, '
+    'which has no TPU equivalent. Port the compute to: (1) a pallas TPU '
+    'kernel (paddle_tpu/ops/pallas has five worked examples), (2) plain '
+    'jax.numpy (XLA fuses it), or (3) for host-side native code, a '
+    'ctypes/cffi-wrapped shared library like paddle_tpu/_native. '
+    'See docs/migration.md.'
+)
+
+
+def CppExtension(*args, **kwargs):
+    raise NotImplementedError(f'CppExtension: {_GUIDE}')
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(f'CUDAExtension: {_GUIDE}')
+
+
+def load(name=None, sources=None, **kwargs):
+    raise NotImplementedError(f'cpp_extension.load: {_GUIDE}')
+
+
+def setup(**kwargs):
+    raise NotImplementedError(f'cpp_extension.setup: {_GUIDE}')

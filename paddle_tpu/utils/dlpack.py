@@ -1,0 +1,27 @@
+"""DLPack interop (ref: python/paddle/utils/dlpack.py).
+
+Zero-copy(ish) tensor exchange with torch/numpy/any DLPack producer —
+jax arrays natively speak the protocol; these wrappers give the
+reference's to_dlpack/from_dlpack names.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ['to_dlpack', 'from_dlpack']
+
+
+def to_dlpack(x):
+    """ref: paddle.utils.dlpack.to_dlpack — export a DLPack capsule.
+
+    Also fine: pass the jax array straight to any consumer that accepts
+    objects implementing ``__dlpack__`` (torch.from_dlpack(x) works).
+    """
+    return x.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """ref: paddle.utils.dlpack.from_dlpack — import from a capsule or
+    any object implementing the DLPack protocol (torch tensor, numpy
+    array, cupy, ...)."""
+    return jnp.from_dlpack(dlpack)

@@ -357,3 +357,32 @@ def test_image_load_cv2_grayscale(tmp_path):
     img.save(p)
     arr = V.image_load(str(p), backend='cv2')
     assert arr.shape == (6, 7, 3)
+
+
+def test_dlpack_interop_with_torch():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    torch = pytest.importorskip('torch')
+    import paddle_tpu as pt
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    t = torch.from_dlpack(to_dlpack(x))
+    np.testing.assert_allclose(t.numpy(), np.asarray(x))
+    back = from_dlpack(torch.arange(4, dtype=torch.float32))
+    np.testing.assert_allclose(np.asarray(back), [0, 1, 2, 3])
+
+
+def test_compiled_with_predicates_and_cpp_extension():
+    import paddle_tpu as pt
+
+    assert pt.is_compiled_with_cuda() is False
+    assert pt.is_compiled_with_rocm() is False
+    assert isinstance(pt.is_compiled_with_tpu(), bool)
+    assert pt.get_cudnn_version() is None
+    import pytest
+
+    with pytest.raises(NotImplementedError, match='pallas'):
+        pt.utils.cpp_extension.load(name='x', sources=['x.cc'])
