@@ -2,26 +2,56 @@
 
 Zero-copy(ish) tensor exchange with torch/numpy/any DLPack producer —
 jax arrays natively speak the protocol; these wrappers give the
-reference's to_dlpack/from_dlpack names.
+reference's to_dlpack/from_dlpack names and make the round trip
+``from_dlpack(to_dlpack(x))`` work like the reference's.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 __all__ = ['to_dlpack', 'from_dlpack']
 
 
 def to_dlpack(x):
-    """ref: paddle.utils.dlpack.to_dlpack — export a DLPack capsule.
+    """ref: paddle.utils.dlpack.to_dlpack — export for DLPack consumers.
 
-    Also fine: pass the jax array straight to any consumer that accepts
-    objects implementing ``__dlpack__`` (torch.from_dlpack(x) works).
+    Returns an object implementing ``__dlpack__``/``__dlpack_device__``
+    (jax arrays speak the protocol natively), which both
+    ``torch.from_dlpack`` and this module's ``from_dlpack`` accept.
+    TPU-backed arrays are copied to host first: DLPack export only
+    covers CPU/GPU buffers, so the exchange costs one device->host
+    transfer there.
     """
-    return x.__dlpack__()
+    try:
+        platform = list(x.devices())[0].platform
+    except Exception:
+        platform = 'cpu'
+    if platform not in ('cpu', 'cuda', 'gpu', 'rocm'):
+        x = jax.device_put(x, jax.devices('cpu')[0])
+    return x
+
+
+class _CapsuleWrapper:
+    """Adapt a raw DLPack PyCapsule (legacy producers) to the
+    object-protocol jax's importer requires. A bare capsule carries no
+    device info; host memory is assumed (kDLCPU), matching what
+    legacy-style producers hand over."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, device 0)
 
 
 def from_dlpack(dlpack):
-    """ref: paddle.utils.dlpack.from_dlpack — import from a capsule or
-    any object implementing the DLPack protocol (torch tensor, numpy
-    array, cupy, ...)."""
-    return jnp.from_dlpack(dlpack)
+    """ref: paddle.utils.dlpack.from_dlpack — import from any DLPack
+    protocol object (torch tensor, numpy array, jax array, ...) or a
+    raw legacy capsule."""
+    if hasattr(dlpack, '__dlpack__'):
+        return jnp.from_dlpack(dlpack)
+    return jnp.from_dlpack(_CapsuleWrapper(dlpack))

@@ -373,6 +373,12 @@ def test_dlpack_interop_with_torch():
     np.testing.assert_allclose(t.numpy(), np.asarray(x))
     back = from_dlpack(torch.arange(4, dtype=torch.float32))
     np.testing.assert_allclose(np.asarray(back), [0, 1, 2, 3])
+    # the reference's canonical round trip
+    rt = from_dlpack(to_dlpack(x))
+    np.testing.assert_allclose(np.asarray(rt), np.asarray(x))
+    # legacy capsule producers
+    cap = torch.ones(3).__dlpack__()
+    np.testing.assert_allclose(np.asarray(from_dlpack(cap)), 1.0)
 
 
 def test_compiled_with_predicates_and_cpp_extension():
