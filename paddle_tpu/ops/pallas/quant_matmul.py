@@ -48,7 +48,35 @@ def quantize_weight_fp8(w, axis=0, dtype=jnp.float8_e4m3fn):
     return q, scale.reshape(-1)
 
 
-def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K):
+def quantize_weight_int4(w, axis=0):
+    """fp weight (K, N) → (packed int4 weight (⌈K/2⌉, N) int8, scale).
+
+    Two 4-bit codes per byte along K (row 2r in the low nibble, 2r+1 in
+    the high nibble) — HALF the HBM traffic of the int8 path; the kernel
+    sign-extends both nibbles in VMEM right before the MXU. Odd K pads
+    one zero row.
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / 7.0, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -8, 7)
+    q = q.astype(jnp.int8)
+    if q.shape[0] % 2:
+        q = jnp.concatenate([q, jnp.zeros((1, q.shape[1]), jnp.int8)], 0)
+    lo = q[0::2].astype(jnp.uint8) & 0xF
+    hi = (q[1::2].astype(jnp.uint8) & 0xF) << 4
+    return (lo | hi).astype(jnp.int8), scale.reshape(-1)
+
+
+def _unpack_int4(w8):
+    """(bk/2, bn) packed int8 → (bk, bn) fp32 sign-extended codes."""
+    lo = jnp.right_shift(jnp.left_shift(w8, 4), 4)       # arithmetic: sext
+    hi = jnp.right_shift(w8, 4)
+    half, bn = w8.shape
+    return jnp.stack([lo, hi], axis=1).reshape(2 * half, bn).astype(
+        jnp.float32)
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K, int4=False):
     k = pl.program_id(2)
 
     @pl.when(k == 0)
@@ -56,7 +84,10 @@ def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K):
         acc[:] = jnp.zeros_like(acc)
 
     x = x_ref[:].astype(jnp.float32)                     # (bm, bk)
-    w = w_ref[:].astype(jnp.float32)                     # (bk, bn) dequant in VMEM
+    if int4:
+        w = _unpack_int4(w_ref[:])                       # (bk, bn) from bk/2
+    else:
+        w = w_ref[:].astype(jnp.float32)                 # (bk, bn) dequant in VMEM
     if K % bk:
         # tail K block: the padded x columns / w rows read unspecified
         # memory — zero them out of the accumulation
@@ -96,11 +127,44 @@ def quant_matmul(x, wq, scale, block_m=256, block_n=256, block_k=512,
     )(x, wq, scale.reshape(1, N))
 
 
-def weight_only_linear(x, wq, scale, bias=None):
+def quant_matmul_int4(x, wq_packed, scale, block_m=256, block_n=256,
+                      block_k=512, out_dtype=None):
+    """x: (M, K) fp; wq_packed: (⌈K/2⌉, N) int8 (two int4 codes per
+    byte along K); scale: (N,) fp32 → (M, N)."""
+    M, K = x.shape
+    half, N = wq_packed.shape
+    if half * 2 not in (K, K + 1):
+        raise ValueError(
+            f'packed int4 weight rows {half} do not match K={K}')
+    out_dtype = out_dtype or x.dtype
+    if K % 2:
+        x = jnp.concatenate([x, jnp.zeros((M, 1), x.dtype)], axis=1)
+        K = K + 1
+    bm, bn = min(block_m, M), min(block_n, N)
+    bk = min(block_k, K)
+    bk = bk + (bk % 2)                                   # even K blocks
+    nk = pl.cdiv(K, bk)
+    return pl.pallas_call(
+        functools.partial(_kernel, nk=nk, bk=bk, K=K, int4=True),
+        grid=(pl.cdiv(M, bm), pl.cdiv(N, bn), nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk // 2, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=_interpret(),
+    )(x, wq_packed, scale.reshape(1, N))
+
+
+def weight_only_linear(x, wq, scale, bias=None, weight_dtype='int8'):
     """ref: paddle.nn.quant.weight_only_linear. x: (..., K)."""
     K = x.shape[-1]
     lead = x.shape[:-1]
-    out = quant_matmul(x.reshape(-1, K), wq, scale)
+    mm = quant_matmul_int4 if weight_dtype == 'int4' else quant_matmul
+    out = mm(x.reshape(-1, K), wq, scale)
     out = out.reshape(*lead, -1)
     if bias is not None:
         out = out + bias

@@ -166,9 +166,18 @@ def test_nn_quant():
                                         weight_scale=scale))
     np.testing.assert_allclose(out8, out, atol=1e-5)
     w4, s4 = Q.weight_quantize(jnp.asarray(w), algo='weight_only_int4')
-    assert int(np.asarray(w4).max()) <= 7 and int(np.asarray(w4).min()) >= -8
-    back4 = np.asarray(Q.weight_dequantize(w4, s4, algo='weight_only_int4'))
+    # packed: two 4-bit codes per byte along K
+    assert w4.shape == ((w.shape[0] + 1) // 2, w.shape[1])
+    back4 = np.asarray(Q.weight_dequantize(w4, s4, algo='weight_only_int4',
+                                           out_features=w.shape[0]))
+    assert back4.shape == w.shape
     np.testing.assert_allclose(back4, w, atol=np.abs(w).max() / 6)
+    out4 = np.asarray(Q.weight_only_linear(jnp.asarray(x), w4,
+                                           weight_scale=s4,
+                                           weight_dtype='int4'))
+    # exact vs the dequantized weights (the quantization error itself is
+    # bounded separately in test_pallas.py::TestInt4Matmul)
+    np.testing.assert_allclose(out4, x @ back4, rtol=1e-4, atol=1e-3)
     assert Q.Stub()(jnp.ones(3)).shape == (3,)
 
 
