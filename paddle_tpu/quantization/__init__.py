@@ -17,12 +17,22 @@ from ..ops.pallas.quant_matmul import (  # noqa: F401
 
 
 class QuantizedLinear(Layer):
-    """Weight-only int8 Linear (ref: paddle.nn.quant.weight_only_linear)."""
+    """Weight-only int8/int4 Linear (ref: paddle.nn.quant
+    .weight_only_linear). ``bits=4`` packs two codes per byte — half the
+    weight HBM traffic of int8."""
 
-    def __init__(self, linear=None, weight_quantize_type='abs_max'):
+    def __init__(self, linear=None, weight_quantize_type='abs_max', bits=8):
         super().__init__()
+        if bits not in (4, 8):
+            raise ValueError(f'bits must be 4 or 8, got {bits}')
+        self.bits = bits
         if linear is not None:
-            wq, scale = quantize_weight(linear.weight)
+            if bits == 4:
+                from ..ops.pallas.quant_matmul import quantize_weight_int4
+
+                wq, scale = quantize_weight_int4(linear.weight)
+            else:
+                wq, scale = quantize_weight(linear.weight)
             self.weight_q = Parameter(wq, trainable=False)
             self.scale = Parameter(scale, trainable=False)
             self.bias = linear.bias
@@ -30,7 +40,9 @@ class QuantizedLinear(Layer):
             self.out_features = linear.out_features
 
     def forward(self, x):
-        return weight_only_linear(x, self.weight_q, self.scale, self.bias)
+        return weight_only_linear(
+            x, self.weight_q, self.scale, self.bias,
+            weight_dtype='int4' if self.bits == 4 else 'int8')
 
 
 def quantize_model(model, quantizable=('Linear',), inplace=False):

@@ -156,3 +156,17 @@ class TestQuantization:
         qnet = ptq.convert(observed)
         q_acc = acc(qnet)
         assert fp_acc - q_acc < 0.01, (fp_acc, q_acc)
+
+    def test_quantized_linear_int4(self):
+        pt.seed(1)
+        lin = nn.Linear(32, 16)
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(4, 32)),
+                        jnp.float32)
+        from paddle_tpu.quantization import QuantizedLinear
+        q4 = QuantizedLinear(lin, bits=4)
+        assert q4.weight_q.shape == (16, 16)    # packed K/2 rows
+        out = q4(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(lin(x)),
+                                   rtol=0.3, atol=0.5)
+        with pytest.raises(ValueError, match='bits'):
+            QuantizedLinear(lin, bits=2)
