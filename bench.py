@@ -52,7 +52,35 @@ def _accelerator_reachable(timeout_s=90):
         return False
 
 
+def _arm_watchdog(seconds=1500):
+    """The probe only proves the tunnel was up at t=0; if it dies
+    MID-BENCH the process would hang forever and the driver would record
+    no JSON line at all. A daemon timer THREAD (not SIGALRM — a Python
+    signal handler can't run while the main thread is stuck inside a
+    blocking jax C++ call) emits a marked failure line instead. Returns
+    a cancel() callable for the success path."""
+    import os
+    import sys
+    import threading
+
+    def fire():
+        print(json.dumps({
+            'metric': 'llama_decoder_train_tokens_per_sec_per_chip',
+            'value': 0.0, 'unit': 'tokens/s', 'vs_baseline': 0.0,
+            'detail': {'error': f'watchdog: bench exceeded {seconds}s '
+                                '(tunnel died mid-run?)'},
+        }), flush=True)
+        sys.stdout.flush()
+        os._exit(1)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t.cancel
+
+
 def main():
+    cancel_watchdog = _arm_watchdog()
     if not _accelerator_reachable():
         # tunnel down: fall back to the CPU smoke config so the driver
         # still records a line (vs_baseline 0 marks it as non-TPU)
@@ -197,7 +225,8 @@ def main():
             'backend': jax.default_backend(),
             'device': getattr(jax.devices()[0], 'device_kind', '?'),
         },
-    }))
+    }), flush=True)
+    cancel_watchdog()   # success line is out; don't let the timer clobber it
 
 
 if __name__ == '__main__':

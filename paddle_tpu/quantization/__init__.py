@@ -27,12 +27,11 @@ class QuantizedLinear(Layer):
             raise ValueError(f'bits must be 4 or 8, got {bits}')
         self.bits = bits
         if linear is not None:
-            if bits == 4:
-                from ..ops.pallas.quant_matmul import quantize_weight_int4
+            from ..nn.quant import weight_quantize
 
-                wq, scale = quantize_weight_int4(linear.weight)
-            else:
-                wq, scale = quantize_weight(linear.weight)
+            wq, scale = weight_quantize(
+                linear.weight,
+                algo='weight_only_int4' if bits == 4 else 'weight_only_int8')
             self.weight_q = Parameter(wq, trainable=False)
             self.scale = Parameter(scale, trainable=False)
             self.bias = linear.bias
@@ -45,23 +44,18 @@ class QuantizedLinear(Layer):
             weight_dtype='int4' if self.bits == 4 else 'int8')
 
 
-def quantize_model(model, quantizable=('Linear',), inplace=False):
-    """PTQ pass: swap matching sublayers for QuantizedLinear.
+def quantize_model(model, quantizable=('Linear',), inplace=False, bits=8):
+    """PTQ pass: swap matching sublayers for QuantizedLinear (``bits``:
+    8 or 4 — int4 packs two codes per byte).
 
     Returns the (new) model; original untouched unless inplace.
     """
     from ..nn.layer.common import Linear
 
-    if not inplace:
-        import jax
-
-        leaves, treedef = jax.tree.flatten(model)
-        model = jax.tree.unflatten(treedef, leaves)   # structural copy
-    for _, layer in model.named_sublayers(include_self=True):
-        for name, child in list(layer._children()):
-            if isinstance(child, Linear) and 'Linear' in quantizable:
-                object.__setattr__(layer, name, QuantizedLinear(child))
-    return model
+    if 'Linear' not in quantizable:
+        return model
+    return _replace_layers(model, lambda c: isinstance(c, Linear),
+                           lambda c: QuantizedLinear(c, bits=bits), inplace)
 
 
 def _replace_layers(model, match, build, inplace=False):
@@ -114,8 +108,9 @@ class PTQ:
     static-quant consumers.
     """
 
-    def __init__(self, config=None):
+    def __init__(self, config=None, weight_bits=8):
         self.config = config or QuantConfig()
+        self.weight_bits = weight_bits
 
     def quantize(self, model, inplace=False):
         from ..nn.layer.common import Linear
@@ -129,7 +124,7 @@ class PTQ:
 
     def convert(self, model, inplace=False):
         def build(child):
-            q = QuantizedLinear(child.inner)
+            q = QuantizedLinear(child.inner, bits=self.weight_bits)
             object.__setattr__(q, 'act_scale', child._obs.scales())
             return q
 

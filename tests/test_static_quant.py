@@ -170,3 +170,22 @@ class TestQuantization:
                                    rtol=0.3, atol=0.5)
         with pytest.raises(ValueError, match='bits'):
             QuantizedLinear(lin, bits=2)
+
+    def test_ptq_int4_flow(self):
+        pt.seed(2)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+        x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 32)),
+                        jnp.float32)
+        ref = net(x)
+        ptq = PTQ(weight_bits=4)
+        observed = ptq.quantize(net)
+        observed(x)
+        qnet = ptq.convert(observed)
+        from paddle_tpu.quantization import QuantizedLinear
+        assert isinstance(qnet.sublayers()[0], QuantizedLinear)
+        assert qnet.sublayers()[0].bits == 4
+        assert qnet.sublayers()[0].weight_q.shape == (16, 64)  # packed
+        np.testing.assert_allclose(np.asarray(qnet(x)), np.asarray(ref),
+                                   rtol=0.5, atol=1.0)
+        q4model = quantize_model(net, bits=4)
+        assert q4model.sublayers()[0].bits == 4
