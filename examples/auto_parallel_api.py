@@ -10,7 +10,12 @@ emits — so the same five-line mental model drives real chips.
 
 Runs on the virtual CPU mesh (8 devices) for local experimentation.
 """
+
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 os.environ.setdefault('XLA_FLAGS', '--xla_force_host_platform_device_count=8')
 

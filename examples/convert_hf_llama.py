@@ -8,12 +8,13 @@ real checkpoint.
 
 Run: python examples/convert_hf_llama.py
 """
+
 import os
 import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+import numpy as np
 
 
 def main():

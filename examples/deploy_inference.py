@@ -8,7 +8,12 @@ Executor.run workflow (python/paddle/static) — the program artifact here
 is a serialized StableHLO export (+ weights), which any XLA runtime can
 load; `paddle_tpu.onnx.export` produces the same pair.
 """
+
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tempfile
 
 import jax

@@ -6,6 +6,12 @@ The whole decode (prefill + N single-token steps) compiles to one XLA
 program (`lax.scan` over steps, static shapes, preallocated cache) —
 the TPU-native version of the reference's fused generation loop.
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,6 +21,9 @@ from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
 
 
 def main():
+    # tiny demo model: run anywhere (drop this line to use the real TPU)
+    jax.config.update('jax_platforms', 'cpu')
+
     pt.seed(0)
     model = LlamaForCausalLM(llama_tiny(vocab_size=256)).eval()
     prompt = jnp.asarray(

@@ -11,6 +11,12 @@ parallelize → one jitted train step with donated state → checkpoint.
 """
 from __future__ import annotations
 
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

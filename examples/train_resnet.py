@@ -5,6 +5,12 @@
 ref workflow parity: paddle.vision tutorial (Model.prepare/fit) with
 the DataLoader's native shared-memory worker path.
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import paddle_tpu as pt

@@ -351,7 +351,13 @@ def _is_descriptor(cls, name):
 
 
 def _patch_targets():
-    concrete = type(jnp.zeros((), dtype=jnp.float32))
+    # resolve the concrete array class WITHOUT creating an array:
+    # instantiating one would initialise the jax backend at import time
+    # (and hang `import paddle_tpu` outright when the TPU tunnel is down)
+    try:
+        from jax._src.array import ArrayImpl as concrete
+    except ImportError:  # jax moved it: pay the backend init
+        concrete = type(jnp.zeros((), dtype=jnp.float32))
     return (concrete, jax.core.Tracer)
 
 
