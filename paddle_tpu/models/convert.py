@@ -18,6 +18,16 @@ from ..nn.layer.base import Parameter
 from .llama import LlamaConfig, LlamaForCausalLM
 
 
+def _np(v):
+    """torch tensor / numpy / jax -> numpy (torch bf16 upcast via float)."""
+    if hasattr(v, 'detach'):                      # torch tensor
+        v = v.detach().cpu()
+        if str(getattr(v, 'dtype', '')) == 'torch.bfloat16':
+            v = v.float()
+        v = v.numpy()
+    return np.asarray(v)
+
+
 def hf_llama_config(hf_config) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto ours."""
     get = (hf_config.get if isinstance(hf_config, dict)
@@ -59,11 +69,6 @@ def from_hf_llama(state_dict, config, dtype=None):
     (out, in) applied as x·Wᵀ; ours are (in, out) applied as x·W, so
     every projection transposes.
     """
-    def _np(v):
-        if hasattr(v, 'detach'):                      # torch tensor
-            v = v.detach().cpu().numpy()
-        return np.asarray(v)
-
     def arr(v):
         a = jnp.asarray(_np(v))
         return a.astype(dtype) if dtype else a
@@ -116,3 +121,107 @@ def from_hf_llama_pretrained(model_or_path, dtype=None):
         model_or_path = HFLlama.from_pretrained(model_or_path)
     cfg = hf_llama_config(model_or_path.config)
     return from_hf_llama(model_or_path.state_dict(), cfg, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# BERT (encoder-stack anchor, mirrors the Llama converter)
+# ---------------------------------------------------------------------------
+
+def hf_bert_config(hf_config):
+    """Map a transformers BertConfig (object or dict) onto ours."""
+    from .bert import BertConfig
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    act = get('hidden_act', 'gelu')
+    if act not in ('gelu',):
+        raise ValueError(f'hidden_act={act!r} unsupported: the encoder '
+                         f'hardcodes exact gelu')
+    return BertConfig(
+        vocab_size=get('vocab_size'),
+        hidden_size=get('hidden_size'),
+        num_hidden_layers=get('num_hidden_layers'),
+        num_attention_heads=get('num_attention_heads'),
+        intermediate_size=get('intermediate_size'),
+        max_position_embeddings=get('max_position_embeddings', 512),
+        type_vocab_size=get('type_vocab_size', 2),
+        layer_norm_eps=get('layer_norm_eps', 1e-12),
+        dropout=0.0,                       # inference conversion
+    )
+
+
+def from_hf_bert(state_dict, config, dtype=None):
+    """Build a BertModel from a HuggingFace bert-base-style state dict.
+
+    HF Linear weights are (out, in); ours are (in, out) — transposed on
+    the way in. Returns the bare encoder (ref transformers BertModel);
+    wrap in BertForSequenceClassification/MaskedLM yourself (pretraining
+    and fine-tuning heads — cls.*, classifier.*, qa_outputs.* — are
+    skipped; checkpoints without a pooler keep the fresh random one).
+    """
+    from .bert import BertModel
+
+    sd = {k: state_dict[k] for k in state_dict}
+    model = BertModel(config)
+
+    def assign(layer, name, value, transpose=False):
+        v = _np(value)
+        if transpose:
+            v = v.T
+        a = jnp.asarray(v)
+        if dtype:
+            a = a.astype(dtype)
+        meta = layer.meta_for(name)
+        layer.__setattr__(name, Parameter(
+            a, spec=meta.spec if meta is not None else None))
+
+    def pop(key):
+        return sd.pop(f'bert.{key}' if f'bert.{key}' in sd else key)
+
+    emb = model.embeddings
+    assign(emb, 'word_embeddings', pop('embeddings.word_embeddings.weight'))
+    assign(emb, 'position_embeddings',
+           pop('embeddings.position_embeddings.weight'))
+    assign(emb, 'token_type_embeddings',
+           pop('embeddings.token_type_embeddings.weight'))
+    assign(emb.layer_norm, 'weight', pop('embeddings.LayerNorm.weight'))
+    assign(emb.layer_norm, 'bias', pop('embeddings.LayerNorm.bias'))
+
+    for i, layer in enumerate(model.encoder):
+        p = f'encoder.layer.{i}.'
+        for ours, theirs in (('q_proj', 'attention.self.query'),
+                             ('k_proj', 'attention.self.key'),
+                             ('v_proj', 'attention.self.value'),
+                             ('out_proj', 'attention.output.dense')):
+            lin = getattr(layer.attn, ours)
+            assign(lin, 'weight', pop(p + theirs + '.weight'), transpose=True)
+            assign(lin, 'bias', pop(p + theirs + '.bias'))
+        assign(layer.ln1, 'weight', pop(p + 'attention.output.LayerNorm.weight'))
+        assign(layer.ln1, 'bias', pop(p + 'attention.output.LayerNorm.bias'))
+        assign(layer.fc1, 'weight', pop(p + 'intermediate.dense.weight'),
+               transpose=True)
+        assign(layer.fc1, 'bias', pop(p + 'intermediate.dense.bias'))
+        assign(layer.fc2, 'weight', pop(p + 'output.dense.weight'),
+               transpose=True)
+        assign(layer.fc2, 'bias', pop(p + 'output.dense.bias'))
+        assign(layer.ln2, 'weight', pop(p + 'output.LayerNorm.weight'))
+        assign(layer.ln2, 'bias', pop(p + 'output.LayerNorm.bias'))
+
+    if any('pooler.dense.weight' in k for k in sd):
+        assign(model.pooler, 'weight', pop('pooler.dense.weight'),
+               transpose=True)
+        assign(model.pooler, 'bias', pop('pooler.dense.bias'))
+    else:
+        # MaskedLM-style checkpoints ship no pooler (add_pooling_layer
+        # False); the fresh random pooler stays
+        import warnings
+
+        warnings.warn('state dict has no pooler weights; pooled output '
+                      'uses a randomly initialised pooler', stacklevel=2)
+
+    leftovers = [k for k in sd if not re.search(
+        r'position_ids|cls\.|seq_relationship|classifier\.|qa_outputs\.',
+        k)]
+    if leftovers:
+        raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
+    return model

@@ -97,3 +97,82 @@ def test_rope_scaling_rejected():
         hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
                          'intermediate_size': 64, 'num_hidden_layers': 1,
                          'num_attention_heads': 2, 'hidden_act': 'gelu'})
+
+
+def test_bert_hidden_states_match_transformers():
+    """Encoder-stack anchor: converted HF BERT must reproduce
+    transformers' sequence output and pooled output."""
+    from paddle_tpu.models.convert import from_hf_bert, hf_bert_config
+
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation='eager')
+    torch.manual_seed(2)
+    hf = transformers.BertModel(cfg).eval()
+    model = from_hf_bert(hf.state_dict(), hf_bert_config(cfg)).eval()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 19))
+    tt = rng.integers(0, 2, (2, 19))
+    am = np.ones((2, 19), np.int64)
+    am[1, 12:] = 0
+    with torch.no_grad():
+        out = hf(torch.tensor(ids), attention_mask=torch.tensor(am),
+                 token_type_ids=torch.tensor(tt))
+    seq, pooled = model(jnp.asarray(ids, jnp.int32),
+                        token_type_ids=jnp.asarray(tt, jnp.int32),
+                        attention_mask=jnp.asarray(am, jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(seq)[0], out.last_hidden_state.numpy()[0],
+        rtol=2e-3, atol=2e-3)
+    # masked batch row: only compare the attended positions
+    np.testing.assert_allclose(
+        np.asarray(seq)[1, :12], out.last_hidden_state.numpy()[1, :12],
+        rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pooled), out.pooler_output.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_bert_rejects_unknown_weights_and_act():
+    from paddle_tpu.models.convert import from_hf_bert, hf_bert_config
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32)
+    torch.manual_seed(3)
+    hf = transformers.BertModel(cfg).eval()
+    sd = dict(hf.state_dict())
+    sd['encoder.layer.0.bogus.weight'] = torch.zeros(2)
+    with pytest.raises(ValueError, match='unconverted'):
+        from_hf_bert(sd, hf_bert_config(cfg))
+    with pytest.raises(ValueError, match='hidden_act'):
+        hf_bert_config({'vocab_size': 64, 'hidden_size': 32,
+                        'num_hidden_layers': 1, 'num_attention_heads': 2,
+                        'intermediate_size': 64, 'hidden_act': 'relu'})
+
+
+def test_bert_mlm_and_classifier_checkpoints():
+    from paddle_tpu.models.convert import from_hf_bert, hf_bert_config
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, attn_implementation='eager')
+    torch.manual_seed(4)
+    mlm = transformers.BertForMaskedLM(cfg).eval()     # no pooler
+    with pytest.warns(UserWarning, match='pooler'):
+        m1 = from_hf_bert(mlm.state_dict(), hf_bert_config(cfg))
+    ids = np.random.default_rng(2).integers(0, 64, (1, 7))
+    seq, _ = m1(jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        want = mlm.bert(torch.tensor(ids)).last_hidden_state.numpy()
+    np.testing.assert_allclose(np.asarray(seq), want, rtol=2e-3, atol=2e-3)
+
+    clf = transformers.BertForSequenceClassification(cfg).eval()
+    m2 = from_hf_bert(clf.state_dict(), hf_bert_config(cfg))  # no raise
+    assert m2 is not None
