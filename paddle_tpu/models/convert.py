@@ -28,6 +28,31 @@ def _np(v):
     return np.asarray(v)
 
 
+def _make_assign(dtype=None):
+    """Spec-preserving Parameter assignment shared by all converters:
+    keeps the layer's registered PartitionSpec (tensor parallelism would
+    silently vanish otherwise) and applies the optional load dtype."""
+    def assign(layer, name, value, transpose=False):
+        v = _np(value)
+        if transpose:
+            v = v.T
+        a = jnp.asarray(v)
+        if dtype:
+            a = a.astype(dtype)
+        meta = layer.meta_for(name)
+        layer.__setattr__(name, Parameter(
+            a, spec=meta.spec if meta is not None else None))
+    return assign
+
+
+def _make_pop(sd, prefix):
+    """Pop keys tolerating an optional wrapper prefix ('bert.',
+    'transformer.', ...)."""
+    def pop(key):
+        return sd.pop(f'{prefix}{key}' if f'{prefix}{key}' in sd else key)
+    return pop
+
+
 def hf_llama_config(hf_config) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto ours."""
     get = (hf_config.get if isinstance(hf_config, dict)
@@ -69,20 +94,9 @@ def from_hf_llama(state_dict, config, dtype=None):
     (out, in) applied as x·Wᵀ; ours are (in, out) applied as x·W, so
     every projection transposes.
     """
-    def arr(v):
-        a = jnp.asarray(_np(v))
-        return a.astype(dtype) if dtype else a
-
     sd = {k: state_dict[k] for k in state_dict}
     model = LlamaForCausalLM(config)
-
-    def assign(layer, name, value):
-        # keep the layer's registered PartitionSpec (tp/vocab sharding)
-        # — a bare Parameter would overwrite the meta and the converted
-        # model would silently lose tensor parallelism
-        meta = layer.meta_for(name)
-        layer.__setattr__(name, Parameter(
-            arr(value), spec=meta.spec if meta is not None else None))
+    assign = _make_assign(dtype)
 
     m = model.model
     assign(m, 'embed_tokens', sd.pop('model.embed_tokens.weight'))
@@ -90,11 +104,11 @@ def from_hf_llama(state_dict, config, dtype=None):
         p = f'model.layers.{i}.'
         attn = layer.self_attn
         for w in ('q_proj', 'k_proj', 'v_proj', 'o_proj'):
-            assign(attn, w, np.asarray(_np(sd.pop(
-                p + f'self_attn.{w}.weight'))).T)
+            assign(attn, w, sd.pop(p + f'self_attn.{w}.weight'),
+                   transpose=True)
         mlp = layer.mlp
         for w in ('gate_proj', 'up_proj', 'down_proj'):
-            assign(mlp, w, np.asarray(_np(sd.pop(p + f'mlp.{w}.weight'))).T)
+            assign(mlp, w, sd.pop(p + f'mlp.{w}.weight'), transpose=True)
         assign(layer.input_layernorm, 'weight',
                sd.pop(p + 'input_layernorm.weight'))
         assign(layer.post_attention_layernorm, 'weight',
@@ -103,7 +117,7 @@ def from_hf_llama(state_dict, config, dtype=None):
     if config.tie_word_embeddings:
         sd.pop('lm_head.weight', None)
     else:
-        assign(model, 'lm_head', np.asarray(_np(sd.pop('lm_head.weight'))).T)
+        assign(model, 'lm_head', sd.pop('lm_head.weight'), transpose=True)
 
     leftovers = [k for k in sd
                  if not re.search(r'rotary_emb|inv_freq|position_ids', k)]
@@ -137,6 +151,12 @@ def hf_bert_config(hf_config):
     if act not in ('gelu',):
         raise ValueError(f'hidden_act={act!r} unsupported: the encoder '
                          f'hardcodes exact gelu')
+    pet = get('position_embedding_type', 'absolute')
+    if pet != 'absolute':
+        raise ValueError(
+            f'position_embedding_type={pet!r} unsupported: the encoder has '
+            f'no relative-position attention term — converting would give '
+            f'silently wrong hidden states')
     return BertConfig(
         vocab_size=get('vocab_size'),
         hidden_size=get('hidden_size'),
@@ -163,20 +183,8 @@ def from_hf_bert(state_dict, config, dtype=None):
 
     sd = {k: state_dict[k] for k in state_dict}
     model = BertModel(config)
-
-    def assign(layer, name, value, transpose=False):
-        v = _np(value)
-        if transpose:
-            v = v.T
-        a = jnp.asarray(v)
-        if dtype:
-            a = a.astype(dtype)
-        meta = layer.meta_for(name)
-        layer.__setattr__(name, Parameter(
-            a, spec=meta.spec if meta is not None else None))
-
-    def pop(key):
-        return sd.pop(f'bert.{key}' if f'bert.{key}' in sd else key)
+    assign = _make_assign(dtype)
+    pop = _make_pop(sd, 'bert.')
 
     emb = model.embeddings
     assign(emb, 'word_embeddings', pop('embeddings.word_embeddings.weight'))
@@ -222,6 +230,85 @@ def from_hf_bert(state_dict, config, dtype=None):
     leftovers = [k for k in sd if not re.search(
         r'position_ids|cls\.|seq_relationship|classifier\.|qa_outputs\.',
         k)]
+    if leftovers:
+        raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
+    return model
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (learned-pos-emb pre-LN decoder anchor)
+# ---------------------------------------------------------------------------
+
+def hf_gpt2_config(hf_config):
+    """Map a transformers GPT2Config (object or dict) onto ours."""
+    from .gpt import GPTConfig
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    act = get('activation_function', 'gelu_new')
+    if act not in ('gelu_new', 'gelu_pytorch_tanh'):
+        raise ValueError(f'activation_function={act!r} unsupported: the '
+                         f'model hardcodes gelu_new (tanh approximation)')
+    if not get('tie_word_embeddings', True):
+        raise ValueError('untied GPT-2 embeddings unsupported: the model '
+                         'computes logits as hidden @ wte.T')
+    for flag in ('scale_attn_by_inverse_layer_idx', 'reorder_and_upcast_attn'):
+        if get(flag, False):
+            raise ValueError(
+                f'{flag}=True unsupported: attention always scales by '
+                f'1/sqrt(head_dim) — converting would give silently wrong '
+                f'logits')
+    if get('scale_attn_weights', True) is False:
+        raise ValueError('scale_attn_weights=False unsupported')
+    h = get('n_embd')
+    return GPTConfig(
+        vocab_size=get('vocab_size'),
+        hidden_size=h,
+        num_hidden_layers=get('n_layer'),
+        num_attention_heads=get('n_head'),
+        intermediate_size=get('n_inner') or 4 * h,
+        max_position_embeddings=get('n_positions', 1024),
+        layer_norm_epsilon=get('layer_norm_epsilon', 1e-5),
+        dropout=0.0,                        # inference conversion
+        tie_word_embeddings=True,           # GPT-2 always ties
+    )
+
+
+def from_hf_gpt2(state_dict, config, dtype=None):
+    """Build a GPTForCausalLM from a HuggingFace GPT-2 state dict.
+
+    HF GPT-2 uses Conv1D modules whose weights are ALREADY (in, out) —
+    no transposes, unlike the Llama/BERT converters.
+    """
+    from .gpt import GPTForCausalLM
+
+    sd = {k: state_dict[k] for k in state_dict}
+    model = GPTForCausalLM(config)
+    assign = _make_assign(dtype)
+    pop = _make_pop(sd, 'transformer.')
+
+    t = model.transformer
+    assign(t, 'wte', pop('wte.weight'))
+    assign(t, 'wpe', pop('wpe.weight'))
+    for i, block in enumerate(t.h):
+        p = f'h.{i}.'
+        assign(block.ln_1, 'weight', pop(p + 'ln_1.weight'))
+        assign(block.ln_1, 'bias', pop(p + 'ln_1.bias'))
+        assign(block.attn, 'qkv', pop(p + 'attn.c_attn.weight'))
+        assign(block.attn, 'qkv_bias', pop(p + 'attn.c_attn.bias'))
+        assign(block.attn, 'out_proj', pop(p + 'attn.c_proj.weight'))
+        assign(block.attn, 'out_bias', pop(p + 'attn.c_proj.bias'))
+        assign(block.ln_2, 'weight', pop(p + 'ln_2.weight'))
+        assign(block.ln_2, 'bias', pop(p + 'ln_2.bias'))
+        assign(block, 'fc_in', pop(p + 'mlp.c_fc.weight'))
+        assign(block, 'fc_in_bias', pop(p + 'mlp.c_fc.bias'))
+        assign(block, 'fc_out', pop(p + 'mlp.c_proj.weight'))
+        assign(block, 'fc_out_bias', pop(p + 'mlp.c_proj.bias'))
+    assign(t.ln_f, 'weight', pop('ln_f.weight'))
+    assign(t.ln_f, 'bias', pop('ln_f.bias'))
+
+    leftovers = [k for k in sd if not re.search(
+        r'attn\.bias|attn\.masked_bias|lm_head\.weight', k)]
     if leftovers:
         raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
     return model

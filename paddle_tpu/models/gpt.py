@@ -83,7 +83,9 @@ class GPTBlock(Layer):
 
     def forward(self, x):
         x = x + self.attn(self.ln_1(x))
-        h = F.gelu(self.ln_2(x) @ self.fc_in + self.fc_in_bias)
+        # gelu_new (tanh approximation) — GPT-2's canonical activation
+        h = F.gelu(self.ln_2(x) @ self.fc_in + self.fc_in_bias,
+                   approximate=True)
         return x + self.dropout(h @ self.fc_out + self.fc_out_bias)
 
 

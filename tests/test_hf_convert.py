@@ -176,3 +176,43 @@ def test_bert_mlm_and_classifier_checkpoints():
     clf = transformers.BertForSequenceClassification(cfg).eval()
     m2 = from_hf_bert(clf.state_dict(), hf_bert_config(cfg))  # no raise
     assert m2 is not None
+
+
+def test_gpt2_logits_and_generation_match_transformers():
+    """Pre-LN learned-pos-emb decoder anchor."""
+    from paddle_tpu.models.convert import from_hf_gpt2, hf_gpt2_config
+
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation='eager')
+    torch.manual_seed(5)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    model = from_hf_gpt2(hf.state_dict(), hf_gpt2_config(cfg))
+
+    ids = np.random.default_rng(3).integers(0, 96, (2, 13))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    with pytest.raises(ValueError, match='activation_function'):
+        hf_gpt2_config({'vocab_size': 96, 'n_embd': 48, 'n_layer': 1,
+                        'n_head': 2, 'activation_function': 'relu'})
+
+
+def test_gpt2_and_bert_unsupported_configs_rejected():
+    from paddle_tpu.models.convert import hf_bert_config, hf_gpt2_config
+
+    base = {'vocab_size': 96, 'n_embd': 48, 'n_layer': 1, 'n_head': 2}
+    with pytest.raises(ValueError, match='untied'):
+        hf_gpt2_config({**base, 'tie_word_embeddings': False})
+    with pytest.raises(ValueError, match='inverse_layer_idx'):
+        hf_gpt2_config({**base, 'scale_attn_by_inverse_layer_idx': True})
+    with pytest.raises(ValueError, match='scale_attn_weights'):
+        hf_gpt2_config({**base, 'scale_attn_weights': False})
+    with pytest.raises(ValueError, match='position_embedding_type'):
+        hf_bert_config({'vocab_size': 64, 'hidden_size': 32,
+                        'num_hidden_layers': 1, 'num_attention_heads': 2,
+                        'intermediate_size': 64,
+                        'position_embedding_type': 'relative_key'})
