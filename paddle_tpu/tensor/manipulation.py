@@ -32,7 +32,14 @@ def swapaxes(x, axis0, axis1):
     return jnp.swapaxes(x, axis0, axis1)
 
 
-t = swapaxes
+def t(x, name=None):
+    """ref: tensor/linalg.py::t — transpose for tensors of rank <= 2
+    (rank 0/1 returned unchanged, like the reference)."""
+    if x.ndim > 2:
+        raise ValueError(
+            f'paddle.t expects a tensor of rank <= 2, got shape {x.shape} '
+            f'(use transpose/swapaxes for higher ranks)')
+    return x if x.ndim < 2 else jnp.swapaxes(x, -2, -1)
 
 
 def concat(x, axis=0):

@@ -269,7 +269,20 @@ def _resolve(name, pt, special):
     return fn
 
 
-def _make_method(fn, name):
+def _allowed_kwargs(fn):
+    try:
+        import inspect
+
+        params = inspect.signature(fn).parameters
+        if any(p.kind == inspect.Parameter.VAR_KEYWORD
+               for p in params.values()):
+            return None
+        return set(params)
+    except (TypeError, ValueError):
+        return None
+
+
+def _make_method(fn, name, orig=None, allowed=None):
     vararg_shape = name in _VARARG_SHAPE
 
     def method(self, *args, **kwargs):
@@ -281,6 +294,14 @@ def _make_method(fn, name):
             kwargs.pop('out')
         if kwargs.get('order', 'absent') in (None, 'C', 'K', 'A'):
             kwargs.pop('order', None)
+        if (orig is not None and allowed is not None
+                and any(k not in allowed for k in kwargs)):
+            # numpy-protocol kwargs the paddle op doesn't know (where=,
+            # initial=, ... — jnp.nansum etc. call the METHOD with them):
+            # route to the original jax method, numpy spelling restored
+            if 'keepdim' in kwargs:
+                kwargs['keepdims'] = kwargs.pop('keepdim')
+            return orig(self, *args, **kwargs)
         if (vararg_shape and len(args) > 1
                 and all(isinstance(a, (int, np.integer)) for a in args)):
             args = (list(args),)
@@ -434,15 +455,22 @@ def monkey_patch_tensor():
         _unbound[name] = fn
         if name in _KEEP_BUILTIN and hasattr(targets[0], name):
             continue
-        method = _make_method(fn, name)
+        allowed = _allowed_kwargs(fn)
         for cls in targets:
             if _is_descriptor(cls, name):
                 # never shadow a property/getset like .shape/.real —
                 # jax internals and paddle attribute-style access both
                 # depend on it (paddle Tensor.shape is an attribute too)
                 continue
+            # first-capture the TRUE builtin per (cls, name): repeated
+            # patching must not stack wrappers (idempotence)
+            okey = (cls.__name__, name)
+            if okey not in _ORIGINALS:
+                orig = getattr(cls, name, None)
+                _ORIGINALS[okey] = orig if callable(orig) else None
             try:
-                setattr(cls, name, method)
+                setattr(cls, name, _make_method(
+                    fn, name, orig=_ORIGINALS[okey], allowed=allowed))
             except (AttributeError, TypeError):  # immutable class
                 pass
 

@@ -243,3 +243,64 @@ def test_trace_branch_diagnostic():
         return t
     with pytest.raises(Exception, match='while_loop'):
         jax.jit(loop)(jnp.ones(()))
+
+
+def test_broad_method_smoke():
+    """Call a wide sample of bound methods with plausible args and check
+    they compute (shape/dtype sanity) — parity beyond hasattr."""
+    x = jnp.asarray(np.random.default_rng(0).random((4, 6)) + 0.5,
+                    jnp.float32)
+    sq = jnp.asarray(np.random.default_rng(1).random((4, 4)) + 0.5,
+                     jnp.float32) + 4 * jnp.eye(4)
+    unary_same_shape = [
+        'abs', 'acos', 'acosh', 'asin', 'atan', 'atanh', 'ceil', 'cos',
+        'cosh', 'digamma', 'erf', 'erfinv', 'exp', 'expm1', 'floor',
+        'frac', 'lgamma', 'log', 'log10', 'log1p', 'log2', 'logit',
+        'neg', 'reciprocal', 'round', 'rsqrt', 'sigmoid', 'sign',
+        'sin', 'sinh', 'sqrt', 'square', 'tanh', 'trunc', 'deg2rad',
+        'rad2deg', 'i0', 'sinc',
+    ]
+    for name in unary_same_shape:
+        out = getattr(x * 0.4, name)()
+        assert out.shape == x.shape, name
+    binary = ['add', 'subtract', 'multiply', 'divide', 'maximum', 'minimum',
+              'pow', 'mod', 'floor_divide', 'fmax', 'fmin', 'atan2',
+              'heaviside', 'hypot', 'logaddexp', 'nextafter', 'copysign']
+    y = x + 0.25
+    for name in binary:
+        out = getattr(x, name)(y)
+        assert out.shape == x.shape, name
+    compare = ['equal', 'not_equal', 'greater_than', 'greater_equal',
+               'less_than', 'less_equal', 'isclose']
+    for name in compare:
+        out = getattr(x, name)(y)
+        assert out.shape == x.shape and out.dtype == jnp.bool_, name
+    reductions = ['sum', 'mean', 'max', 'min', 'prod', 'std', 'var',
+                  'nansum', 'nanmean', 'logsumexp', 'median', 'nanmedian',
+                  'amax', 'amin']
+    for name in reductions:
+        out = getattr(x, name)(axis=1)
+        assert out.shape == (4,), name
+    # linalg-flavoured methods on a well-conditioned square matrix
+    assert sq.inverse().shape == (4, 4)
+    assert sq.cholesky().shape == (4, 4)
+    assert sq.matrix_power(2).shape == (4, 4)
+    assert sq.diagonal().shape == (4,)
+    assert sq.trace().shape == ()
+    assert sq.t().shape == (4, 4)
+    # manipulation
+    assert x.roll(1, axis=0).shape == x.shape
+    assert x.flip(0).shape == x.shape
+    assert x.chunk(2, axis=0)[0].shape == (2, 6)
+    assert len(x.unbind(1)) == 6
+    assert x.topk(2)[0].shape == (4, 2)
+    assert x.argsort(axis=1).shape == x.shape
+    assert x.sort(axis=1).shape == x.shape
+    assert x.cumsum(axis=1).shape == x.shape
+    assert x.cumprod(1).shape == x.shape
+    assert x.clip(0.2, 0.8).shape == x.shape
+    assert x.kthvalue(2, axis=1)[0].shape == (4,)
+    assert x.diff(axis=1).shape == (4, 5)
+    assert x.broadcast_to([2, 4, 6]).shape == (2, 4, 6)
+    assert x.expand_as(jnp.ones((2, 4, 6))).shape == (2, 4, 6)
+    assert x.repeat_interleave(2, axis=1).shape == (4, 12)
