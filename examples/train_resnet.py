@@ -1,6 +1,7 @@
 """ResNet-50 image classification through the hapi Model API.
 
-    python examples/train_resnet.py
+    python examples/train_resnet.py          # real TPU, full CIFAR-10
+    python examples/train_resnet.py --tiny   # CPU smoke (synthetic data)
 
 ref workflow parity: paddle.vision tutorial (Model.prepare/fit) with
 the DataLoader's native shared-memory worker path.
@@ -24,23 +25,43 @@ from paddle_tpu.vision.datasets import Cifar10
 
 
 def main():
-    pt.seed(0)
-    transform = T.Compose([
-        T.RandomHorizontalFlip(),
-        T.Normalize(mean=127.5, std=127.5),
-        T.ToTensor(data_format='HWC'),          # NHWC for the TPU conv path
-    ])
-    train_ds = Cifar10(mode='train', transform=transform)
-    test_ds = Cifar10(mode='test', transform=T.Compose([
-        T.Normalize(mean=127.5, std=127.5), T.ToTensor(data_format='HWC')]))
+    tiny = '--tiny' in sys.argv
+    if tiny:
+        import jax
 
-    model = pt.Model(resnet50(num_classes=10))
+        jax.config.update('jax_platforms', 'cpu')
+    pt.seed(0)
+    if tiny:
+        from paddle_tpu.io import TensorDataset
+
+        rng = np.random.default_rng(0)
+        imgs = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
+        labels = rng.integers(0, 10, (64,)).astype(np.int64)
+        train_ds = test_ds = TensorDataset([imgs, labels])
+        from paddle_tpu.models.resnet import resnet18
+        net = resnet18(num_classes=10)
+        epochs, batch_size = 1, 16
+    else:
+        transform = T.Compose([
+            T.RandomHorizontalFlip(),
+            T.Normalize(mean=127.5, std=127.5),
+            T.ToTensor(data_format='HWC'),      # NHWC for the TPU conv path
+        ])
+        train_ds = Cifar10(mode='train', transform=transform)
+        test_ds = Cifar10(mode='test', transform=T.Compose([
+            T.Normalize(mean=127.5, std=127.5),
+            T.ToTensor(data_format='HWC')]))
+        net = resnet50(num_classes=10)
+        epochs, batch_size = 2, 64
+
+    model = pt.Model(net)
     sched = CosineAnnealingDecay(0.1, T_max=10)
     model.prepare(Momentum(learning_rate=sched, momentum=0.9,
                            weight_decay=5e-4),
                   nn.CrossEntropyLoss(), Accuracy(topk=(1, 5)))
-    model.fit(train_ds, test_ds, epochs=2, batch_size=64, verbose=1)
-    print(model.evaluate(test_ds, batch_size=64, verbose=0))
+    model.fit(train_ds, test_ds, epochs=epochs, batch_size=batch_size,
+              verbose=1)
+    print(model.evaluate(test_ds, batch_size=batch_size, verbose=0))
 
 
 if __name__ == '__main__':
