@@ -238,8 +238,39 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
     return (1 - epsilon) * label + epsilon * prior_dist
 
 
-def class_center_sample(label, num_classes, num_samples):  # pragma: no cover
-    raise NotImplementedError('class_center_sample: PS-specific, out of TPU scope')
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """ref: nn/functional/common.py::class_center_sample (PartialFC,
+    arXiv:2010.05222) — keep every positive class center, fill up to
+    ``num_samples`` with uniformly sampled negatives, and remap labels
+    into the sampled index space.
+
+    Host-side (eager) op: sampling belongs in the data/step-setup path,
+    and the output length is data-dependent (all positives kept when
+    they exceed num_samples), which jit's static shapes cannot express.
+    Returns (remapped_label (N,), sampled_class_center (M,)), integer
+    dtype (int64 when jax_enable_x64 is on, int32 otherwise).
+    """
+    import numpy as np
+
+    if num_samples > num_classes:
+        raise ValueError(
+            f'num_samples ({num_samples}) cannot exceed num_classes '
+            f'({num_classes})')
+    lab = np.asarray(label).astype(np.int64).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                                assume_unique=True)
+        key = random_mod.split_key()
+        order = np.asarray(
+            jax.random.permutation(key, neg_pool.shape[0]))
+        need = num_samples - len(pos)
+        sampled = np.sort(np.concatenate([pos, neg_pool[order[:need]]]))
+    # remap: position of each label within the sampled (sorted) centers
+    remapped = np.searchsorted(sampled, lab)
+    return jnp.asarray(remapped), jnp.asarray(sampled)
 
 
 def zeropad2d(x, padding, data_format='NCHW'):

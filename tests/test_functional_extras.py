@@ -475,3 +475,32 @@ def test_flash_attention_module_path_and_signature():
                                atol=1e-4)
     with sdp_kernel(enable_flash=False):
         pass
+
+
+def test_class_center_sample():
+    """PartialFC sampling (ref nn/functional/common.py:2361): positives
+    always kept, negatives fill to num_samples, labels remapped."""
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    label = jnp.asarray([3, 10, 3, 7], jnp.int64)
+    remapped, sampled = F.class_center_sample(label, num_classes=20,
+                                              num_samples=8)
+    s = np.asarray(sampled)
+    assert len(s) == 8 and len(np.unique(s)) == 8
+    for p in (3, 7, 10):
+        assert p in s
+    # remapped labels point at their class's position in sampled
+    for orig, rm in zip(np.asarray(label), np.asarray(remapped)):
+        assert s[rm] == orig
+    # more positives than num_samples: keep all positives
+    lab2 = jnp.asarray(np.arange(12), jnp.int64)
+    rm2, s2 = F.class_center_sample(lab2, num_classes=20, num_samples=8)
+    assert len(np.asarray(s2)) == 12
+    np.testing.assert_array_equal(np.asarray(s2)[np.asarray(rm2)],
+                                  np.asarray(lab2))
+
+
+def test_class_center_sample_rejects_oversample():
+    with pytest.raises(ValueError, match='num_samples'):
+        F.class_center_sample(jnp.asarray([0]), num_classes=5, num_samples=8)
