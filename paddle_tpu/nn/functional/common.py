@@ -252,6 +252,15 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     """
     import numpy as np
 
+    if group not in (None, False):
+        # the reference's distributed PartialFC samples per model-parallel
+        # rank over a process group; here the sharded-classes story lives
+        # in margin_cross_entropy(group=<mesh axis>) — sampling locally
+        # against the global class space would silently disagree with it
+        raise NotImplementedError(
+            'class_center_sample(group=...) is not supported: sample '
+            'locally (group=None) and use margin_cross_entropy(group='
+            '<mesh axis>) for sharded class centers')
     if num_samples > num_classes:
         raise ValueError(
             f'num_samples ({num_samples}) cannot exceed num_classes '

@@ -8,9 +8,14 @@ streams K and V exactly once per step — the whole op is
 memory-bandwidth-bound, so one fused pass is the ceiling.
 
 Layout: q (B, 1, Hq, D) against the cache's NATIVE (B, S, Hkv, D)
-layout — no per-step transpose of the (large) cache. GQA: all
-``group = Hq // Hkv`` query heads of one kv head are processed together
-so K/V blocks are read once per kv head. Inference-only (no VJP).
+layout — no per-step transpose of the (large) cache. Blocks keep the
+full head dim (Mosaic requires the trailing two block dims to equal the
+array dims or tile evenly; per-head size-1 blocks are illegal), so GQA
+is handled by a head-match mask on a dense (Hq, bs·Hkv) score matrix:
+query row i may only attend columns whose kv head h == i // group.
+The mask multiplies score-matmul FLOPs by Hkv, but decode is
+HBM-bandwidth-bound — the MXU time stays far under the K/V stream time.
+Inference-only (no VJP).
 """
 from __future__ import annotations
 
@@ -22,6 +27,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_S = 1024
+# K and V blocks are (bs, Hkv, D) in VMEM; cap each at ~2 MiB so the
+# kernel fits comfortably alongside scores + scratch at any head count.
+VMEM_BLOCK_BUDGET = 2 * 1024 * 1024
 NEG_INF = -1e30
 
 
@@ -32,8 +40,8 @@ def _interpret():
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
-                   *, scale, ns, bs, S):
-    j = pl.program_id(2)
+                   *, scale, ns, bs, S, hkv, group):
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _():
@@ -41,25 +49,35 @@ def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)                 # (G, D)
-    k = k_ref[0, :, 0].astype(jnp.float32)              # (bs, D)
-    v = v_ref[0, :, 0].astype(jnp.float32)
-    valid = kv_ref[0] > 0                               # (bs,)
+    hq = group * hkv
+    cols = bs * hkv
+    D = q_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32)                 # (Hq, D)
+    # rows r = s*hkv + h: cache position r // hkv, kv head r % hkv
+    k = k_ref[0].astype(jnp.float32).reshape(cols, D)
+    v = v_ref[0].astype(jnp.float32).reshape(cols, D)
+    pvalid = kv_ref[0, 0] > 0                           # (bs,) per position
     if S % bs != 0:
         # padded tail block reads unspecified memory: bound-mask from the
-        # static S (the padded kvalid rows are themselves unspecified)
-        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs,), 0)
-        inb = kpos < S
-        valid = valid & inb
-        v = jnp.where(inb[:, None], v, 0.0)
+        # static S (the padded kvalid entries are themselves unspecified)
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs,), 0)
+        pvalid = pvalid & (pos < S)
+    # (bs,) per-position validity → (cols,) per-(position, head), same
+    # broadcast+reshape flattening as K/V so column orders line up
+    valid = jnp.broadcast_to(pvalid[:, None], (bs, hkv)).reshape(cols)
+    if S % bs != 0:
+        v = jnp.where(valid[:, None], v, 0.0)
+    rowh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 0) // group
+    colh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 1) % hkv
+    keep = (rowh == colh) & valid[None, :]
 
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (G, bs)
-    s = jnp.where(valid[None, :], s, NEG_INF)
+                            preferred_element_type=jnp.float32)  # (Hq, cols)
+    s = jnp.where(keep, s, NEG_INF)
 
-    m_prev = m_scr[:, 0]                                # (G,)
+    m_prev = m_scr[:, 0]                                # (Hq,)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    p = jnp.where(valid[None, :], jnp.exp(s - m_new[:, None]), 0.0)
+    p = jnp.where(keep, jnp.exp(s - m_new[:, None]), 0.0)
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
     acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
@@ -71,6 +89,20 @@ def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
     def _():
         safe = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0, 0] = (acc[:] / safe[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(block_s, S, hkv, D, itemsize, interpret):
+    """Block length along the cache axis: VMEM-bounded, and on real TPU
+    sized so the flattened (bs·hkv) validity block tiles by 128."""
+    row_bytes = max(1, hkv * D * itemsize)      # one cache position, all heads
+    cap = max(1, VMEM_BLOCK_BUDGET // row_bytes)
+    bs = min(block_s, S, max(cap, 128))
+    if bs >= S:
+        return S
+    if interpret:
+        return bs
+    # validity block is (1, 1, bs): the lane dim must tile by 128
+    return min(max(128, bs // 128 * 128), S)
 
 
 def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
@@ -90,33 +122,33 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
             f'query heads ({Hq}) must be a multiple of kv heads ({Hkv})')
     group = Hq // Hkv
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    bs = min(block_s, S)
+    interp = _interpret()
+    bs = _pick_block(block_s, S, Hkv, D, k_cache.dtype.itemsize, interp)
     ns = pl.cdiv(S, bs)
 
-    # per-position validity: padded tail blocks fold into the same mask
+    # per-position validity; the kernel broadcasts it per kv head
     valid = jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1, 1))
     kvalid = (jnp.arange(S)[None, :] < valid).astype(jnp.int32)
-    kvalid = jnp.broadcast_to(kvalid, (B, S))
+    kvalid = jnp.broadcast_to(kvalid, (B, S))[:, None, :]   # (B, 1, S)
 
-    # q as (B, 1, Hkv*group, D): kv head h owns q-head rows [h*group, ...)
     kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
-                               S=S)
+                               S=S, hkv=Hkv, group=group)
     out = pl.pallas_call(
         kernel,
-        grid=(B, Hkv, ns),
+        grid=(B, ns),
         in_specs=[
-            pl.BlockSpec((1, 1, group, D), lambda b, h, j: (b, 0, h, 0)),
-            pl.BlockSpec((1, bs, 1, D), lambda b, h, j: (b, j, h, 0)),
-            pl.BlockSpec((1, bs, 1, D), lambda b, h, j: (b, j, h, 0)),
-            pl.BlockSpec((1, bs), lambda b, h, j: (b, j)),
+            pl.BlockSpec((1, 1, Hq, D), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, 1, bs), lambda b, j: (b, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, D), lambda b, h, j: (b, 0, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, Hq, D), lambda b, j: (b, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, 1, Hq, D), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((group, D), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((Hq, D), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
         ],
-        interpret=_interpret(),
+        interpret=interp,
     )(q, k_cache, v_cache, kvalid)
     return out

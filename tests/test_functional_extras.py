@@ -504,3 +504,11 @@ def test_class_center_sample():
 def test_class_center_sample_rejects_oversample():
     with pytest.raises(ValueError, match='num_samples'):
         F.class_center_sample(jnp.asarray([0]), num_classes=5, num_samples=8)
+
+
+def test_class_center_sample_rejects_group():
+    """group= is the reference's process-group path; local sampling under
+    it would silently disagree with margin_cross_entropy's sharding."""
+    with pytest.raises(NotImplementedError, match='margin_cross_entropy'):
+        F.class_center_sample(jnp.asarray([0]), num_classes=5,
+                              num_samples=2, group='tp')

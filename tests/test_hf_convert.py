@@ -8,6 +8,11 @@ import pytest
 torch = pytest.importorskip('torch')
 transformers = pytest.importorskip('transformers')
 
+# the cross-library forward comparisons (torch forward + our forward per
+# test) dominate the default tier, so they are heavy; the cheap
+# config/weight rejection tests stay per-commit
+e2e = pytest.mark.heavy
+
 from paddle_tpu.models.convert import (from_hf_llama, hf_llama_config)  # noqa: E402
 
 
@@ -24,6 +29,7 @@ def _tiny_hf(num_kv_heads):
 
 
 @pytest.mark.parametrize('kv_heads', [4, 2])
+@e2e
 def test_logits_match_transformers(kv_heads):
     hf = _tiny_hf(kv_heads)
     cfg = hf_llama_config(hf.config)
@@ -36,6 +42,7 @@ def test_logits_match_transformers(kv_heads):
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+@e2e
 def test_generate_matches_transformers_greedy():
     hf = _tiny_hf(2)
     cfg = hf_llama_config(hf.config)
@@ -57,6 +64,7 @@ def test_unconverted_weights_raise():
         from_hf_llama(sd, hf_llama_config(hf.config))
 
 
+@e2e
 def test_tied_embeddings():
     cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
@@ -99,6 +107,7 @@ def test_rope_scaling_rejected():
                          'num_attention_heads': 2, 'hidden_act': 'gelu'})
 
 
+@e2e
 def test_bert_hidden_states_match_transformers():
     """Encoder-stack anchor: converted HF BERT must reproduce
     transformers' sequence output and pooled output."""
@@ -155,6 +164,7 @@ def test_bert_rejects_unknown_weights_and_act():
                         'intermediate_size': 64, 'hidden_act': 'relu'})
 
 
+@e2e
 def test_bert_mlm_and_classifier_checkpoints():
     from paddle_tpu.models.convert import from_hf_bert, hf_bert_config
 
@@ -178,6 +188,7 @@ def test_bert_mlm_and_classifier_checkpoints():
     assert m2 is not None
 
 
+@e2e
 def test_gpt2_logits_and_generation_match_transformers():
     """Pre-LN learned-pos-emb decoder anchor."""
     from paddle_tpu.models.convert import from_hf_gpt2, hf_gpt2_config
