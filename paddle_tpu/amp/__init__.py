@@ -95,6 +95,7 @@ class GradScaler:
         self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
         self.dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
+        self._last_found_inf = False
 
     def scale(self, loss):
         return loss * self._scale if self._enable else loss
@@ -109,7 +110,9 @@ class GradScaler:
         leaves = jax.tree.leaves(grads)
         return sum(jnp.sum(~jnp.isfinite(g.astype(jnp.float32))) for g in leaves) > 0
 
-    def update(self, found_inf=False):
+    def update(self, found_inf=None):
+        if found_inf is None:           # dygraph: use the last step()'s check
+            found_inf = self._last_found_inf
         if not (self._enable and self.dynamic):
             return
         if found_inf:
@@ -122,10 +125,36 @@ class GradScaler:
                 self._good_steps = 0
 
     def step(self, optimizer=None):
-        return None
+        """Dygraph AMP step (ref: amp/grad_scaler.py::step): unscale the
+        grads `scaled_loss.backward()` deposited on the bound module,
+        skip the update when any grad is non-finite, else optimizer.step().
+        """
+        if optimizer is None:
+            return None
+        layer = getattr(optimizer, '_bound_layer', None)
+        if layer is None:
+            raise RuntimeError(
+                'GradScaler.step(opt) needs a dygraph-bound optimizer '
+                '(construct it with parameters=net.parameters()); for the '
+                'functional path use scaler.unscale_/found_inf/update on '
+                'the grads tree directly.')
+        if not self._enable:            # bf16: scaling is a faithful no-op
+            return optimizer.step()
+        grads = layer.__dict__.get('_param_grads')
+        if grads is None:
+            raise RuntimeError(
+                'GradScaler.step() found no gradients: call '
+                'scaler.scale(loss).backward() first')
+        grads = self.unscale_(grads)
+        self._last_found_inf = bool(self.found_inf(grads))
+        if not self._last_found_inf:
+            layer.__dict__['_param_grads'] = grads
+            optimizer.step()
 
-    def minimize(self, optimizer, scaled_loss):
-        return None
+    def minimize(self, optimizer, scaled_loss=None):
+        """ref: GradScaler.minimize — step then update the scale."""
+        self.step(optimizer)
+        self.update()
 
     def is_enable(self):
         return self._enable

@@ -24,6 +24,22 @@ import jax
 import jax.numpy as jnp
 
 
+def _add_cot(a, b):
+    if getattr(a, 'dtype', None) == jax.dtypes.float0:
+        return a        # zero cotangent of a non-differentiable output
+    return jnp.add(a, b)
+
+
+def _acc(a, b):
+    """Cotangent accumulation that also works for pytree cotangents
+    (module-call nodes carry a whole trainable-tree cotangent)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jax.tree.map(_add_cot, a, b)
+
+
 class Variable:
     """A tape-recording wrapper over a jax array (ref: dygraph Tensor)."""
 
@@ -86,14 +102,18 @@ class Variable:
             cot = cots.pop(id(v), None)
             if cot is None:
                 continue
-            v.grad = cot if v.grad is None else v.grad + cot
+            sink = getattr(v, '_sink', None)
+            if sink is not None:          # module-call param node
+                sink(cot)
+            elif not getattr(v, '_no_grad_store', False):
+                v.grad = _acc(v.grad, cot)
             if v._vjp is None:
                 continue
             parent_cots = v._vjp(cot)
             for p, pc in zip(v._parents, parent_cots):
                 if p.stop_gradient:
                     continue
-                cots[id(p)] = cots[id(p)] + pc if id(p) in cots else pc
+                cots[id(p)] = _acc(cots.get(id(p)), pc)
             if not retain_graph:
                 v._vjp, v._parents = None, ()
 
@@ -117,6 +137,12 @@ class Variable:
         import numpy as np
 
         return np.asarray(self.value)
+
+    def __array__(self, dtype=None):
+        import numpy as np
+
+        a = np.asarray(self.value)
+        return a.astype(dtype) if dtype is not None else a
 
     def item(self):
         return self.value.item()
@@ -239,3 +265,195 @@ def backward(tensors, grad_tensors=None):
         grad_tensors = [None] * len(tensors)
     for t, g in zip(tensors, grad_tensors):
         t.backward(g, retain_graph=True)
+
+
+# -- module-boundary taping (the dygraph train loop) ----------------------
+#
+# The canonical Paddle loop —
+#     loss = loss_fn(net(x), y); loss.backward(); opt.step(); opt.clear_grad()
+# — records per-op into a C++ tape. Recording the whole module call as ONE
+# tape node is the TPU-native equivalent: the forward runs as a single
+# (jit-cached) XLA program under `jax.vjp`, the node's pullback yields the
+# cotangent for the module's entire trainable tree, and `backward()`
+# deposits it on the owning Layer (`layer._param_grads`), where
+# `Optimizer.step()` finds it. Activated by binding an optimizer with
+# `parameters=net.parameters()` (the dygraph signal in Paddle) or by
+# passing `Variable` inputs.
+
+class _ParamNode:
+    """Tape node standing for a module's whole trainable tree; the
+    cotangent arriving here is a model-shaped pytree, sunk onto the
+    owning layer rather than kept as `.grad`."""
+
+    __slots__ = ('stop_gradient', 'grad', '_parents', '_vjp', 'layer')
+
+    def __init__(self, layer):
+        self.stop_gradient = False
+        self.grad = None
+        self._parents = ()
+        self._vjp = None
+        self.layer = layer
+
+    def _sink(self, cot):
+        d = self.layer.__dict__
+        d['_param_grads'] = _acc(d.get('_param_grads'), cot)
+
+
+# One stable jitted forward per (module structure, call signature): jax
+# re-traces through the cached pjit cheaply per step instead of
+# recompiling. Keyed on hashable static call structure; falls back to
+# uncached eager when a static argument is unhashable.
+_MODULE_FWD_CACHE: dict = {}
+
+
+def _pure_module_fwd(in_tree, dyn_idx, static_vals):
+    from ..framework.tree import merge, split_trainable
+
+    def fwd(t, f, dyn_vals):
+        flat = list(static_vals)
+        for i, v in zip(dyn_idx, dyn_vals):
+            flat[i] = v
+        args, kwargs = jax.tree_util.tree_unflatten(in_tree, flat)
+        m = merge(t, f)
+        out = m.forward(*args, **kwargs)
+        _, new_f = split_trainable(m)
+        return out, new_f      # new_f is vjp aux: buffers aren't differentiated
+
+    return fwd
+
+
+def call_module(layer, args, kwargs):
+    """Run `layer.forward(*args, **kwargs)` as one tape node.
+
+    Differentiates w.r.t. the layer's trainable tree and any live
+    `Variable` inputs; buffer mutations (BatchNorm stats, RNG threading)
+    are carried out of the traced copy and written back in place.
+    """
+    from ..framework.tree import split_trainable
+
+    flat, in_tree = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Variable))
+    vals = [x.value if isinstance(x, Variable) else x for x in flat]
+    live = tuple(i for i, x in enumerate(flat)
+                 if isinstance(x, Variable) and not x.stop_gradient)
+    dyn_idx = tuple(i for i, v in enumerate(vals)
+                    if isinstance(v, (jax.Array,)) or hasattr(v, '__array__'))
+    static_vals = tuple(None if i in dyn_idx else v
+                        for i, v in enumerate(vals))
+    try:
+        key = (in_tree, dyn_idx, static_vals)
+        fwd = _MODULE_FWD_CACHE.get(key)
+        if fwd is None:
+            fwd = jax.jit(_pure_module_fwd(in_tree, dyn_idx, static_vals))
+            _MODULE_FWD_CACHE[key] = fwd
+    except TypeError:   # unhashable static arg: run uncached
+        fwd = _pure_module_fwd(in_tree, dyn_idx, static_vals)
+
+    t, f = split_trainable(layer)
+    dyn_vals = [jnp.asarray(vals[i]) for i in dyn_idx]
+    live_dyn = tuple(dyn_idx.index(i) for i in live)
+
+    def diff_fwd(t_, lv):
+        dv = list(dyn_vals)
+        for j, v in zip(live_dyn, lv):
+            dv[j] = v
+        return fwd(t_, f, dv)
+
+    out, vjp_fn, new_f = jax.vjp(
+        diff_fwd, t, [dyn_vals[j] for j in live_dyn], has_aux=True)
+    _write_back(layer, new_f)
+
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+    pnode = _ParamNode(layer)
+
+    def _zero_cot(l):
+        if jnp.issubdtype(l.dtype, jnp.inexact):
+            return jnp.zeros_like(l)
+        import numpy as np
+
+        return np.zeros(l.shape, jax.dtypes.float0)
+
+    def module_pull(cot_list):
+        t_cot, lv_cot = vjp_fn(
+            jax.tree_util.tree_unflatten(out_tree, list(cot_list)))
+        return (t_cot, *lv_cot)
+
+    if len(out_leaves) == 1:
+        parents = (pnode,) + tuple(flat[i] for i in live)
+        l = out_leaves[0]
+        wrapped = [
+            Variable(l, stop_gradient=False, _parents=parents,
+                     _vjp=lambda cot: module_pull([cot.astype(l.dtype)]))
+            if jnp.issubdtype(l.dtype, jnp.inexact)
+            else Variable(l, stop_gradient=True)
+        ]
+    else:
+        # multi-output call: leaves feed a shared gather node whose
+        # cotangent is the padded list; cots from the leaves ADD before
+        # the module pullback runs, so the (expensive) vjp runs ONCE no
+        # matter how many outputs participate in the loss
+        gather = Variable.__new__(Variable)
+        gather.value, gather.grad = None, None
+        gather.stop_gradient = False
+        gather._parents = (pnode,) + tuple(flat[i] for i in live)
+        gather._vjp = module_pull
+        gather._no_grad_store = True
+
+        def make_leaf_pull(i, l):
+            def pull(cot):
+                return ([cot.astype(l.dtype) if j == i else _zero_cot(o)
+                         for j, o in enumerate(out_leaves)],)
+
+            return pull
+
+        wrapped = [
+            Variable(l, stop_gradient=False, _parents=(gather,),
+                     _vjp=make_leaf_pull(i, l))
+            if jnp.issubdtype(l.dtype, jnp.inexact)
+            else Variable(l, stop_gradient=True)
+            for i, l in enumerate(out_leaves)
+        ]
+    return jax.tree_util.tree_unflatten(out_tree, wrapped)
+
+
+def _write_back(dst, src):
+    """Copy `src`'s array leaves into the same-structure Layer `dst` in
+    place (buffer mutations out of a traced copy; optimizer updates)."""
+    from ..nn.layer.base import Layer
+
+    for name, sv in list(src._children()) if isinstance(src, Layer) else []:
+        dv = dst.__dict__.get(name)
+        if isinstance(sv, Layer):
+            _write_back(dv, sv)
+        elif sv is not None:
+            object.__setattr__(dst, name, sv)
+
+
+def module_call_would_tape(layer, args, kwargs):
+    """Decide whether Layer.__call__ should record (see call_module).
+
+    Never tapes inside jax transforms: tracer inputs or tracer params
+    mean a functional transform owns this call.
+    """
+    from . import is_grad_enabled
+
+    flat = jax.tree_util.tree_leaves(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Variable))
+    has_var = any(isinstance(x, Variable) for x in flat)
+    if not has_var and not layer.__dict__.get('_dygraph', False):
+        return False, False
+    if not is_grad_enabled():
+        return False, has_var
+    if any(isinstance(x, jax.core.Tracer) for x in flat):
+        return False, has_var
+    _, p0 = next(iter(layer.named_parameters()), (None, None))
+    if isinstance(p0, jax.core.Tracer):
+        return False, has_var
+    return True, has_var
+
+
+def unwrap(tree):
+    """Strip Variables (no_grad forwarding of taped values)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, Variable) else x, tree,
+        is_leaf=lambda x: isinstance(x, Variable))

@@ -119,7 +119,10 @@ class _ByEq:
 
 
 # Attributes handled specially by flatten (never children, never plain static).
-_INTERNAL = ('_param_meta',)
+# _param_grads (a model-shaped cotangent tree deposited by the eager tape)
+# and _dygraph (the taping flag) are host-side training-loop state, not
+# part of the model pytree.
+_INTERNAL = ('_param_meta', '_param_grads', '_dygraph')
 
 
 def _is_child(v):
@@ -176,6 +179,31 @@ def _register(cls):
         lambda aux, children: _unflatten_layer(aux, children),
         _flatten_layer,
     )
+
+
+class ParamList(list):
+    """`Layer.parameters()` result: a plain list that also remembers the
+    owning module (`.owner`), so optimizers constructed with
+    `parameters=net.parameters()` can bind dygraph step()/clear_grad()."""
+
+    owner = None
+
+
+def _args_may_tape(args, kwargs):
+    """Cheap pre-filter for the dygraph tape: any Variable visible at the
+    call surface (top level or one container deep)?"""
+    from ...autograd.eager import Variable
+
+    def scan(v):
+        if isinstance(v, Variable):
+            return True
+        if isinstance(v, (list, tuple)):
+            return any(isinstance(x, Variable) for x in v)
+        if isinstance(v, dict):
+            return any(isinstance(x, Variable) for x in v.values())
+        return False
+
+    return any(scan(a) for a in args) or any(scan(v) for v in kwargs.values())
 
 
 class Layer:
@@ -284,7 +312,12 @@ class Layer:
                 yield path, v
 
     def parameters(self):
-        return [p for _, p in self.named_parameters()]
+        # ParamList remembers the owning module: passing it to an
+        # optimizer (`Adam(parameters=net.parameters())`) is the dygraph
+        # signal that binds opt.step()/clear_grad() to this Layer
+        out = ParamList(p for _, p in self.named_parameters())
+        out.owner = self
+        return out
 
     def named_buffers(self, prefix='', persistable_only=False):
         for name, v in self._children():
@@ -382,6 +415,20 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *args, **kwargs):
+        # dygraph tape: a bound optimizer (parameters=net.parameters())
+        # or Variable inputs record the whole call as one vjp node so the
+        # canonical loss.backward()/opt.step() loop works (ref: dygraph
+        # tracer, python/paddle/base/dygraph/tensor_patch_methods.py).
+        # Never records inside jax transforms — tracers mean a functional
+        # transform owns this call.
+        if self.__dict__.get('_dygraph', False) or _args_may_tape(args, kwargs):
+            from ...autograd import eager
+
+            tape, has_var = eager.module_call_would_tape(self, args, kwargs)
+            if tape:
+                return eager.call_module(self, args, kwargs)
+            if has_var:
+                args, kwargs = eager.unwrap((args, kwargs))
         return self.forward(*args, **kwargs)
 
     def __repr__(self):

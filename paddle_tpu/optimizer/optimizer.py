@@ -42,6 +42,21 @@ class Optimizer:
         self.multi_precision = multi_precision
         self._model_ref = parameters
         self.state = None
+        # dygraph binding: `parameters=net.parameters()` (a ParamList
+        # carrying its owner) or the Layer itself flips the module into
+        # eager-tape mode so loss.backward()/opt.step() work — the
+        # reference's imperative loop (ref: optimizer.py dygraph mode)
+        self._bound_layer = None
+        if parameters is not None:
+            owner = getattr(parameters, 'owner', None)
+            if owner is None:
+                from ..nn.layer.base import Layer
+
+                if isinstance(parameters, Layer):
+                    owner = parameters
+            if owner is not None:
+                self._bound_layer = owner
+                owner.__dict__['_dygraph'] = True
 
     # -- lr ---------------------------------------------------------------
     def get_lr(self, step=0):
@@ -158,14 +173,35 @@ class Optimizer:
         return new_t, new_slots, new_master
 
     # -- paddle-style imperative conveniences ------------------------------
-    def step(self):  # pragma: no cover - dygraph-compat shim
-        raise RuntimeError(
-            'paddle_tpu optimizers are functional: use '
-            'model, state = opt.apply_gradients(model, grads, state) '
-            'inside your (jitted) train step.'
-        )
+    def step(self):
+        """Dygraph update: consume the grads `loss.backward()` deposited
+        on the bound Layer and write updated params back in place."""
+        layer = self._bound_layer
+        if layer is None:
+            raise RuntimeError(
+                'opt.step() needs a bound module: construct the optimizer '
+                'with parameters=net.parameters() (dygraph), or use '
+                'model, state = opt.apply_gradients(model, grads, state) '
+                'inside your (jitted) train step.'
+            )
+        grads = layer.__dict__.get('_param_grads')
+        if grads is None:
+            raise RuntimeError(
+                'opt.step() found no gradients: call loss.backward() first '
+                '(and construct the loss from the bound model\'s outputs)')
+        if self.state is None:
+            self.init(layer)
+        lr = None
+        if isinstance(self._lr, LRScheduler):
+            lr = self._lr.get_lr()      # host epoch state (sched.step())
+        new_model, _ = self.apply_gradients(layer, grads, self.state, lr=lr)
+        from ..autograd.eager import _write_back
+
+        _write_back(layer, new_model)
 
     def clear_grad(self):
+        if self._bound_layer is not None:
+            self._bound_layer.__dict__['_param_grads'] = None
         return None
 
     def state_dict(self):

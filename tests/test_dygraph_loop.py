@@ -1,0 +1,329 @@
+"""The canonical dygraph train loop — module-boundary taping.
+
+ref: python/paddle/base/dygraph/tensor_patch_methods.py (backward),
+python/paddle/optimizer/optimizer.py (step/clear_grad dygraph mode).
+Binding an optimizer with parameters=net.parameters() flips the Layer
+into eager-tape mode: net(x) records one vjp node for the whole call,
+loss.backward() deposits a trainable-tree cotangent on the Layer, and
+opt.step() applies the functional update in place.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.framework.tree import leaves_with_meta
+
+
+def _grad_leaves(tree):
+    return [(p, l) for p, _, l in leaves_with_meta(tree) if l is not None]
+
+
+def _mlp(seed=0):
+    pt.seed(seed)
+    return nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 3, (n,)), jnp.int32)
+    return x, y
+
+
+class TestDygraphGrads:
+    def test_grads_match_functional(self):
+        net = _mlp()
+        x, y = _batch()
+        loss_fn = nn.CrossEntropyLoss()
+        ref_loss, ref_grads = pt.autograd.value_and_grad(
+            lambda m: loss_fn(m(x), y))(net)
+
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        loss = loss_fn(net(x), y)
+        assert isinstance(loss, pt.autograd.Variable)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        loss.backward()
+        got = net.__dict__['_param_grads']
+        for (p1, g1), (p2, g2) in zip(_grad_leaves(got),
+                                      _grad_leaves(ref_grads)):
+            assert p1 == p2
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=1e-5, atol=1e-6, err_msg=p1)
+
+    def test_sgd_step_applies_update_in_place(self):
+        net = _mlp()
+        x, y = _batch()
+        loss_fn = nn.CrossEntropyLoss()
+        _, ref_grads = pt.autograd.value_and_grad(
+            lambda m: loss_fn(m(x), y))(net)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        before = [np.asarray(p) for p in net.parameters()]
+        loss_fn(net(x), y).backward()
+        opt.step()
+        opt.clear_grad()
+        after = [np.asarray(p) for p in net.parameters()]
+        for b, a, (_, g) in zip(before, after, _grad_leaves(ref_grads)):
+            np.testing.assert_allclose(a, b - 0.1 * np.asarray(g),
+                                       rtol=1e-5, atol=1e-6)
+        assert net.__dict__['_param_grads'] is None
+
+    def test_backward_twice_accumulates(self):
+        net = _mlp()
+        x, y = _batch()
+        loss_fn = nn.CrossEntropyLoss()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        loss_fn(net(x), y).backward()
+        g1 = [np.asarray(g) for _, g in
+              _grad_leaves(net.__dict__['_param_grads'])]
+        loss_fn(net(x), y).backward()
+        g2 = [np.asarray(g) for _, g in
+              _grad_leaves(net.__dict__['_param_grads'])]
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(b, 2 * a, rtol=1e-5, atol=1e-6)
+
+    def test_input_variable_receives_grad(self):
+        net = _mlp()
+        x, y = _batch()
+        xv = pt.autograd.to_variable(x, stop_gradient=False)
+        loss = nn.CrossEntropyLoss()(net(xv), y)
+        loss.backward()
+        gx = xv.grad
+        ref = jax.grad(
+            lambda xx: nn.CrossEntropyLoss()(net.forward(xx), y))(x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestDygraphLoop:
+    def test_loss_decreases_on_separable_data(self):
+        pt.seed(0)
+        rng = np.random.default_rng(0)
+        w_true = rng.normal(size=(6, 3)).astype('float32')
+        x = rng.normal(size=(64, 6)).astype('float32')
+        y = np.argmax(x @ w_true, axis=-1)
+        net = nn.Sequential(nn.Linear(6, 32), nn.Tanh(), nn.Linear(32, 3))
+        opt = pt.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        xs, ys = jnp.asarray(x), jnp.asarray(y, jnp.int32)
+        first = last = None
+        for _ in range(30):
+            loss = loss_fn(net(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else loss.item()
+            last = loss.item()
+        assert last < 0.25 * first, (first, last)
+
+    def test_lr_scheduler_drives_step_size(self):
+        net = _mlp()
+        x, y = _batch()
+        sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+        opt = pt.optimizer.SGD(learning_rate=sched,
+                               parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        p0 = np.asarray(net.parameters()[0])
+        loss_fn(net(x), y).backward()
+        g0 = np.asarray(_grad_leaves(net.__dict__['_param_grads'])[0][1])
+        opt.step()
+        p1 = np.asarray(net.parameters()[0])
+        np.testing.assert_allclose(p1, p0 - 0.1 * g0, rtol=1e-5, atol=1e-7)
+        opt.clear_grad()
+        sched.step()                      # lr: 0.1 → 0.05
+        loss_fn(net(x), y).backward()
+        g1 = np.asarray(_grad_leaves(net.__dict__['_param_grads'])[0][1])
+        opt.step()
+        p2 = np.asarray(net.parameters()[0])
+        np.testing.assert_allclose(p2, p1 - 0.05 * g1, rtol=1e-5, atol=1e-7)
+
+    def test_step_without_backward_raises(self):
+        net = _mlp()
+        opt = pt.optimizer.Adam(parameters=net.parameters())
+        with pytest.raises(RuntimeError, match='loss.backward'):
+            opt.step()
+
+    def test_step_without_binding_raises(self):
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        with pytest.raises(RuntimeError, match='parameters=net.parameters'):
+            opt.step()
+
+
+class TestDygraphInterop:
+    def test_no_grad_returns_raw_array(self):
+        net = _mlp()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        x, _ = _batch()
+        with pt.no_grad():
+            out = net(x)
+        assert isinstance(out, jax.Array)
+
+    def test_functional_transform_not_taped(self):
+        """value_and_grad / jit over a BOUND model must keep working:
+        tracer params/inputs suppress the tape."""
+        net = _mlp()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        x, y = _batch()
+        loss_fn = nn.CrossEntropyLoss()
+        loss, grads = pt.autograd.value_and_grad(
+            lambda m: loss_fn(m(x), y))(net)
+        assert np.isfinite(float(loss))
+        assert _grad_leaves(grads)
+
+        @jax.jit
+        def fwd(m, xx):
+            return m(xx)
+
+        out = fwd(net, x)
+        assert isinstance(out, jax.Array)
+
+    def test_batchnorm_stats_update_through_tape(self):
+        pt.seed(1)
+        net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        bn = net[1]
+        before = np.asarray(bn._mean).copy()
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(16, 4)),
+                        jnp.float32)
+        out = net(x)
+        assert isinstance(out, pt.autograd.Variable)
+        after = np.asarray(bn._mean)
+        assert not np.allclose(before, after), 'running mean did not update'
+
+    def test_tuple_output_backward(self):
+        class TwoHead(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(4, 2)
+                self.b = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.a(x), self.b(x)
+
+        pt.seed(3)
+        net = TwoHead()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(5, 4)),
+                        jnp.float32)
+        ya, yb = net(x)
+        (ya.sum() + 2.0 * yb.sum()).backward()
+        got = net.__dict__['_param_grads']
+
+        def ref_loss(m):
+            oa, ob = m.forward(x)
+            return oa.sum() + 2.0 * ob.sum()
+
+        ref = jax.grad(ref_loss)(net)
+        for (p1, g1), (p2, g2) in zip(_grad_leaves(got), _grad_leaves(
+                pt.framework.tree.split_trainable(ref)[0])):
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=1e-5, atol=1e-6, err_msg=p1)
+
+    def test_grad_scaler_loop(self):
+        """scaler.scale(loss).backward(); scaler.step(opt);
+        scaler.update() — the dygraph AMP pattern (ref grad_scaler.py)."""
+        net = _mlp()
+        x, y = _batch()
+        loss_fn = nn.CrossEntropyLoss()
+        _, ref_grads = pt.autograd.value_and_grad(
+            lambda m: loss_fn(m(x), y))(net)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        scaler = pt.amp.GradScaler(init_loss_scaling=128.0)
+        before = np.asarray(net.parameters()[0])
+        scaler.scale(loss_fn(net(x), y)).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        after = np.asarray(net.parameters()[0])
+        g = np.asarray(_grad_leaves(ref_grads)[0][1])
+        # update used UNSCALED grads
+        np.testing.assert_allclose(after, before - 0.1 * g,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_grad_scaler_skips_nonfinite_step(self):
+        net = _mlp()
+        x, _ = _batch()
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        scaler = pt.amp.GradScaler(init_loss_scaling=64.0)
+        before = np.asarray(net.parameters()[0])
+        bad = net(x).sum() * jnp.inf
+        scaler.scale(bad).backward()
+        scaler.step(opt)
+        scaler.update()
+        after = np.asarray(net.parameters()[0])
+        np.testing.assert_array_equal(before, after)     # step skipped
+        assert scaler.get_loss_scaling() < 64.0          # scale backed off
+
+    def test_numpy_interop_on_taped_output(self):
+        """np.asarray / np.argmax over a bound model's outputs must see
+        the data, not an object-boxed Variable."""
+        net = _mlp()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        x, _ = _batch()
+        out = net(x)
+        a = np.asarray(out)
+        assert a.dtype == np.float32 and a.shape == (8, 3)
+        assert np.argmax(out, axis=-1).shape == (8,)
+
+    def test_mixed_int_output_backward(self):
+        """Int outputs of a taped call are stop-gradient; float outputs
+        still backprop (float0 cotangents for the int leaves)."""
+        class WithIdx(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return h, jnp.argmax(h, axis=-1)
+
+        pt.seed(5)
+        net = WithIdx()
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        x = jnp.asarray(np.random.default_rng(5).normal(size=(6, 4)),
+                        jnp.float32)
+        h, idx = net(x)
+        assert idx.stop_gradient
+        (h ** 2).sum().backward()
+        got = _grad_leaves(net.__dict__['_param_grads'])
+        ref = jax.grad(lambda m: (m.forward(x)[0] ** 2).sum())(net)
+        for (p1, g1), (p2, g2) in zip(
+                got, _grad_leaves(pt.framework.tree.split_trainable(ref)[0])):
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=1e-5, atol=1e-6, err_msg=p1)
+
+    def test_disabled_scaler_steps_unconditionally(self):
+        net = _mlp()
+        x, y = _batch()
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        scaler = pt.amp.GradScaler(enable=False)
+        before = np.asarray(net.parameters()[0])
+        scaler.scale(nn.CrossEntropyLoss()(net(x), y)).backward()
+        scaler.step(opt)
+        scaler.update()
+        after = np.asarray(net.parameters()[0])
+        assert not np.allclose(before, after)
+
+    def test_state_dict_has_no_tape_state(self):
+        net = _mlp()
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        x, y = _batch()
+        nn.CrossEntropyLoss()(net(x), y).backward()
+        opt.step()
+        sd = net.state_dict()
+        assert all('_param_grads' not in k and '_dygraph' not in k
+                   for k in sd)
+        # a fresh unbound copy loads it cleanly
+        net2 = _mlp(seed=7)
+        net2.set_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(net2.parameters()[0]),
+                                   np.asarray(net.parameters()[0]))
