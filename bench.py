@@ -181,19 +181,22 @@ def main():
     def bench_decode(dec_batch, cache_len, dec_steps):
         caches = model.init_cache(dec_batch, cache_len)
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def decode_step(tok, caches, i):
-            logits, caches = model(tok, caches=caches, cache_index=i)
+        # model must be an ARGUMENT, not a closure: closed-over params are
+        # baked into the executable as constants (2GB+ at 7B dims), which
+        # explodes compile time and doubles HBM
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def decode_step(m, tok, caches, i):
+            logits, caches = m(tok, caches=caches, cache_index=i)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
             return nxt, caches
 
         tok = jnp.zeros((dec_batch, 1), jnp.int32)
         base = jnp.asarray(cache_len - dec_steps - 2, jnp.int32)
-        tok, caches = decode_step(tok, caches, base)       # compile
+        tok, caches = decode_step(model, tok, caches, base)  # compile
         float(tok[0, 0])
         t0 = time.perf_counter()
         for s in range(dec_steps):
-            tok, caches = decode_step(tok, caches, base + 1 + s)
+            tok, caches = decode_step(model, tok, caches, base + 1 + s)
         float(tok[0, 0])
         ddt = time.perf_counter() - t0 - sync_latency
         return dec_batch * dec_steps / ddt

@@ -39,8 +39,9 @@ def _interpret():
     return interpret_mode()
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
-                   *, scale, ns, bs, S, hkv, group):
+def _decode_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
+                   *, scale, ns, bs, hkv, group):
+    b = pl.program_id(0)
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -56,20 +57,20 @@ def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
     # rows r = s*hkv + h: cache position r // hkv, kv head r % hkv
     k = k_ref[0].astype(jnp.float32).reshape(cols, D)
     v = v_ref[0].astype(jnp.float32).reshape(cols, D)
-    pvalid = kv_ref[0, 0] > 0                           # (bs,) per position
-    if S % bs != 0:
-        # padded tail block reads unspecified memory: bound-mask from the
-        # static S (the padded kvalid entries are themselves unspecified)
-        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs,), 0)
-        pvalid = pvalid & (pos < S)
-    # (bs,) per-position validity → (cols,) per-(position, head), same
-    # broadcast+reshape flattening as K/V so column orders line up
-    valid = jnp.broadcast_to(pvalid[:, None], (bs, hkv)).reshape(cols)
-    if S % bs != 0:
-        v = jnp.where(valid[:, None], v, 0.0)
+    # Validity comes in as a scalar count (SMEM prefetch) and every mask
+    # is built from 2-D iota in its final shape: Mosaic cannot reshape or
+    # minor-dim-broadcast i1 (or lane-misaligned i32) vectors, so no mask
+    # array ever changes rank. Column c's global cache position is
+    # j*bs + c//hkv; positions >= count (incl. the padded tail block's
+    # unspecified memory) are masked out of the scores, and V is zeroed
+    # there so garbage (inf/nan bit patterns) cannot reach the matmul.
+    count = vl_ref[b]
+    vpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (cols, D), 0) // hkv
+    v = jnp.where(vpos < count, v, 0.0)
     rowh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 0) // group
     colh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 1) % hkv
-    keep = (rowh == colh) & valid[None, :]
+    colp = j * bs + jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 1) // hkv
+    keep = (rowh == colh) & (colp < count)
 
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (Hq, cols)
@@ -92,8 +93,9 @@ def _decode_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, acc, m_scr, l_scr,
 
 
 def _pick_block(block_s, S, hkv, D, itemsize, interpret):
-    """Block length along the cache axis: VMEM-bounded, and on real TPU
-    sized so the flattened (bs·hkv) validity block tiles by 128."""
+    """Block length along the cache axis: VMEM-bounded; on real TPU kept
+    a multiple of 128 so the flattened (bs·hkv, D) K/V views stay
+    sublane-aligned for Mosaic's layout inference."""
     row_bytes = max(1, hkv * D * itemsize)      # one cache position, all heads
     cap = max(1, VMEM_BLOCK_BUDGET // row_bytes)
     bs = min(block_s, S, max(cap, 128))
@@ -101,7 +103,6 @@ def _pick_block(block_s, S, hkv, D, itemsize, interpret):
         return S
     if interpret:
         return bs
-    # validity block is (1, 1, bs): the lane dim must tile by 128
     return min(max(128, bs // 128 * 128), S)
 
 
@@ -126,29 +127,32 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
     bs = _pick_block(block_s, S, Hkv, D, k_cache.dtype.itemsize, interp)
     ns = pl.cdiv(S, bs)
 
-    # per-position validity; the kernel broadcasts it per kv head
-    valid = jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1, 1))
-    kvalid = (jnp.arange(S)[None, :] < valid).astype(jnp.int32)
-    kvalid = jnp.broadcast_to(kvalid, (B, S))[:, None, :]   # (B, 1, S)
+    # per-batch valid count, scalar-prefetched to SMEM (no mask array);
+    # clamped to S so an out-of-range count can never unmask the padded
+    # tail block's unspecified memory
+    vl = jnp.minimum(jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1,)), (B,)), S)
 
     kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
-                               S=S, hkv=Hkv, group=group)
+                               hkv=Hkv, group=group)
     out = pl.pallas_call(
         kernel,
-        grid=(B, ns),
-        in_specs=[
-            pl.BlockSpec((1, 1, Hq, D), lambda b, j: (b, 0, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda b, j: (b, j, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda b, j: (b, j, 0, 0)),
-            pl.BlockSpec((1, 1, bs), lambda b, j: (b, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, Hq, D), lambda b, j: (b, 0, 0, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, ns),
+            in_specs=[
+                pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
+                pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((Hq, D), jnp.float32),
+                pltpu.VMEM((Hq, 128), jnp.float32),
+                pltpu.VMEM((Hq, 128), jnp.float32),
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct((B, 1, Hq, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((Hq, D), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-        ],
         interpret=interp,
-    )(q, k_cache, v_cache, kvalid)
+    )(vl, q, k_cache, v_cache)
     return out

@@ -401,6 +401,23 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_valid_len_beyond_cache_is_clamped(self):
+        """valid_len > S must behave exactly like valid_len == S: the
+        clamp keeps the padded tail block's unspecified memory masked."""
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(3)
+        B, S, H, D = 2, 130, 2, 8                # tail block at block_s=64
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        over = decode_attention(q, ck, cv,
+                                jnp.asarray([S + 9, S + 1], jnp.int32),
+                                block_s=64)
+        full = decode_attention(q, ck, cv, S, block_s=64)
+        assert np.isfinite(np.asarray(over)).all()
+        np.testing.assert_array_equal(np.asarray(over), np.asarray(full))
+
     def test_generate_uses_decode_kernel_when_enabled(self, monkeypatch):
         """Dispatch check: the llama cached path must route Sq==1 steps
         through the decode kernel when pallas is on."""
