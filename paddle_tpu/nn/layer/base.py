@@ -349,7 +349,14 @@ class Layer:
             else:
                 m = self._param_meta.get(name, _META_BUFFER)
                 if m.kind == 'param' or m.persistable:
-                    dest[path] = v
+                    if hasattr(v, '_state_dict_entries'):
+                        # composite param (e.g. QuantizedWeight): store
+                        # its arrays under sub-keys so checkpoints hold
+                        # only plain arrays and round-trip by path
+                        for sub, arr in v._state_dict_entries():
+                            dest[f'{path}.{sub}'] = arr
+                    else:
+                        dest[path] = v
         return dest
 
     def set_state_dict(self, state_dict, strict=True):

@@ -6,6 +6,7 @@ per-output-channel scales, dequantized in VMEM right before the MXU.
 """
 from __future__ import annotations
 
+import jax as _jax
 import jax.numpy as jnp
 
 
@@ -64,6 +65,79 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     from ...ops.pallas.quant_matmul import weight_only_linear as wol
 
     return wol(x, weight, weight_scale, bias=bias)
+
+
+@_jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """A weight-only quantized matrix: codes + per-output-column scale.
+
+    Drop-in replacement for a dense (K, N) projection Parameter inside a
+    Layer pytree (meta-registered attributes stay children whatever their
+    type), used by `LlamaForCausalLM.quantize_weights` and friends.
+    `codes`/`scale` are the pytree leaves; `bits` rides in the treedef.
+    `matmul(x)` routes through the pallas weight-only kernel.
+    """
+
+    def __init__(self, codes, scale, bits=8, shape=None):
+        self.codes = codes
+        self.scale = scale
+        self.bits = int(bits)
+        # logical (K, N) of the dense weight this replaces (int4 packs
+        # two codes per byte, so codes.shape underreports K)
+        self._shape = tuple(shape) if shape is not None else tuple(
+            getattr(codes, 'shape', ()))
+
+    @classmethod
+    def quantize(cls, w, bits=8):
+        algo = {8: 'weight_only_int8', 4: 'weight_only_int4'}.get(bits)
+        if algo is None:
+            raise ValueError(f'bits must be 4 or 8, got {bits}')
+        codes, scale = weight_quantize(w, algo=algo)
+        return cls(codes, scale, bits, shape=w.shape)
+
+    def matmul(self, x):
+        return weight_only_linear(
+            x, self.codes, weight_scale=self.scale,
+            weight_dtype='int4' if self.bits == 4 else 'int8')
+
+    # -- array-ish protocol: Layer repr/astype/state_dict iterate params
+    # and expect shape/dtype; codes' integer dtype makes floating-only
+    # casts (amp O2, Layer.astype) skip this weight, which is the right
+    # semantic — the codes are fixed-point by construction.
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self.codes.dtype
+
+    def astype(self, dtype):
+        """Quantized codes have a fixed dtype; only the scale casts."""
+        return type(self)(self.codes, self.scale.astype(dtype), self.bits,
+                          self._shape)
+
+    def _state_dict_entries(self):
+        """Split into plain-array entries so checkpoints round-trip
+        (Layer.state_dict expands these as `<name>.codes`/`<name>.scale`
+        and `_set_by_path` writes them back onto this object)."""
+        return [('codes', self.codes), ('scale', self.scale)]
+
+    def tree_flatten(self):
+        return (self.codes, self.scale), (self.bits, self._shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        bits, shape = aux
+        return cls(children[0], children[1], bits, shape)
+
+    def __repr__(self):
+        return (f'QuantizedWeight(bits={self.bits}, shape={self._shape}, '
+                f'codes={getattr(self.codes, "shape", None)})')
 
 
 class Stub:

@@ -85,6 +85,74 @@ class TestLlama:
                                    rtol=1e-6)
 
 
+class TestLlamaQuantized:
+    """Weight-only PTQ of the flagship (quantize_weights): the pallas
+    int8/int4 serving path must approximate the bf16 model and leave the
+    original untouched."""
+
+    def _model(self):
+        pt.seed(0)
+        cfg = llama_tiny(vocab_size=128, hidden_size=64, layers=2, heads=4,
+                         kv_heads=2, intermediate_size=128, max_pos=64)
+        return LlamaForCausalLM(cfg)
+
+    @pytest.mark.parametrize('bits,rel_tol', [(8, 0.03), (4, 0.35)])
+    def test_quantized_forward_close(self, bits, rel_tol):
+        model = self._model()
+        ids = _ids((2, 16), vocab=128)
+        ref = model(ids)
+        qm = model.quantize_weights(bits=bits)
+        out = jax.jit(lambda m, i: m(i))(qm, ids)
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < rel_tol, rel
+        # original model is untouched
+        assert jnp.array_equal(model(ids), ref)
+
+    def test_quantized_generate_and_cache_path(self):
+        model = self._model()
+        qm = model.quantize_weights(bits=8)
+        ids = _ids((2, 4), vocab=128)
+        out = qm.generate(ids, max_new_tokens=5)
+        assert out.shape == (2, 9)
+        # greedy tokens should mostly agree with the bf16 model's
+        base = model.generate(ids, max_new_tokens=5)
+        agree = float(jnp.mean((out == base).astype(jnp.float32)))
+        assert agree > 0.6, agree
+
+    def test_quantized_state_dict_roundtrip(self, tmp_path):
+        model = self._model()
+        qm = model.quantize_weights(bits=8)
+        ids = _ids((2, 8), vocab=128)
+        ref = qm(ids)
+        sd = qm.state_dict()
+        # composite params expand to plain-array sub-keys
+        assert 'model.layers.L0.self_attn.q_proj.codes' in sd
+        assert 'model.layers.L0.self_attn.q_proj.scale' in sd
+        path = str(tmp_path / 'qllama.pdparams')
+        pt.save(sd, path)
+        qm2 = self._model().quantize_weights(bits=8)
+        qm2.set_state_dict(pt.load(path))
+        assert jnp.array_equal(qm2(ids), ref)
+
+    def test_quantized_repr_and_astype(self):
+        qm = self._model().quantize_weights(bits=4)
+        assert 'params=' in repr(qm)          # Layer.__repr__ walks shapes
+        qm.astype('float32')                  # floating-only: skips codes
+        attn = qm.model.layers[0].self_attn
+        assert attn.q_proj.codes.dtype == jnp.int8
+        assert attn.q_proj.shape == (64, 64)  # logical K, not packed K/2
+
+    def test_quantized_params_not_trainable(self):
+        qm = self._model().quantize_weights(bits=8)
+        attn = qm.model.layers[0].self_attn
+        meta = attn._param_meta['q_proj']
+        assert meta.trainable is False
+        from paddle_tpu.nn.quant import QuantizedWeight
+
+        assert isinstance(attn.q_proj, QuantizedWeight)
+        assert attn.q_proj.codes.dtype == jnp.int8
+
+
 @pytest.mark.heavy
 class TestResNet:
     def test_resnet18_forward(self):
