@@ -178,7 +178,7 @@ def main():
 
     # -- decode path: steady-state single-token generation over a long KV
     # cache (the inference-stack half of the reference's perf story) -----
-    def bench_decode(dec_batch, cache_len, dec_steps):
+    def bench_decode(dec_batch, cache_len, dec_steps, m=None):
         # Times the SCANNED decode loop — the same shape as
         # model.generate()'s lax.scan — so the number reflects on-device
         # steady-state throughput, not per-step host dispatch latency
@@ -186,14 +186,15 @@ def main():
         # not pay). model must be an ARGUMENT, not a closure: closed-over
         # params are baked into the executable as constants (2GB+ at 7B
         # dims), which explodes compile time and HBM.
-        caches = model.init_cache(dec_batch, cache_len)
+        m = model if m is None else m
+        caches = m.init_cache(dec_batch, cache_len)
         base = jnp.asarray(cache_len - dec_steps - 2, jnp.int32)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def decode_run(m, caches, tok0):
+        def decode_run(mm, caches, tok0):
             def body(carry, i):
                 tok, caches = carry
-                logits, caches = m(tok, caches=caches, cache_index=base + i)
+                logits, caches = mm(tok, caches=caches, cache_index=base + i)
                 nxt = jnp.argmax(logits[:, -1], axis=-1)
                 return (nxt.astype(jnp.int32)[:, None], caches), ()
 
@@ -202,12 +203,12 @@ def main():
             return tok, caches
 
         tok = jnp.zeros((dec_batch, 1), jnp.int32)
-        tok, caches = decode_run(model, caches, tok)       # compile
+        tok, caches = decode_run(m, caches, tok)           # compile
         float(tok[0, 0])
         reps = 3
         t0 = time.perf_counter()
         for _ in range(reps):
-            tok, caches = decode_run(model, caches, tok)
+            tok, caches = decode_run(m, caches, tok)
         float(tok[0, 0])
         ddt = time.perf_counter() - t0 - sync_latency
         return dec_batch * dec_steps * reps / ddt
@@ -216,6 +217,16 @@ def main():
     dec_steps = 48 if on_tpu else 8
     decode_b1 = bench_decode(1, dec_cache, dec_steps)
     decode_b8 = bench_decode(8, dec_cache, dec_steps)
+    # weight-only int8 serving path (pallas quant matmul): decode is
+    # weight-HBM-bound, so this is the 2x lever. Guarded: a failure here
+    # must not cost the train metric.
+    try:
+        decode_b1_int8 = bench_decode(
+            1, dec_cache, dec_steps, m=model.quantize_weights(bits=8))
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        decode_b1_int8 = None
+        print(f'# int8 decode bench failed: {type(e).__name__}: {e}',
+              flush=True)
 
     # FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
@@ -235,6 +246,8 @@ def main():
             'vocab_size': cfg.vocab_size,
             'decode_tok_s_b1': round(decode_b1, 1),
             'decode_tok_s_b8': round(decode_b8, 1),
+            'decode_tok_s_b1_int8': (round(decode_b1_int8, 1)
+                                     if decode_b1_int8 is not None else None),
             'decode_cache_len': dec_cache,
             'backend': jax.default_backend(),
             'device': getattr(jax.devices()[0], 'device_kind', '?'),

@@ -96,16 +96,6 @@ def apply_rotary(x, cos, sin):
     ).astype(x.dtype)
 
 
-def _mm(x, w):
-    """Projection matmul that also accepts a weight-only QuantizedWeight
-    (as installed by `LlamaForCausalLM.quantize_weights`)."""
-    from ..nn.quant import QuantizedWeight
-
-    if isinstance(w, QuantizedWeight):
-        return w.matmul(x)
-    return x @ w
-
-
 # ---------------------------------------------------------------------------
 # Blocks
 # ---------------------------------------------------------------------------
@@ -139,9 +129,9 @@ class LlamaAttention(Layer):
         cache_index and attends over the full cache (masked by position).
         """
         B, S, _ = x.shape
-        q = _mm(x, self.q_proj).reshape(B, S, self.num_heads, self.head_dim)
-        k = _mm(x, self.k_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
-        v = _mm(x, self.v_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
+        q = (x @ self.q_proj).reshape(B, S, self.num_heads, self.head_dim)
+        k = (x @ self.k_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
+        v = (x @ self.v_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
 
         cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
         q = apply_rotary(q, cos, sin)
@@ -220,7 +210,7 @@ class LlamaAttention(Layer):
             new_cache = (ck, cv)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
-        return _mm(out, self.o_proj), new_cache
+        return out @ self.o_proj, new_cache
 
 
 class LlamaMLP(Layer):
@@ -235,8 +225,7 @@ class LlamaMLP(Layer):
         self.down_proj = Parameter(init((m, h), config.dtype), spec=P('tp', None))
 
     def forward(self, x):
-        return _mm(F.silu(_mm(x, self.gate_proj)) * _mm(x, self.up_proj),
-                   self.down_proj)
+        return (F.silu(x @ self.gate_proj) * (x @ self.up_proj)) @ self.down_proj
 
 
 class LlamaDecoderLayer(Layer):
@@ -323,7 +312,7 @@ class LlamaForCausalLM(Layer):
     def logits(self, hidden):
         if self.lm_head is None:
             return hidden @ self.model.embed_tokens.T
-        return _mm(hidden, self.lm_head)
+        return hidden @ self.lm_head
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
                 cache_index=None):
@@ -354,7 +343,6 @@ class LlamaForCausalLM(Layer):
         Single-chip inference: TP shardings are dropped from the
         quantized attrs. The original model is untouched.
         """
-        from ..nn.layer.base import _Meta
         from ..nn.quant import QuantizedWeight
 
         new = jax.tree_util.tree_map(lambda x: x, self)
@@ -363,7 +351,7 @@ class LlamaForCausalLM(Layer):
             for n in names:
                 mod.__dict__[n] = QuantizedWeight.quantize(
                     mod.__dict__[n], bits)
-                mod._param_meta[n] = _Meta('param', False, True, None)
+                mod.set_param_meta(n, trainable=False, spec=None)
 
         for layer in new.model.layers:
             _swap(layer.self_attn, ('q_proj', 'k_proj', 'v_proj', 'o_proj'))

@@ -100,6 +100,12 @@ class QuantizedWeight:
             x, self.codes, weight_scale=self.scale,
             weight_dtype='int4' if self.bits == 4 else 'int8')
 
+    def __rmatmul__(self, x):
+        # jax arrays/tracers return NotImplemented for unrecognized
+        # matmul operands, so plain `x @ w` model code works unchanged
+        # when w has been swapped for a QuantizedWeight
+        return self.matmul(x)
+
     # -- array-ish protocol: Layer repr/astype/state_dict iterate params
     # and expect shape/dtype; codes' integer dtype makes floating-only
     # casts (amp O2, Layer.astype) skip this weight, which is the right
