@@ -114,6 +114,10 @@ class BaseGate(Layer):
     """ref: gate/base_gate.py — scoring module contract: forward(inp) ->
     (topk_val, topk_idx); the load-balance loss is stashed on the gate."""
 
+    # routing scores must stay full precision: int8 noise flips top-k
+    # expert selection (quantization.quantize_matmul_weights honours this)
+    no_quantize = True
+
     def __init__(self, num_expert, world_size=1):
         super().__init__()
         self.num_expert = num_expert
@@ -252,6 +256,9 @@ class MoELayer(Layer):
     Dense GShard dispatch: out = combine · expert(dispatchᵀ · x).
     Shared experts (DeepSeek-style) run on every token additively.
     """
+
+    # the router weight: keep full precision under weight-only PTQ
+    no_quantize = ('gate',)
 
     def __init__(self, hidden, intermediate, num_experts=8, top_k=2,
                  capacity_factor=1.25, num_shared_experts=0, gate_init=None,

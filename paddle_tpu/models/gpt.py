@@ -90,6 +90,10 @@ class GPTBlock(Layer):
 
 
 class GPTModel(Layer):
+    # wte/wpe are lookup tables (gather; wte.T serves the tied head) —
+    # exempt from weight-only PTQ
+    no_quantize = ('wte', 'wpe')
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config

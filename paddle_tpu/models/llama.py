@@ -248,6 +248,10 @@ class LlamaDecoderLayer(Layer):
 class LlamaModel(Layer):
     """Embedding + decoder stack + final norm."""
 
+    # vocab table is gathered (and .T-served when tied) — exempt from
+    # weight-only PTQ (quantization.quantize_matmul_weights)
+    no_quantize = ('embed_tokens',)
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -343,22 +347,12 @@ class LlamaForCausalLM(Layer):
         Single-chip inference: TP shardings are dropped from the
         quantized attrs. The original model is untouched.
         """
-        from ..nn.quant import QuantizedWeight
+        from ..quantization import quantize_matmul_weights
 
-        new = jax.tree_util.tree_map(lambda x: x, self)
-
-        def _swap(mod, names):
-            for n in names:
-                mod.__dict__[n] = QuantizedWeight.quantize(
-                    mod.__dict__[n], bits)
-                mod.set_param_meta(n, trainable=False, spec=None)
-
-        for layer in new.model.layers:
-            _swap(layer.self_attn, ('q_proj', 'k_proj', 'v_proj', 'o_proj'))
-            _swap(layer.mlp, ('gate_proj', 'up_proj', 'down_proj'))
-        if new.lm_head is not None:
-            _swap(new, ('lm_head',))
-        return new
+        # min_features=1: ALL projections quantize, including GQA k/v
+        # narrower than the generic default (embed_tokens is exempted
+        # structurally via LlamaModel.no_quantize)
+        return quantize_matmul_weights(self, bits=bits, min_features=1)
 
     # -- generation --------------------------------------------------------
     def init_cache(self, batch_size, max_len, dtype=None):

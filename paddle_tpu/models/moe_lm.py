@@ -82,6 +82,9 @@ class MoEDecoderLayer(Layer):
 
 
 class MoEForCausalLM(Layer):
+    # vocab table is gathered, not matmul'd — exempt from weight-only PTQ
+    no_quantize = ('embed_tokens',)
+
     def __init__(self, config: MoEConfig):
         super().__init__()
         self.config = config

@@ -13,7 +13,9 @@ def linear(x, weight, bias=None):
     """y = x @ W + b with W stored (in_features, out_features) as Paddle does
     (ref: nn/functional/common.py::linear) — this is also the MXU-friendly
     layout (no transpose needed)."""
-    y = jnp.matmul(x, weight)
+    # operator form, not jnp.matmul: jax defers `@` to __rmatmul__ for
+    # non-array weights, which is how QuantizedWeight serves Linear
+    y = x @ weight
     if bias is not None:
         y = y + bias
     return y

@@ -58,6 +58,56 @@ def quantize_model(model, quantizable=('Linear',), inplace=False, bits=8):
                            lambda c: QuantizedLinear(c, bits=bits), inplace)
 
 
+def quantize_matmul_weights(model, bits=8, min_features=64, exclude=()):
+    """Weight-only PTQ for raw-`x @ w` models (ref capability: the
+    serving-side weight_only pass of paddle.quantization): every
+    trainable 2-D floating param with min(shape) >= min_features becomes
+    a `QuantizedWeight` served by the pallas int8/int4 kernels.
+
+    This covers models that hold projections as bare Parameters (llama,
+    gpt) — `quantize_model` handles nn.Linear-built ones. Exclusion is
+    STRUCTURAL, not name-based: `nn.Embedding` subtrees are never
+    touched (gathered, not matmul'd), and a layer class opts out by
+    declaring ``no_quantize = True`` (whole subtree — e.g. MoE router
+    gates, where int8 noise flips top-k expert selection) or a tuple of
+    its param names (lookup tables held as raw Parameters, e.g. a
+    model's ``embed_tokens``). `exclude` adds user path-substring
+    excludes on top. Returns a new model; the original is untouched.
+    """
+    import jax
+
+    from ..nn.layer.common import Embedding
+    from ..nn.quant import QuantizedWeight
+
+    new = jax.tree_util.tree_map(lambda x: x, model)
+
+    def walk(sub, path):
+        nq = getattr(sub, 'no_quantize', ())
+        if nq is True or isinstance(sub, Embedding):
+            return
+        for name in sorted(sub.__dict__):
+            v = sub.__dict__[name]
+            full = f'{path}.{name}' if path else name
+            if isinstance(v, Layer):
+                walk(v, full)
+                continue
+            meta = sub._param_meta.get(name)
+            if meta is None or meta.kind != 'param' or not meta.trainable:
+                continue
+            if name in nq or any(e in full for e in exclude):
+                continue
+            if getattr(v, 'ndim', 0) != 2 or min(v.shape) < min_features:
+                continue
+            if not (jnp.issubdtype(v.dtype, jnp.floating)
+                    or v.dtype == jnp.bfloat16):
+                continue
+            sub.__dict__[name] = QuantizedWeight.quantize(v, bits)
+            sub.set_param_meta(name, trainable=False, spec=None)
+
+    walk(new, '')
+    return new
+
+
 def _replace_layers(model, match, build, inplace=False):
     """Shared PTQ/QAT traversal: structural-copy (unless inplace), then
     recursively swap every child where ``match(child)`` for

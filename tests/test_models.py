@@ -151,6 +151,12 @@ class TestLlamaQuantized:
 
         assert isinstance(attn.q_proj, QuantizedWeight)
         assert attn.q_proj.codes.dtype == jnp.int8
+        # GQA k/v are NARROWER than the generic min_features default —
+        # quantize_weights must still cover them (docstring contract)
+        assert isinstance(attn.k_proj, QuantizedWeight)
+        assert isinstance(attn.v_proj, QuantizedWeight)
+        # the vocab table stays dense (structural no_quantize)
+        assert not isinstance(qm.model.embed_tokens, QuantizedWeight)
 
 
 @pytest.mark.heavy

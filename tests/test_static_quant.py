@@ -189,3 +189,76 @@ class TestQuantization:
                                    rtol=0.5, atol=1.0)
         q4model = quantize_model(net, bits=4)
         assert q4model.sublayers()[0].bits == 4
+
+
+class TestQuantizeMatmulWeights:
+    """Generic weight-only PTQ walker over raw `x @ w` models
+    (quantization.quantize_matmul_weights)."""
+
+    def test_gpt2_quantizes_and_stays_close(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        from paddle_tpu.nn.quant import QuantizedWeight
+        from paddle_tpu.quantization import quantize_matmul_weights
+
+        pt.seed(0)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, 96, (2, 12)), jnp.int32)
+        g = GPTForCausalLM(gpt2_tiny(vocab_size=96, hidden_size=64,
+                                     num_hidden_layers=2))
+        ref = g(ids)
+        qg = quantize_matmul_weights(g, bits=8)
+        out = jax.jit(lambda m, i: m(i))(qg, ids)
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.03, rel
+        quantized = [
+            f'{p}.{n}' if p else n
+            for p, s in qg.named_sublayers(include_self=True)
+            for n, v in s.__dict__.items() if isinstance(v, QuantizedWeight)
+        ]
+        # per block: qkv + out_proj + fc_in + fc_out; embeddings stay dense
+        assert len(quantized) == 8, quantized
+        assert not any('wte' in q or 'wpe' in q for q in quantized)
+
+    def test_moe_excludes_3d_experts_and_router(self):
+        from paddle_tpu.distributed.moe import MoELayer
+        from paddle_tpu.models.moe_lm import MoEForCausalLM, moe_tiny
+        from paddle_tpu.nn.quant import QuantizedWeight
+        from paddle_tpu.quantization import quantize_matmul_weights
+
+        pt.seed(1)
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 96, (2, 12)), jnp.int32)
+        m = MoEForCausalLM(moe_tiny(vocab_size=96, hidden_size=64))
+        r = m(ids)
+        ref = r[0] if isinstance(r, tuple) else r
+        # min_features=1 so the router gate would QUALIFY by shape — only
+        # the structural no_quantize declarations may keep it dense
+        qm = quantize_matmul_weights(m, bits=8, min_features=1)
+        o = jax.jit(lambda mo, i: mo(i))(qm, ids)
+        out = o[0] if isinstance(o, tuple) else o
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.05, rel
+        routers = 0
+        for _, s in qm.named_sublayers(include_self=True):
+            if isinstance(s, MoELayer):
+                routers += 1
+                assert not isinstance(s.gate, QuantizedWeight)
+            for n, v in s.__dict__.items():
+                if isinstance(v, QuantizedWeight):
+                    assert v.ndim == 2  # 3-D batched expert weights stay fp
+        assert routers > 0
+        assert not isinstance(qm.embed_tokens, QuantizedWeight)
+
+    def test_linear_forward_serves_quantized_weight(self):
+        """F.linear's `x @ w` defers to QuantizedWeight.__rmatmul__."""
+        from paddle_tpu.nn.quant import QuantizedWeight
+
+        pt.seed(2)
+        lin = nn.Linear(64, 96)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 64)),
+                        jnp.float32)
+        ref = lin(x)
+        lin.__dict__['weight'] = QuantizedWeight.quantize(lin.weight, bits=8)
+        out = lin(x)
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.03, rel
