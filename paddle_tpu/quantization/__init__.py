@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..nn.layer.base import Layer, Parameter
+from ..nn.quant import QuantizedWeight  # noqa: F401
 from ..ops.pallas.quant_matmul import (  # noqa: F401
     quant_matmul,
     quantize_weight,
