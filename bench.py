@@ -228,6 +228,12 @@ def main():
         print(f'# int8 decode bench failed: {type(e).__name__}: {e}',
               flush=True)
 
+    try:  # HBM watermark (TPU runtimes expose it; None elsewhere)
+        _peak = pt.device.cuda.max_memory_allocated()
+        hbm_peak_gb = round(_peak / 2 ** 30, 2) if _peak else None
+    except Exception:  # noqa: BLE001
+        hbm_peak_gb = None
+
     # FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     attn = 6 * cfg.num_hidden_layers * cfg.hidden_size * seq  # 12*L*h*S * 0.5 causal
@@ -249,6 +255,7 @@ def main():
             'decode_tok_s_b1_int8': (round(decode_b1_int8, 1)
                                      if decode_b1_int8 is not None else None),
             'decode_cache_len': dec_cache,
+            'hbm_peak_gb': hbm_peak_gb,
             'backend': jax.default_backend(),
             'device': getattr(jax.devices()[0], 'device_kind', '?'),
         },

@@ -38,7 +38,10 @@ def main():
 
     out_fp = model.generate(prompt, max_new_tokens=12)
     out_q = qmodel.generate(prompt, max_new_tokens=12)
-    agree = float(jnp.mean((out_fp == out_q).astype(jnp.float32)))
+    # generate() returns concat([prompt, new_tokens]); compare only the
+    # generated positions or the prompt inflates the agreement
+    gen_fp, gen_q = out_fp[:, prompt.shape[1]:], out_q[:, prompt.shape[1]:]
+    agree = float(jnp.mean((gen_fp == gen_q).astype(jnp.float32)))
     print(f'greedy agreement bf16 vs int8: {agree:.0%}')
 
     # the quantized model checkpoints like any other: state_dict splits
