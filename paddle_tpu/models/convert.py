@@ -312,3 +312,111 @@ def from_hf_gpt2(state_dict, config, dtype=None):
     if leftovers:
         raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
     return model
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (sparse-MoE decoder → MoEForCausalLM, mirrors the Llama converter)
+# ---------------------------------------------------------------------------
+
+
+def hf_mixtral_config(hf_config):
+    """Map a transformers MixtralConfig (object or dict) onto MoEConfig.
+
+    Mixtral = Llama attention + top-k routed SwiGLU experts, no shared
+    experts. `dispatch_mode='ragged'` (dropless) is forced: the GShard
+    capacity dispatch drops tokens, which would silently diverge from
+    the HF reference.
+    """
+    from .moe_lm import MoEConfig
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    act = get('hidden_act', 'silu')
+    if act not in ('silu', 'swish'):
+        raise ValueError(
+            f'hidden_act={act!r} unsupported: the experts hardcode SwiGLU')
+    if get('sliding_window') not in (None, 0):
+        raise ValueError(
+            f"sliding_window={get('sliding_window')!r} unsupported: "
+            f'attention here is full-causal — converting would give '
+            f'silently wrong logits past the window')
+    if get('tie_word_embeddings', False):
+        raise ValueError(
+            'tie_word_embeddings=True unsupported: MoEForCausalLM has a '
+            'separate lm_head (and tied checkpoints omit lm_head.weight)')
+    return MoEConfig(
+        vocab_size=get('vocab_size'),
+        hidden_size=get('hidden_size'),
+        intermediate_size=get('intermediate_size'),
+        num_hidden_layers=get('num_hidden_layers'),
+        num_attention_heads=get('num_attention_heads'),
+        num_key_value_heads=(get('num_key_value_heads')
+                             or get('num_attention_heads')),
+        num_experts=get('num_local_experts'),
+        num_shared_experts=0,
+        top_k=get('num_experts_per_tok', 2),
+        max_position_embeddings=get('max_position_embeddings', 4096),
+        rms_norm_eps=get('rms_norm_eps', 1e-5),
+        rope_theta=get('rope_theta', 1e6),
+        aux_loss_weight=get('router_aux_loss_coef', 0.001),
+        dispatch_mode='ragged',
+    )
+
+
+def from_hf_mixtral(state_dict, config, dtype=None):
+    """Build a MoEForCausalLM from a HuggingFace Mixtral state dict.
+
+    Routing parity: HF softmaxes ALL router logits, takes top-k, and
+    renormalises over the chosen k — the same operation `_topk_gates`
+    performs. HF per-expert Linears w1/w3/w2 are (out, in); ours are
+    batched (E, in, out) tensors w_gate/w_up/w_down, so each expert
+    transposes then stacks.
+    """
+    from .moe_lm import MoEForCausalLM
+
+    sd = {k: state_dict[k] for k in state_dict}
+    model = MoEForCausalLM(config)
+    assign = _make_assign(dtype)
+
+    assign(model, 'embed_tokens', sd.pop('model.embed_tokens.weight'))
+    for i, layer in enumerate(model.layers):
+        p = f'model.layers.{i}.'
+        attn = layer.self_attn
+        for w in ('q_proj', 'k_proj', 'v_proj', 'o_proj'):
+            assign(attn, w, sd.pop(p + f'self_attn.{w}.weight'),
+                   transpose=True)
+        moe = layer.moe
+        assign(moe, 'gate', sd.pop(p + 'block_sparse_moe.gate.weight'),
+               transpose=True)
+        stacks = {'w1': [], 'w3': [], 'w2': []}
+        for e in range(config.num_experts):
+            for w in stacks:
+                stacks[w].append(
+                    _np(sd.pop(p + f'block_sparse_moe.experts.{e}.{w}.weight'))
+                    .T)
+        assign(moe.experts, 'w_gate', np.stack(stacks['w1']))
+        assign(moe.experts, 'w_up', np.stack(stacks['w3']))
+        assign(moe.experts, 'w_down', np.stack(stacks['w2']))
+        assign(layer.input_layernorm, 'weight',
+               sd.pop(p + 'input_layernorm.weight'))
+        assign(layer.post_attention_layernorm, 'weight',
+               sd.pop(p + 'post_attention_layernorm.weight'))
+    assign(model.norm, 'weight', sd.pop('model.norm.weight'))
+    assign(model, 'lm_head', sd.pop('lm_head.weight'), transpose=True)
+
+    leftovers = [k for k in sd
+                 if not re.search(r'rotary_emb|inv_freq|position_ids', k)]
+    if leftovers:
+        raise ValueError(f'unconverted HF weights: {leftovers[:8]}')
+    return model
+
+
+def from_hf_mixtral_pretrained(model_or_path, dtype=None):
+    """Accept a transformers MixtralForCausalLM (or local path) and
+    convert it."""
+    if isinstance(model_or_path, str):
+        from transformers import MixtralForCausalLM as HFMixtral
+
+        model_or_path = HFMixtral.from_pretrained(model_or_path)
+    cfg = hf_mixtral_config(model_or_path.config)
+    return from_hf_mixtral(model_or_path.state_dict(), cfg, dtype=dtype)

@@ -35,6 +35,10 @@ class MoEConfig:
     rms_norm_eps: float = 1e-6
     rope_theta: float = 10000.0
     initializer_range: float = 0.02
+    # 'auto' | 'dense' (GShard capacity dispatch) | 'ragged' (dropless
+    # sort + grouped GEMM — required for HF-Mixtral logit parity, since
+    # capacity dispatch drops tokens)
+    dispatch_mode: str = 'auto'
 
     def attn_config(self) -> LlamaConfig:
         return LlamaConfig(
@@ -72,6 +76,7 @@ class MoEDecoderLayer(Layer):
             num_experts=config.num_experts, top_k=config.top_k,
             capacity_factor=config.capacity_factor,
             num_shared_experts=config.num_shared_experts, return_aux=True,
+            dispatch_mode=config.dispatch_mode,
         )
 
     def forward(self, x, positions):

@@ -227,3 +227,64 @@ def test_gpt2_and_bert_unsupported_configs_rejected():
                         'num_hidden_layers': 1, 'num_attention_heads': 2,
                         'intermediate_size': 64,
                         'position_embedding_type': 'relative_key'})
+
+
+# ---------------------------------------------------------------------------
+# Mixtral → MoEForCausalLM
+# ---------------------------------------------------------------------------
+
+
+def _tiny_hf_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        sliding_window=None, attention_dropout=0.0,
+        attn_implementation='eager',
+    )
+    torch.manual_seed(7)
+    return transformers.MixtralForCausalLM(cfg).eval()
+
+
+@e2e
+def test_mixtral_logits_match_transformers():
+    """Whole-stack MoE validation: converted weights must reproduce HF's
+    logits through routing, ragged expert GEMMs, GQA, and RoPE."""
+    from paddle_tpu.models.convert import from_hf_mixtral, hf_mixtral_config
+
+    hf = _tiny_hf_mixtral()
+    model = from_hf_mixtral(hf.state_dict(), hf_mixtral_config(hf.config))
+    assert model.config.dispatch_mode == 'ragged'   # dropless: no capacity
+
+    ids = np.random.default_rng(11).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got, _aux = model(jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_unsupported_configs_rejected():
+    from paddle_tpu.models.convert import hf_mixtral_config
+
+    base = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=1, num_attention_heads=4,
+                num_local_experts=4)
+    with pytest.raises(ValueError, match='sliding_window'):
+        hf_mixtral_config({**base, 'sliding_window': 1024})
+    with pytest.raises(ValueError, match='hidden_act'):
+        hf_mixtral_config({**base, 'hidden_act': 'relu'})
+    # tied checkpoints omit lm_head.weight: refuse up front, not KeyError
+    with pytest.raises(ValueError, match='tie_word_embeddings'):
+        hf_mixtral_config({**base, 'tie_word_embeddings': True})
+
+
+@e2e
+def test_mixtral_unconverted_weights_raise():
+    from paddle_tpu.models.convert import from_hf_mixtral, hf_mixtral_config
+
+    hf = _tiny_hf_mixtral()
+    sd = hf.state_dict()
+    sd['model.layers.0.block_sparse_moe.surprise.weight'] = torch.zeros(2)
+    with pytest.raises(ValueError, match='unconverted'):
+        from_hf_mixtral(sd, hf_mixtral_config(hf.config))
