@@ -301,8 +301,13 @@ class MoELayer(Layer):
         self.return_aux = return_aux
         self.aux_loss = jnp.zeros(())   # registered buffer: last aux loss
 
-    def forward(self, x):
+    def forward(self, x, dropless=False):
         """x: (B, S, H) → (B, S, H), or (out, aux_loss) if return_aux.
+
+        ``dropless=True`` routes through the ragged (no-capacity) path
+        regardless of dispatch_mode — KV-cached decode passes it, since
+        capacity computed from a single-token call (T = B) drops every
+        routing collision and silently degrades generation.
 
         `self.aux_loss` is also updated in place; being a registered
         buffer it follows the framework's state-in/state-out rule — under
@@ -314,7 +319,7 @@ class MoELayer(Layer):
         tokens = x.reshape(B * S, H)
         T = B * S
         logits = tokens @ self.gate
-        if self.dispatch_mode == 'ragged':
+        if dropless or self.dispatch_mode == 'ragged':
             _, gate_vals, expert_idx, aux = _topk_gates(logits, self.top_k)
             out = ragged_expert_apply(
                 tokens.astype(x.dtype), expert_idx, gate_vals,
@@ -340,7 +345,16 @@ class MoELayer(Layer):
                 tokens[None], (self.num_shared, T, H)).astype(x.dtype)
             shared_out = self.shared(shared_in).sum(axis=0)
             out = out + shared_out.reshape(B, S, H)
-        object.__setattr__(self, 'aux_loss', aux)
+        # state-in/state-out: only stash aux on a layer whose own leaves
+        # are part of the active trace. When a CONCRETE model runs under
+        # an inner trace (e.g. generate()'s lax.scan closes over self),
+        # writing the traced aux would leak a tracer into the instance
+        # and poison every later flatten/jit with UnexpectedTracerError.
+        if (isinstance(aux, jax.core.Tracer)
+                and not isinstance(self.aux_loss, jax.core.Tracer)):
+            pass
+        else:
+            object.__setattr__(self, 'aux_loss', aux)
         if self.return_aux:
             return out, aux
         return out

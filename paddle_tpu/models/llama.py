@@ -31,6 +31,7 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
+from .generation import GenerationMixin
 
 
 @dataclasses.dataclass
@@ -297,8 +298,9 @@ class LlamaModel(Layer):
         return self.norm(x), new_caches
 
 
-class LlamaForCausalLM(Layer):
-    """LM head on top; loss = causal cross-entropy (shifted)."""
+class LlamaForCausalLM(GenerationMixin, Layer):
+    """LM head on top; loss = causal cross-entropy (shifted); generation
+    (greedy/sampled/beam) via models/generation.py::GenerationMixin."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -354,165 +356,10 @@ class LlamaForCausalLM(Layer):
         # structurally via LlamaModel.no_quantize)
         return quantize_matmul_weights(self, bits=bits, min_features=1)
 
-    # -- generation --------------------------------------------------------
-    def init_cache(self, batch_size, max_len, dtype=None):
-        cfg = self.config
-        dtype = dtype or self.model.embed_tokens.dtype
-        shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
-        return [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(cfg.num_hidden_layers)
-        ]
+    # -- generation (loops from GenerationMixin) ---------------------------
+    def cache_dtype(self):
+        return self.model.embed_tokens.dtype
 
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
-                 top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,
-                 length_penalty=0.0):
-        if num_beams > 1:
-            if temperature != 0.0 or top_k != 0 or top_p != 1.0:
-                raise ValueError(
-                    'beam search is deterministic: temperature/top_k/top_p '
-                    'are not supported with num_beams > 1')
-            return self.beam_search(input_ids, max_new_tokens, num_beams,
-                                    eos_token_id=eos_token_id,
-                                    length_penalty=length_penalty)
-        return self._generate_sample(input_ids, max_new_tokens, temperature,
-                                     top_k, top_p, rng_key, eos_token_id)
-
-    def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
-                    eos_token_id=None, length_penalty=0.0):
-        """Static-shape beam search with a shared KV-cache (ref:
-        python/paddle/nn/decode.py::BeamSearchDecoder semantics on the
-        causal-LM surface).
-
-        Every step scores all num_beams*vocab continuations, keeps the
-        top num_beams by cumulative log-prob (finished beams frozen),
-        and gathers the KV-cache rows along the flattened batch*beam
-        axis — one `lax.scan`, fully jittable.
-        """
-        B, S = input_ids.shape
-        K = num_beams
-        max_len = S + max_new_tokens
-        NEG = -1e9
-
-        # prefill ONCE at batch B, then replicate the KV rows K ways —
-        # the K beams share an identical prompt, so prefilling (B*K, S)
-        # would do K-fold redundant attention/MLP work
-        caches = self.init_cache(B, max_len)
-        logits, caches = self(input_ids, caches=caches, cache_index=0)
-        caches = jax.tree.map(lambda c: jnp.repeat(c, K, axis=0), caches)
-        logp = jax.nn.log_softmax(
-            logits[:, -1, :].astype(jnp.float32), axis=-1)
-        logp = jnp.repeat(logp, K, axis=0)               # (B*K, V)
-        V = logp.shape[-1]
-
-        def select_and_reorder(scores_kv, caches, bufs):
-            """scores_kv: (B, K, V) candidate scores → top-K beams."""
-            flat = scores_kv.reshape(B, K * V)
-            top_scores, top_idx = jax.lax.top_k(flat, K)  # (B, K)
-            beam_idx = top_idx // V
-            tok = (top_idx % V).astype(input_ids.dtype)
-            gather = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
-            caches = jax.tree.map(lambda c: c[gather], caches)
-            bufs = [b[jnp.arange(B)[:, None], beam_idx] for b in bufs]
-            return top_scores, tok, caches, bufs, beam_idx
-
-        # first expansion: all K rows hold the same prefix — keep only
-        # beam 0's candidates or every beam would duplicate
-        first = jnp.where(jnp.arange(K)[None, :, None] == 0,
-                          logp.reshape(B, K, V), NEG)
-        tokens_buf = jnp.zeros((B, K, max_new_tokens), input_ids.dtype)
-        finished0 = jnp.zeros((B, K), bool)
-        lengths0 = jnp.ones((B, K), jnp.float32)
-        scores, tok, caches, (tokens_buf,), _ = select_and_reorder(
-            first, caches, [tokens_buf])
-        tokens_buf = tokens_buf.at[:, :, 0].set(tok)
-        if eos_token_id is not None:
-            finished0 = tok == eos_token_id
-
-        def step(carry, i):
-            scores, tok, finished, lengths, caches, tokens_buf = carry
-            logits, caches = self(tok.reshape(B * K, 1), caches=caches,
-                                  cache_index=S + i)
-            logp = jax.nn.log_softmax(
-                logits[:, -1, :].astype(jnp.float32), -1).reshape(B, K, V)
-            if eos_token_id is not None:
-                # finished beams emit only eos at zero cost (frozen score)
-                frozen = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
-                logp = jnp.where(finished[:, :, None], frozen[None, None],
-                                 logp)
-            cand = scores[:, :, None] + logp
-            scores, tok, caches, bufs, beam_idx = select_and_reorder(
-                cand, caches, [tokens_buf, finished.astype(jnp.float32),
-                               lengths])
-            tokens_buf, finished_f, lengths = bufs
-            finished = finished_f > 0.5
-            lengths = jnp.where(finished, lengths, lengths + 1)
-            if eos_token_id is not None:
-                finished = finished | (tok == eos_token_id)
-            tokens_buf = tokens_buf.at[:, :, i + 1].set(tok)
-            return (scores, tok, finished, lengths, caches, tokens_buf), None
-
-        if max_new_tokens > 1:
-            (scores, _, finished, lengths, _, tokens_buf), _ = jax.lax.scan(
-                step, (scores, tok, finished0, lengths0, caches, tokens_buf),
-                jnp.arange(max_new_tokens - 1))
-        else:
-            lengths = lengths0
-
-        if length_penalty:
-            final = scores / (lengths ** length_penalty)
-        else:
-            final = scores
-        best = jnp.argmax(final, axis=-1)                # (B,)
-        seq = tokens_buf[jnp.arange(B), best]            # (B, max_new)
-        return jnp.concatenate([input_ids, seq], axis=1)
-
-    def _generate_sample(self, input_ids, max_new_tokens=32, temperature=0.0,
-                         top_k=0, top_p=1.0, rng_key=None, eos_token_id=None):
-        """Greedy / sampled decode with a preallocated KV-cache.
-
-        Functional loop (`lax.while_loop`-shaped via scan): prefill once,
-        then one-token steps; static shapes throughout so the whole decode
-        compiles to a single XLA program.
-        """
-        B, S = input_ids.shape
-        max_len = S + max_new_tokens
-        caches = self.init_cache(B, max_len)
-        if rng_key is None:
-            rng_key = jax.random.PRNGKey(0)
-
-        # prefill
-        logits, caches = self(input_ids, caches=caches, cache_index=0)
-        last_logits = logits[:, -1, :]
-
-        def sample(logits, key):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(input_ids.dtype)
-            logits = logits / temperature
-            if top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p < 1.0:
-                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-            return jax.random.categorical(key, logits, axis=-1).astype(input_ids.dtype)
-
-        def step(carry, _):
-            last_logits, caches, idx, key = carry
-            key, sub = jax.random.split(key)
-            tok = sample(last_logits, sub)
-            logits, caches = self(tok[:, None], caches=caches, cache_index=idx)
-            return (logits[:, -1, :], caches, idx + 1, key), tok
-
-        (_, _, _, _), tokens = jax.lax.scan(
-            step, (last_logits, caches, jnp.asarray(S, jnp.int32), rng_key),
-            None, length=max_new_tokens,
-        )
-        return jnp.concatenate([input_ids, tokens.T], axis=1)
 
 
 # ---------------------------------------------------------------------------

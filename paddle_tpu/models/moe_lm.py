@@ -15,6 +15,7 @@ from .. import nn
 from ..distributed.moe import MoELayer
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
+from .generation import GenerationMixin
 from .llama import LlamaAttention, LlamaConfig
 
 
@@ -79,14 +80,18 @@ class MoEDecoderLayer(Layer):
             dispatch_mode=config.dispatch_mode,
         )
 
-    def forward(self, x, positions):
-        attn_out, _ = self.self_attn(self.input_layernorm(x), positions)
+    def forward(self, x, positions, cache=None, cache_index=None):
+        attn_out, new_cache = self.self_attn(
+            self.input_layernorm(x), positions, None, cache, cache_index)
         x = x + attn_out
-        moe_out, aux = self.moe(self.post_attention_layernorm(x))
-        return x + moe_out, aux
+        # cached decode routes dropless: dense capacity computed from a
+        # single-token call would drop colliding tokens
+        moe_out, aux = self.moe(self.post_attention_layernorm(x),
+                                dropless=cache is not None)
+        return x + moe_out, aux, new_cache
 
 
-class MoEForCausalLM(Layer):
+class MoEForCausalLM(GenerationMixin, Layer):
     # vocab table is gathered, not matmul'd — exempt from weight-only PTQ
     no_quantize = ('embed_tokens',)
 
@@ -102,17 +107,30 @@ class MoEForCausalLM(Layer):
         self.lm_head = Parameter(
             init((config.hidden_size, config.vocab_size), 'float32'))
 
-    def forward(self, input_ids):
-        """Returns (logits, total_aux_loss)."""
+    def forward(self, input_ids, caches=None, cache_index=None):
+        """Returns (logits, total_aux_loss), or (logits, new_caches) when
+        called with a KV-cache (the GenerationMixin cached-call
+        contract, same as LlamaForCausalLM)."""
         B, S = input_ids.shape
-        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S)).astype(jnp.int32)
+        base = 0 if cache_index is None else cache_index
+        positions = jnp.broadcast_to(
+            base + jnp.arange(S)[None].astype(jnp.int32), (B, S))
         x = self.embed_tokens[input_ids]
         aux_total = jnp.zeros((), jnp.float32)
-        for layer in self.layers:
-            x, aux = layer(x, positions)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            x, aux, nc = layer(x, positions, cache, cache_index)
             aux_total = aux_total + aux
+            if new_caches is not None:
+                new_caches.append(nc)
         logits = self.norm(x) @ self.lm_head
+        if caches is not None:
+            return logits, new_caches
         return logits, aux_total
+
+    def cache_dtype(self):
+        return self.embed_tokens.dtype
 
     def loss(self, input_ids, labels=None):
         if labels is None:

@@ -264,6 +264,23 @@ def test_mixtral_logits_match_transformers():
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
+@e2e
+def test_mixtral_generate_matches_transformers_greedy():
+    """MoE KV-cached decode (GenerationMixin over the cached-call
+    contract) must reproduce HF's greedy continuation token-for-token."""
+    from paddle_tpu.models.convert import from_hf_mixtral, hf_mixtral_config
+
+    hf = _tiny_hf_mixtral()
+    model = from_hf_mixtral(hf.state_dict(), hf_mixtral_config(hf.config))
+    ids = np.random.default_rng(4).integers(3, 96, (2, 8))
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=10,
+                           do_sample=False).numpy()
+    got = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
+                                    max_new_tokens=10))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_mixtral_unsupported_configs_rejected():
     from paddle_tpu.models.convert import hf_mixtral_config
 
