@@ -28,8 +28,10 @@ class GenerationMixin:
         head_dim = getattr(cfg, 'head_dim', None)
         if head_dim is None:
             head_dim = cfg.hidden_size // cfg.num_attention_heads
+        kv_heads = (getattr(cfg, 'num_key_value_heads', None)
+                    or cfg.num_attention_heads)
         dtype = dtype or self.cache_dtype()
-        shape = (batch_size, max_len, cfg.num_key_value_heads, head_dim)
+        shape = (batch_size, max_len, kv_heads, head_dim)
         return [
             (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.num_hidden_layers)
@@ -38,16 +40,27 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,
                  length_penalty=0.0):
-        if num_beams > 1:
-            if temperature != 0.0 or top_k != 0 or top_p != 1.0:
-                raise ValueError(
-                    'beam search is deterministic: temperature/top_k/top_p '
-                    'are not supported with num_beams > 1')
-            return self.beam_search(input_ids, max_new_tokens, num_beams,
-                                    eos_token_id=eos_token_id,
-                                    length_penalty=length_penalty)
-        return self._generate_sample(input_ids, max_new_tokens, temperature,
-                                     top_k, top_p, rng_key, eos_token_id)
+        # decode always runs in eval mode: dropout inside the scan would
+        # corrupt greedy decoding and make beam scores non-deterministic
+        # (the mode flag is static layer state, restored on exit)
+        was_training = bool(getattr(self, 'training', False))
+        if was_training:
+            self.eval()
+        try:
+            if num_beams > 1:
+                if temperature != 0.0 or top_k != 0 or top_p != 1.0:
+                    raise ValueError(
+                        'beam search is deterministic: temperature/top_k/'
+                        'top_p are not supported with num_beams > 1')
+                return self.beam_search(input_ids, max_new_tokens, num_beams,
+                                        eos_token_id=eos_token_id,
+                                        length_penalty=length_penalty)
+            return self._generate_sample(input_ids, max_new_tokens,
+                                         temperature, top_k, top_p, rng_key,
+                                         eos_token_id)
+        finally:
+            if was_training:
+                self.train()
 
     def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
                     eos_token_id=None, length_penalty=0.0):

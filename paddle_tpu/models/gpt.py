@@ -14,6 +14,8 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
+from .generation import GenerationMixin
+from .llama import cached_attention
 
 
 @dataclasses.dataclass
@@ -54,14 +56,21 @@ class GPTAttention(Layer):
         self.out_proj = Parameter(init((h, h), 'float32'), spec=P('tp', None))
         self.out_bias = Parameter(jnp.zeros((h,)))
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cache_index=None):
+        """cache: optional (k, v) of (B, max_len, H, D) — same cached-call
+        contract as LlamaAttention (ref llama.py), incl. the fused pallas
+        decode kernel on single-token steps."""
         B, S, H = x.shape
         qkv = x @ self.qkv + self.qkv_bias
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, S, self.num_heads, self.head_dim)
-        out = F.scaled_dot_product_attention(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape), is_causal=True)
-        return out.reshape(B, S, H) @ self.out_proj + self.out_bias
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        if cache is None:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            new_cache = None
+        else:
+            out, new_cache = cached_attention(q, k, v, cache, cache_index)
+        return out.reshape(B, S, H) @ self.out_proj + self.out_bias, new_cache
 
 
 class GPTBlock(Layer):
@@ -81,12 +90,13 @@ class GPTBlock(Layer):
         self.fc_out_bias = Parameter(jnp.zeros((h,)))
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x):
-        x = x + self.attn(self.ln_1(x))
+    def forward(self, x, cache=None, cache_index=None):
+        attn_out, new_cache = self.attn(self.ln_1(x), cache, cache_index)
+        x = x + attn_out
         # gelu_new (tanh approximation) — GPT-2's canonical activation
         h = F.gelu(self.ln_2(x) @ self.fc_in + self.fc_in_bias,
                    approximate=True)
-        return x + self.dropout(h @ self.fc_out + self.fc_out_bias)
+        return x + self.dropout(h @ self.fc_out + self.fc_out_bias), new_cache
 
 
 class GPTModel(Layer):
@@ -108,16 +118,26 @@ class GPTModel(Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, cache_index=None):
         B, S = input_ids.shape
-        pos = jnp.arange(S)[None, :]
+        if cache_index is None and S > self.config.max_position_embeddings:
+            raise ValueError(
+                f'sequence length {S} exceeds the learned position table '
+                f'(max_position_embeddings='
+                f'{self.config.max_position_embeddings})')
+        base = 0 if cache_index is None else cache_index
+        pos = base + jnp.arange(S)[None, :]
         x = self.drop(self.wte[input_ids] + self.wpe[pos])
-        for block in self.h:
-            x = block(x)
-        return self.ln_f(x)
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            cache = caches[i] if caches is not None else None
+            x, nc = block(x, cache, cache_index)
+            if new_caches is not None:
+                new_caches.append(nc)
+        return self.ln_f(x), new_caches
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -130,11 +150,28 @@ class GPTForCausalLM(Layer):
                 init((config.hidden_size, config.vocab_size), 'float32'),
                 spec=P(None, 'tp'))
 
-    def forward(self, input_ids):
-        hidden = self.transformer(input_ids)
+    def cache_dtype(self):
+        return self.transformer.wte.dtype
+
+    def init_cache(self, batch_size, max_len, dtype=None):
+        limit = self.config.max_position_embeddings
+        if max_len > limit:
+            raise ValueError(
+                f'prompt + max_new_tokens = {max_len} exceeds the learned '
+                f'position table (max_position_embeddings={limit}); the '
+                f'gather would silently clamp to the last row. Unlike '
+                f'RoPE models, GPT cannot extrapolate positions.')
+        return super().init_cache(batch_size, max_len, dtype)
+
+    def forward(self, input_ids, caches=None, cache_index=None):
+        hidden, new_caches = self.transformer(input_ids, caches, cache_index)
         if self.lm_head is None:
-            return hidden @ self.transformer.wte.T
-        return hidden @ self.lm_head
+            logits = hidden @ self.transformer.wte.T
+        else:
+            logits = hidden @ self.lm_head
+        if caches is not None:
+            return logits, new_caches
+        return logits
 
     def loss(self, input_ids, labels=None):
         if labels is None:

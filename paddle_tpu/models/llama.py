@@ -101,6 +101,42 @@ def apply_rotary(x, cos, sin):
 # Blocks
 # ---------------------------------------------------------------------------
 
+def cached_attention(q, k, v, cache, cache_index):
+    """Shared KV-cached attention step (LlamaAttention, GPTAttention):
+    write the S new rows at cache_index, attend over the full cache
+    masked by position; single-token steps dispatch to the fused pallas
+    decode kernel. Returns (out (B, S, H, D), (ck, cv))."""
+    B, S, H, D = q.shape
+    ck, cv = cache
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, cache_index, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, cache_index, 0, 0))
+    max_len = ck.shape[1]
+    out = None
+    if S == 1 and D % 8 == 0:
+        from ..ops import use_pallas
+
+        if use_pallas():
+            # fused single-token decode: one streaming pass over the
+            # cache (ops/pallas/decode_attention.py)
+            try:
+                from ..ops.pallas.decode_attention import decode_attention
+
+                out = decode_attention(q, ck, cv, cache_index + 1)
+            except Exception as e:
+                from ..ops import pallas_failed
+
+                pallas_failed('decode_attention', e)
+    if out is None:
+        # valid keys: position <= current query position
+        kpos = jnp.arange(max_len)
+        qpos = cache_index + jnp.arange(S)
+        mask = (kpos[None, :] <= qpos[:, None])[None, None]
+        out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)
+    return out, (ck, cv)
+
+
 class LlamaAttention(Layer):
     """GQA attention with RoPE. Column-parallel QKV, row-parallel output."""
 
@@ -180,35 +216,7 @@ class LlamaAttention(Layer):
                     q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
             new_cache = None
         else:
-            ck, cv = cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
-            max_len = ck.shape[1]
-            out = None
-            if S == 1 and self.head_dim % 8 == 0:
-                from ..ops import use_pallas
-
-                if use_pallas():
-                    # fused single-token decode: one streaming pass over
-                    # the cache (ops/pallas/decode_attention.py)
-                    try:
-                        from ..ops.pallas.decode_attention import (
-                            decode_attention)
-
-                        out = decode_attention(q, ck, cv, cache_index + 1)
-                    except Exception as e:
-                        from ..ops import pallas_failed
-
-                        pallas_failed('decode_attention', e)
-            if out is None:
-                # valid keys: position <= current query position
-                kpos = jnp.arange(max_len)
-                qpos = cache_index + jnp.arange(S)
-                mask = kpos[None, :] <= qpos[:, None]      # (S, max_len)
-                mask = mask[None, None, :, :]              # (B, H, S, max_len)
-                out = F.scaled_dot_product_attention(q, ck, cv,
-                                                     attn_mask=mask)
-            new_cache = (ck, cv)
+            out, new_cache = cached_attention(q, k, v, cache, cache_index)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache

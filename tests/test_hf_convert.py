@@ -305,3 +305,24 @@ def test_mixtral_unconverted_weights_raise():
     sd['model.layers.0.block_sparse_moe.surprise.weight'] = torch.zeros(2)
     with pytest.raises(ValueError, match='unconverted'):
         from_hf_mixtral(sd, hf_mixtral_config(hf.config))
+
+
+@e2e
+def test_gpt2_generate_matches_transformers_greedy():
+    """GPT's new KV-cached decode (GenerationMixin) must reproduce HF's
+    greedy continuation token-for-token."""
+    from paddle_tpu.models.convert import from_hf_gpt2, hf_gpt2_config
+
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(1)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    model = from_hf_gpt2(hf.state_dict(), hf_gpt2_config(cfg))
+    ids = np.random.default_rng(2).integers(3, 96, (2, 7))
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    got = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
+                                    max_new_tokens=8))
+    np.testing.assert_array_equal(got, want)

@@ -97,6 +97,44 @@ class TestGPT:
             model, state, loss = step(model, state, batch)
         assert float(loss) < float(l0)
 
+    def test_generate_kv_cached_matches_full_forward(self):
+        pt.seed(8)
+        model = GPTForCausalLM(gpt2_tiny(vocab_size=128, hidden_size=32,
+                                         num_hidden_layers=2,
+                                         num_attention_heads=2,
+                                         intermediate_size=64))
+        ids = _ids((2, 5), vocab=128)
+        out = model.generate(ids, max_new_tokens=4)
+        cur = ids
+        for _ in range(4):
+            logits = model(cur)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+    def test_generate_forces_eval_and_restores_mode(self):
+        """Dropout must not fire inside the decode scan; the training
+        flag is restored afterwards."""
+        pt.seed(9)
+        model = GPTForCausalLM(gpt2_tiny(dropout=0.3))
+        assert model.training
+        ids = _ids((2, 6))
+        a = model.generate(ids, max_new_tokens=5)
+        b = model.generate(ids, max_new_tokens=5)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert model.training                  # mode restored
+
+    def test_generate_past_position_table_raises(self):
+        """GPT cannot extrapolate its learned wpe table — refuse instead
+        of silently clamping the gather."""
+        pt.seed(10)
+        model = GPTForCausalLM(gpt2_tiny())   # max_position_embeddings=128
+        ids = _ids((1, 6))
+        with pytest.raises(ValueError, match='position table'):
+            model.generate(ids, max_new_tokens=125)
+        with pytest.raises(ValueError, match='position table'):
+            model(_ids((1, 130)))
+
     def test_tied_embeddings(self):
         cfg = gpt2_tiny(tie_word_embeddings=True)
         model = GPTForCausalLM(cfg)
