@@ -106,6 +106,10 @@ def from_hf_llama(state_dict, config, dtype=None):
         for w in ('q_proj', 'k_proj', 'v_proj', 'o_proj'):
             assign(attn, w, sd.pop(p + f'self_attn.{w}.weight'),
                    transpose=True)
+        if config.attention_bias:          # Qwen2-style qkv biases
+            for w in ('q', 'k', 'v'):
+                assign(attn, f'{w}_bias',
+                       sd.pop(p + f'self_attn.{w}_proj.bias'))
         mlp = layer.mlp
         for w in ('gate_proj', 'up_proj', 'down_proj'):
             assign(mlp, w, sd.pop(p + f'mlp.{w}.weight'), transpose=True)
@@ -420,3 +424,51 @@ def from_hf_mixtral_pretrained(model_or_path, dtype=None):
         model_or_path = HFMixtral.from_pretrained(model_or_path)
     cfg = hf_mixtral_config(model_or_path.config)
     return from_hf_mixtral(model_or_path.state_dict(), cfg, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Qwen2 (Llama architecture + qkv biases, mirrors the Llama converter)
+# ---------------------------------------------------------------------------
+
+
+def hf_qwen2_config(hf_config) -> LlamaConfig:
+    """Map a transformers Qwen2Config onto LlamaConfig: identical
+    architecture (RMSNorm/RoPE/SwiGLU/GQA) plus qkv biases
+    (`attention_bias=True`). Reuses the Llama mapping — including its
+    rope_scaling / hidden_act guards — then overrides the defaults that
+    differ and the sliding-window refusal."""
+    import dataclasses
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get('use_sliding_window', False):
+        raise ValueError(
+            'use_sliding_window=True unsupported: attention here is '
+            'full-causal — converting would give silently wrong logits '
+            'past the window')
+    cfg = hf_llama_config(hf_config)
+    return dataclasses.replace(
+        cfg,
+        max_position_embeddings=get('max_position_embeddings', 32768),
+        rms_norm_eps=get('rms_norm_eps', 1e-6),
+        rope_theta=get('rope_theta', 1e6),
+        attention_bias=True,
+    )
+
+
+def from_hf_qwen2(state_dict, config, dtype=None):
+    """Build a LlamaForCausalLM from a HuggingFace Qwen2 state dict —
+    the Llama mapping pops the per-projection qkv bias vectors when
+    `config.attention_bias` is set, so this is a thin alias."""
+    return from_hf_llama(state_dict, config, dtype=dtype)
+
+
+def from_hf_qwen2_pretrained(model_or_path, dtype=None):
+    """Accept a transformers Qwen2ForCausalLM (or local path) and
+    convert it."""
+    if isinstance(model_or_path, str):
+        from transformers import Qwen2ForCausalLM as HFQwen2
+
+        model_or_path = HFQwen2.from_pretrained(model_or_path)
+    cfg = hf_qwen2_config(model_or_path.config)
+    return from_hf_qwen2(model_or_path.state_dict(), cfg, dtype=dtype)

@@ -46,6 +46,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    attention_bias: bool = False           # qkv biases (Qwen2-style)
     initializer_range: float = 0.02
     dtype: str = 'float32'                 # param dtype; compute follows
     remat: bool = False                    # jax.checkpoint each decoder layer
@@ -158,6 +159,13 @@ class LlamaAttention(Layer):
         self.k_proj = Parameter(init((h, self.num_kv_heads * d), config.dtype), spec=P(None, 'tp'))
         self.v_proj = Parameter(init((h, self.num_kv_heads * d), config.dtype), spec=P(None, 'tp'))
         self.o_proj = Parameter(init((self.num_heads * d, h), config.dtype), spec=P('tp', None))
+        if config.attention_bias:          # Qwen2-style qkv biases
+            zeros = lambda n: jnp.zeros((n,), jnp.dtype(config.dtype))
+            self.q_bias = Parameter(zeros(self.num_heads * d), spec=P('tp'))
+            self.k_bias = Parameter(zeros(self.num_kv_heads * d), spec=P('tp'))
+            self.v_bias = Parameter(zeros(self.num_kv_heads * d), spec=P('tp'))
+        else:
+            self.q_bias = self.k_bias = self.v_bias = None
 
     def forward(self, x, positions, attn_mask=None, cache=None, cache_index=None):
         """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
@@ -166,9 +174,12 @@ class LlamaAttention(Layer):
         cache_index and attends over the full cache (masked by position).
         """
         B, S, _ = x.shape
-        q = (x @ self.q_proj).reshape(B, S, self.num_heads, self.head_dim)
-        k = (x @ self.k_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
-        v = (x @ self.v_proj).reshape(B, S, self.num_kv_heads, self.head_dim)
+        q, k, v = x @ self.q_proj, x @ self.k_proj, x @ self.v_proj
+        if self.q_bias is not None:
+            q, k, v = q + self.q_bias, k + self.k_bias, v + self.v_bias
+        q = q.reshape(B, S, self.num_heads, self.head_dim)
+        k = k.reshape(B, S, self.num_kv_heads, self.head_dim)
+        v = v.reshape(B, S, self.num_kv_heads, self.head_dim)
 
         cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
         q = apply_rotary(q, cos, sin)

@@ -326,3 +326,57 @@ def test_gpt2_generate_matches_transformers_greedy():
     got = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
                                     max_new_tokens=8))
     np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# Qwen2 → LlamaForCausalLM (attention_bias)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_hf_qwen2(tie=False):
+    cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=tie, attn_implementation='eager')
+    torch.manual_seed(8)
+    return transformers.Qwen2ForCausalLM(cfg).eval()
+
+
+@e2e
+@pytest.mark.parametrize('tie', [False, True])
+def test_qwen2_logits_and_generation_match_transformers(tie):
+    """Qwen2 = Llama + qkv biases (attention_bias): converted logits and
+    greedy continuations must reproduce transformers'."""
+    from paddle_tpu.models.convert import from_hf_qwen2, hf_qwen2_config
+
+    hf = _tiny_hf_qwen2(tie)
+    model = from_hf_qwen2(hf.state_dict(), hf_qwen2_config(hf.config))
+    assert model.config.attention_bias
+    ids = np.random.default_rng(6).integers(3, 96, (2, 9))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    with torch.no_grad():
+        wg = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                         do_sample=False).numpy()
+    gg = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8))
+    np.testing.assert_array_equal(gg, wg)
+
+
+def test_qwen2_unsupported_configs_rejected():
+    from paddle_tpu.models.convert import hf_qwen2_config
+
+    base = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=1, num_attention_heads=4)
+    with pytest.raises(ValueError, match='sliding_window'):
+        hf_qwen2_config({**base, 'use_sliding_window': True})
+    with pytest.raises(ValueError, match='hidden_act'):
+        hf_qwen2_config({**base, 'hidden_act': 'gelu'})
+    # long-context Qwen2.5 checkpoints ship yarn scaling — refuse (the
+    # guard is inherited from the Llama mapping)
+    with pytest.raises(ValueError, match='rope_scaling'):
+        hf_qwen2_config({**base, 'rope_scaling': {'rope_type': 'yarn',
+                                                  'factor': 4.0}})
