@@ -61,11 +61,23 @@ def hf_llama_config(hf_config) -> LlamaConfig:
     if scaling and (not isinstance(scaling, dict)
                     or scaling.get('rope_type', scaling.get('type',
                                                             'default'))
-                    not in (None, 'default')):
+                    not in (None, 'default', 'llama3')):
         raise ValueError(
             f'rope_scaling={scaling!r} is not supported by this converter '
-            f'(plain rope_theta RoPE only) — converting would produce '
-            f'silently wrong logits at long positions')
+            f"(plain rope_theta RoPE or rope_type='llama3' only) — "
+            f'converting would produce silently wrong logits at long '
+            f'positions')
+    if scaling and scaling.get('rope_type',
+                               scaling.get('type')) == 'llama3':
+        missing = [k for k in ('factor', 'low_freq_factor',
+                               'high_freq_factor',
+                               'original_max_position_embeddings')
+                   if k not in scaling]
+        if missing:
+            raise ValueError(
+                f"rope_scaling rope_type='llama3' is missing required "
+                f'keys {missing} — refusing rather than guessing '
+                f'defaults transformers would reject')
     act = get('hidden_act', 'silu')
     if act not in ('silu', 'swish'):
         raise ValueError(
@@ -81,6 +93,7 @@ def hf_llama_config(hf_config) -> LlamaConfig:
         max_position_embeddings=get('max_position_embeddings', 4096),
         rms_norm_eps=get('rms_norm_eps', 1e-5),
         rope_theta=get('rope_theta', 10000.0),
+        rope_scaling=dict(scaling) if scaling else None,
         tie_word_embeddings=bool(get('tie_word_embeddings', False)),
     )
 

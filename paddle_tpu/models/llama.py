@@ -45,6 +45,9 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # optional dict, e.g. {'rope_type': 'llama3', 'factor': 8.0, ...}
+    # (Llama-3.x frequency rescale); None = plain RoPE
+    rope_scaling: typing.Optional[dict] = None
     tie_word_embeddings: bool = False
     attention_bias: bool = False           # qkv biases (Qwen2-style)
     initializer_range: float = 0.02
@@ -79,9 +82,36 @@ def llama_tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, kv_heads=2,
 # Rotary position embedding
 # ---------------------------------------------------------------------------
 
-def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32):
-    """cos/sin tables for the given integer positions, shape (..., head_dim//2)."""
+def _llama3_scaled_inv_freq(inv_freq, scaling):
+    """Llama-3.x rope scaling (ref: transformers
+    modeling_rope_utils._compute_llama3_parameters): long wavelengths
+    are slowed by `factor`, short ones kept, with a smooth ramp between
+    the low/high frequency cutoffs."""
+    factor = scaling['factor']
+    low = scaling.get('low_freq_factor', 1.0)
+    high = scaling.get('high_freq_factor', 4.0)
+    orig = scaling.get('original_max_position_embeddings', 8192)
+    wavelen = 2.0 * math.pi / inv_freq
+    smooth = (orig / wavelen - low) / (high - low)
+    interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(wavelen < orig / high, inv_freq,
+                     jnp.where(wavelen > orig / low, inv_freq / factor,
+                               interp))
+
+
+def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32,
+                 rope_scaling=None):
+    """cos/sin tables for the given integer positions, shape (..., head_dim//2).
+
+    rope_scaling: optional dict; rope_type 'llama3' applies the Llama-3.x
+    frequency rescale (other types are rejected at config time)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    if rope_scaling:
+        rt = rope_scaling.get('rope_type', rope_scaling.get('type'))
+        if rt == 'llama3':
+            inv_freq = _llama3_scaled_inv_freq(inv_freq, rope_scaling)
+        elif rt not in (None, 'default'):
+            raise ValueError(f'unsupported rope_scaling type {rt!r}')
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., D/2)
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
@@ -147,6 +177,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = config.head_dim
         self.rope_theta = config.rope_theta
+        self.rope_scaling = config.rope_scaling
         self.sequence_parallel = config.sequence_parallel
         if config.sp_mode not in ('ring', 'ulysses'):
             raise ValueError(
@@ -181,7 +212,8 @@ class LlamaAttention(Layer):
         k = k.reshape(B, S, self.num_kv_heads, self.head_dim)
         v = v.reshape(B, S, self.num_kv_heads, self.head_dim)
 
-        cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
+        cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta,
+                                rope_scaling=self.rope_scaling)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 

@@ -99,6 +99,14 @@ def test_rope_scaling_rejected():
         hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
                          'intermediate_size': 64, 'num_hidden_layers': 1,
                          'num_attention_heads': 2,
+                         'rope_scaling': {'rope_type': 'yarn',
+                                          'factor': 8.0}})
+    # llama3 scaling with missing keys: refuse at convert time, not at
+    # first forward (or silently diverging defaults)
+    with pytest.raises(ValueError, match='missing required'):
+        hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
+                         'intermediate_size': 64, 'num_hidden_layers': 1,
+                         'num_attention_heads': 2,
                          'rope_scaling': {'rope_type': 'llama3',
                                           'factor': 8.0}})
     with pytest.raises(ValueError, match='hidden_act'):
@@ -380,3 +388,33 @@ def test_qwen2_unsupported_configs_rejected():
     with pytest.raises(ValueError, match='rope_scaling'):
         hf_qwen2_config({**base, 'rope_scaling': {'rope_type': 'yarn',
                                                   'factor': 4.0}})
+
+
+@e2e
+def test_llama3_rope_scaling_matches_transformers():
+    """rope_type='llama3' (Llama-3.x checkpoints) applies the frequency
+    rescale: logits and greedy continuations must match transformers at
+    positions well past original_max_position_embeddings."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation='eager',
+        rope_scaling={'rope_type': 'llama3', 'factor': 8.0,
+                      'low_freq_factor': 1.0, 'high_freq_factor': 4.0,
+                      'original_max_position_embeddings': 32})
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model = from_hf_llama(hf.state_dict(), hf_llama_config(cfg))
+    assert model.config.rope_scaling['rope_type'] == 'llama3'
+    ids = np.random.default_rng(0).integers(3, 96, (2, 120))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    with torch.no_grad():
+        wg = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                         do_sample=False).numpy()
+    gg = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=6))
+    np.testing.assert_array_equal(gg, wg)
