@@ -39,7 +39,23 @@ class GenerationMixin:
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,
-                 length_penalty=0.0):
+                 length_penalty=0.0, attention_mask=None):
+        """attention_mask (B, S) 0/1 supports LEFT-padded batches of
+        unequal-length prompts (HF decoder-only convention): positions
+        are counted from each row's first real token and pad rows never
+        receive attention. Requires the model's cached forward to accept
+        `positions`/`kvalid` (the Llama family does)."""
+        if attention_mask is not None:
+            import inspect
+
+            params = inspect.signature(self.forward).parameters
+            if 'kvalid' not in params:
+                raise NotImplementedError(
+                    f'{type(self).__name__} does not support attention_mask '
+                    f'generation (cached forward lacks positions/kvalid)')
+            if num_beams > 1:
+                raise NotImplementedError(
+                    'attention_mask + beam search is not supported yet')
         # decode always runs in eval mode: dropout inside the scan would
         # corrupt greedy decoding and make beam scores non-deterministic
         # (the mode flag is static layer state, restored on exit)
@@ -57,7 +73,7 @@ class GenerationMixin:
                                         length_penalty=length_penalty)
             return self._generate_sample(input_ids, max_new_tokens,
                                          temperature, top_k, top_p, rng_key,
-                                         eos_token_id)
+                                         eos_token_id, attention_mask)
         finally:
             if was_training:
                 self.train()
@@ -152,12 +168,15 @@ class GenerationMixin:
         return jnp.concatenate([input_ids, seq], axis=1)
 
     def _generate_sample(self, input_ids, max_new_tokens=32, temperature=0.0,
-                         top_k=0, top_p=1.0, rng_key=None, eos_token_id=None):
+                         top_k=0, top_p=1.0, rng_key=None, eos_token_id=None,
+                         attention_mask=None):
         """Greedy / sampled decode with a preallocated KV-cache.
 
         Functional loop (`lax.while_loop`-shaped via scan): prefill once,
         then one-token steps; static shapes throughout so the whole decode
-        compiles to a single XLA program.
+        compiles to a single XLA program. With `attention_mask`, prompts
+        are LEFT-padded: per-row positions count real tokens only and
+        pad cache rows stay invalid for every later step.
         """
         B, S = input_ids.shape
         max_len = S + max_new_tokens
@@ -165,8 +184,29 @@ class GenerationMixin:
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
 
+        if attention_mask is not None and not isinstance(
+                attention_mask, jax.core.Tracer):
+            # HF tokenizers hand back an all-ones mask for equal-length
+            # batches; treating it as no mask keeps the fused pallas
+            # decode kernel in play (an all-ones kvalid is a no-op)
+            import numpy as _np
+
+            if bool(_np.asarray(attention_mask).all()):
+                attention_mask = None
+        if attention_mask is not None:
+            am = jnp.asarray(attention_mask, jnp.int32)
+            # pad rows clip to position 0; they are masked out anyway
+            prompt_pos = jnp.maximum(jnp.cumsum(am, axis=1) - 1, 0)
+            real_len = am.sum(axis=1).astype(jnp.int32)       # (B,)
+            kvalid = jnp.concatenate(
+                [am, jnp.ones((B, max_new_tokens), jnp.int32)], axis=1)
+            extra = dict(positions=prompt_pos, kvalid=kvalid)
+        else:
+            extra = {}
+
         # prefill
-        logits, caches = self(input_ids, caches=caches, cache_index=0)
+        logits, caches = self(input_ids, caches=caches, cache_index=0,
+                              **extra)
         last_logits = logits[:, -1, :]
 
         def sample(logits, key):
@@ -197,7 +237,15 @@ class GenerationMixin:
                 tok = jnp.where(finished,
                                 jnp.asarray(eos_token_id, tok.dtype), tok)
                 finished = finished | (tok == eos_token_id)
-            logits, caches = self(tok[:, None], caches=caches, cache_index=idx)
+            if attention_mask is not None:
+                # per-row rope position = real tokens so far; buffer
+                # index stays the uniform idx
+                step_extra = dict(
+                    positions=(real_len + (idx - S))[:, None], kvalid=kvalid)
+            else:
+                step_extra = {}
+            logits, caches = self(tok[:, None], caches=caches, cache_index=idx,
+                                  **step_extra)
             return (logits[:, -1, :], caches, idx + 1, key, finished), tok
 
         (_, _, _, _, _), tokens = jax.lax.scan(

@@ -132,11 +132,15 @@ def apply_rotary(x, cos, sin):
 # Blocks
 # ---------------------------------------------------------------------------
 
-def cached_attention(q, k, v, cache, cache_index):
+def cached_attention(q, k, v, cache, cache_index, kvalid=None):
     """Shared KV-cached attention step (LlamaAttention, GPTAttention):
     write the S new rows at cache_index, attend over the full cache
     masked by position; single-token steps dispatch to the fused pallas
-    decode kernel. Returns (out (B, S, H, D), (ck, cv))."""
+    decode kernel. `kvalid` (B, max_len) 0/1 marks cache rows that may
+    be attended at all — left-padded batched generation puts 0 on the
+    pad rows (the fused kernel's contiguous-count validity cannot
+    express holes, so it is bypassed then). Returns
+    (out (B, S, H, D), (ck, cv))."""
     B, S, H, D = q.shape
     ck, cv = cache
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
@@ -145,7 +149,7 @@ def cached_attention(q, k, v, cache, cache_index):
                                       (0, cache_index, 0, 0))
     max_len = ck.shape[1]
     out = None
-    if S == 1 and D % 8 == 0:
+    if S == 1 and D % 8 == 0 and kvalid is None:
         from ..ops import use_pallas
 
         if use_pallas():
@@ -160,10 +164,12 @@ def cached_attention(q, k, v, cache, cache_index):
 
                 pallas_failed('decode_attention', e)
     if out is None:
-        # valid keys: position <= current query position
+        # valid keys: position <= current query position (& kvalid)
         kpos = jnp.arange(max_len)
         qpos = cache_index + jnp.arange(S)
         mask = (kpos[None, :] <= qpos[:, None])[None, None]
+        if kvalid is not None:
+            mask = mask & (kvalid[:, None, None, :] > 0)
         out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)
     return out, (ck, cv)
 
@@ -198,11 +204,13 @@ class LlamaAttention(Layer):
         else:
             self.q_bias = self.k_bias = self.v_bias = None
 
-    def forward(self, x, positions, attn_mask=None, cache=None, cache_index=None):
+    def forward(self, x, positions, attn_mask=None, cache=None,
+                cache_index=None, kvalid=None):
         """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
 
         Returns (out, new_cache). With a cache, writes the S new kv rows at
-        cache_index and attends over the full cache (masked by position).
+        cache_index and attends over the full cache (masked by position;
+        `kvalid` additionally invalidates rows — left-pad support).
         """
         B, S, _ = x.shape
         q, k, v = x @ self.q_proj, x @ self.k_proj, x @ self.v_proj
@@ -218,6 +226,16 @@ class LlamaAttention(Layer):
         k = apply_rotary(k, cos, sin)
 
         if cache is None:
+            if kvalid is not None:
+                # honor pad-invalidation on the uncached path too: fold
+                # it into an explicit causal+kvalid mask (silently
+                # ignoring it would let real tokens attend to pads)
+                causal = (jnp.arange(S)[None, :]
+                          <= jnp.arange(S)[:, None])[None, None]
+                kv = (kvalid[:, :S] > 0)[:, None, None, :]
+                extra_mask = causal & kv
+                attn_mask = (extra_mask if attn_mask is None
+                             else attn_mask & extra_mask)
             out = None
             if self.sequence_parallel and attn_mask is None:
                 from ..distributed.mesh import get_mesh
@@ -259,7 +277,8 @@ class LlamaAttention(Layer):
                     q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
             new_cache = None
         else:
-            out, new_cache = cached_attention(q, k, v, cache, cache_index)
+            out, new_cache = cached_attention(q, k, v, cache, cache_index,
+                                              kvalid=kvalid)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache
@@ -288,9 +307,11 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, positions, attn_mask=None, cache=None, cache_index=None):
+    def forward(self, x, positions, attn_mask=None, cache=None,
+                cache_index=None, kvalid=None):
         attn_out, new_cache = self.self_attn(
-            self.input_layernorm(x), positions, attn_mask, cache, cache_index
+            self.input_layernorm(x), positions, attn_mask, cache,
+            cache_index, kvalid
         )
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
@@ -317,7 +338,7 @@ class LlamaModel(Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None):
+                cache_index=None, kvalid=None):
         B, S = input_ids.shape
         if positions is None:
             base = 0 if cache_index is None else cache_index
@@ -338,12 +359,14 @@ class LlamaModel(Layer):
                 policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                           if self.config.remat_policy == 'dots' else None)
                 x = jax.checkpoint(
-                    lambda lyr, h: lyr(h, positions, attn_mask)[0],
+                    lambda lyr, h: lyr(h, positions, attn_mask,
+                                       kvalid=kvalid)[0],
                     policy=policy,
                 )(layer, x)
                 nc = None
             else:
-                x, nc = layer(x, positions, attn_mask, cache, cache_index)
+                x, nc = layer(x, positions, attn_mask, cache, cache_index,
+                              kvalid)
             if new_caches is not None:
                 new_caches.append(nc)
         return self.norm(x), new_caches
@@ -372,9 +395,9 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         return hidden @ self.lm_head
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None):
+                cache_index=None, kvalid=None):
         hidden, new_caches = self.model(input_ids, positions, attn_mask, caches,
-                                        cache_index)
+                                        cache_index, kvalid)
         logits = self.logits(hidden)
         if caches is None:
             return logits

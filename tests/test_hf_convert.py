@@ -418,3 +418,45 @@ def test_llama3_rope_scaling_matches_transformers():
     gg = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
                                    max_new_tokens=6))
     np.testing.assert_array_equal(gg, wg)
+
+
+@e2e
+def test_left_padded_batch_generation_matches_transformers():
+    """generate(attention_mask=...) with LEFT-padded unequal prompts:
+    token-for-token vs HF, and the padded row must reproduce its own
+    solo-run continuation exactly (padding must not leak into
+    attention or positions)."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation='eager',
+        pad_token_id=2, eos_token_id=2)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model = from_hf_llama(hf.state_dict(), hf_llama_config(cfg))
+    p1 = [5, 9, 23]
+    p2 = [11, 7, 33, 41, 8, 60, 12]
+    ids = np.array([[2, 2, 2, 2] + p1, p2])
+    mask = np.array([[0, 0, 0, 0, 1, 1, 1], [1, 1, 1, 1, 1, 1, 1]])
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids),
+                           attention_mask=torch.tensor(mask),
+                           max_new_tokens=8, do_sample=False).numpy()
+    got = np.asarray(model.generate(jnp.asarray(ids, jnp.int32),
+                                    attention_mask=jnp.asarray(mask,
+                                                               jnp.int32),
+                                    max_new_tokens=8, eos_token_id=2))
+    np.testing.assert_array_equal(got[:, 7:], want[:, 7:])
+    solo = np.asarray(model.generate(jnp.asarray([p1], jnp.int32),
+                                     max_new_tokens=8, eos_token_id=2))
+    np.testing.assert_array_equal(got[0, 7:], solo[0, 3:])
+
+
+def test_attention_mask_unsupported_models_raise():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+
+    m = GPTForCausalLM(gpt2_tiny())
+    with pytest.raises(NotImplementedError, match='attention_mask'):
+        m.generate(jnp.zeros((1, 4), jnp.int32),
+                   attention_mask=jnp.ones((1, 4), jnp.int32))
