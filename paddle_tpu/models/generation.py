@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class GenerationMixin:
@@ -45,6 +46,14 @@ class GenerationMixin:
         are counted from each row's first real token and pad rows never
         receive attention. Requires the model's cached forward to accept
         `positions`/`kvalid` (the Llama family does)."""
+        if attention_mask is not None and not isinstance(
+                attention_mask, jax.core.Tracer):
+            # HF tokenizers hand back an all-ones mask for equal-length
+            # batches; collapsing it to None BEFORE the capability
+            # checks keeps GPT/beam-search usable with standard HF
+            # pipelines and preserves the fused decode kernel
+            if bool(np.asarray(attention_mask).all()):
+                attention_mask = None
         if attention_mask is not None:
             import inspect
 
@@ -184,15 +193,6 @@ class GenerationMixin:
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
 
-        if attention_mask is not None and not isinstance(
-                attention_mask, jax.core.Tracer):
-            # HF tokenizers hand back an all-ones mask for equal-length
-            # batches; treating it as no mask keeps the fused pallas
-            # decode kernel in play (an all-ones kvalid is a no-op)
-            import numpy as _np
-
-            if bool(_np.asarray(attention_mask).all()):
-                attention_mask = None
         if attention_mask is not None:
             am = jnp.asarray(attention_mask, jnp.int32)
             # pad rows clip to position 0; they are masked out anyway
@@ -255,3 +255,112 @@ class GenerationMixin:
             None, length=max_new_tokens,
         )
         return jnp.concatenate([input_ids, tokens.T], axis=1)
+
+
+def generate_speculative(target, draft, input_ids, max_new_tokens=32,
+                         num_draft_tokens=4, eos_token_id=None):
+    """Greedy speculative decoding (ref capability: the reference
+    ecosystem's speculative/draft-model inference).
+
+    LOSSLESS for greedy: emits exactly the tokens `target.generate(...)`
+    would, but the big model runs one forward per accepted window
+    (~(m+1) tokens per dispatch, m = accepted draft prefix) instead of
+    one per token. Both models keep KV caches; rejected draft rows are
+    simply overwritten on the next window (cache writes always start at
+    the committed length, and position masking hides rows beyond it).
+
+    Host-driven loop: the accepted length is data-dependent, so each
+    window syncs once — the win is fewer *target* forwards, which is
+    what dominates when the draft is much smaller. Batch 1 only (rows
+    would commit at different lengths).
+    """
+    B, S = input_ids.shape
+    if B != 1:
+        raise NotImplementedError(
+            'speculative decoding is batch-1 (rows commit at different '
+            'lengths); loop prompts individually')
+    # same eval-mode rule as generate(): dropout would break the
+    # losslessness contract (and differ between draft and verify)
+    restore = []
+    for m_ in (target, draft):
+        if bool(getattr(m_, 'training', False)):
+            m_.eval()
+            restore.append(m_)
+    try:
+        return _speculative_loop(target, draft, input_ids, max_new_tokens,
+                                 num_draft_tokens, eos_token_id)
+    finally:
+        for m_ in restore:
+            m_.train()
+
+
+def _speculative_loop(target, draft, input_ids, max_new_tokens,
+                      num_draft_tokens, eos_token_id):
+    import functools
+
+    B, S = input_ids.shape
+    k = int(num_draft_tokens)
+    if k < 1:
+        raise ValueError('num_draft_tokens must be >= 1')
+    max_len = S + max_new_tokens + k + 1      # room for the last window
+    tcaches = target.init_cache(B, max_len)
+    dcaches = draft.init_cache(B, max_len)
+
+    @jax.jit
+    def prefill(m, caches, ids):
+        logits, caches = m(ids, caches=caches, cache_index=0)
+        return logits[:, -1, :], caches
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def propose(m, caches, c, idx, k):
+        """Draft processes committed token c at buffer idx, then greedily
+        proposes k tokens. Scans k+1 steps (discarding the last output)
+        so the k-th proposal's OWN kv row is written too: on a fully
+        accepted window the committed length passes that row, and a
+        zero-filled hole there would pollute every later proposal."""
+        def body(carry, i):
+            tok, caches = carry
+            logits, caches = m(tok, caches=caches, cache_index=idx + i)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt[:, None], caches), nxt
+        (_, caches), toks = jax.lax.scan(body, (c, caches),
+                                         jnp.arange(k + 1))
+        return toks[:k, 0], caches             # (k,), caches
+
+    @jax.jit
+    def verify(m, caches, window, idx):
+        """Target forward over the whole window [c, d1..dk] at idx:
+        greedy choices at every position in one dispatch."""
+        logits, caches = m(window, caches=caches, cache_index=idx)
+        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), caches
+
+    last_logits, tcaches = prefill(target, tcaches, input_ids)
+    _, dcaches = prefill(draft, dcaches, input_ids)
+    c_host = int(np.asarray(jnp.argmax(last_logits, axis=-1))[0])
+
+    out = []
+    L = S                                      # committed length
+    while len(out) < max_new_tokens:
+        c = jnp.asarray([[c_host]], jnp.int32)
+        drafts, dcaches = propose(draft, dcaches, c, jnp.asarray(L, jnp.int32),
+                                  k)
+        window = jnp.concatenate([c, drafts[None, :]], axis=1)   # (1, k+1)
+        choices, tcaches = verify(target, tcaches, window,
+                                  jnp.asarray(L, jnp.int32))
+        d = np.asarray(drafts)
+        t = np.asarray(choices)                # t[i] = target after window[:i+1]
+        m_acc = 0
+        while m_acc < k and d[m_acc] == int(t[m_acc]):
+            m_acc += 1
+        committed = [c_host] + [int(x) for x in d[:m_acc]]
+        out.extend(committed)
+        if eos_token_id is not None and eos_token_id in committed:
+            # stop at the first eos; generate() freezes to eos after it
+            out = out[:out.index(eos_token_id) + 1]
+            break
+        c_host = int(t[m_acc]) if m_acc < k else int(t[k])
+        L += len(committed)
+    if eos_token_id is not None and len(out) < max_new_tokens:
+        out += [eos_token_id] * (max_new_tokens - len(out))
+    gen = jnp.asarray([out[:max_new_tokens]], input_ids.dtype)
+    return jnp.concatenate([input_ids, gen], axis=1)

@@ -234,8 +234,13 @@ class LlamaAttention(Layer):
                           <= jnp.arange(S)[:, None])[None, None]
                 kv = (kvalid[:, :S] > 0)[:, None, None, :]
                 extra_mask = causal & kv
-                attn_mask = (extra_mask if attn_mask is None
-                             else attn_mask & extra_mask)
+                if attn_mask is None:
+                    attn_mask = extra_mask
+                elif attn_mask.dtype == jnp.bool_:
+                    attn_mask = attn_mask & extra_mask
+                else:                  # additive float mask (see attention.py)
+                    attn_mask = attn_mask + jnp.where(
+                        extra_mask, 0.0, -1e30).astype(attn_mask.dtype)
             out = None
             if self.sequence_parallel and attn_mask is None:
                 from ..distributed.mesh import get_mesh

@@ -157,3 +157,62 @@ class TestBeamSearchDecoder:
         # beams sorted best-first
         lp = np.asarray(states['log_probs'])
         assert (np.diff(lp, axis=1) <= 1e-6).all()
+
+
+class TestSpeculativeDecoding:
+    """generate_speculative must be LOSSLESS: identical tokens to plain
+    greedy generate(), at any draft length, including eos handling."""
+
+    def _models(self):
+        pt.seed(0)
+        target = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                             layers=2))
+        pt.seed(1)
+        draft = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=32,
+                                            layers=1, intermediate_size=64))
+        return target, draft
+
+    @pytest.mark.parametrize('k', [1, 3, 5])
+    def test_lossless_vs_plain_greedy(self, k):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._models()
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(3, 96, (1, 6)), jnp.int32)
+        ref = target.generate(ids, max_new_tokens=16)
+        spec = generate_speculative(target, draft, ids, max_new_tokens=16,
+                                    num_draft_tokens=k)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_self_draft_accepts_everything(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, _ = self._models()
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(3, 96, (1, 5)), jnp.int32)
+        ref = target.generate(ids, max_new_tokens=12)
+        spec = generate_speculative(target, target, ids, max_new_tokens=12,
+                                    num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_eos_matches_plain_generate(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._models()
+        ids = jnp.asarray(
+            np.random.default_rng(2).integers(3, 96, (1, 6)), jnp.int32)
+        ref = target.generate(ids, max_new_tokens=20, eos_token_id=None)
+        # pick the token generate() actually emits mid-stream as "eos"
+        eos = int(np.asarray(ref)[0, 6 + 7])
+        ref_eos = target.generate(ids, max_new_tokens=20, eos_token_id=eos)
+        spec = generate_speculative(target, draft, ids, max_new_tokens=20,
+                                    num_draft_tokens=3, eos_token_id=eos)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref_eos))
+
+    def test_batch_gt1_raises(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._models()
+        with pytest.raises(NotImplementedError, match='batch-1'):
+            generate_speculative(target, draft,
+                                 jnp.zeros((2, 4), jnp.int32))

@@ -457,6 +457,13 @@ def test_attention_mask_unsupported_models_raise():
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
 
     m = GPTForCausalLM(gpt2_tiny())
+    # an ALL-ONES mask is a no-op and must NOT raise (HF tokenizers
+    # always hand one back for equal-length batches)
+    out = m.generate(jnp.ones((1, 4), jnp.int32),
+                     attention_mask=jnp.ones((1, 4), jnp.int32),
+                     max_new_tokens=2)
+    assert out.shape == (1, 6)
+    # a REAL pad mask needs positions/kvalid support, which GPT lacks
     with pytest.raises(NotImplementedError, match='attention_mask'):
-        m.generate(jnp.zeros((1, 4), jnp.int32),
-                   attention_mask=jnp.ones((1, 4), jnp.int32))
+        m.generate(jnp.ones((1, 4), jnp.int32),
+                   attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32))
