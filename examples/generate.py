@@ -40,6 +40,33 @@ def main():
     beam = model.generate(prompt, max_new_tokens=16, num_beams=4)
     print('beam-4 :', np.asarray(beam[0]))
 
+    # unequal-length prompts: LEFT-pad and pass the attention_mask (the
+    # HF decoder-only convention) — pad rows never receive attention and
+    # RoPE positions count real tokens only
+    padded = jnp.concatenate(
+        [jnp.zeros((1, 3), jnp.int32), prompt[:1, :5]], axis=1)
+    batch = jnp.concatenate([padded, prompt[1:2]], axis=0)
+    mask = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1], [1] * 8], jnp.int32)
+    pad_out = model.generate(batch, attention_mask=mask, max_new_tokens=8)
+    print('padded :', np.asarray(pad_out[0, 8:]))
+
+    # lossless speculative decoding: a small draft proposes windows, the
+    # big model verifies each in ONE forward — identical tokens, fewer
+    # target dispatches
+    from paddle_tpu.models.generation import generate_speculative
+
+    pt.seed(1)
+    draft = LlamaForCausalLM(llama_tiny(vocab_size=256, hidden_size=32,
+                                        layers=1, intermediate_size=64)).eval()
+    spec = generate_speculative(model, draft, prompt[:1], max_new_tokens=16,
+                                num_draft_tokens=4)
+    print('specul :', np.asarray(spec[0]))
+    # the lossless contract is vs generate() ON THE SAME batch-1 input
+    # (batch-2 logits can argmax differently on near-ties under XLA's
+    # batch-dependent tiling)
+    solo = model.generate(prompt[:1], max_new_tokens=16)
+    assert bool(jnp.array_equal(spec, solo)), 'speculative != greedy'
+
 
 if __name__ == '__main__':
     main()
