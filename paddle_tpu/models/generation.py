@@ -16,6 +16,18 @@ import numpy as np
 
 
 class GenerationMixin:
+    def quantize_weights(self, bits=8):
+        """Weight-only PTQ for serving: every 2-D trainable projection
+        becomes a pallas-served QuantizedWeight (int8 or packed int4) —
+        decode streams 2x/4x fewer weight bytes from HBM. Per-model
+        exemptions are STRUCTURAL: lookup tables / routers declare
+        `no_quantize` on their layer class (embed_tokens, wte/wpe, MoE
+        gates) and nn.Embedding subtrees are never touched. Returns a
+        new model; the original is untouched."""
+        from ..quantization import quantize_matmul_weights
+
+        return quantize_matmul_weights(self, bits=bits, min_features=1)
+
     def cache_dtype(self):
         """Dtype for the preallocated KV cache — override per model
         (usually the embedding table's dtype)."""

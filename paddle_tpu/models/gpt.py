@@ -150,6 +150,7 @@ class GPTForCausalLM(GenerationMixin, Layer):
                 init((config.hidden_size, config.vocab_size), 'float32'),
                 spec=P(None, 'tp'))
 
+
     def cache_dtype(self):
         return self.transformer.wte.dtype
 

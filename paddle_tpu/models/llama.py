@@ -418,22 +418,6 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         logits = self(input_ids)
         return softmax_cross_entropy(logits, labels).mean()
 
-    def quantize_weights(self, bits=8):
-        """Weight-only PTQ for inference (ref capability: paddle.nn.quant
-        weight_only_linear serving path): returns a NEW model whose
-        projection matrices (q/k/v/o, gate/up/down, lm_head) are
-        `QuantizedWeight`s served by the pallas int8/int4 kernels —
-        decode streams 2x (int8) / 4x (int4) fewer weight bytes from
-        HBM. Embedding stays dense (it is a gather, not a matmul).
-        Single-chip inference: TP shardings are dropped from the
-        quantized attrs. The original model is untouched.
-        """
-        from ..quantization import quantize_matmul_weights
-
-        # min_features=1: ALL projections quantize, including GQA k/v
-        # narrower than the generic default (embed_tokens is exempted
-        # structurally via LlamaModel.no_quantize)
-        return quantize_matmul_weights(self, bits=bits, min_features=1)
 
     # -- generation (loops from GenerationMixin) ---------------------------
     def cache_dtype(self):

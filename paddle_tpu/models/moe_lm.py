@@ -132,6 +132,7 @@ class MoEForCausalLM(GenerationMixin, Layer):
     def cache_dtype(self):
         return self.embed_tokens.dtype
 
+
     def loss(self, input_ids, labels=None):
         if labels is None:
             labels = input_ids[:, 1:]

@@ -262,3 +262,24 @@ class TestQuantizeMatmulWeights:
         out = lin(x)
         rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
         assert rel < 0.03, rel
+
+    def test_quantize_weights_method_on_gpt_and_moe(self):
+        """API symmetry: GPT/MoE expose quantize_weights like the
+        flagship, and the quantized models still decode."""
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        from paddle_tpu.models.moe_lm import MoEForCausalLM, moe_tiny
+        from paddle_tpu.nn.quant import QuantizedWeight
+
+        pt.seed(3)
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(0, 96, (1, 6)), jnp.int32)
+        qg = GPTForCausalLM(gpt2_tiny(vocab_size=96, hidden_size=64,
+                                      num_hidden_layers=1)).quantize_weights()
+        assert isinstance(qg.transformer.h[0].attn.qkv, QuantizedWeight)
+        assert qg.generate(ids, max_new_tokens=3).shape == (1, 9)
+        qm = MoEForCausalLM(moe_tiny(vocab_size=96, hidden_size=64,
+                                     dispatch_mode='ragged')
+                            ).quantize_weights()
+        assert isinstance(qm.lm_head, QuantizedWeight)
+        assert not isinstance(qm.embed_tokens, QuantizedWeight)
+        assert qm.generate(ids, max_new_tokens=3).shape == (1, 9)
