@@ -159,18 +159,23 @@ class TestBeamSearchDecoder:
         assert (np.diff(lp, axis=1) <= 1e-6).all()
 
 
+def _spec_models():
+    """Canonical target/draft pair for the speculative-decoding tests."""
+    pt.seed(0)
+    target = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                         layers=2))
+    pt.seed(1)
+    draft = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=32,
+                                        layers=1, intermediate_size=64))
+    return target, draft
+
+
 class TestSpeculativeDecoding:
     """generate_speculative must be LOSSLESS: identical tokens to plain
     greedy generate(), at any draft length, including eos handling."""
 
     def _models(self):
-        pt.seed(0)
-        target = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
-                                             layers=2))
-        pt.seed(1)
-        draft = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=32,
-                                            layers=1, intermediate_size=64))
-        return target, draft
+        return _spec_models()
 
     @pytest.mark.parametrize('k', [1, 3, 5])
     def test_lossless_vs_plain_greedy(self, k):
@@ -216,3 +221,41 @@ class TestSpeculativeDecoding:
         with pytest.raises(NotImplementedError, match='batch-1'):
             generate_speculative(target, draft,
                                  jnp.zeros((2, 4), jnp.int32))
+
+
+class TestGenerationCompositions:
+    """Real deployments stack the serving features; the combinations
+    must compose."""
+
+    def test_speculative_with_quantized_draft(self):
+        """The natural pairing: int8 draft proposes, bf16 target
+        verifies — still lossless vs the target's own greedy."""
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, _ = _spec_models()
+        draft = target.quantize_weights(bits=8)
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(3, 96, (1, 6)), jnp.int32)
+        ref = target.generate(ids, max_new_tokens=12)
+        spec = generate_speculative(target, draft, ids, max_new_tokens=12,
+                                    num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_quantized_model_with_padded_batch(self):
+        """Weight-only quantization + left-padded attention_mask: the
+        padded row must match the quantized model's solo run."""
+        pt.seed(1)
+        model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64))
+        qm = model.quantize_weights(bits=8)
+        p1 = [5, 9, 23]
+        p2 = [11, 7, 33, 41, 8, 60]
+        ids = jnp.asarray([[0, 0, 0] + p1, p2], jnp.int32)
+        mask = jnp.asarray([[0, 0, 0, 1, 1, 1], [1] * 6], jnp.int32)
+        out = qm.generate(ids, attention_mask=mask, max_new_tokens=6)
+        solo1 = qm.generate(jnp.asarray([p1], jnp.int32), max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(out)[0, 6:],
+                                      np.asarray(solo1)[0, 3:])
+        # the FULL-LENGTH row must be untouched by the mask machinery too
+        solo2 = qm.generate(jnp.asarray([p2], jnp.int32), max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(out)[1, 6:],
+                                      np.asarray(solo2)[0, 6:])
