@@ -34,22 +34,34 @@ def peak_flops(device) -> float:
     return 275e12  # assume v4 if unknown
 
 
-def _accelerator_reachable(timeout_s=90):
+def _accelerator_reachable(timeout_s=90, attempts=3, gap_s=45):
     """Probe the TPU tunnel in a SUBPROCESS: when the axon tunnel is
     down, backend init (even `jax.devices()`) can hang indefinitely and
     would take the whole bench with it. A child process we can kill
-    answers the question safely."""
+    answers the question safely. Retries a few times — the tunnel's
+    outages are sometimes intermittent, and a CPU-fallback bench line
+    costs the round its TPU artifact."""
     import subprocess
     import sys
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, '-c',
-             'import jax; jax.devices(); print("ok")'],
-            capture_output=True, timeout=timeout_s)
-        return proc.returncode == 0 and b'ok' in proc.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, '-c',
+                 'import jax; jax.devices(); print("ok")'],
+                capture_output=True, timeout=timeout_s)
+            if proc.returncode == 0 and b'ok' in proc.stdout:
+                return True
+            # fast deterministic failure (broken jax, import error):
+            # retrying cannot help — fall back immediately
+            return False
+        except subprocess.TimeoutExpired:
+            pass                      # the hang signature retries exist for
+        except OSError:
+            return False
+        if i + 1 < attempts:
+            time.sleep(gap_s)
+    return False
 
 
 def _arm_watchdog(seconds=1500):
@@ -80,7 +92,12 @@ def _arm_watchdog(seconds=1500):
 
 
 def main():
-    cancel_watchdog = _arm_watchdog()
+    # watchdog FIRST: even the parent's `import jax` can hang on a dead
+    # tunnel (plugin discovery), and an unguarded hang records no JSON
+    # line at all. The retrying probe's worst case (3x90s timeouts +
+    # 2x45s gaps = 360s) fits inside the 1800s budget alongside the
+    # fast CPU-fallback bench; the TPU path only probes once when up.
+    cancel_watchdog = _arm_watchdog(1800)
     if not _accelerator_reachable():
         # tunnel down: fall back to the CPU smoke config so the driver
         # still records a line (vs_baseline 0 marks it as non-TPU)
