@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-start TPU tunnel watcher (VERDICT r04 item 1).
+#
+# The axon tunnel dies for hours at a time; a bench run attempted only at
+# driver time therefore records a CPU fallback. This loop probes the tunnel
+# every ~4 min and, the moment it answers, runs bench.py and stashes the
+# JSON line (only if backend==tpu) into BENCH_TPU_STASH.json. It keeps
+# re-arming so later bench.py extensions get re-captured while the tunnel
+# is up.
+cd /root/repo
+LOG=/tmp/tpu_watch.log
+STASH=/root/repo/BENCH_TPU_STASH.json
+echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if timeout 100 python -c 'import jax; jax.devices(); print("ok")' \
+      >/dev/null 2>&1; then
+    echo "[watch] tunnel UP $(date -u +%FT%TZ); running bench" >> "$LOG"
+    OUT=$(timeout 2400 python bench.py 2>>"$LOG")
+    # a FRESH capture only: bench.py itself may have re-emitted the
+    # existing stash (marked "stashed": true) if the tunnel died between
+    # our probe and its own — re-stashing that would fake freshness
+    LINE=$(printf '%s\n' "$OUT" | grep -m1 '"backend": "tpu"' \
+           | grep -v '"stashed": true')
+    if [ -n "$LINE" ]; then
+      printf '%s\n' "$LINE" > "$STASH.tmp" && mv "$STASH.tmp" "$STASH"
+      echo "[watch] captured TPU artifact $(date -u +%FT%TZ)" >> "$LOG"
+      sleep 1200   # re-capture every ~20 min while up (bench may evolve)
+    else
+      echo "[watch] bench ran but no tpu line $(date -u +%FT%TZ)" >> "$LOG"
+      sleep 240
+    fi
+  else
+    sleep 240
+  fi
+done
