@@ -120,13 +120,38 @@ def _stashed_tpu_line():
     return rec
 
 
+def _acquire_bench_lock(max_wait_s=900):
+    """Serialize bench runs: tools/tpu_watch.sh may be mid-bench when the
+    driver launches its own — two concurrent TPU processes either fail
+    backend init or contend and deflate every number. Both paths run
+    THIS file, so a file lock here covers them. Gives up after
+    max_wait_s (a contended number beats none) and reports whether the
+    run was exclusive."""
+    import fcntl
+
+    fh = open('/tmp/paddle_tpu_bench.lock', 'w')
+    t0 = time.time()
+    while time.time() - t0 < max_wait_s:
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fh, True
+        except OSError:
+            time.sleep(10)
+    return fh, False
+
+
 def main():
-    # watchdog FIRST: even the parent's `import jax` can hang on a dead
-    # tunnel (plugin discovery), and an unguarded hang records no JSON
-    # line at all. The retrying probe's worst case (3x90s timeouts +
-    # 2x45s gaps = 360s) fits inside the 2100s budget alongside the
-    # fast CPU-fallback bench; the TPU path only probes once when up.
+    # lock BEFORE the watchdog: waiting out a concurrent bench must not
+    # eat the measurement budget
+    _lock_fh, exclusive = _acquire_bench_lock()
+    # watchdog FIRST after that: even the parent's `import jax` can hang
+    # on a dead tunnel (plugin discovery), and an unguarded hang records
+    # no JSON line at all. The retrying probe's worst case (3x90s
+    # timeouts + 2x45s gaps = 360s) fits inside the 2100s budget
+    # alongside the fast CPU-fallback bench; the TPU path only probes
+    # once when up.
     cancel_watchdog = _arm_watchdog(2100)
+    watchdog_t0 = time.perf_counter()
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
@@ -272,7 +297,6 @@ def main():
     # weight-only int8 serving path (pallas quant matmul): decode is
     # weight-HBM-bound, so this is the 2x lever. Guarded: a failure here
     # must not cost the train metric.
-    bench_t0 = time.perf_counter()
     model_int8 = None
     try:
         model_int8 = model.quantize_weights(bits=8)
@@ -298,7 +322,11 @@ def main():
     # optional serving lines must never push the run into the watchdog
     # and cost the already-measured train metric.
     spec_tok_s = None
-    if model_int8 is not None and time.perf_counter() - bench_t0 < 600:
+    # box against time-since-watchdog-arm: the whole run must finish
+    # inside the 2100s timer, so only start this optional section with
+    # >=600s of headroom left
+    if (model_int8 is not None
+            and time.perf_counter() - watchdog_t0 < 1500):
         try:
             from paddle_tpu.models.generation import generate_speculative
 
@@ -317,7 +345,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f'# speculative bench failed: {type(e).__name__}: {e}',
                   flush=True)
-    elif spec_tok_s is None:
+    else:
         print('# speculative bench skipped (time box / no int8 model)',
               flush=True)
 
@@ -372,6 +400,7 @@ def main():
             'host_rss_gb': host_rss_gb,
             'backend': jax.default_backend(),
             'device': getattr(jax.devices()[0], 'device_kind', '?'),
+            'exclusive_run': exclusive,
             'captured_at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
         },
     }), flush=True)

@@ -45,10 +45,35 @@ class GenerationMixin:
                     or cfg.num_attention_heads)
         dtype = dtype or self.cache_dtype()
         shape = (batch_size, max_len, kv_heads, head_dim)
-        return [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(cfg.num_hidden_layers)
-        ]
+
+        def make():
+            return jnp.zeros(shape, dtype)
+
+        mesh = None
+        if not isinstance(batch_size, jax.core.Tracer):
+            from ..distributed.mesh import get_mesh
+
+            mesh = get_mesh()
+        if mesh is not None:
+            # sharded serving (ref: fleet mpu mp_layers serving path —
+            # mp_layers.py:47,334,541): KV cache lives TP-sharded on the
+            # heads axis (and dp/fsdp on batch when divisible) so a
+            # 7B-class model's cache splits across chips instead of
+            # replicating; GSPMD keeps the decode step's attention local
+            # to each head shard
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..distributed.parallel import _valid_spec
+
+            spec = _valid_spec(P(('dp', 'fsdp'), None, 'tp', None),
+                               shape, mesh)
+            sharding = NamedSharding(mesh, spec)
+
+            def make():  # noqa: F811 - mesh-aware variant
+                return jax.device_put(jnp.zeros(shape, dtype), sharding)
+
+        return [(make(), make()) for _ in range(cfg.num_hidden_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,

@@ -154,11 +154,42 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
 
         if use_pallas():
             # fused single-token decode: one streaming pass over the
-            # cache (ops/pallas/decode_attention.py)
+            # cache (ops/pallas/decode_attention.py); under a tp mesh
+            # each head-shard runs its own kernel via shard_map (the
+            # GQA group alignment survives contiguous head sharding)
             try:
                 from ..ops.pallas.decode_attention import decode_attention
 
-                out = decode_attention(q, ck, cv, cache_index + 1)
+                mesh = None
+                from ..distributed.mesh import get_mesh
+
+                m = get_mesh()
+                if (m is not None and m.shape.get('tp', 1) > 1
+                        and ck.shape[2] % m.shape['tp'] == 0
+                        and H % m.shape['tp'] == 0):
+                    mesh = m
+                if mesh is not None:
+                    import jax as _jax
+                    from jax.sharding import PartitionSpec as P
+
+                    from ..distributed.parallel import _valid_spec
+
+                    # mirror init_cache's placement (batch over dp/fsdp
+                    # when divisible, heads over tp) so a batch-sharded
+                    # cache is NOT all-gathered every decode step
+                    hspec = _valid_spec(
+                        P(('dp', 'fsdp'), None, 'tp', None), ck.shape, mesh)
+                    bat = hspec[0]
+                    out = _jax.shard_map(
+                        decode_attention,
+                        mesh=mesh,
+                        in_specs=(hspec, hspec, hspec, P(bat)),
+                        out_specs=hspec, check_vma=False,
+                    )(q, ck, cv,
+                      jnp.broadcast_to(jnp.asarray(cache_index + 1,
+                                                   jnp.int32), (B,)))
+                else:
+                    out = decode_attention(q, ck, cv, cache_index + 1)
             except Exception as e:
                 from ..ops import pallas_failed
 
