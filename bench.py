@@ -255,7 +255,8 @@ def main():
 
     # -- decode path: steady-state single-token generation over a long KV
     # cache (the inference-stack half of the reference's perf story) -----
-    def bench_decode(dec_batch, cache_len, dec_steps, m=None):
+    def bench_decode(dec_batch, cache_len, dec_steps, m=None,
+                     kv_int8=False):
         # Times the SCANNED decode loop — the same shape as
         # model.generate()'s lax.scan — so the number reflects on-device
         # steady-state throughput, not per-step host dispatch latency
@@ -264,7 +265,14 @@ def main():
         # params are baked into the executable as constants (2GB+ at 7B
         # dims), which explodes compile time and HBM.
         m = model if m is None else m
-        caches = m.init_cache(dec_batch, cache_len)
+        caches = m.init_cache(dec_batch, cache_len, quantized=kv_int8)
+        if kv_int8:
+            # no prefill in this loop: unit scales keep the dequant math
+            # well-defined; bandwidth (the measured quantity) is identical
+            from paddle_tpu.models.generation import QuantKVCache
+
+            caches = [QuantKVCache(c.kq, c.vq, jnp.ones_like(c.kscale),
+                                   jnp.ones_like(c.vscale)) for c in caches]
         base = jnp.asarray(cache_len - dec_steps - 2, jnp.int32)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
@@ -294,6 +302,12 @@ def main():
     dec_steps = 48 if on_tpu else 8
     decode_b1 = bench_decode(1, dec_cache, dec_steps)
     decode_b8 = bench_decode(8, dec_cache, dec_steps)
+    try:  # cache-KV int8: halves the cache stream, the binding term at b8
+        decode_b8_kv8 = bench_decode(8, dec_cache, dec_steps, kv_int8=True)
+    except Exception as e:  # noqa: BLE001
+        decode_b8_kv8 = None
+        print(f'# kv8 decode bench failed: {type(e).__name__}: {e}',
+              flush=True)
     # weight-only int8 serving path (pallas quant matmul): decode is
     # weight-HBM-bound, so this is the 2x lever. Guarded: a failure here
     # must not cost the train metric.
@@ -383,6 +397,8 @@ def main():
             'vocab_size': cfg.vocab_size,
             'decode_tok_s_b1': round(decode_b1, 1),
             'decode_tok_s_b8': round(decode_b8, 1),
+            'decode_tok_s_b8_kv8': (round(decode_b8_kv8, 1)
+                                    if decode_b8_kv8 is not None else None),
             'decode_tok_s_b1_int8': (round(decode_b1_int8, 1)
                                      if decode_b1_int8 is not None else None),
             'decode_tok_s_b1_int4': (round(decode_b1_int4, 1)

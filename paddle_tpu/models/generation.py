@@ -10,9 +10,40 @@ this in (LlamaForCausalLM, MoEForCausalLM). Everything is static-shape
 """
 from __future__ import annotations
 
+import typing
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class QuantKVCache(typing.NamedTuple):
+    """Cache-KV int8 (ref capability:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py:44,60
+    — dynamic/static cache-KV quantization in the reference serving
+    stack). K/V live int8 in HBM with per-(kv-head, dim) f32 scales,
+    calibrated at prefill ('dynamic' in the reference's terms) and held
+    static over decode. Halves the cache stream — the binding term of
+    decode at batch >= 8 and long contexts."""
+
+    kq: jax.Array        # int8 (B, max_len, Hkv, D)
+    vq: jax.Array        # int8 (B, max_len, Hkv, D)
+    kscale: jax.Array    # f32 (Hkv, D)
+    vscale: jax.Array    # f32 (Hkv, D)
+
+
+def quantize_kv_rows(x, scale):
+    """Symmetric int8 quantization of new K/V rows (B, S, Hkv, D) with
+    per-(head, dim) scales; saturates rows that exceed the prefill
+    calibration range."""
+    q = jnp.round(x.astype(jnp.float32) / scale[None, None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def calibrate_kv_scale(x, margin=1.0):
+    """Per-(kv-head, dim) amax scales from the prefill rows."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(0, 1))
+    return jnp.maximum(amax * margin, 1e-6) / 127.0
 
 
 class GenerationMixin:
@@ -33,10 +64,14 @@ class GenerationMixin:
         (usually the embedding table's dtype)."""
         raise NotImplementedError
 
-    def init_cache(self, batch_size, max_len, dtype=None):
+    def init_cache(self, batch_size, max_len, dtype=None, quantized=False):
         """Per-layer (k, v) zero pairs of (B, max_len, kv_heads, head_dim),
         derived from `self.config` (`head_dim` property or
-        hidden_size // num_attention_heads)."""
+        hidden_size // num_attention_heads).
+
+        quantized=True returns QuantKVCache entries (int8 data +
+        per-(head, dim) scales). The first cached call must be a
+        multi-token prefill — that's where the scales calibrate."""
         cfg = self.config
         head_dim = getattr(cfg, 'head_dim', None)
         if head_dim is None:
@@ -73,16 +108,42 @@ class GenerationMixin:
             def make():  # noqa: F811 - mesh-aware variant
                 return jax.device_put(jnp.zeros(shape, dtype), sharding)
 
+        if quantized:
+            sshape = (kv_heads, head_dim)
+
+            def make_scale():
+                return jnp.zeros(sshape, jnp.float32)
+
+            if mesh is not None:
+                sspec = _valid_spec(P('tp', None), sshape, mesh)
+                ssharding = NamedSharding(mesh, sspec)
+
+                def make_scale():  # noqa: F811
+                    return jax.device_put(jnp.zeros(sshape, jnp.float32),
+                                          ssharding)
+
+            def make_q():
+                z = jnp.zeros(shape, jnp.int8)
+                return jax.device_put(z, sharding) if mesh is not None else z
+
+            return [QuantKVCache(make_q(), make_q(), make_scale(),
+                                 make_scale())
+                    for _ in range(cfg.num_hidden_layers)]
         return [(make(), make()) for _ in range(cfg.num_hidden_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,
-                 length_penalty=0.0, attention_mask=None):
+                 length_penalty=0.0, attention_mask=None,
+                 kv_cache_int8=False):
         """attention_mask (B, S) 0/1 supports LEFT-padded batches of
         unequal-length prompts (HF decoder-only convention): positions
         are counted from each row's first real token and pad rows never
         receive attention. Requires the model's cached forward to accept
-        `positions`/`kvalid` (the Llama family does)."""
+        `positions`/`kvalid` (the Llama family does).
+
+        kv_cache_int8=True serves with a quantized KV cache (see
+        QuantKVCache): scales calibrate on the prompt, decode streams
+        half the cache bytes. Requires a multi-token prompt."""
         if attention_mask is not None and not isinstance(
                 attention_mask, jax.core.Tracer):
             # HF tokenizers hand back an all-ones mask for equal-length
@@ -116,16 +177,19 @@ class GenerationMixin:
                         'top_p are not supported with num_beams > 1')
                 return self.beam_search(input_ids, max_new_tokens, num_beams,
                                         eos_token_id=eos_token_id,
-                                        length_penalty=length_penalty)
+                                        length_penalty=length_penalty,
+                                        kv_cache_int8=kv_cache_int8)
             return self._generate_sample(input_ids, max_new_tokens,
                                          temperature, top_k, top_p, rng_key,
-                                         eos_token_id, attention_mask)
+                                         eos_token_id, attention_mask,
+                                         kv_cache_int8=kv_cache_int8)
         finally:
             if was_training:
                 self.train()
 
     def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
-                    eos_token_id=None, length_penalty=0.0):
+                    eos_token_id=None, length_penalty=0.0,
+                    kv_cache_int8=False):
         """Static-shape beam search with a shared KV-cache (ref:
         python/paddle/nn/decode.py::BeamSearchDecoder semantics on the
         causal-LM surface).
@@ -136,6 +200,10 @@ class GenerationMixin:
         axis — one `lax.scan`, fully jittable.
         """
         B, S = input_ids.shape
+        if kv_cache_int8 and S < 2:
+            raise ValueError(
+                'kv_cache_int8 needs a multi-token prompt: the per-head '
+                'scales calibrate on the prefill rows')
         K = num_beams
         max_len = S + max_new_tokens
         NEG = -1e9
@@ -143,9 +211,12 @@ class GenerationMixin:
         # prefill ONCE at batch B, then replicate the KV rows K ways —
         # the K beams share an identical prompt, so prefilling (B*K, S)
         # would do K-fold redundant attention/MLP work
-        caches = self.init_cache(B, max_len)
+        caches = self.init_cache(B, max_len, quantized=kv_cache_int8)
         logits, caches = self(input_ids, caches=caches, cache_index=0)
-        caches = jax.tree.map(lambda c: jnp.repeat(c, K, axis=0), caches)
+        # replicate per-beam: only the 4-D (B, L, H, D) data leaves have a
+        # batch axis — QuantKVCache scales are 2-D and beam-invariant
+        caches = jax.tree.map(
+            lambda c: jnp.repeat(c, K, axis=0) if c.ndim == 4 else c, caches)
         logp = jax.nn.log_softmax(
             logits[:, -1, :].astype(jnp.float32), axis=-1)
         logp = jnp.repeat(logp, K, axis=0)               # (B*K, V)
@@ -158,7 +229,8 @@ class GenerationMixin:
             beam_idx = top_idx // V
             tok = (top_idx % V).astype(input_ids.dtype)
             gather = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
-            caches = jax.tree.map(lambda c: c[gather], caches)
+            caches = jax.tree.map(
+                lambda c: c[gather] if c.ndim == 4 else c, caches)
             bufs = [b[jnp.arange(B)[:, None], beam_idx] for b in bufs]
             return top_scores, tok, caches, bufs, beam_idx
 
@@ -215,7 +287,7 @@ class GenerationMixin:
 
     def _generate_sample(self, input_ids, max_new_tokens=32, temperature=0.0,
                          top_k=0, top_p=1.0, rng_key=None, eos_token_id=None,
-                         attention_mask=None):
+                         attention_mask=None, kv_cache_int8=False):
         """Greedy / sampled decode with a preallocated KV-cache.
 
         Functional loop (`lax.while_loop`-shaped via scan): prefill once,
@@ -225,8 +297,12 @@ class GenerationMixin:
         pad cache rows stay invalid for every later step.
         """
         B, S = input_ids.shape
+        if kv_cache_int8 and S < 2:
+            raise ValueError(
+                'kv_cache_int8 needs a multi-token prompt: the per-head '
+                'scales calibrate on the prefill rows')
         max_len = S + max_new_tokens
-        caches = self.init_cache(B, max_len)
+        caches = self.init_cache(B, max_len, quantized=kv_cache_int8)
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
 

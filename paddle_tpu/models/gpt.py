@@ -154,7 +154,7 @@ class GPTForCausalLM(GenerationMixin, Layer):
     def cache_dtype(self):
         return self.transformer.wte.dtype
 
-    def init_cache(self, batch_size, max_len, dtype=None):
+    def init_cache(self, batch_size, max_len, dtype=None, quantized=False):
         limit = self.config.max_position_embeddings
         if max_len > limit:
             raise ValueError(
@@ -162,7 +162,8 @@ class GPTForCausalLM(GenerationMixin, Layer):
                 f'position table (max_position_embeddings={limit}); the '
                 f'gather would silently clamp to the last row. Unlike '
                 f'RoPE models, GPT cannot extrapolate positions.')
-        return super().init_cache(batch_size, max_len, dtype)
+        return super().init_cache(batch_size, max_len, dtype,
+                                  quantized=quantized)
 
     def forward(self, input_ids, caches=None, cache_index=None):
         hidden, new_caches = self.transformer(input_ids, caches, cache_index)

@@ -140,13 +140,43 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
     be attended at all — left-padded batched generation puts 0 on the
     pad rows (the fused kernel's contiguous-count validity cannot
     express holes, so it is bypassed then). Returns
-    (out (B, S, H, D), (ck, cv))."""
+    (out (B, S, H, D), new_cache).
+
+    A QuantKVCache stores K/V int8 with per-(head, dim) scales: prefill
+    (S > 1) calibrates the scales from its own rows, decode steps
+    quantize against them; attention dequantizes (in-kernel on the
+    pallas path, whole-cache on the XLA fallback)."""
+    from .generation import QuantKVCache, calibrate_kv_scale, quantize_kv_rows
+
     B, S, H, D = q.shape
-    ck, cv = cache
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                      (0, cache_index, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                      (0, cache_index, 0, 0))
+    quant = isinstance(cache, QuantKVCache)
+    if quant:
+        kq, vq, kscale, vscale = cache
+        # calibrate ONLY on the index-0 prefill: a later multi-token
+        # chunk (chunked prefill, speculative verify) must keep the
+        # existing scales — recalibrating would reinterpret every int8
+        # row already in the cache under new scales. cache_index is a
+        # concrete 0 at prefill in all generation loops; traced indices
+        # are by construction later steps.
+        is_prefill = (S > 1
+                      and not isinstance(cache_index, jax.core.Tracer)
+                      and int(cache_index) == 0)
+        if is_prefill:
+            kscale = calibrate_kv_scale(k)
+            vscale = calibrate_kv_scale(v)
+        kq = jax.lax.dynamic_update_slice(
+            kq, quantize_kv_rows(k, kscale), (0, cache_index, 0, 0))
+        vq = jax.lax.dynamic_update_slice(
+            vq, quantize_kv_rows(v, vscale), (0, cache_index, 0, 0))
+        new_cache = QuantKVCache(kq, vq, kscale, vscale)
+        ck, cv = kq, vq
+    else:
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, cache_index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, cache_index, 0, 0))
+        new_cache = (ck, cv)
     max_len = ck.shape[1]
     out = None
     if S == 1 and D % 8 == 0 and kvalid is None:
@@ -180,14 +210,32 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
                     hspec = _valid_spec(
                         P(('dp', 'fsdp'), None, 'tp', None), ck.shape, mesh)
                     bat = hspec[0]
-                    out = _jax.shard_map(
-                        decode_attention,
-                        mesh=mesh,
-                        in_specs=(hspec, hspec, hspec, P(bat)),
-                        out_specs=hspec, check_vma=False,
-                    )(q, ck, cv,
-                      jnp.broadcast_to(jnp.asarray(cache_index + 1,
-                                                   jnp.int32), (B,)))
+                    vl = jnp.broadcast_to(
+                        jnp.asarray(cache_index + 1, jnp.int32), (B,))
+                    if quant:
+                        sspec = _valid_spec(P('tp', None), kscale.shape,
+                                            mesh)
+
+                        def _da8(q_, k_, v_, vl_, ks_, vs_):
+                            return decode_attention(q_, k_, v_, vl_,
+                                                    k_scale=ks_, v_scale=vs_)
+
+                        out = _jax.shard_map(
+                            _da8, mesh=mesh,
+                            in_specs=(hspec, hspec, hspec, P(bat), sspec,
+                                      sspec),
+                            out_specs=hspec, check_vma=False,
+                        )(q, ck, cv, vl, kscale, vscale)
+                    else:
+                        out = _jax.shard_map(
+                            decode_attention,
+                            mesh=mesh,
+                            in_specs=(hspec, hspec, hspec, P(bat)),
+                            out_specs=hspec, check_vma=False,
+                        )(q, ck, cv, vl)
+                elif quant:
+                    out = decode_attention(q, ck, cv, cache_index + 1,
+                                           k_scale=kscale, v_scale=vscale)
                 else:
                     out = decode_attention(q, ck, cv, cache_index + 1)
             except Exception as e:
@@ -201,8 +249,13 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
         mask = (kpos[None, :] <= qpos[:, None])[None, None]
         if kvalid is not None:
             mask = mask & (kvalid[:, None, None, :] > 0)
+        if quant:
+            # XLA fallback: whole-cache dequant (correctness path; the
+            # bandwidth win lives in the pallas kernel)
+            ck = (ck.astype(jnp.float32) * kscale[None, None]).astype(q.dtype)
+            cv = (cv.astype(jnp.float32) * vscale[None, None]).astype(q.dtype)
         out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)
-    return out, (ck, cv)
+    return out, new_cache
 
 
 class LlamaAttention(Layer):

