@@ -307,6 +307,8 @@ class GenerationMixin:
             rng_key = jax.random.PRNGKey(0)
 
         if attention_mask is not None:
+            import inspect
+
             am = jnp.asarray(attention_mask, jnp.int32)
             # pad rows clip to position 0; they are masked out anyway
             prompt_pos = jnp.maximum(jnp.cumsum(am, axis=1) - 1, 0)
@@ -314,6 +316,21 @@ class GenerationMixin:
             kvalid = jnp.concatenate(
                 [am, jnp.ones((B, max_new_tokens), jnp.int32)], axis=1)
             extra = dict(positions=prompt_pos, kvalid=kvalid)
+            # left-padded masks are the contiguous window [S - real_len,
+            # now]: models that accept kv_start keep the fused decode
+            # kernel (per-row start) instead of the masked XLA fallback.
+            # Gate on verified left-contiguity (host check on the
+            # concrete mask): a right-padded or holed mask must keep the
+            # exact masked path — kv_start would attend the wrong window.
+            if ('kv_start' in inspect.signature(self.forward).parameters
+                    and not isinstance(am, jax.core.Tracer)):
+                amn = np.asarray(am)
+                rl = amn.sum(axis=1)
+                left_contig = bool(
+                    (amn == (np.arange(S)[None, :]
+                             >= (S - rl)[:, None])).all())
+                if left_contig:
+                    extra['kv_start'] = S - real_len
         else:
             extra = {}
 
@@ -355,6 +372,8 @@ class GenerationMixin:
                 # index stays the uniform idx
                 step_extra = dict(
                     positions=(real_len + (idx - S))[:, None], kvalid=kvalid)
+                if 'kv_start' in extra:
+                    step_extra['kv_start'] = extra['kv_start']
             else:
                 step_extra = {}
             logits, caches = self(tok[:, None], caches=caches, cache_index=idx,

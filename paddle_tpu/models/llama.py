@@ -132,15 +132,18 @@ def apply_rotary(x, cos, sin):
 # Blocks
 # ---------------------------------------------------------------------------
 
-def cached_attention(q, k, v, cache, cache_index, kvalid=None):
+def cached_attention(q, k, v, cache, cache_index, kvalid=None,
+                     kv_start=None):
     """Shared KV-cached attention step (LlamaAttention, GPTAttention):
     write the S new rows at cache_index, attend over the full cache
     masked by position; single-token steps dispatch to the fused pallas
     decode kernel. `kvalid` (B, max_len) 0/1 marks cache rows that may
     be attended at all — left-padded batched generation puts 0 on the
-    pad rows (the fused kernel's contiguous-count validity cannot
-    express holes, so it is bypassed then). Returns
-    (out (B, S, H, D), new_cache).
+    pad rows. `kv_start` (B,) asserts the caller's kvalid is exactly the
+    contiguous window [kv_start, now] (left-pad hole at the front) —
+    with it, single-token steps KEEP the fused kernel (per-row start via
+    scalar prefetch) instead of falling back to the masked XLA path.
+    Returns (out (B, S, H, D), new_cache).
 
     A QuantKVCache stores K/V int8 with per-(head, dim) scales: prefill
     (S > 1) calibrates the scales from its own rows, decode steps
@@ -179,7 +182,7 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
         new_cache = (ck, cv)
     max_len = ck.shape[1]
     out = None
-    if S == 1 and D % 8 == 0 and kvalid is None:
+    if S == 1 and D % 8 == 0 and (kvalid is None or kv_start is not None):
         from ..ops import use_pallas
 
         if use_pallas():
@@ -212,32 +215,41 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
                     bat = hspec[0]
                     vl = jnp.broadcast_to(
                         jnp.asarray(cache_index + 1, jnp.int32), (B,))
+                    st = jnp.broadcast_to(jnp.asarray(
+                        0 if kv_start is None else kv_start, jnp.int32),
+                        (B,))
                     if quant:
                         sspec = _valid_spec(P('tp', None), kscale.shape,
                                             mesh)
 
-                        def _da8(q_, k_, v_, vl_, ks_, vs_):
+                        def _da8(q_, k_, v_, vl_, st_, ks_, vs_):
                             return decode_attention(q_, k_, v_, vl_,
-                                                    k_scale=ks_, v_scale=vs_)
+                                                    k_scale=ks_, v_scale=vs_,
+                                                    start=st_)
 
                         out = _jax.shard_map(
                             _da8, mesh=mesh,
-                            in_specs=(hspec, hspec, hspec, P(bat), sspec,
-                                      sspec),
+                            in_specs=(hspec, hspec, hspec, P(bat), P(bat),
+                                      sspec, sspec),
                             out_specs=hspec, check_vma=False,
-                        )(q, ck, cv, vl, kscale, vscale)
+                        )(q, ck, cv, vl, st, kscale, vscale)
                     else:
+                        def _da(q_, k_, v_, vl_, st_):
+                            return decode_attention(q_, k_, v_, vl_,
+                                                    start=st_)
+
                         out = _jax.shard_map(
-                            decode_attention,
-                            mesh=mesh,
-                            in_specs=(hspec, hspec, hspec, P(bat)),
+                            _da, mesh=mesh,
+                            in_specs=(hspec, hspec, hspec, P(bat), P(bat)),
                             out_specs=hspec, check_vma=False,
-                        )(q, ck, cv, vl)
+                        )(q, ck, cv, vl, st)
                 elif quant:
                     out = decode_attention(q, ck, cv, cache_index + 1,
-                                           k_scale=kscale, v_scale=vscale)
+                                           k_scale=kscale, v_scale=vscale,
+                                           start=kv_start)
                 else:
-                    out = decode_attention(q, ck, cv, cache_index + 1)
+                    out = decode_attention(q, ck, cv, cache_index + 1,
+                                           start=kv_start)
             except Exception as e:
                 from ..ops import pallas_failed
 
@@ -249,6 +261,12 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None):
         mask = (kpos[None, :] <= qpos[:, None])[None, None]
         if kvalid is not None:
             mask = mask & (kvalid[:, None, None, :] > 0)
+        if kv_start is not None:
+            # honor the window start here too: a caller passing only
+            # kv_start must see the same window whether or not the
+            # fused kernel ran
+            st = jnp.reshape(jnp.asarray(kv_start, jnp.int32), (-1,))
+            mask = mask & (kpos[None, :] >= st[:, None])[:, None, None, :]
         if quant:
             # XLA fallback: whole-cache dequant (correctness path; the
             # bandwidth win lives in the pallas kernel)
@@ -289,7 +307,7 @@ class LlamaAttention(Layer):
             self.q_bias = self.k_bias = self.v_bias = None
 
     def forward(self, x, positions, attn_mask=None, cache=None,
-                cache_index=None, kvalid=None):
+                cache_index=None, kvalid=None, kv_start=None):
         """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
 
         Returns (out, new_cache). With a cache, writes the S new kv rows at
@@ -367,7 +385,8 @@ class LlamaAttention(Layer):
             new_cache = None
         else:
             out, new_cache = cached_attention(q, k, v, cache, cache_index,
-                                              kvalid=kvalid)
+                                              kvalid=kvalid,
+                                              kv_start=kv_start)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache
@@ -397,10 +416,10 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, positions, attn_mask=None, cache=None,
-                cache_index=None, kvalid=None):
+                cache_index=None, kvalid=None, kv_start=None):
         attn_out, new_cache = self.self_attn(
             self.input_layernorm(x), positions, attn_mask, cache,
-            cache_index, kvalid
+            cache_index, kvalid, kv_start
         )
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
@@ -427,7 +446,7 @@ class LlamaModel(Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None, kvalid=None):
+                cache_index=None, kvalid=None, kv_start=None):
         B, S = input_ids.shape
         if positions is None:
             base = 0 if cache_index is None else cache_index
@@ -455,7 +474,7 @@ class LlamaModel(Layer):
                 nc = None
             else:
                 x, nc = layer(x, positions, attn_mask, cache, cache_index,
-                              kvalid)
+                              kvalid, kv_start)
             if new_caches is not None:
                 new_caches.append(nc)
         return self.norm(x), new_caches
@@ -484,9 +503,9 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         return hidden @ self.lm_head
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None, kvalid=None):
+                cache_index=None, kvalid=None, kv_start=None):
         hidden, new_caches = self.model(input_ids, positions, attn_mask, caches,
-                                        cache_index, kvalid)
+                                        cache_index, kvalid, kv_start)
         logits = self.logits(hidden)
         if caches is None:
             return logits

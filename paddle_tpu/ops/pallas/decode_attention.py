@@ -39,22 +39,23 @@ def _interpret():
     return interpret_mode()
 
 
-def _decode_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
-                   *, scale, ns, bs, hkv, group):
-    _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, None, None, o_ref,
-                        acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
+def _decode_kernel(vl_ref, st_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
+                   l_scr, *, scale, ns, bs, hkv, group):
+    _decode_kernel_body(vl_ref, st_ref, q_ref, k_ref, v_ref, None, None,
+                        o_ref, acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
                         hkv=hkv, group=group)
 
 
-def _decode_kernel_q8(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-                      acc, m_scr, l_scr, *, scale, ns, bs, hkv, group):
-    _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-                        acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
+def _decode_kernel_q8(vl_ref, st_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                      o_ref, acc, m_scr, l_scr, *, scale, ns, bs, hkv, group):
+    _decode_kernel_body(vl_ref, st_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                        o_ref, acc, m_scr, l_scr, scale=scale, ns=ns, bs=bs,
                         hkv=hkv, group=group)
 
 
-def _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-                        acc, m_scr, l_scr, *, scale, ns, bs, hkv, group):
+def _decode_kernel_body(vl_ref, st_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                        o_ref, acc, m_scr, l_scr, *, scale, ns, bs, hkv,
+                        group):
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -88,12 +89,16 @@ def _decode_kernel_body(vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
     # unspecified memory) are masked out of the scores, and V is zeroed
     # there so garbage (inf/nan bit patterns) cannot reach the matmul.
     count = vl_ref[b]
+    # per-row window start (left-padded batches: rows [0, start) are pad
+    # holes) — same scalar-prefetch + 2-D-iota mechanism as the validity
+    # count, so Mosaic legality is unchanged
+    start = st_ref[b]
     vpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (cols, D), 0) // hkv
-    v = jnp.where(vpos < count, v, 0.0)
+    v = jnp.where((vpos < count) & (vpos >= start), v, 0.0)
     rowh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 0) // group
     colh = jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 1) % hkv
     colp = j * bs + jax.lax.broadcasted_iota(jnp.int32, (hq, cols), 1) // hkv
-    keep = (rowh == colh) & (colp < count)
+    keep = (rowh == colh) & (colp < count) & (colp >= start)
 
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (Hq, cols)
@@ -135,12 +140,15 @@ def _pick_block(block_s, S, hkv, D, itemsize, interpret):
 
 
 def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
-                     block_s=DEFAULT_BLOCK_S, k_scale=None, v_scale=None):
+                     block_s=DEFAULT_BLOCK_S, k_scale=None, v_scale=None,
+                     start=None):
     """One fused decode-attention step.
 
     q: (B, 1, Hq, D); k_cache/v_cache: (B, S, Hkv, D) in cache-native
     layout; valid_len: scalar or (B,) — number of cache positions the
-    query may attend to (cache_index + 1). Returns (B, 1, Hq, D).
+    query may attend to (cache_index + 1). `start`: scalar or (B,) —
+    first attendable cache position per row (left-padded batches put the
+    pad hole at [0, start); default 0). Returns (B, 1, Hq, D).
 
     Cache-KV int8 (ref capability: the reference serving stack's
     cache-quantized block_multihead_attention —
@@ -167,19 +175,22 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
     # tail block's unspecified memory
     vl = jnp.minimum(jnp.broadcast_to(
         jnp.reshape(jnp.asarray(valid_len, jnp.int32), (-1,)), (B,)), S)
+    st = jnp.clip(jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(0 if start is None else start, jnp.int32),
+                    (-1,)), (B,)), 0, S)
 
     quant = k_scale is not None
     in_specs = [
-        pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
-        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
-        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl: (b, j, 0, 0)),
+        pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl, st: (b, 0, 0, 0)),
+        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl, st: (b, j, 0, 0)),
+        pl.BlockSpec((1, bs, Hkv, D), lambda b, j, vl, st: (b, j, 0, 0)),
     ]
-    args = [vl, q, k_cache, v_cache]
+    args = [vl, st, q, k_cache, v_cache]
     if quant:
         kernel = functools.partial(_decode_kernel_q8, scale=scale, ns=ns,
                                    bs=bs, hkv=Hkv, group=group)
         # scales are tiny and constant across the grid: one full block
-        in_specs += [pl.BlockSpec((Hkv, D), lambda b, j, vl: (0, 0))] * 2
+        in_specs += [pl.BlockSpec((Hkv, D), lambda b, j, vl, st: (0, 0))] * 2
         args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     else:
         kernel = functools.partial(_decode_kernel, scale=scale, ns=ns, bs=bs,
@@ -187,10 +198,11 @@ def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, ns),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, Hq, D), lambda b, j, vl: (b, 0, 0, 0)),
+            out_specs=pl.BlockSpec((1, 1, Hq, D),
+                                   lambda b, j, vl, st: (b, 0, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((Hq, D), jnp.float32),
                 pltpu.VMEM((Hq, 128), jnp.float32),

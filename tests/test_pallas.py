@@ -418,6 +418,43 @@ class TestDecodeAttention:
         assert np.isfinite(np.asarray(over)).all()
         np.testing.assert_array_equal(np.asarray(over), np.asarray(full))
 
+    def test_per_row_start_matches_masked_reference(self):
+        """Left-pad holes: rows [0, start) masked out via the second
+        scalar-prefetch vector, including starts inside later blocks."""
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(5)
+        B, S, H, D = 3, 96, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        valid = jnp.asarray([40, 80, 96], jnp.int32)
+        start = jnp.asarray([0, 7, 50], jnp.int32)   # row 2: start in blk 1
+        got = decode_attention(q, ck, cv, valid, start=start, block_s=32)
+        mask = ((jnp.arange(S)[None, :] < valid[:, None])
+                & (jnp.arange(S)[None, :] >= start[:, None]))[:, None, None]
+        want = _sdpa_reference(q, ck, cv, attn_mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_start_composes_with_int8_cache(self):
+        from paddle_tpu.models.generation import (calibrate_kv_scale,
+                                                  quantize_kv_rows)
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(6)
+        B, S, H, D = 2, 64, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        ks, vs = calibrate_kv_scale(ck), calibrate_kv_scale(cv)
+        k8, v8 = quantize_kv_rows(ck, ks), quantize_kv_rows(cv, vs)
+        start = jnp.asarray([3, 17], jnp.int32)
+        got = decode_attention(q, k8, v8, 60, k_scale=ks, v_scale=vs,
+                               start=start, block_s=32)
+        want = decode_attention(q, ck, cv, 60, start=start, block_s=32)
+        assert np.max(np.abs(np.asarray(got) - np.asarray(want))) < 1e-2
+
     def test_generate_uses_decode_kernel_when_enabled(self, monkeypatch):
         """Dispatch check: the llama cached path must route Sq==1 steps
         through the decode kernel when pallas is on."""
