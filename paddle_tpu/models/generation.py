@@ -403,14 +403,26 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
 
     Host-driven loop: the accepted length is data-dependent, so each
     window syncs once — the win is fewer *target* forwards, which is
-    what dominates when the draft is much smaller. Batch 1 only (rows
-    would commit at different lengths).
+    what dominates when the draft is much smaller. Batched prompts
+    (B > 1, equal length) commit per row at their own rates via per-row
+    cache write offsets (`kv_write_pos` — models that lack it are
+    batch-1 only): each row commits by the same greedy rule its solo
+    `generate()` follows. (As with batched generate(), bit-exactness vs
+    a SOLO run holds unless some step's top-2 logits sit within float
+    rounding of each other — XLA may tile batched matmuls differently;
+    see examples/generate.py for the same caveat.)
     """
     B, S = input_ids.shape
     if B != 1:
-        raise NotImplementedError(
-            'speculative decoding is batch-1 (rows commit at different '
-            'lengths); loop prompts individually')
+        import inspect
+
+        for m_ in (target, draft):
+            if 'kv_write_pos' not in inspect.signature(
+                    m_.forward).parameters:
+                raise NotImplementedError(
+                    f'{type(m_).__name__} does not support batched '
+                    f'speculative decoding (cached forward lacks '
+                    f'kv_write_pos); loop prompts individually')
     # same eval-mode rule as generate(): dropout would break the
     # losslessness contract (and differ between draft and verify)
     restore = []
@@ -419,11 +431,30 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
             m_.eval()
             restore.append(m_)
     try:
-        return _speculative_loop(target, draft, input_ids, max_new_tokens,
-                                 num_draft_tokens, eos_token_id)
+        if B == 1:
+            return _speculative_loop(target, draft, input_ids,
+                                     max_new_tokens, num_draft_tokens,
+                                     eos_token_id)
+        return _speculative_loop_batched(target, draft, input_ids,
+                                         max_new_tokens, num_draft_tokens,
+                                         eos_token_id)
     finally:
         for m_ in restore:
             m_.train()
+
+
+def _commit_window(c, d_row, t_row, k):
+    """The greedy speculative commit rule, shared by the batch-1 and
+    batched loops so they can never drift: accept the longest draft
+    prefix the target agrees with, commit [c] + that prefix, and pick
+    the next committed token from the target's own choices. Returns
+    (committed_tokens, next_c)."""
+    m_acc = 0
+    while m_acc < k and int(d_row[m_acc]) == int(t_row[m_acc]):
+        m_acc += 1
+    committed = [int(c)] + [int(x) for x in d_row[:m_acc]]
+    next_c = int(t_row[m_acc]) if m_acc < k else int(t_row[k])
+    return committed, next_c
 
 
 def _speculative_loop(target, draft, input_ids, max_new_tokens,
@@ -481,18 +512,96 @@ def _speculative_loop(target, draft, input_ids, max_new_tokens,
                                   jnp.asarray(L, jnp.int32))
         d = np.asarray(drafts)
         t = np.asarray(choices)                # t[i] = target after window[:i+1]
-        m_acc = 0
-        while m_acc < k and d[m_acc] == int(t[m_acc]):
-            m_acc += 1
-        committed = [c_host] + [int(x) for x in d[:m_acc]]
+        committed, c_host = _commit_window(c_host, d, t, k)
         out.extend(committed)
         if eos_token_id is not None and eos_token_id in committed:
             # stop at the first eos; generate() freezes to eos after it
             out = out[:out.index(eos_token_id) + 1]
             break
-        c_host = int(t[m_acc]) if m_acc < k else int(t[k])
         L += len(committed)
     if eos_token_id is not None and len(out) < max_new_tokens:
         out += [eos_token_id] * (max_new_tokens - len(out))
     gen = jnp.asarray([out[:max_new_tokens]], input_ids.dtype)
+    return jnp.concatenate([input_ids, gen], axis=1)
+
+
+def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
+                              num_draft_tokens, eos_token_id):
+    """B > 1 speculative decoding: rows accept different draft prefixes,
+    so each row carries its OWN committed length — cache writes go to
+    per-row offsets (kv_write_pos) and attention masks by per-row
+    position. The per-row commit rule is byte-identical to the batch-1
+    loop, so losslessness holds row-wise."""
+    import functools
+
+    B, S = input_ids.shape
+    k = int(num_draft_tokens)
+    if k < 1:
+        raise ValueError('num_draft_tokens must be >= 1')
+    max_len = S + max_new_tokens + k + 1
+    tcaches = target.init_cache(B, max_len)
+    dcaches = draft.init_cache(B, max_len)
+
+    @jax.jit
+    def prefill(m, caches, ids):
+        logits, caches = m(ids, caches=caches, cache_index=0)
+        return jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32), caches
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def propose(m, caches, c, wp, k):
+        """Draft processes each row's committed token at its own offset,
+        then proposes k tokens per row (k+1 steps: the k-th proposal's
+        own kv row must be written — see the batch-1 docstring)."""
+        def body(carry, i):
+            tok, caches = carry
+            logits, caches = m(tok, caches=caches, kv_write_pos=wp + i)
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            return (nxt[:, None], caches), nxt
+        (_, caches), toks = jax.lax.scan(body, (c, caches),
+                                         jnp.arange(k + 1))
+        return toks[:k].T, caches              # (B, k)
+
+    @jax.jit
+    def verify(m, caches, window, wp):
+        logits, caches = m(window, caches=caches, kv_write_pos=wp)
+        return jnp.argmax(logits, -1).astype(jnp.int32), caches  # (B, k+1)
+
+    c0, tcaches = prefill(target, tcaches, input_ids)
+    _, dcaches = prefill(draft, dcaches, input_ids)
+    c_host = np.asarray(c0).astype(np.int64)           # (B,)
+
+    out = [[] for _ in range(B)]
+    finished = [False] * B
+    L = np.full((B,), S, np.int64)
+
+    def row_needs(b):
+        return not finished[b] and len(out[b]) < max_new_tokens
+
+    while any(row_needs(b) for b in range(B)):
+        cj = jnp.asarray(c_host[:, None], jnp.int32)
+        wp = jnp.asarray(L, jnp.int32)
+        drafts, dcaches = propose(draft, dcaches, cj, wp, k)
+        window = jnp.concatenate([cj, drafts], axis=1)           # (B, k+1)
+        choices, tcaches = verify(target, tcaches, window, wp)
+        d = np.asarray(drafts)
+        t = np.asarray(choices)
+        for b in range(B):
+            if not row_needs(b):
+                # full/finished rows still ran through the window
+                # (static shapes) but commit nothing: their L stays put,
+                # so next window simply overwrites the same scratch rows
+                continue
+            committed, c_host[b] = _commit_window(c_host[b], d[b], t[b], k)
+            out[b].extend(committed)
+            if eos_token_id is not None and eos_token_id in committed:
+                out[b] = out[b][:out[b].index(eos_token_id) + 1]
+                finished[b] = True
+            L[b] += len(committed)
+
+    pad = eos_token_id if eos_token_id is not None else 0
+    rows = []
+    for b in range(B):
+        row = out[b][:max_new_tokens]
+        rows.append(row + [pad] * (max_new_tokens - len(row)))
+    gen = jnp.asarray(rows, input_ids.dtype)
     return jnp.concatenate([input_ids, gen], axis=1)

@@ -133,7 +133,7 @@ def apply_rotary(x, cos, sin):
 # ---------------------------------------------------------------------------
 
 def cached_attention(q, k, v, cache, cache_index, kvalid=None,
-                     kv_start=None):
+                     kv_start=None, kv_write_pos=None):
     """Shared KV-cached attention step (LlamaAttention, GPTAttention):
     write the S new rows at cache_index, attend over the full cache
     masked by position; single-token steps dispatch to the fused pallas
@@ -143,7 +143,11 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     contiguous window [kv_start, now] (left-pad hole at the front) —
     with it, single-token steps KEEP the fused kernel (per-row start via
     scalar prefetch) instead of falling back to the masked XLA path.
-    Returns (out (B, S, H, D), new_cache).
+    `kv_write_pos` (B,) replaces the uniform cache_index with PER-ROW
+    write offsets (batched speculative decoding: rows commit at
+    different lengths); rows stay contiguous per row — position i of the
+    chunk lands at kv_write_pos[b] + i, and attention masks by per-row
+    position. Returns (out (B, S, H, D), new_cache).
 
     A QuantKVCache stores K/V int8 with per-(head, dim) scales: prefill
     (S > 1) calibrates the scales from its own rows, decode steps
@@ -152,6 +156,18 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     from .generation import QuantKVCache, calibrate_kv_scale, quantize_kv_rows
 
     B, S, H, D = q.shape
+    if kv_write_pos is not None:
+        wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,))
+        wp = jnp.broadcast_to(wp, (B,))
+        rows = jnp.arange(B)[:, None]
+        wcols = wp[:, None] + jnp.arange(S)[None, :]
+
+        def write(buf, new):
+            return buf.at[rows, wcols].set(new.astype(buf.dtype))
+    else:
+        def write(buf, new):
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (0, cache_index, 0, 0))
     quant = isinstance(cache, QuantKVCache)
     if quant:
         kq, vq, kscale, vscale = cache
@@ -161,24 +177,20 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
         # row already in the cache under new scales. cache_index is a
         # concrete 0 at prefill in all generation loops; traced indices
         # are by construction later steps.
-        is_prefill = (S > 1
+        is_prefill = (S > 1 and kv_write_pos is None
                       and not isinstance(cache_index, jax.core.Tracer)
                       and int(cache_index) == 0)
         if is_prefill:
             kscale = calibrate_kv_scale(k)
             vscale = calibrate_kv_scale(v)
-        kq = jax.lax.dynamic_update_slice(
-            kq, quantize_kv_rows(k, kscale), (0, cache_index, 0, 0))
-        vq = jax.lax.dynamic_update_slice(
-            vq, quantize_kv_rows(v, vscale), (0, cache_index, 0, 0))
+        kq = write(kq, quantize_kv_rows(k, kscale))
+        vq = write(vq, quantize_kv_rows(v, vscale))
         new_cache = QuantKVCache(kq, vq, kscale, vscale)
         ck, cv = kq, vq
     else:
         ck, cv = cache
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, cache_index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, cache_index, 0, 0))
+        ck = write(ck, k)
+        cv = write(cv, v)
         new_cache = (ck, cv)
     max_len = ck.shape[1]
     out = None
@@ -213,8 +225,9 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                     hspec = _valid_spec(
                         P(('dp', 'fsdp'), None, 'tp', None), ck.shape, mesh)
                     bat = hspec[0]
-                    vl = jnp.broadcast_to(
-                        jnp.asarray(cache_index + 1, jnp.int32), (B,))
+                    vl = jnp.broadcast_to(jnp.asarray(
+                        wp + 1 if kv_write_pos is not None
+                        else cache_index + 1, jnp.int32), (B,))
                     st = jnp.broadcast_to(jnp.asarray(
                         0 if kv_start is None else kv_start, jnp.int32),
                         (B,))
@@ -243,13 +256,17 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                             in_specs=(hspec, hspec, hspec, P(bat), P(bat)),
                             out_specs=hspec, check_vma=False,
                         )(q, ck, cv, vl, st)
-                elif quant:
-                    out = decode_attention(q, ck, cv, cache_index + 1,
-                                           k_scale=kscale, v_scale=vscale,
-                                           start=kv_start)
                 else:
-                    out = decode_attention(q, ck, cv, cache_index + 1,
-                                           start=kv_start)
+                    vl1 = (wp + 1 if kv_write_pos is not None
+                           else cache_index + 1)
+                    if quant:
+                        out = decode_attention(q, ck, cv, vl1,
+                                               k_scale=kscale,
+                                               v_scale=vscale,
+                                               start=kv_start)
+                    else:
+                        out = decode_attention(q, ck, cv, vl1,
+                                               start=kv_start)
             except Exception as e:
                 from ..ops import pallas_failed
 
@@ -257,8 +274,13 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     if out is None:
         # valid keys: position <= current query position (& kvalid)
         kpos = jnp.arange(max_len)
-        qpos = cache_index + jnp.arange(S)
-        mask = (kpos[None, :] <= qpos[:, None])[None, None]
+        if kv_write_pos is not None:
+            # per-row query positions (batched speculative verify)
+            qpos = wp[:, None] + jnp.arange(S)[None, :]        # (B, S)
+            mask = (kpos[None, None, None, :] <= qpos[:, None, :, None])
+        else:
+            qpos = cache_index + jnp.arange(S)
+            mask = (kpos[None, :] <= qpos[:, None])[None, None]
         if kvalid is not None:
             mask = mask & (kvalid[:, None, None, :] > 0)
         if kv_start is not None:
@@ -307,7 +329,8 @@ class LlamaAttention(Layer):
             self.q_bias = self.k_bias = self.v_bias = None
 
     def forward(self, x, positions, attn_mask=None, cache=None,
-                cache_index=None, kvalid=None, kv_start=None):
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
 
         Returns (out, new_cache). With a cache, writes the S new kv rows at
@@ -386,7 +409,8 @@ class LlamaAttention(Layer):
         else:
             out, new_cache = cached_attention(q, k, v, cache, cache_index,
                                               kvalid=kvalid,
-                                              kv_start=kv_start)
+                                              kv_start=kv_start,
+                                              kv_write_pos=kv_write_pos)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache
@@ -416,10 +440,11 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, positions, attn_mask=None, cache=None,
-                cache_index=None, kvalid=None, kv_start=None):
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         attn_out, new_cache = self.self_attn(
             self.input_layernorm(x), positions, attn_mask, cache,
-            cache_index, kvalid, kv_start
+            cache_index, kvalid, kv_start, kv_write_pos
         )
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
@@ -446,12 +471,18 @@ class LlamaModel(Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None, kvalid=None, kv_start=None):
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         B, S = input_ids.shape
         if positions is None:
-            base = 0 if cache_index is None else cache_index
-            positions = base + jnp.arange(S)[None, :].astype(jnp.int32)
-            positions = jnp.broadcast_to(positions, (B, S))
+            if kv_write_pos is not None:
+                wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,))
+                positions = wp[:, None] + jnp.arange(S)[None, :]
+                positions = jnp.broadcast_to(positions, (B, S))
+            else:
+                base = 0 if cache_index is None else cache_index
+                positions = base + jnp.arange(S)[None, :].astype(jnp.int32)
+                positions = jnp.broadcast_to(positions, (B, S))
         # mesh-aware lookup: one_hot matmul under a sharded mesh so the
         # (tp, fsdp) table sharding doesn't force an activation remat
         # (see distributed.embedding_lookup)
@@ -474,7 +505,7 @@ class LlamaModel(Layer):
                 nc = None
             else:
                 x, nc = layer(x, positions, attn_mask, cache, cache_index,
-                              kvalid, kv_start)
+                              kvalid, kv_start, kv_write_pos)
             if new_caches is not None:
                 new_caches.append(nc)
         return self.norm(x), new_caches
@@ -503,9 +534,11 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         return hidden @ self.lm_head
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
-                cache_index=None, kvalid=None, kv_start=None):
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         hidden, new_caches = self.model(input_ids, positions, attn_mask, caches,
-                                        cache_index, kvalid, kv_start)
+                                        cache_index, kvalid, kv_start,
+                                        kv_write_pos)
         logits = self.logits(hidden)
         if caches is None:
             return logits

@@ -214,13 +214,66 @@ class TestSpeculativeDecoding:
                                     num_draft_tokens=3, eos_token_id=eos)
         np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref_eos))
 
-    def test_batch_gt1_raises(self):
+    def test_batched_each_row_matches_solo_generate(self):
+        """B=4: per-row accepted lengths — every row must byte-match its
+        OWN plain greedy generate()."""
         from paddle_tpu.models.generation import generate_speculative
 
         target, draft = self._models()
-        with pytest.raises(NotImplementedError, match='batch-1'):
-            generate_speculative(target, draft,
-                                 jnp.zeros((2, 4), jnp.int32))
+        ids = jnp.asarray(
+            np.random.default_rng(5).integers(3, 96, (4, 6)), jnp.int32)
+        spec = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=12, num_draft_tokens=3))
+        for b in range(4):
+            solo = np.asarray(target.generate(ids[b:b + 1],
+                                              max_new_tokens=12))
+            np.testing.assert_array_equal(spec[b:b + 1], solo,
+                                          err_msg=f'row {b}')
+
+    def test_batched_eos_per_row(self):
+        """Rows hit eos at different points; each must match its own
+        eos-frozen generate()."""
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._models()
+        ids = jnp.asarray(
+            np.random.default_rng(6).integers(3, 96, (3, 6)), jnp.int32)
+        ref = np.asarray(target.generate(ids, max_new_tokens=16))
+        # pick a token that appears mid-stream in ONE row's output
+        eos = int(ref[0, 6 + 5])
+        spec = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=16, num_draft_tokens=4,
+            eos_token_id=eos))
+        for b in range(3):
+            solo = np.asarray(target.generate(ids[b:b + 1],
+                                              max_new_tokens=16,
+                                              eos_token_id=eos))
+            np.testing.assert_array_equal(spec[b:b + 1], solo,
+                                          err_msg=f'row {b}')
+
+    def test_batched_self_draft(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, _ = self._models()
+        ids = jnp.asarray(
+            np.random.default_rng(7).integers(3, 96, (2, 5)), jnp.int32)
+        ref = np.asarray(target.generate(ids, max_new_tokens=10))
+        spec = np.asarray(generate_speculative(
+            target, target, ids, max_new_tokens=10, num_draft_tokens=4))
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_batched_unsupported_model_raises(self):
+        """Models without kv_write_pos (GPT) stay batch-1 with a clear
+        error."""
+        from paddle_tpu.models.generation import generate_speculative
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        pt.seed(2)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, max_position_embeddings=64)
+        gpt = GPTForCausalLM(cfg)
+        with pytest.raises(NotImplementedError, match='kv_write_pos'):
+            generate_speculative(gpt, gpt, jnp.zeros((2, 4), jnp.int32))
 
 
 class TestGenerationCompositions:
