@@ -61,11 +61,11 @@ def hf_llama_config(hf_config) -> LlamaConfig:
     if scaling and (not isinstance(scaling, dict)
                     or scaling.get('rope_type', scaling.get('type',
                                                             'default'))
-                    not in (None, 'default', 'llama3')):
+                    not in (None, 'default', 'llama3', 'yarn')):
         raise ValueError(
             f'rope_scaling={scaling!r} is not supported by this converter '
-            f"(plain rope_theta RoPE or rope_type='llama3' only) — "
-            f'converting would produce silently wrong logits at long '
+            f"(plain rope_theta RoPE, rope_type='llama3', or 'yarn' only) "
+            f'— converting would produce silently wrong logits at long '
             f'positions')
     if scaling and scaling.get('rope_type',
                                scaling.get('type')) == 'llama3':
@@ -78,6 +78,12 @@ def hf_llama_config(hf_config) -> LlamaConfig:
                 f"rope_scaling rope_type='llama3' is missing required "
                 f'keys {missing} — refusing rather than guessing '
                 f'defaults transformers would reject')
+    if scaling and scaling.get('rope_type',
+                               scaling.get('type')) == 'yarn':
+        if 'factor' not in scaling:
+            raise ValueError(
+                "rope_scaling rope_type='yarn' is missing 'factor' — "
+                'refusing rather than guessing')
     act = get('hidden_act', 'silu')
     if act not in ('silu', 'swish'):
         raise ValueError(
@@ -95,6 +101,11 @@ def hf_llama_config(hf_config) -> LlamaConfig:
         rope_theta=get('rope_theta', 10000.0),
         rope_scaling=dict(scaling) if scaling else None,
         tie_word_embeddings=bool(get('tie_word_embeddings', False)),
+        # Mistral-style SWA: sliding_window set and no gating flag (a
+        # Qwen2 config gates it behind use_sliding_window — handled in
+        # hf_qwen2_config); Llama configs have no sliding_window at all
+        sliding_window=(get('sliding_window')
+                        if get('use_sliding_window', True) else None),
     )
 
 
@@ -449,23 +460,28 @@ def hf_qwen2_config(hf_config) -> LlamaConfig:
     architecture (RMSNorm/RoPE/SwiGLU/GQA) plus qkv biases
     (`attention_bias=True`). Reuses the Llama mapping — including its
     rope_scaling / hidden_act guards — then overrides the defaults that
-    differ and the sliding-window refusal."""
+    differ. use_sliding_window checkpoints convert with SWA applied to
+    layers >= max_window_layers (Qwen2 semantics)."""
     import dataclasses
 
     get = (hf_config.get if isinstance(hf_config, dict)
            else lambda k, d=None: getattr(hf_config, k, d))
-    if get('use_sliding_window', False):
-        raise ValueError(
-            'use_sliding_window=True unsupported: attention here is '
-            'full-causal — converting would give silently wrong logits '
-            'past the window')
     cfg = hf_llama_config(hf_config)
+    # Qwen2 SWA semantics with QWEN2's defaults (not Mistral's, which
+    # hf_llama_config assumes): use_sliding_window defaults to False and
+    # max_window_layers to 28, and the window applies only to layers
+    # >= max_window_layers (transformers Qwen2Attention)
+    sliding = (get('sliding_window')
+               if get('use_sliding_window', False) else None)
     return dataclasses.replace(
         cfg,
         max_position_embeddings=get('max_position_embeddings', 32768),
         rms_norm_eps=get('rms_norm_eps', 1e-6),
         rope_theta=get('rope_theta', 1e6),
         attention_bias=True,
+        sliding_window=sliding,
+        max_window_layers=(get('max_window_layers', 28) or 0
+                           if sliding is not None else 0),
     )
 
 

@@ -56,6 +56,12 @@ class LlamaConfig:
     remat_policy: str = 'dots'             # 'full' | 'dots' (save matmul outs)
     sequence_parallel: bool = False        # shard seq over the 'sp' axis
     sp_mode: str = 'ring'                  # 'ring' | 'ulysses' attention
+    # sliding-window (local) attention: each token attends its last
+    # `sliding_window` positions (Mistral/Qwen2-style SWA). None = full
+    # causal. Layers with index < max_window_layers keep FULL attention
+    # (Qwen2's use_sliding_window/max_window_layers semantics).
+    sliding_window: typing.Optional[int] = None
+    max_window_layers: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -99,21 +105,74 @@ def _llama3_scaled_inv_freq(inv_freq, scaling):
                                interp))
 
 
+def _yarn_scaled_inv_freq(inv_freq, scaling, head_dim, theta):
+    """YaRN rope scaling (ref: transformers
+    modeling_rope_utils._compute_yarn_parameters): interpolated (long-
+    wavelength) and extrapolated (short-wavelength) frequencies blended
+    by a per-dimension linear ramp between the beta_fast/beta_slow
+    correction dims. Returns (inv_freq, attention_factor) — the factor
+    scales cos/sin (softmax temperature correction)."""
+    factor = scaling['factor']
+    beta_fast = scaling.get('beta_fast', 32.0)
+    beta_slow = scaling.get('beta_slow', 1.0)
+    orig = scaling.get('original_max_position_embeddings', 4096)
+
+    def get_mscale(scale, mscale=1.0):
+        # transformers' guard: no temperature correction for scale <= 1
+        if scale <= 1:
+            return 1.0
+        return 0.1 * mscale * math.log(scale) + 1.0
+
+    attention_factor = scaling.get('attention_factor')
+    if attention_factor is None:
+        mscale = scaling.get('mscale')
+        mscale_all_dim = scaling.get('mscale_all_dim')
+        if mscale and mscale_all_dim:
+            # DeepSeek-style: the two mscales RATIO (transformers
+            # _compute_yarn_parameters); mscale without mscale_all_dim is
+            # ignored, matching transformers
+            attention_factor = float(get_mscale(factor, mscale)
+                                     / get_mscale(factor, mscale_all_dim))
+        else:
+            attention_factor = get_mscale(factor)
+
+    def correction_dim(num_rotations):
+        return (head_dim * math.log(orig / (num_rotations * 2 * math.pi))
+                ) / (2 * math.log(theta))
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), head_dim - 1)
+    ramp = jnp.clip(
+        (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
+        / max(high - low, 0.001), 0.0, 1.0)
+    extrapolation_factor = 1.0 - ramp
+    inv_freq = (inv_freq / factor * (1 - extrapolation_factor)
+                + inv_freq * extrapolation_factor)
+    return inv_freq, float(attention_factor)
+
+
 def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32,
                  rope_scaling=None):
     """cos/sin tables for the given integer positions, shape (..., head_dim//2).
 
     rope_scaling: optional dict; rope_type 'llama3' applies the Llama-3.x
-    frequency rescale (other types are rejected at config time)."""
+    frequency rescale, 'yarn' the YaRN interpolation (incl. the
+    attention-temperature factor on cos/sin, matching transformers);
+    other types are rejected at config time."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    att = 1.0
     if rope_scaling:
         rt = rope_scaling.get('rope_type', rope_scaling.get('type'))
         if rt == 'llama3':
             inv_freq = _llama3_scaled_inv_freq(inv_freq, rope_scaling)
+        elif rt == 'yarn':
+            inv_freq, att = _yarn_scaled_inv_freq(inv_freq, rope_scaling,
+                                                  head_dim, theta)
         elif rt not in (None, 'default'):
             raise ValueError(f'unsupported rope_scaling type {rt!r}')
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., D/2)
-    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+    return ((jnp.cos(angles) * att).astype(dtype),
+            (jnp.sin(angles) * att).astype(dtype))
 
 
 def apply_rotary(x, cos, sin):
@@ -133,7 +192,7 @@ def apply_rotary(x, cos, sin):
 # ---------------------------------------------------------------------------
 
 def cached_attention(q, k, v, cache, cache_index, kvalid=None,
-                     kv_start=None, kv_write_pos=None):
+                     kv_start=None, kv_write_pos=None, window=None):
     """Shared KV-cached attention step (LlamaAttention, GPTAttention):
     write the S new rows at cache_index, attend over the full cache
     masked by position; single-token steps dispatch to the fused pallas
@@ -147,7 +206,10 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     write offsets (batched speculative decoding: rows commit at
     different lengths); rows stay contiguous per row — position i of the
     chunk lands at kv_write_pos[b] + i, and attention masks by per-row
-    position. Returns (out (B, S, H, D), new_cache).
+    position. `window` (int) applies sliding-window attention over the
+    cache: only the last `window` positions are attended — on the fused
+    decode path this is just a larger per-row start, so the kernel still
+    streams only the live band. Returns (out (B, S, H, D), new_cache).
 
     A QuantKVCache stores K/V int8 with per-(head, dim) scales: prefill
     (S > 1) calibrates the scales from its own rows, decode steps
@@ -231,6 +293,10 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                     st = jnp.broadcast_to(jnp.asarray(
                         0 if kv_start is None else kv_start, jnp.int32),
                         (B,))
+                    if window is not None:
+                        # SWA over the cache: window start is just a
+                        # bigger per-row start offset
+                        st = jnp.maximum(st, vl - window)
                     if quant:
                         sspec = _valid_spec(P('tp', None), kscale.shape,
                                             mesh)
@@ -259,14 +325,21 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                 else:
                     vl1 = (wp + 1 if kv_write_pos is not None
                            else cache_index + 1)
+                    st1 = kv_start
+                    if window is not None:
+                        wstart = jnp.maximum(
+                            jnp.asarray(vl1, jnp.int32) - window, 0)
+                        st1 = (wstart if st1 is None
+                               else jnp.maximum(
+                                   jnp.asarray(st1, jnp.int32), wstart))
                     if quant:
                         out = decode_attention(q, ck, cv, vl1,
                                                k_scale=kscale,
                                                v_scale=vscale,
-                                               start=kv_start)
+                                               start=st1)
                     else:
                         out = decode_attention(q, ck, cv, vl1,
-                                               start=kv_start)
+                                               start=st1)
             except Exception as e:
                 from ..ops import pallas_failed
 
@@ -289,6 +362,15 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
             # fused kernel ran
             st = jnp.reshape(jnp.asarray(kv_start, jnp.int32), (-1,))
             mask = mask & (kpos[None, :] >= st[:, None])[:, None, None, :]
+        if window is not None:
+            # sliding window: qpos - kpos < window (qpos is (S,) uniform
+            # or (B, S) per-row; both broadcast against kpos)
+            if qpos.ndim == 2:
+                band = (qpos[:, :, None] - kpos[None, None, :]
+                        < window)[:, None]
+            else:
+                band = (qpos[:, None] - kpos[None, :] < window)[None, None]
+            mask = mask & band
         if quant:
             # XLA fallback: whole-cache dequant (correctness path; the
             # bandwidth win lives in the pallas kernel)
@@ -301,13 +383,26 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
 class LlamaAttention(Layer):
     """GQA attention with RoPE. Column-parallel QKV, row-parallel output."""
 
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
+        # Qwen2 semantics: SWA only on layers >= max_window_layers
+        self.sliding_window = (
+            config.sliding_window
+            if (config.sliding_window is not None
+                and layer_idx >= config.max_window_layers) else None)
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = config.head_dim
         self.rope_theta = config.rope_theta
-        self.rope_scaling = config.rope_scaling
+        rs = config.rope_scaling
+        if (rs and rs.get('rope_type', rs.get('type')) == 'yarn'
+                and 'original_max_position_embeddings' not in rs):
+            # transformers falls back to config.max_position_embeddings
+            # for the yarn correction ramp — a 4096 guess here would
+            # silently skew every frequency
+            rs = dict(rs, original_max_position_embeddings=config
+                      .max_position_embeddings)
+        self.rope_scaling = rs
         self.sequence_parallel = config.sequence_parallel
         if config.sp_mode not in ('ring', 'ulysses'):
             raise ValueError(
@@ -351,14 +446,23 @@ class LlamaAttention(Layer):
         k = apply_rotary(k, cos, sin)
 
         if cache is None:
-            if kvalid is not None:
-                # honor pad-invalidation on the uncached path too: fold
-                # it into an explicit causal+kvalid mask (silently
-                # ignoring it would let real tokens attend to pads)
-                causal = (jnp.arange(S)[None, :]
-                          <= jnp.arange(S)[:, None])[None, None]
-                kv = (kvalid[:, :S] > 0)[:, None, None, :]
-                extra_mask = causal & kv
+            win = self.sliding_window
+            if kvalid is not None or (win is not None
+                                      and attn_mask is not None):
+                # honor pad-invalidation (and the SWA band when a user
+                # mask blocks the kernel path) on the uncached path too:
+                # fold into an explicit causal mask (silently ignoring
+                # kvalid would let real tokens attend to pads)
+                extra_mask = (jnp.arange(S)[None, :]
+                              <= jnp.arange(S)[:, None])[None, None]
+                if kvalid is not None:
+                    extra_mask = extra_mask & (
+                        kvalid[:, :S] > 0)[:, None, None, :]
+                if win is not None:
+                    extra_mask = extra_mask & (
+                        jnp.arange(S)[:, None] - jnp.arange(S)[None, :]
+                        < win)[None, None]
+                    win = None          # folded; don't pass to sdpa too
                 if attn_mask is None:
                     attn_mask = extra_mask
                 elif attn_mask.dtype == jnp.bool_:
@@ -367,7 +471,8 @@ class LlamaAttention(Layer):
                     attn_mask = attn_mask + jnp.where(
                         extra_mask, 0.0, -1e30).astype(attn_mask.dtype)
             out = None
-            if self.sequence_parallel and attn_mask is None:
+            if (self.sequence_parallel and attn_mask is None
+                    and win is None):
                 from ..distributed.mesh import get_mesh
 
                 mesh = get_mesh()
@@ -404,13 +509,15 @@ class LlamaAttention(Layer):
                                                      axis='sp', causal=True)
             if out is None:
                 out = F.scaled_dot_product_attention(
-                    q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+                    q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+                    window_size=win)
             new_cache = None
         else:
             out, new_cache = cached_attention(q, k, v, cache, cache_index,
                                               kvalid=kvalid,
                                               kv_start=kv_start,
-                                              kv_write_pos=kv_write_pos)
+                                              kv_write_pos=kv_write_pos,
+                                              window=self.sliding_window)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache
@@ -432,10 +539,10 @@ class LlamaMLP(Layer):
 
 
 class LlamaDecoderLayer(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
-        self.self_attn = LlamaAttention(config)
+        self.self_attn = LlamaAttention(config, layer_idx)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
@@ -466,7 +573,8 @@ class LlamaModel(Layer):
             init((config.vocab_size, config.hidden_size), config.dtype), spec=P('tp', None)
         )
         self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+            [LlamaDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)]
         )
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 

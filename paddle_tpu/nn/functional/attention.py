@@ -58,12 +58,19 @@ def scaled_dot_product_attention(
     rng_key=None,
     segment_ids=None,
     kv_segment_ids=None,
+    window_size=None,
 ):
     """Flash attention on TPU; lax reference elsewhere/with masks it can't take.
 
     segment_ids (+optional kv_segment_ids for Sq != Sk): (B, Sq)/(B, Sk)
     int32 packed-sequence ids — attention is block-diagonal within equal
     ids (flash kernel fast path on TPU).
+
+    window_size: optional int — causal sliding-window attention (each
+    query sees only its last `window_size` keys, self included). On TPU
+    this takes the flash kernel's block-skipping fast path (ref:
+    python/paddle/nn/functional/flash_attention.py:1106); elsewhere the
+    band folds into the mask.
     """
     from ...ops import use_pallas
 
@@ -74,6 +81,8 @@ def scaled_dot_product_attention(
             raise ValueError(
                 'segment_ids with Sq != Sk requires kv_segment_ids')
         kv_segment_ids = segment_ids
+    if window_size is not None and not is_causal:
+        raise ValueError('window_size requires is_causal=True')
 
     use_flash = (
         dropout_p == 0.0
@@ -88,11 +97,24 @@ def scaled_dot_product_attention(
 
             return flash_attention(query, key, value, causal=is_causal,
                                    scale=scale, segment_ids=segment_ids,
-                                   kv_segment_ids=kv_segment_ids)
+                                   kv_segment_ids=kv_segment_ids,
+                                   window_size=window_size)
         except Exception as e:
             from ...ops import pallas_failed
 
             pallas_failed('flash_attention', e)
+    if window_size is not None:
+        # fold the band into the mask for the reference path
+        Sq, Sk = query.shape[1], key.shape[1]
+        qpos = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        kpos = jnp.arange(Sk)[None, :]
+        band = (qpos - kpos < window_size)[None, None]    # causal half below
+        if attn_mask is None:
+            attn_mask = band
+        elif attn_mask.dtype == jnp.bool_:
+            attn_mask = attn_mask & band
+        else:
+            attn_mask = jnp.where(band, attn_mask.astype(jnp.float32), -1e30)
     if segment_ids is not None:
         qseg = jnp.asarray(segment_ids)
         kseg = jnp.asarray(kv_segment_ids)

@@ -38,7 +38,8 @@ def _interpret():
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
-                scale, causal, bq, bk, nk, offset, Sq, Sk, has_seg=False):
+                scale, causal, bq, bk, nk, offset, Sq, Sk, has_seg=False,
+                window=None):
     if has_seg:
         qseg_ref, kseg_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
     else:
@@ -55,6 +56,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
 
     # causal block skip: whole q block above the diagonal → contributes 0
     live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
+    if window is not None:
+        # sliding-window block skip: a k block wholly BEFORE every query's
+        # window start (qpos + offset - w < kpos) is dead — same static
+        # machinery as the causal skip, mirrored to the other side
+        live = live & (ik * bk + (bk - 1) > iq * bq + offset - window)
 
     @pl.when(live)
     def _():
@@ -70,13 +76,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         ok = None
-        if causal or k_tail or has_seg:
+        if causal or k_tail or has_seg or window is not None:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq),
             # merged with the key-tail validity and segment masks
             ok = (qpos + offset >= kpos) if causal else \
                 jnp.ones((bq, bk), bool)
+            if window is not None:
+                # attend only the last `window` positions (incl. self)
+                ok = ok & (qpos + offset - kpos < window)
             if k_tail:
                 ok = ok & (kpos < Sk)
             if has_seg:
@@ -109,7 +118,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
         lse_ref[0, 0, 0] = m_scr[:, 0] + jnp.log(safe)
 
 
-def _fwd(q, k, v, scale, causal, bq, bk, qseg=None, kseg=None):
+def _fwd(q, k, v, scale, causal, bq, bk, qseg=None, kseg=None,
+         window=None):
     """q: (B, H, Sq, D); k/v: (B, Hkv, Sk, D) → (out, lse).
 
     qseg/kseg: optional (B, Sq)/(B, Sk) int32 segment ids — tokens only
@@ -125,7 +135,7 @@ def _fwd(q, k, v, scale, causal, bq, bk, qseg=None, kseg=None):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, offset=Sk - Sq,
-                               Sq=Sq, Sk=Sk, has_seg=has_seg)
+                               Sq=Sq, Sk=Sk, has_seg=has_seg, window=window)
     in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h // group, j, 0)),
@@ -167,7 +177,7 @@ def _fwd(q, k, v, scale, causal, bq, bk, qseg=None, kseg=None):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                    scale, causal, bq, bk, nk, offset, Sq, Sk,
-                   has_seg=False):
+                   has_seg=False, window=None):
     if has_seg:
         qseg_ref, kseg_ref, dq_ref, dq_acc = refs
     else:
@@ -182,6 +192,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
     # causal block skip (same as fwd): fully-masked blocks contribute 0
     live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
+    if window is not None:
+        live = live & (ik * bk + (bk - 1) > iq * bq + offset - window)
 
     @pl.when(live)
     def _():
@@ -199,12 +211,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         kvalid = True
-        if causal or k_tail or has_seg:
+        if causal or k_tail or has_seg or window is not None:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
             ok = (qpos + offset >= kpos) if causal else \
                 jnp.ones((bq, bk), bool)
+            if window is not None:
+                ok = ok & (qpos + offset - kpos < window)
             if k_tail:
                 kvalid = kpos < Sk
                 ok = ok & kvalid
@@ -212,7 +226,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                 ok = ok & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                    # (bq, bk)
-        if causal or k_tail or has_seg:
+        if causal or k_tail or has_seg or window is not None:
             # empty-segment rows: lse ≈ NEG_INF makes exp(s - lse) = 1
             p = jnp.where(ok, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -231,7 +245,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, scale, causal, bq, bk, nq,
-                    offset, Sq, Sk, has_seg=False):
+                    offset, Sq, Sk, has_seg=False, window=None):
     if has_seg:
         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     else:
@@ -247,6 +261,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # causal block skip (same as fwd): fully-masked blocks contribute 0
     live = (iq * bq + (bq - 1) + offset >= ik * bk) if causal else True
+    if window is not None:
+        live = live & (ik * bk + (bk - 1) > iq * bq + offset - window)
 
     @pl.when(live)
     def _():
@@ -269,17 +285,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal or has_seg:
+        if causal or has_seg or window is not None:
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             # bottom-right causal (matches _sdpa_reference tril k=Sk-Sq)
             ok = (qpos + offset >= kpos) if causal else \
                 jnp.ones((bq, bk), bool)
+            if window is not None:
+                ok = ok & (qpos + offset - kpos < window)
             if has_seg:
                 ok = ok & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        if causal or has_seg:
+        if causal or has_seg or window is not None:
             # empty-segment rows: lse ≈ NEG_INF makes exp(s - lse) = 1
             p = jnp.where(ok, p, 0.0)
         if q_tail:
@@ -302,7 +320,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None):
+def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None,
+         window=None):
     q, k, v, out, lse = res
     do, _ = g
     has_seg = qseg is not None
@@ -335,7 +354,7 @@ def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq_, bk=bk_, nk=nk, offset=Sk - Sq,
-                          Sq=Sq, Sk=Sk, has_seg=has_seg),
+                          Sq=Sq, Sk=Sk, has_seg=has_seg, window=window),
         grid=(B, H, nq, nk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq_, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -361,7 +380,7 @@ def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq_, bk=bk_, nq=nq, offset=Sk - Sq,
-                          Sq=Sq, Sk=Sk, has_seg=has_seg),
+                          Sq=Sq, Sk=Sk, has_seg=has_seg, window=window),
         grid=(B, H, nk, nq),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -389,39 +408,40 @@ def _bwd(scale, causal, bq, bk, res, g, qseg=None, kseg=None):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, bq, bk):
-    out, _ = _fwd(q, k, v, scale, causal, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, window):
+    out, _ = _fwd(q, k, v, scale, causal, bq, bk, window=window)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk):
-    out, lse = _fwd(q, k, v, scale, causal, bq, bk)
+def _flash_fwd(q, k, v, scale, causal, bq, bk, window):
+    out, lse = _fwd(q, k, v, scale, causal, bq, bk, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, bq, bk, res, g):
-    return _bwd(scale, causal, bq, bk, res, (g, None))
+def _flash_bwd(scale, causal, bq, bk, window, res, g):
+    return _bwd(scale, causal, bq, bk, res, (g, None), window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_seg(q, k, v, qseg, kseg, scale, causal, bq, bk):
-    out, _ = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_seg(q, k, v, qseg, kseg, scale, causal, bq, bk, window):
+    out, _ = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg, window=window)
     return out
 
 
-def _flash_seg_fwd(q, k, v, qseg, kseg, scale, causal, bq, bk):
-    out, lse = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg)
+def _flash_seg_fwd(q, k, v, qseg, kseg, scale, causal, bq, bk, window):
+    out, lse = _fwd(q, k, v, scale, causal, bq, bk, qseg, kseg,
+                    window=window)
     return out, (q, k, v, out, lse, qseg, kseg)
 
 
-def _flash_seg_bwd(scale, causal, bq, bk, res, g):
+def _flash_seg_bwd(scale, causal, bq, bk, window, res, g):
     q, k, v, out, lse, qseg, kseg = res
     dq, dk, dv = _bwd(scale, causal, bq, bk, (q, k, v, out, lse),
-                      (g, None), qseg, kseg)
+                      (g, None), qseg, kseg, window=window)
     return dq, dk, dv, None, None
 
 
@@ -430,7 +450,7 @@ _flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    segment_ids=None, kv_segment_ids=None):
+                    segment_ids=None, kv_segment_ids=None, window_size=None):
     """q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D). Returns (B, Sq, H, D).
 
     segment_ids/(kv_segment_ids): optional (B, Sq)/(B, Sk) int32 packed-
@@ -438,7 +458,22 @@ def flash_attention(q, k, v, causal=False, scale=None,
     of different packed documents never attend to each other). With
     causal=True both masks compose. A query whose segment has no kv
     tokens returns 0 for that row.
+
+    window_size: optional int — sliding-window (local) attention: each
+    query attends only the last `window_size` keys including itself
+    (ref: python/paddle/nn/functional/flash_attention.py:1106 —
+    flash_attention's window_size). Requires causal=True; k blocks
+    wholly outside the band are SKIPPED (same grid machinery as the
+    causal skip), so long-sequence SWA costs O(S·w) not O(S²).
     """
+    if window_size is not None:
+        window_size = int(window_size)
+        if not causal:
+            raise ValueError(
+                'window_size requires causal=True (decoder sliding-window '
+                'attention); use an explicit mask for bidirectional bands')
+        if window_size < 1:
+            raise ValueError(f'window_size must be >= 1, got {window_size}')
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)
@@ -448,7 +483,9 @@ def flash_attention(q, k, v, causal=False, scale=None,
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         out = _flash_seg(qt, kt, vt, jnp.asarray(segment_ids, jnp.int32),
                          jnp.asarray(kv_seg, jnp.int32),
-                         float(scale), bool(causal), block_q, block_k)
+                         float(scale), bool(causal), block_q, block_k,
+                         window_size)
     else:
-        out = _flash(qt, kt, vt, float(scale), bool(causal), block_q, block_k)
+        out = _flash(qt, kt, vt, float(scale), bool(causal), block_q,
+                     block_k, window_size)
     return jnp.swapaxes(out, 1, 2)

@@ -95,12 +95,19 @@ def test_converted_model_keeps_tp_specs():
 
 
 def test_rope_scaling_rejected():
+    # unknown scaling types still refuse; yarn is now supported
     with pytest.raises(ValueError, match='rope_scaling'):
         hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
                          'intermediate_size': 64, 'num_hidden_layers': 1,
                          'num_attention_heads': 2,
-                         'rope_scaling': {'rope_type': 'yarn',
+                         'rope_scaling': {'rope_type': 'longrope',
                                           'factor': 8.0}})
+    cfg = hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
+                           'intermediate_size': 64, 'num_hidden_layers': 1,
+                           'num_attention_heads': 2,
+                           'rope_scaling': {'rope_type': 'yarn',
+                                            'factor': 8.0}})
+    assert cfg.rope_scaling['rope_type'] == 'yarn'
     # llama3 scaling with missing keys: refuse at convert time, not at
     # first forward (or silently diverging defaults)
     with pytest.raises(ValueError, match='missing required'):
@@ -379,14 +386,19 @@ def test_qwen2_unsupported_configs_rejected():
 
     base = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
                 num_hidden_layers=1, num_attention_heads=4)
-    with pytest.raises(ValueError, match='sliding_window'):
-        hf_qwen2_config({**base, 'use_sliding_window': True})
+    # use_sliding_window now CONVERTS (SWA support, r5); the window maps
+    cfg = hf_qwen2_config({**base, 'use_sliding_window': True,
+                           'sliding_window': 8})
+    assert cfg.sliding_window == 8
     with pytest.raises(ValueError, match='hidden_act'):
         hf_qwen2_config({**base, 'hidden_act': 'gelu'})
-    # long-context Qwen2.5 checkpoints ship yarn scaling — refuse (the
-    # guard is inherited from the Llama mapping)
+    # long-context Qwen2.5 yarn checkpoints now convert too
+    cfg = hf_qwen2_config({**base, 'rope_scaling': {'rope_type': 'yarn',
+                                                    'factor': 4.0}})
+    assert cfg.rope_scaling['factor'] == 4.0
+    # unknown scaling types still refuse
     with pytest.raises(ValueError, match='rope_scaling'):
-        hf_qwen2_config({**base, 'rope_scaling': {'rope_type': 'yarn',
+        hf_qwen2_config({**base, 'rope_scaling': {'rope_type': 'longrope',
                                                   'factor': 4.0}})
 
 
