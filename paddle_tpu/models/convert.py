@@ -471,7 +471,10 @@ def hf_qwen2_config(hf_config) -> LlamaConfig:
     # hf_llama_config assumes): use_sliding_window defaults to False and
     # max_window_layers to 28, and the window applies only to layers
     # >= max_window_layers (transformers Qwen2Attention)
-    sliding = (get('sliding_window')
+    # transformers defaults sliding_window to 4096 when the flag is on
+    # and the key absent — mirror it rather than silently converting to
+    # full attention
+    sliding = ((get('sliding_window') or 4096)
                if get('use_sliding_window', False) else None)
     return dataclasses.replace(
         cfg,

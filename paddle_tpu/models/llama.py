@@ -115,7 +115,9 @@ def _yarn_scaled_inv_freq(inv_freq, scaling, head_dim, theta):
     factor = scaling['factor']
     beta_fast = scaling.get('beta_fast', 32.0)
     beta_slow = scaling.get('beta_slow', 1.0)
-    orig = scaling.get('original_max_position_embeddings', 4096)
+    # `or`: an explicit None (transformers accepts it) must not reach
+    # math.log; model callers inject config.max_position_embeddings
+    orig = scaling.get('original_max_position_embeddings') or 4096
 
     def get_mscale(scale, mscale=1.0):
         # transformers' guard: no temperature correction for scale <= 1
@@ -396,7 +398,7 @@ class LlamaAttention(Layer):
         self.rope_theta = config.rope_theta
         rs = config.rope_scaling
         if (rs and rs.get('rope_type', rs.get('type')) == 'yarn'
-                and 'original_max_position_embeddings' not in rs):
+                and rs.get('original_max_position_embeddings') is None):
             # transformers falls back to config.max_position_embeddings
             # for the yarn correction ramp — a 4096 guess here would
             # silently skew every frequency
@@ -404,6 +406,16 @@ class LlamaAttention(Layer):
                       .max_position_embeddings)
         self.rope_scaling = rs
         self.sequence_parallel = config.sequence_parallel
+        if self.sequence_parallel and self.sliding_window is not None:
+            import warnings
+
+            warnings.warn(
+                'sliding_window disables the ring/ulysses sequence-'
+                'parallel attention path (the ring schedule has no '
+                'window fast path yet); attention falls back to the '
+                'flash kernel on sp-sharded activations, which GSPMD '
+                'reshards — expect a perf cliff, not wrong results',
+                stacklevel=3)
         if config.sp_mode not in ('ring', 'ulysses'):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got "
