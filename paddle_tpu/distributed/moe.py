@@ -350,10 +350,12 @@ class MoELayer(Layer):
         # an inner trace (e.g. generate()'s lax.scan closes over self),
         # writing the traced aux would leak a tracer into the instance
         # and poison every later flatten/jit with UnexpectedTracerError.
-        if (isinstance(aux, jax.core.Tracer)
-                and not isinstance(self.aux_loss, jax.core.Tracer)):
-            pass
-        else:
+        # NOTE: in that skipped case `self.aux_loss` retains its value
+        # from the last eager call (stale) — read the aux via
+        # `return_aux=True` inside jitted code, never off the instance.
+        stash_ok = not (isinstance(aux, jax.core.Tracer)
+                        and not isinstance(self.aux_loss, jax.core.Tracer))
+        if stash_ok:
             object.__setattr__(self, 'aux_loss', aux)
         if self.return_aux:
             return out, aux

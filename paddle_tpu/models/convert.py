@@ -101,6 +101,11 @@ def hf_llama_config(hf_config) -> LlamaConfig:
         rope_theta=get('rope_theta', 10000.0),
         rope_scaling=dict(scaling) if scaling else None,
         tie_word_embeddings=bool(get('tie_word_embeddings', False)),
+        # Llama-architecture checkpoints with qkv biases (HF
+        # attention_bias=True) convert via the same bias path Qwen2 uses;
+        # without this mapping they'd fail late with an opaque
+        # 'unconverted HF weights: [...bias...]'
+        attention_bias=bool(get('attention_bias', False)),
         # Mistral-style SWA: sliding_window set and no gating flag (a
         # Qwen2 config gates it behind use_sliding_window — handled in
         # hf_qwen2_config); Llama configs have no sliding_window at all
@@ -471,11 +476,15 @@ def hf_qwen2_config(hf_config) -> LlamaConfig:
     # hf_llama_config assumes): use_sliding_window defaults to False and
     # max_window_layers to 28, and the window applies only to layers
     # >= max_window_layers (transformers Qwen2Attention)
-    # transformers defaults sliding_window to 4096 when the flag is on
-    # and the key absent — mirror it rather than silently converting to
-    # full attention
-    sliding = ((get('sliding_window') or 4096)
-               if get('use_sliding_window', False) else None)
+    # transformers defaults sliding_window to 4096 only when the key is
+    # ABSENT; an explicit null in config.json means full attention —
+    # mirror both (an `or 4096` would silently window a null config)
+    has_sw = ('sliding_window' in hf_config if isinstance(hf_config, dict)
+              else hasattr(hf_config, 'sliding_window'))
+    if get('use_sliding_window', False):
+        sliding = (get('sliding_window') or None) if has_sw else 4096
+    else:
+        sliding = None
     return dataclasses.replace(
         cfg,
         max_position_embeddings=get('max_position_embeddings', 32768),

@@ -54,7 +54,12 @@ class GenerationMixin:
         exemptions are STRUCTURAL: lookup tables / routers declare
         `no_quantize` on their layer class (embed_tokens, wte/wpe, MoE
         gates) and nn.Embedding subtrees are never touched. Returns a
-        new model; the original is untouched."""
+        new model; the original is untouched.
+
+        Caveats: 3-D batched MoE expert weights and tied heads served
+        off the embedding table stay full precision (see
+        quantization.quantize_matmul_weights) — MoE models should not
+        expect the full 2x/4x HBM saving."""
         from ..quantization import quantize_matmul_weights
 
         return quantize_matmul_weights(self, bits=bits, min_features=1)
@@ -149,20 +154,32 @@ class GenerationMixin:
             # HF tokenizers hand back an all-ones mask for equal-length
             # batches; collapsing it to None BEFORE the capability
             # checks keeps GPT/beam-search usable with standard HF
-            # pipelines and preserves the fused decode kernel
+            # pipelines and preserves the fused decode kernel. The
+            # collapse (and the left-contiguity fast path) inspect
+            # CONCRETE masks only — a traced mask skips both, so
+            # jit-wrapping generate() should pass mask=None for
+            # equal-length batches (the errors below say so).
             if bool(np.asarray(attention_mask).all()):
                 attention_mask = None
         if attention_mask is not None:
             import inspect
 
+            traced_hint = (
+                ' (note: the mask is a tracer here — the all-ones '
+                'collapse only inspects concrete masks, so jit-wrapped '
+                'generate() must pass attention_mask=None for '
+                'equal-length batches)'
+                if isinstance(attention_mask, jax.core.Tracer) else '')
             params = inspect.signature(self.forward).parameters
             if 'kvalid' not in params:
                 raise NotImplementedError(
                     f'{type(self).__name__} does not support attention_mask '
-                    f'generation (cached forward lacks positions/kvalid)')
+                    f'generation (cached forward lacks positions/kvalid)'
+                    + traced_hint)
             if num_beams > 1:
                 raise NotImplementedError(
-                    'attention_mask + beam search is not supported yet')
+                    'attention_mask + beam search is not supported yet'
+                    + traced_hint)
         # decode always runs in eval mode: dropout inside the scan would
         # corrupt greedy decoding and make beam scores non-deterministic
         # (the mode flag is static layer state, restored on exit)

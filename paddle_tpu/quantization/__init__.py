@@ -74,6 +74,13 @@ def quantize_matmul_weights(model, bits=8, min_features=64, exclude=()):
     its param names (lookup tables held as raw Parameters, e.g. a
     model's ``embed_tokens``). `exclude` adds user path-substring
     excludes on top. Returns a new model; the original is untouched.
+
+    Known limitations (weight bytes that do NOT shrink):
+      - 3-D batched MoE expert weights (E, in, out) are skipped by the
+        ndim==2 rule — for expert-heavy MoE models most weight bytes
+        stay full precision, so the 2x/4x decode win does not apply;
+      - tied LM heads served as ``embed_tokens.T`` ride the (excluded)
+        embedding table, so the head matmul stays full precision.
     """
     import jax
 

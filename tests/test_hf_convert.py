@@ -94,6 +94,21 @@ def test_converted_model_keeps_tp_specs():
     assert model.model.meta_for('embed_tokens').spec is not None
 
 
+def test_llama_attention_bias_maps():
+    # a Llama-architecture checkpoint with qkv biases (attention_bias
+    # in the HF config) converts via the Qwen2-style bias path instead
+    # of failing late on unconverted bias tensors
+    cfg = hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
+                           'intermediate_size': 64, 'num_hidden_layers': 1,
+                           'num_attention_heads': 2,
+                           'attention_bias': True})
+    assert cfg.attention_bias is True
+    cfg = hf_llama_config({'vocab_size': 64, 'hidden_size': 32,
+                           'intermediate_size': 64, 'num_hidden_layers': 1,
+                           'num_attention_heads': 2})
+    assert cfg.attention_bias is False
+
+
 def test_rope_scaling_rejected():
     # unknown scaling types still refuse; yarn is now supported
     with pytest.raises(ValueError, match='rope_scaling'):
