@@ -241,3 +241,350 @@ def fused_bias_act(x, bias=None, act_method='gelu'):
         x = x + bias
     return {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'silu': jax.nn.silu,
             'swiglu': swiglu}[act_method](x)
+
+
+# ---------------------------------------------------------------------------
+# Serving attention primitives (paged + masked decode)
+# ---------------------------------------------------------------------------
+
+def _split_qkv(x, num_heads, num_kv_heads, head_dim):
+    """(T, (Hq + 2*Hkv) * D) fused qkv -> q (T, Hq, D), k/v (T, Hkv, D)."""
+    q_sz = num_heads * head_dim
+    kv_sz = num_kv_heads * head_dim
+    q = x[..., :q_sz].reshape(*x.shape[:-1], num_heads, head_dim)
+    k = x[..., q_sz:q_sz + kv_sz].reshape(*x.shape[:-1], num_kv_heads,
+                                          head_dim)
+    v = x[..., q_sz + kv_sz:].reshape(*x.shape[:-1], num_kv_heads, head_dim)
+    return q, k, v
+
+
+def _rope_rows(q, k, cos, sin, neox):
+    """Rotate one row per sequence: q/k (N, H, D); cos/sin (N, D/2).
+    neox=True -> rotate-half; False -> GPT-J interleaved pairs (the
+    reference default), mirroring fused_rotary_position_embedding."""
+    if neox:
+        from ...models.llama import apply_rotary
+
+        return (apply_rotary(q[:, None], cos[:, None], sin[:, None])[:, 0],
+                apply_rotary(k[:, None], cos[:, None], sin[:, None])[:, 0])
+
+    def rot(x):
+        D = x.shape[-1]
+        xp = x.reshape(*x.shape[:-1], D // 2, 2)
+        xe, xo = xp[..., 0], xp[..., 1]
+        c, sn = cos[:, None, :], sin[:, None, :]
+        return jnp.stack([xe * c - xo * sn, xo * c + xe * sn],
+                         -1).reshape(x.shape).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype='default', out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode MHA over a contiguous cache (ref:
+    python/paddle/incubate/nn/functional/masked_multihead_attention.py:74
+    — the reference generation loop's fused decode attention).
+
+    x: (B, 3*H*D) fused qkv for ONE new token per row; cache_kv:
+    (2, B, H, max_seq, D); sequence_lengths: (B, 1) current per-row
+    lengths (write position). rotary_tensor: optional (2, B, S, D/2)
+    cos/sin stack applied to q/k at each row's position. Returns
+    (out (B, H*D), cache_kv_out).
+
+    TPU-native: the cache row is attended by the paged decode kernel
+    (ops/pallas/paged_attention.py, one page per row) when the row fits
+    VMEM; the XLA masked path otherwise. The reference's smooth-quant
+    int8 GEMM pipeline knobs (qkv_out_scale / out_shift / out_smooth /
+    int32 x / out_scale) are CUDA-pipeline-specific and rejected.
+    """
+    for name, v_ in (('qkv_out_scale', qkv_out_scale),
+                     ('out_shift', out_shift), ('out_smooth', out_smooth),
+                     ('beam_cache_offset', beam_cache_offset)):
+        if v_ is not None:
+            raise NotImplementedError(
+                f'{name} belongs to the reference CUDA smooth-quant/beam '
+                f'pipeline; quantize with paddle_tpu.quantization + '
+                f'kv_cache_int8 instead')
+    if out_scale != -1:
+        raise NotImplementedError('out_scale quantized output unsupported')
+    _, B, H, S, D = cache_kv.shape
+    if cache_kv.dtype == jnp.int8:
+        raise NotImplementedError(
+            'int8 cache_kv is not supported by masked_multihead_attention '
+            '(no scale inputs in this API) — use block_multihead_attention '
+            'with static dequant scales, or the model-level '
+            'generate(kv_cache_int8=True) path')
+    q, k, v = _split_qkv(x, H, H, D)                     # (B, H, D) each
+    if bias is not None:
+        b3 = jnp.asarray(bias).reshape(3, H, D)
+        q, k, v = q + b3[0], k + b3[1], v + b3[2]
+    if sequence_lengths is None:
+        raise ValueError(
+            'sequence_lengths is required (per-row cache write position)')
+    lens = jnp.reshape(jnp.asarray(sequence_lengths, jnp.int32), (-1,))
+    if rotary_tensor is not None:
+        rt = jnp.asarray(rotary_tensor)
+        if rt.ndim != 4 or rt.shape[0] != 2:
+            raise NotImplementedError(
+                'rotary_tensor must be a (2, B, S, D/2) cos/sin stack '
+                '(the reference CUDA layouts are kernel-internal); or '
+                'pre-rotate q/k and pass rotary_tensor=None')
+        pos = lens[:, None]                              # (B, 1)
+        cos = jnp.take_along_axis(rt[0], pos[:, :, None], axis=1)[:, 0]
+        sin = jnp.take_along_axis(rt[1], pos[:, :, None], axis=1)[:, 0]
+        q, k = _rope_rows(q, k, cos, sin, use_neox_rotary_style)
+
+    ck, cv = cache_kv[0], cache_kv[1]                    # (B, H, S, D)
+    rows = jnp.arange(B)
+    ck = ck.at[rows, :, lens].set(k.astype(ck.dtype))
+    cv = cv.at[rows, :, lens].set(v.astype(cv.dtype))
+    counts = lens + 1
+
+    out = None
+    if src_mask is None:
+        from ...ops import use_pallas
+
+        if use_pallas() and D % 8 == 0:
+            try:
+                # head-major contiguous variant of the paged kernel:
+                # streams any cache length blockwise, no transpose
+                from ...ops.pallas.paged_attention import (
+                    decode_attention_headmajor)
+
+                out = decode_attention_headmajor(
+                    q[:, None], ck, cv, counts)[:, 0]
+            except Exception as e:  # noqa: BLE001
+                from ...ops import pallas_failed
+
+                pallas_failed('paged_attention', e)
+    if out is None:
+        logits = jnp.einsum('bhd,bhsd->bhs', q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (D ** 0.5)
+        mask = jnp.arange(S)[None, None, :] < counts[:, None, None]
+        logits = jnp.where(mask, logits, -1e30)
+        if src_mask is not None:
+            logits = logits + jnp.asarray(src_mask,
+                                          jnp.float32).reshape(B, 1, -1)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum('bhs,bhsd->bhd', p,
+                         cv.astype(jnp.float32)).astype(x.dtype)
+    return out.reshape(B, H * D), jnp.stack([ck, cv])
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+        cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+        pre_key_cache=None, pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None, tgt_mask=None,
+        max_seq_len=-1, block_size=64, use_neox_style=False,
+        use_dynamic_cachekv_quant=False, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1,
+        compute_dtype='default', num_heads=None, num_kv_heads=None):
+    """Paged-KV serving attention (ref:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py:30).
+
+    The serving loop's two phases are both supported, per call:
+      - PREFILL (seq_lens_encoder > 0): the unpadded token stream
+        attends causally within each sequence (varlen segment-id flash
+        on TPU) and its K/V rows are scattered into the paged cache via
+        block_tables.
+      - DECODE (seq_lens_decoder > 0, one token per row): the new K/V
+        row lands in its page and the fused paged kernel streams exactly
+        the pages the row occupies (ops/pallas/paged_attention.py — the
+        block table drives the BlockSpec index map via scalar prefetch).
+
+    Layouts follow the reference: qkv (token_num, (Hq+2*Hkv)*D);
+    key_cache/value_cache (max_block_num, Hkv, block_size, D);
+    block_tables (B, MAXB); cu_seqlens_q (B+1,) prefix sums of this
+    call's tokens. STATIC cache-KV int8 is supported via
+    cache_k/v_dequant_scales of shape (Hkv,) or (Hkv, D) with int8
+    caches (quantization on write uses the reciprocal). Mode must be
+    host-decidable (concrete seq_lens): mixed prefill+decode in one call
+    and dynamic per-batch cache quant are rejected with guidance.
+    Returns (out, qkv, key_cache, value_cache).
+    """
+    import numpy as _np
+
+    for name, v_ in (('qkv_out_scale', qkv_out_scale),
+                     ('out_shift', out_shift), ('out_smooth', out_smooth),
+                     ('pre_key_cache', pre_key_cache),
+                     ('pre_value_cache', pre_value_cache)):
+        if v_ is not None:
+            raise NotImplementedError(
+                f'{name} is part of the reference CUDA smooth-quant/'
+                f'pre-cache pipeline and is not supported on TPU')
+    if use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            'dynamic cache-KV quant (per-batch scales) is not supported: '
+            'use static dequant scales, or the model-level '
+            'generate(kv_cache_int8=True) path which calibrates at '
+            'prefill')
+    if out_scale != -1:
+        raise NotImplementedError('quantized fmha output unsupported')
+    if isinstance(seq_lens_encoder, jax.core.Tracer) or isinstance(
+            seq_lens_decoder, jax.core.Tracer):
+        raise NotImplementedError(
+            'block_multihead_attention needs host-known sequence lengths '
+            'to pick the prefill/decode phase (the serving loop knows '
+            'its phase; call it with concrete seq_lens)')
+
+    NB, Hkv, BS, D = key_cache.shape
+    if block_size != BS:
+        raise ValueError(f'block_size={block_size} != cache page size {BS}')
+    enc = _np.reshape(_np.asarray(seq_lens_encoder), (-1,))
+    dec = _np.reshape(_np.asarray(seq_lens_decoder), (-1,))
+    B = enc.shape[0]
+    if num_kv_heads is None:
+        num_kv_heads = Hkv
+    if num_heads is None:
+        num_heads = qkv.shape[-1] // D - 2 * num_kv_heads
+    Hq = num_heads
+    q, k, v = _split_qkv(qkv, Hq, num_kv_heads, D)       # (T, H*, D)
+    if qkv_bias is not None:
+        bq, bk, bv = _split_qkv(jnp.asarray(qkv_bias)[None], Hq,
+                                num_kv_heads, D)
+        q, k, v = q + bq[0], k + bk[0], v + bv[0]
+
+    prefill = bool((enc > 0).any())
+    decode = bool((dec > 0).any()) and not prefill
+    if prefill and bool((dec > 0).any()):
+        raise NotImplementedError(
+            'mixed prefill+decode batches are not supported in one call; '
+            'split the batch by phase (the reference serving loop '
+            'schedules them separately too)')
+
+    tbl = jnp.clip(jnp.asarray(block_tables, jnp.int32), 0, NB - 1)
+    quant_cache = key_cache.dtype == jnp.int8
+    if quant_cache:
+        def canon_scale(s):
+            s = jnp.asarray(s, jnp.float32)
+            return jnp.broadcast_to(s[:, None], (Hkv, D)) if s.ndim == 1 \
+                else s
+        kds = canon_scale(cache_k_dequant_scales)
+        vds = canon_scale(cache_v_dequant_scales)
+
+        def quantize_rows(x, ds):
+            qx = jnp.round(x.astype(jnp.float32) / ds[None])
+            return jnp.clip(qx, quant_min_bound,
+                            quant_max_bound).astype(jnp.int8)
+    if rope_emb is not None:
+        re = jnp.asarray(rope_emb)
+        if re.ndim == 5:                                  # (2,B,S,1,D/2)
+            re = re[:, :, :, 0, :]
+        if re.ndim != 4 or re.shape[0] != 2:
+            raise NotImplementedError(
+                'rope_emb must be (2, B, max_seq, [1,] D/2) cos/sin')
+
+    if prefill:
+        # ---- varlen causal prefill over the unpadded token stream ----
+        cu = jnp.reshape(jnp.asarray(cu_seqlens_q, jnp.int32), (-1,))
+        T = q.shape[0]
+        tok = jnp.arange(T)
+        seg = jnp.searchsorted(cu[1:], tok, side='right').astype(jnp.int32)
+        pos = tok - cu[seg]                               # position in seq
+        if rope_emb is not None:
+            cos = re[0][seg, pos]                         # (T, D/2)
+            sin = re[1][seg, pos]
+            q, k = _rope_rows(q, k, cos, sin, use_neox_style)
+        from ...nn.functional.attention import scaled_dot_product_attention
+
+        out = scaled_dot_product_attention(
+            q[None], k[None], v[None], is_causal=True,
+            segment_ids=seg[None])[0]                     # (T, Hq, D)
+        # scatter K/V rows into pages: token t of seq b at position p
+        # lands in page tbl[b, p // BS] slot p % BS
+        page = tbl[seg, pos // BS]
+        slot = pos % BS
+        kw, vw = (quantize_rows(k, kds), quantize_rows(v, vds)) \
+            if quant_cache else (k.astype(key_cache.dtype),
+                                 v.astype(value_cache.dtype))
+        key_cache = key_cache.at[page, :, slot].set(kw)
+        value_cache = value_cache.at[page, :, slot].set(vw)
+        return out.reshape(T, Hq * D), qkv, key_cache, value_cache
+
+    if decode:
+        # ---- one token per row: paged fused decode -------------------
+        if q.shape[0] != B:
+            raise NotImplementedError(
+                f'decode expects one qkv row per batch row (got '
+                f'{q.shape[0]} tokens for batch {B}); keep finished rows '
+                f'in the batch with seq_lens_this_time=0')
+        this = _np.reshape(_np.asarray(seq_lens_this_time), (-1,))
+        active = jnp.asarray(this > 0)                   # (B,)
+        lens = jnp.asarray(dec, jnp.int32)               # context so far
+        rows = jnp.arange(B)
+        page = tbl[rows, lens // BS]
+        slot = lens % BS
+        if rope_emb is not None:
+            pos = lens[:, None]
+            cos = jnp.take_along_axis(re[0], pos[:, :, None], axis=1)[:, 0]
+            sin = jnp.take_along_axis(re[1], pos[:, :, None], axis=1)[:, 0]
+            q, k = _rope_rows(q, k, cos, sin, use_neox_style)
+        kw, vw = (quantize_rows(k, kds), quantize_rows(v, vds)) \
+            if quant_cache else (k.astype(key_cache.dtype),
+                                 v.astype(value_cache.dtype))
+        # finished/inactive rows (seq_lens_this_time == 0) must not
+        # scatter their dummy token — keep the existing page contents
+        old_k = key_cache[page, :, slot]
+        old_v = value_cache[page, :, slot]
+        key_cache = key_cache.at[page, :, slot].set(
+            jnp.where(active[:, None, None], kw, old_k))
+        value_cache = value_cache.at[page, :, slot].set(
+            jnp.where(active[:, None, None], vw, old_v))
+        counts = lens + 1
+
+        out = None
+        from ...ops import use_pallas
+
+        if use_pallas() and D % 8 == 0 and tgt_mask is None:
+            try:
+                from ...ops.pallas.paged_attention import (
+                    paged_decode_attention)
+
+                out = paged_decode_attention(
+                    q[:, None], key_cache, value_cache, tbl, counts,
+                    k_scale=kds if quant_cache else None,
+                    v_scale=vds if quant_cache else None)[:, 0]
+            except Exception as e:  # noqa: BLE001
+                from ...ops import pallas_failed
+
+                pallas_failed('paged_attention', e)
+        if out is None:
+            # XLA fallback: gather each row's pages to a contiguous view
+            maxb = tbl.shape[1]
+            ck = key_cache[tbl]                           # (B,MAXB,Hkv,BS,D)
+            cv = value_cache[tbl]
+            ck = jnp.swapaxes(ck, 2, 3).reshape(B, maxb * BS, Hkv, D)
+            cv = jnp.swapaxes(cv, 2, 3).reshape(B, maxb * BS, Hkv, D)
+            if quant_cache:
+                ck = ck.astype(jnp.float32) * kds[None, None]
+                cv = cv.astype(jnp.float32) * vds[None, None]
+            rep = Hq // Hkv
+            ckr = jnp.repeat(ck.astype(jnp.float32), rep, axis=2)
+            cvr = jnp.repeat(cv.astype(jnp.float32), rep, axis=2)
+            logits = jnp.einsum('bhd,bshd->bhs', q.astype(jnp.float32),
+                                ckr) / (D ** 0.5)
+            msk = jnp.arange(maxb * BS)[None, None, :] < counts[:, None,
+                                                                None]
+            if tgt_mask is not None:
+                tm = jnp.asarray(tgt_mask, jnp.float32).reshape(B, 1, -1)
+                logits = logits + jnp.pad(
+                    tm, ((0, 0), (0, 0), (0, maxb * BS - tm.shape[-1])))
+            logits = jnp.where(msk, logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum('bhs,bshd->bhd', p, cvr).astype(qkv.dtype)
+        return out.reshape(B, Hq * D), qkv, key_cache, value_cache
+
+    raise ValueError('neither prefill (seq_lens_encoder) nor decode '
+                     '(seq_lens_decoder) rows present')

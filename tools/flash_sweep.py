@@ -1,0 +1,63 @@
+"""Small-seq flash-attention occupancy sweep (VERDICT r4 weak #5).
+
+Run ON THE REAL CHIP when the tunnel answers:
+    python tools/flash_sweep.py
+Measures the standalone fwd+bwd kernel at seq 2048/4096 across block
+configurations (and the swapaxes overhead), prints TFLOP/s per config so
+the default block heuristic can be tuned with evidence instead of
+guesses.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_flash(B, H, S, D, bq, bk, reps=8):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+
+    @functools.partial(jax.jit, static_argnums=())
+    def fwd_bwd(q, k, v):
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True, block_q=bq,
+                                   block_k=bk).astype(jnp.float32).sum()
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    l, _ = fwd_bwd(q, k, v)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        l, grads = fwd_bwd(q, k, v)
+    float(l)
+    dt = (time.perf_counter() - t0) / reps
+    # 3.5x-fwd FLOP convention, causal halved (matches performance.md)
+    flops = 3.5 * (4 * B * H * S * S * D) * 0.5
+    return dt, flops / dt / 1e12
+
+
+def main():
+    assert jax.default_backend() == 'tpu', 'run on the real chip'
+    print(f'device: {jax.devices()[0].device_kind}')
+    for (B, H, S) in [(4, 32, 2048), (1, 32, 4096), (1, 32, 8192)]:
+        for (bq, bk) in [(1024, 1024), (512, 1024), (512, 512),
+                         (256, 512), (2048, 512), (1024, 512)]:
+            if bq > S or bk > S:
+                continue
+            try:
+                dt, tf = bench_flash(B, H, S, 128, bq, bk)
+                print(f'S={S:6d} B={B} bq={bq:5d} bk={bk:5d}: '
+                      f'{dt * 1e3:7.2f} ms  {tf:6.1f} TF/s')
+            except Exception as e:  # noqa: BLE001
+                print(f'S={S:6d} bq={bq} bk={bk}: FAILED {e}')
+
+
+if __name__ == '__main__':
+    main()
