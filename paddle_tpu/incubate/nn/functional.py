@@ -328,6 +328,14 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     if sequence_lengths is None:
         raise ValueError(
             'sequence_lengths is required (per-row cache write position)')
+    if not isinstance(sequence_lengths, jax.core.Tracer):
+        import numpy as _np
+
+        if (_np.reshape(_np.asarray(sequence_lengths), (-1,)) >= S).any():
+            raise ValueError(
+                f'cache is full (sequence_length >= max_seq {S}): the new '
+                f'token has nowhere to land — grow the cache (JAX would '
+                f'silently drop the out-of-bounds write)')
     lens = jnp.reshape(jnp.asarray(sequence_lengths, jnp.int32), (-1,))
     if rotary_tensor is not None:
         rt = jnp.asarray(rotary_tensor)
@@ -522,6 +530,13 @@ def block_multihead_attention(
                 f'in the batch with seq_lens_this_time=0')
         this = _np.reshape(_np.asarray(seq_lens_this_time), (-1,))
         active = jnp.asarray(this > 0)                   # (B,)
+        if ((dec + (this > 0)) > tbl.shape[1] * BS).any():
+            raise ValueError(
+                f'page capacity exceeded: a row needs position '
+                f'{int(dec.max())} but block_tables provides only '
+                f'{tbl.shape[1]} pages x {BS} slots — allocate another '
+                f'page for the row (JAX clamping would silently '
+                f'overwrite a live slot)')
         lens = jnp.asarray(dec, jnp.int32)               # context so far
         rows = jnp.arange(B)
         page = tbl[rows, lens // BS]

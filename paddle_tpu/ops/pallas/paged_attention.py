@@ -23,8 +23,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-# same VMEM budget rationale as decode_attention._pick_block
-VMEM_BLOCK_BUDGET = 2 * 1024 * 1024
 
 
 def _interpret():
@@ -201,13 +199,11 @@ def decode_attention_headmajor(q, k_cache, v_cache, context_lens,
     group = Hq // Hkv
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     interp = _interpret()
-    # VMEM-bounded block along S (budget matches decode_attention: the
-    # in-kernel f32 working set tracks block length, not stored width)
-    row_bytes = max(1, Hkv * D * max(k_cache.dtype.itemsize, 2))
-    cap = max(1, VMEM_BLOCK_BUDGET // row_bytes)
-    bs = min(block_s, S, max(cap, 128))
-    if bs < S and not interp:
-        bs = min(max(128, bs // 128 * 128), S)
+    # VMEM-bounded block along S: the same policy as the contiguous
+    # kernel, shared so tuning lands in both
+    from .decode_attention import _pick_block
+
+    bs = _pick_block(block_s, S, Hkv, D, k_cache.dtype.itemsize, interp)
     nb = pl.cdiv(S, bs)
     cl = jnp.minimum(jnp.broadcast_to(
         jnp.reshape(jnp.asarray(context_lens, jnp.int32), (-1,)), (B,)), S)

@@ -328,3 +328,27 @@ class TestReviewRegressions:
             x, cache, sequence_lengths=lens, rotary_tensor=rt,
             use_neox_rotary_style=True)
         assert not np.allclose(np.asarray(out_gj), np.asarray(out_nx))
+
+
+class TestCapacityGuards:
+    def test_block_mha_page_capacity_exceeded(self):
+        B, Hq, Hkv, D, BS, NB = 1, 4, 2, 16, 16, 8
+        kc = jnp.zeros((NB, Hkv, BS, D), jnp.float32)
+        vc = jnp.zeros((NB, Hkv, BS, D), jnp.float32)
+        tbl = jnp.asarray([[0, 1]], jnp.int32)            # 2 pages = 32 slots
+        dq = jnp.zeros((B, (Hq + 2 * Hkv) * D), jnp.float32)
+        with pytest.raises(ValueError, match='capacity'):
+            block_multihead_attention(
+                dq, kc, vc,
+                seq_lens_encoder=jnp.zeros((B, 1), jnp.int32),
+                seq_lens_decoder=jnp.asarray([[32]], jnp.int32),  # full
+                seq_lens_this_time=jnp.ones((B, 1), jnp.int32),
+                block_tables=tbl, block_size=BS, num_heads=Hq,
+                num_kv_heads=Hkv)
+
+    def test_masked_mha_full_cache_rejected(self):
+        x = jnp.zeros((1, 3 * 2 * 8), jnp.float32)
+        cache = jnp.zeros((2, 1, 2, 8, 8), jnp.float32)
+        with pytest.raises(ValueError, match='full'):
+            masked_multihead_attention(
+                x, cache, sequence_lengths=jnp.asarray([[8]], jnp.int32))
