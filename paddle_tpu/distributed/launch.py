@@ -219,7 +219,10 @@ def main(argv=None):
         if bad:
             print(f'launch: ranks failed with codes {codes}',
                   file=sys.stderr)
-            return bad[0]
+            # surface the rank that actually FAILED, not a peer's
+            # SIGTERM (-15) from the fail-fast teardown
+            real = [c for c in bad if c > 0]
+            return real[0] if real else bad[0]
         return 0
     # single process: initialize the cluster unless the script opts out
     if os.environ.get('PADDLE_TPU_NO_AUTO_INIT') != '1':

@@ -1,0 +1,352 @@
+"""incubate.nn fused Layers (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py and fused_linear.py).
+
+The reference classes wrap hand-fused CUDA kernels; here each Layer owns
+ordinary pytree Parameters and lowers to the composed-jnp/pallas
+functional ops in `incubate.nn.functional` — XLA does the fusing, the
+TPU fast paths (flash attention, fused decode) dispatch underneath.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F  # noqa: F401 (activation lookup)
+from ...nn import initializer as I
+from ...nn.layer.base import Layer, Parameter
+from . import functional as FF
+
+_ACTS = {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'silu': jax.nn.silu}
+
+
+class FusedLinear(Layer):
+    """ref: incubate/nn/layer/fused_linear.py::FusedLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        init = I.XavierNormal()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = Parameter(init(shape, 'float32'))
+        self.bias = (None if bias_attr is False
+                     else Parameter(jnp.zeros((out_features,), jnp.float32)))
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        return FF.fused_matmul_bias(x, self.weight, self.bias,
+                                    transpose_y=self._transpose)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ref: fused_transformer.py:94 — out = LN(residual + dropout(x + b))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+
+    def forward(self, x, residual):
+        h = FF.fused_dropout_add(x + self.bias, residual,
+                                 self.dropout_rate,
+                                 training=getattr(self, 'training', True))
+        return FF.fused_layer_norm(h, self.ln_scale, self.ln_bias,
+                                   self.epsilon)
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: fused_transformer.py:213 — packed-QKV attention block with
+    residual + LN (flash fast path on TPU via the functional op)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if need_weights:
+            raise NotImplementedError(
+                'need_weights=True is unsupported (the reference raises '
+                'too — the fused kernel never materialises probabilities)')
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        init = I.XavierNormal()
+        self.qkv_weight = Parameter(
+            init((3, num_heads, self.head_dim, embed_dim), 'float32'))
+        self.qkv_bias = Parameter(
+            jnp.zeros((3 * embed_dim,), jnp.float32))
+        self.linear_weight = Parameter(init((embed_dim, embed_dim),
+                                            'float32'))
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+        self.pre_ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32))
+        self.pre_ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if (key is not None and key is not query) or (
+                value is not None and value is not query):
+            raise NotImplementedError(
+                'cross-attention is unsupported: the reference fused op '
+                'is self-attention only (query==key==value)')
+        out = FF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon,
+            training=getattr(self, 'training', True),
+            num_heads=self.num_heads)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """ref: fused_transformer.py:534 — LN + linear + act + linear +
+    residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation='relu', act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        init = I.XavierNormal()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate
+                                 if act_dropout_rate is not None
+                                 else dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = Parameter(init((d_model, dim_feedforward),
+                                             'float32'))
+        self.linear1_bias = Parameter(jnp.zeros((dim_feedforward,),
+                                                jnp.float32))
+        self.linear2_weight = Parameter(init((dim_feedforward, d_model),
+                                             'float32'))
+        self.linear2_bias = Parameter(jnp.zeros((d_model,), jnp.float32))
+        self.ln1_scale = Parameter(jnp.ones((d_model,), jnp.float32))
+        self.ln1_bias = Parameter(jnp.zeros((d_model,), jnp.float32))
+        self.ln2_scale = Parameter(jnp.ones((d_model,), jnp.float32))
+        self.ln2_bias = Parameter(jnp.zeros((d_model,), jnp.float32))
+
+    def forward(self, src):
+        return FF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before,
+            training=getattr(self, 'training', True))
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: fused_transformer.py:750 — FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation='relu', attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kw):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                'incremental cache on the encoder layer is unsupported; '
+                'use FusedMultiTransformer for generation')
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """ref: fused_transformer.py:1071 — the serving-side decoder stack:
+    N pre/post-LN self-attention + FFN layers sharing one API, with
+    per-layer contiguous KV caches (the masked_multihead_attention
+    (2, B, H, max_seq, D) layout) and `time_step` single-token decode.
+
+    TPU-native: prefill runs the flash-attention path and writes the
+    caches; decode steps route through
+    functional.masked_multihead_attention (head-major fused kernel).
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation='gelu',
+                 normalize_before=True, num_layers=-1, nranks=1,
+                 trans_qkvw=True, ring_id=-1, name=None, epsilon=1e-5,
+                 **_attr_kw):
+        super().__init__()
+        if num_layers < 1:
+            raise ValueError('num_layers must be >= 1 (attr-list '
+                             'construction is not supported; pass '
+                             'num_layers explicitly)')
+        if not trans_qkvw:
+            raise NotImplementedError(
+                'trans_qkvw=False (untransposed qkv weights) unsupported')
+        if activation not in _ACTS:
+            raise ValueError(f'activation must be one of {list(_ACTS)}')
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.num_layers = num_layers
+        init = I.XavierNormal()
+        H, D, E = num_heads, self.head_dim, embed_dim
+
+        def plist(make):
+            from ...nn import LayerList
+
+            class _P(Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.w = Parameter(make())
+
+            return LayerList([_P() for _ in range(num_layers)])
+
+        self.ln_scales = plist(lambda: jnp.ones((E,), jnp.float32))
+        self.ln_biases = plist(lambda: jnp.zeros((E,), jnp.float32))
+        # reference trans_qkvw layout: (3, num_head, head_dim, embed_dim)
+        self.qkv_weights = plist(lambda: init((3, H, D, E), 'float32'))
+        self.qkv_biases = plist(lambda: jnp.zeros((3 * E,), jnp.float32))
+        self.linear_weights = plist(lambda: init((E, E), 'float32'))
+        self.linear_biases = plist(lambda: jnp.zeros((E,), jnp.float32))
+        self.ffn_ln_scales = plist(lambda: jnp.ones((E,), jnp.float32))
+        self.ffn_ln_biases = plist(lambda: jnp.zeros((E,), jnp.float32))
+        self.ffn1_weights = plist(
+            lambda: init((E, dim_feedforward), 'float32'))
+        self.ffn1_biases = plist(
+            lambda: jnp.zeros((dim_feedforward,), jnp.float32))
+        self.ffn2_weights = plist(
+            lambda: init((dim_feedforward, E), 'float32'))
+        self.ffn2_biases = plist(lambda: jnp.zeros((E,), jnp.float32))
+
+    def gen_cache(self, batch_size, max_seq_len, dtype=jnp.float32):
+        """Per-layer (2, B, H, max_seq, D) zero caches (the reference's
+        cache_kvs layout)."""
+        shape = (2, batch_size, self.num_heads, max_seq_len, self.head_dim)
+        return [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+
+    def _layer(self, i, x, attn_mask, cache, time_step, seq_lens):
+        from ...nn.functional.attention import scaled_dot_product_attention
+        from ...nn.functional.norm import layer_norm
+
+        E, H, D = self.embed_dim, self.num_heads, self.head_dim
+        residual = x
+        h = layer_norm(x, E, self.ln_scales[i].w, self.ln_biases[i].w,
+                       self.epsilon) if self.normalize_before else x
+        qkv_w = self.qkv_weights[i].w                   # (3, H, D, E)
+        new_cache = cache
+        if time_step is not None:
+            # single-token decode: fused head-major kernel over the
+            # contiguous cache
+            xt = h[:, 0]                                 # (B, E)
+            qkv_flat = jnp.einsum('be,thde->bthd', xt, qkv_w).reshape(
+                xt.shape[0], 3 * E) + self.qkv_biases[i].w
+            lens = (seq_lens if seq_lens is not None
+                    else jnp.full((x.shape[0], 1), time_step, jnp.int32))
+            attn_out, new_cache = FF.masked_multihead_attention(
+                qkv_flat, cache_kv=cache, sequence_lengths=lens)
+            attn_out = attn_out[:, None]                 # (B, 1, E)
+        else:
+            qkv = jnp.einsum('bse,thde->bsthd', h, qkv_w)
+            qkv = qkv + self.qkv_biases[i].w.reshape(3, H, D)[None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            attn_out = scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+            attn_out = attn_out.reshape(*h.shape[:2], E)
+            if cache is not None:                        # prefill writes
+                S = h.shape[1]
+                new_cache = cache.at[0, :, :, :S].set(
+                    jnp.swapaxes(k, 1, 2).astype(cache.dtype))
+                new_cache = new_cache.at[1, :, :, :S].set(
+                    jnp.swapaxes(v, 1, 2).astype(cache.dtype))
+        attn_out = attn_out @ self.linear_weights[i].w \
+            + self.linear_biases[i].w
+        x = FF.fused_dropout_add(
+            attn_out, residual, self.dropout_rate,
+            training=getattr(self, 'training', True))
+        if not self.normalize_before:
+            x = layer_norm(x, E, self.ln_scales[i].w, self.ln_biases[i].w,
+                           self.epsilon)
+
+        residual = x
+        h = layer_norm(x, E, self.ffn_ln_scales[i].w,
+                       self.ffn_ln_biases[i].w, self.epsilon) \
+            if self.normalize_before else x
+        h = _ACTS[self.activation](h @ self.ffn1_weights[i].w
+                                   + self.ffn1_biases[i].w)
+        h = h @ self.ffn2_weights[i].w + self.ffn2_biases[i].w
+        # reference parity: the FFN output is dropped out into the
+        # residual too (fused_multi_transformer post-process)
+        x = FF.fused_dropout_add(h, residual, self.dropout_rate,
+                                 training=getattr(self, 'training', True))
+        if not self.normalize_before:
+            x = layer_norm(x, E, self.ffn_ln_scales[i].w,
+                           self.ffn_ln_biases[i].w, self.epsilon)
+        return x, new_cache
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, beam_offset=None,
+                seq_lens=None, time_step=None):
+        if pre_caches is not None or beam_offset is not None:
+            raise NotImplementedError(
+                'pre_caches / beam_offset belong to the reference CUDA '
+                'serving pipeline and are not supported')
+        if rotary_embs is not None:
+            raise NotImplementedError(
+                'rotary_embs: rotate q/k outside or use the Llama family '
+                'models for RoPE serving')
+        if time_step is not None and src.shape[1] != 1:
+            raise ValueError('time_step decode expects a single token '
+                             f'per row, got seq {src.shape[1]}')
+        if time_step is not None and attn_mask is not None:
+            raise NotImplementedError(
+                'attn_mask is not applied on time_step decode steps '
+                '(the cache window is positional) — drive padded decode '
+                'via seq_lens instead of a mask')
+        x = src
+        new_caches = [] if caches is not None else None
+        for i in range(self.num_layers):
+            cache = caches[i] if caches is not None else None
+            x, nc = self._layer(i, x, attn_mask, cache, time_step,
+                                seq_lens)
+            if new_caches is not None:
+                new_caches.append(nc)
+        if caches is not None:
+            return x, new_caches
+        return x
