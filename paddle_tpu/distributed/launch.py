@@ -220,8 +220,11 @@ def main(argv=None):
             print(f'launch: ranks failed with codes {codes}',
                   file=sys.stderr)
             # surface the rank that actually FAILED, not a peer's
-            # SIGTERM (-15) from the fail-fast teardown
-            real = [c for c in bad if c > 0]
+            # SIGTERM from the fail-fast teardown; a crash signal
+            # (segfault -11, OOM kill -9) counts as a real failure too
+            import signal as _sig
+
+            real = [c for c in bad if c != -_sig.SIGTERM]
             return real[0] if real else bad[0]
         return 0
     # single process: initialize the cluster unless the script opts out

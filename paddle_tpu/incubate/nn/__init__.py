@@ -1,6 +1,6 @@
 """paddle_tpu.incubate.nn (ref: python/paddle/incubate/nn)."""
 from . import functional  # noqa: F401
 from .layer import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
-                    FusedFeedForward, FusedLinear,
-                    FusedMultiHeadAttention, FusedMultiTransformer,
-                    FusedTransformerEncoderLayer)
+                    FusedDropout, FusedDropoutAdd, FusedFeedForward,
+                    FusedLinear, FusedMultiHeadAttention,
+                    FusedMultiTransformer, FusedTransformerEncoderLayer)

@@ -350,3 +350,37 @@ class FusedMultiTransformer(Layer):
         if caches is not None:
             return x, new_caches
         return x
+
+
+class FusedDropoutAdd(Layer):
+    """ref: incubate/nn/layer/fused_dropout_add.py — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode='upscale_in_train', name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return FF.fused_dropout_add(x, y, self.p,
+                                    training=getattr(self, 'training', True),
+                                    mode=self.mode)
+
+    def extra_repr(self):
+        return f'p={self.p}, mode={self.mode}'
+
+
+class FusedDropout(Layer):
+    """ref: incubate/nn/layer/fused_dropout_nd.py — plain dropout with
+    an optional axis (dropout_nd broadcast pattern)."""
+
+    def __init__(self, p=0.5, axis=None, mode='upscale_in_train',
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, axis=self.axis,
+                         training=getattr(self, 'training', True),
+                         mode=self.mode)

@@ -334,3 +334,18 @@ class TestFusedLayers:
         g = grads.qkv_weights[0].w
         assert np.isfinite(np.asarray(g)).all() and np.abs(
             np.asarray(g)).max() > 0
+
+    def test_fused_dropout_layers(self):
+        from paddle_tpu.incubate.nn import FusedDropout, FusedDropoutAdd
+
+        x = jnp.ones((4, 8))
+        da = FusedDropoutAdd(p=0.0)
+        np.testing.assert_allclose(np.asarray(da(x, x)), 2.0)
+        d = FusedDropout(p=0.5, axis=0)
+        d.train()
+        pt.seed(0)
+        out = np.asarray(d(x))
+        # axis=0 mask broadcasts over axis 1: each row all-kept or all-0
+        assert all(r.std() == 0 for r in out)
+        d.eval()
+        np.testing.assert_allclose(np.asarray(d(x)), 1.0)
