@@ -56,20 +56,33 @@ class GPTAttention(Layer):
         self.out_proj = Parameter(init((h, h), 'float32'), spec=P('tp', None))
         self.out_bias = Parameter(jnp.zeros((h,)))
 
-    def forward(self, x, cache=None, cache_index=None):
+    def forward(self, x, cache=None, cache_index=None, kvalid=None,
+                kv_start=None, kv_write_pos=None):
         """cache: optional (k, v) of (B, max_len, H, D) — same cached-call
         contract as LlamaAttention (ref llama.py), incl. the fused pallas
-        decode kernel on single-token steps."""
+        decode kernel on single-token steps, left-pad kvalid/kv_start and
+        per-row kv_write_pos (batched speculative)."""
         B, S, H = x.shape
         qkv = x @ self.qkv + self.qkv_bias
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, S, self.num_heads, self.head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
         if cache is None:
-            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            attn_mask = None
+            if kvalid is not None:
+                # left-pad support on the uncached path (same fold as
+                # LlamaAttention): causal & row-validity
+                causal = (jnp.arange(S)[None, :]
+                          <= jnp.arange(S)[:, None])[None, None]
+                attn_mask = causal & (kvalid[:, :S] > 0)[:, None, None, :]
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
             new_cache = None
         else:
-            out, new_cache = cached_attention(q, k, v, cache, cache_index)
+            out, new_cache = cached_attention(q, k, v, cache, cache_index,
+                                              kvalid=kvalid,
+                                              kv_start=kv_start,
+                                              kv_write_pos=kv_write_pos)
         return out.reshape(B, S, H) @ self.out_proj + self.out_bias, new_cache
 
 
@@ -90,8 +103,10 @@ class GPTBlock(Layer):
         self.fc_out_bias = Parameter(jnp.zeros((h,)))
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x, cache=None, cache_index=None):
-        attn_out, new_cache = self.attn(self.ln_1(x), cache, cache_index)
+    def forward(self, x, cache=None, cache_index=None, kvalid=None,
+                kv_start=None, kv_write_pos=None):
+        attn_out, new_cache = self.attn(self.ln_1(x), cache, cache_index,
+                                        kvalid, kv_start, kv_write_pos)
         x = x + attn_out
         # gelu_new (tanh approximation) — GPT-2's canonical activation
         h = F.gelu(self.ln_2(x) @ self.fc_in + self.fc_in_bias,
@@ -118,20 +133,32 @@ class GPTModel(Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, caches=None, cache_index=None):
+    def forward(self, input_ids, positions=None, caches=None,
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         B, S = input_ids.shape
         if cache_index is None and S > self.config.max_position_embeddings:
             raise ValueError(
                 f'sequence length {S} exceeds the learned position table '
                 f'(max_position_embeddings='
                 f'{self.config.max_position_embeddings})')
-        base = 0 if cache_index is None else cache_index
-        pos = base + jnp.arange(S)[None, :]
+        if positions is None:
+            if kv_write_pos is not None:
+                wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32),
+                                 (-1,))
+                positions = wp[:, None] + jnp.arange(S)[None, :]
+            else:
+                base = 0 if cache_index is None else cache_index
+                positions = base + jnp.arange(S)[None, :]
+        # pad rows clip into the learned table (masked out anyway)
+        pos = jnp.clip(positions, 0,
+                       self.config.max_position_embeddings - 1)
         x = self.drop(self.wte[input_ids] + self.wpe[pos])
         new_caches = [] if caches is not None else None
         for i, block in enumerate(self.h):
             cache = caches[i] if caches is not None else None
-            x, nc = block(x, cache, cache_index)
+            x, nc = block(x, cache, cache_index, kvalid, kv_start,
+                          kv_write_pos)
             if new_caches is not None:
                 new_caches.append(nc)
         return self.ln_f(x), new_caches
@@ -165,8 +192,12 @@ class GPTForCausalLM(GenerationMixin, Layer):
         return super().init_cache(batch_size, max_len, dtype,
                                   quantized=quantized)
 
-    def forward(self, input_ids, caches=None, cache_index=None):
-        hidden, new_caches = self.transformer(input_ids, caches, cache_index)
+    def forward(self, input_ids, positions=None, caches=None,
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
+        hidden, new_caches = self.transformer(
+            input_ids, positions, caches, cache_index, kvalid, kv_start,
+            kv_write_pos)
         if self.lm_head is None:
             logits = hidden @ self.transformer.wte.T
         else:

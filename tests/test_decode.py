@@ -263,17 +263,20 @@ class TestSpeculativeDecoding:
         np.testing.assert_array_equal(spec, ref)
 
     def test_batched_unsupported_model_raises(self):
-        """Models without kv_write_pos (GPT) stay batch-1 with a clear
-        error."""
+        """Models without kv_write_pos (MoE LM) stay batch-1 with a
+        clear error. (GPT gained the serving machinery in r5.)"""
         from paddle_tpu.models.generation import generate_speculative
-        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
 
         pt.seed(2)
-        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=1,
-                        num_attention_heads=2, max_position_embeddings=64)
-        gpt = GPTForCausalLM(cfg)
+        cfg = MoEConfig(vocab_size=64, hidden_size=32,
+                        intermediate_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, num_key_value_heads=2,
+                        num_experts=2, num_shared_experts=0, top_k=1,
+                        max_position_embeddings=64)
+        moe = MoEForCausalLM(cfg)
         with pytest.raises(NotImplementedError, match='kv_write_pos'):
-            generate_speculative(gpt, gpt, jnp.zeros((2, 4), jnp.int32))
+            generate_speculative(moe, moe, jnp.zeros((2, 4), jnp.int32))
 
 
 class TestGenerationCompositions:
@@ -389,3 +392,47 @@ class TestPaddedFusedDecode:
                                           max_new_tokens=6))
         np.testing.assert_array_equal(out[0, 6:], solo1[0, 3:])
         np.testing.assert_array_equal(out[1, 6:], solo2[0, 6:])
+
+
+class TestGPTServingParity:
+    """GPT now shares the full serving machinery (VERDICT r5 follow-on):
+    left-padded attention_mask generation and batched speculative."""
+
+    def _gpt(self, seed=4):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        pt.seed(seed)
+        cfg = GPTConfig(vocab_size=96, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        max_position_embeddings=64, dropout=0.0)
+        return GPTForCausalLM(cfg)
+
+    def test_padded_batch_matches_solo(self):
+        model = self._gpt()
+        p1 = [5, 9, 23]
+        p2 = [11, 7, 33, 41, 8, 60]
+        ids = jnp.asarray([[0, 0, 0] + p1, p2], jnp.int32)
+        mask = jnp.asarray([[0, 0, 0, 1, 1, 1], [1] * 6], jnp.int32)
+        out = np.asarray(model.generate(ids, attention_mask=mask,
+                                        max_new_tokens=6))
+        solo1 = np.asarray(model.generate(jnp.asarray([p1], jnp.int32),
+                                          max_new_tokens=6))
+        solo2 = np.asarray(model.generate(jnp.asarray([p2], jnp.int32),
+                                          max_new_tokens=6))
+        np.testing.assert_array_equal(out[0, 6:], solo1[0, 3:])
+        np.testing.assert_array_equal(out[1, 6:], solo2[0, 6:])
+
+    def test_batched_speculative_gpt(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target = self._gpt(seed=4)
+        draft = self._gpt(seed=5)
+        ids = jnp.asarray(
+            np.random.default_rng(8).integers(3, 96, (3, 6)), jnp.int32)
+        spec = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=10, num_draft_tokens=3))
+        for b in range(3):
+            solo = np.asarray(target.generate(ids[b:b + 1],
+                                              max_new_tokens=10))
+            np.testing.assert_array_equal(spec[b:b + 1], solo,
+                                          err_msg=f'row {b}')

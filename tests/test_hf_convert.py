@@ -482,6 +482,7 @@ def test_left_padded_batch_generation_matches_transformers():
 
 def test_attention_mask_unsupported_models_raise():
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+    from paddle_tpu.models.moe_lm import MoEForCausalLM, moe_tiny
 
     m = GPTForCausalLM(gpt2_tiny())
     # an ALL-ONES mask is a no-op and must NOT raise (HF tokenizers
@@ -490,7 +491,13 @@ def test_attention_mask_unsupported_models_raise():
                      attention_mask=jnp.ones((1, 4), jnp.int32),
                      max_new_tokens=2)
     assert out.shape == (1, 6)
-    # a REAL pad mask needs positions/kvalid support, which GPT lacks
+    # GPT gained positions/kvalid in r5: a REAL pad mask now works
+    out = m.generate(jnp.ones((1, 4), jnp.int32),
+                     attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32),
+                     max_new_tokens=2)
+    assert out.shape == (1, 6)
+    # MoE LM still lacks positions/kvalid and must refuse clearly
+    moe = MoEForCausalLM(moe_tiny())
     with pytest.raises(NotImplementedError, match='attention_mask'):
-        m.generate(jnp.ones((1, 4), jnp.int32),
-                   attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32))
+        moe.generate(jnp.ones((1, 4), jnp.int32),
+                     attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32))
