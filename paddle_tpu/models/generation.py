@@ -17,6 +17,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def default_positions(batch, seq, cache_index=None, kv_write_pos=None):
+    """The serving-contract position rule shared by every causal LM:
+    per-row offsets when kv_write_pos is given (batched speculative),
+    else the uniform cache_index base."""
+    if kv_write_pos is not None:
+        wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,))
+        positions = wp[:, None] + jnp.arange(seq)[None, :]
+    else:
+        base = 0 if cache_index is None else cache_index
+        positions = base + jnp.arange(seq)[None, :].astype(jnp.int32)
+    return jnp.broadcast_to(positions, (batch, seq))
+
+
 class QuantKVCache(typing.NamedTuple):
     """Cache-KV int8 (ref capability:
     python/paddle/incubate/nn/functional/block_multihead_attention.py:44,60

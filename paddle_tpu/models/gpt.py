@@ -143,13 +143,9 @@ class GPTModel(Layer):
                 f'(max_position_embeddings='
                 f'{self.config.max_position_embeddings})')
         if positions is None:
-            if kv_write_pos is not None:
-                wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32),
-                                 (-1,))
-                positions = wp[:, None] + jnp.arange(S)[None, :]
-            else:
-                base = 0 if cache_index is None else cache_index
-                positions = base + jnp.arange(S)[None, :]
+            from .generation import default_positions
+
+            positions = default_positions(B, S, cache_index, kv_write_pos)
         # pad rows clip into the learned table (masked out anyway)
         pos = jnp.clip(positions, 0,
                        self.config.max_position_embeddings - 1)

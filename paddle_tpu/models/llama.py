@@ -595,14 +595,9 @@ class LlamaModel(Layer):
                 kv_write_pos=None):
         B, S = input_ids.shape
         if positions is None:
-            if kv_write_pos is not None:
-                wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,))
-                positions = wp[:, None] + jnp.arange(S)[None, :]
-                positions = jnp.broadcast_to(positions, (B, S))
-            else:
-                base = 0 if cache_index is None else cache_index
-                positions = base + jnp.arange(S)[None, :].astype(jnp.int32)
-                positions = jnp.broadcast_to(positions, (B, S))
+            from .generation import default_positions
+
+            positions = default_positions(B, S, cache_index, kv_write_pos)
         # mesh-aware lookup: one_hot matmul under a sharded mesh so the
         # (tp, fsdp) table sharding doesn't force an activation remat
         # (see distributed.embedding_lookup)

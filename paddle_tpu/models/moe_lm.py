@@ -80,9 +80,11 @@ class MoEDecoderLayer(Layer):
             dispatch_mode=config.dispatch_mode,
         )
 
-    def forward(self, x, positions, cache=None, cache_index=None):
+    def forward(self, x, positions, cache=None, cache_index=None,
+                kvalid=None, kv_start=None, kv_write_pos=None):
         attn_out, new_cache = self.self_attn(
-            self.input_layernorm(x), positions, None, cache, cache_index)
+            self.input_layernorm(x), positions, None, cache, cache_index,
+            kvalid, kv_start, kv_write_pos)
         x = x + attn_out
         # cached decode routes dropless: dense capacity computed from a
         # single-token call would drop colliding tokens
@@ -107,20 +109,26 @@ class MoEForCausalLM(GenerationMixin, Layer):
         self.lm_head = Parameter(
             init((config.hidden_size, config.vocab_size), 'float32'))
 
-    def forward(self, input_ids, caches=None, cache_index=None):
+    def forward(self, input_ids, positions=None, caches=None,
+                cache_index=None, kvalid=None, kv_start=None,
+                kv_write_pos=None):
         """Returns (logits, total_aux_loss), or (logits, new_caches) when
         called with a KV-cache (the GenerationMixin cached-call
-        contract, same as LlamaForCausalLM)."""
+        contract, same as LlamaForCausalLM — incl. left-padded
+        attention_mask generation and batched speculative decoding via
+        positions/kvalid/kv_start/kv_write_pos)."""
         B, S = input_ids.shape
-        base = 0 if cache_index is None else cache_index
-        positions = jnp.broadcast_to(
-            base + jnp.arange(S)[None].astype(jnp.int32), (B, S))
+        if positions is None:
+            from .generation import default_positions
+
+            positions = default_positions(B, S, cache_index, kv_write_pos)
         x = self.embed_tokens[input_ids]
         aux_total = jnp.zeros((), jnp.float32)
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             cache = caches[i] if caches is not None else None
-            x, aux, nc = layer(x, positions, cache, cache_index)
+            x, aux, nc = layer(x, positions, cache, cache_index, kvalid,
+                               kv_start, kv_write_pos)
             aux_total = aux_total + aux
             if new_caches is not None:
                 new_caches.append(nc)

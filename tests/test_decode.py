@@ -263,8 +263,22 @@ class TestSpeculativeDecoding:
         np.testing.assert_array_equal(spec, ref)
 
     def test_batched_unsupported_model_raises(self):
-        """Models without kv_write_pos (MoE LM) stay batch-1 with a
-        clear error. (GPT gained the serving machinery in r5.)"""
+        """Third-party models without kv_write_pos stay batch-1 with a
+        clear error (every in-repo causal LM now supports it)."""
+        from paddle_tpu.models.generation import (GenerationMixin,
+                                                  generate_speculative)
+
+        class NoWP(GenerationMixin):
+            def forward(self, input_ids, caches=None, cache_index=None):
+                raise AssertionError('guard must fire before forward')
+
+        stub = NoWP()
+        with pytest.raises(NotImplementedError, match='kv_write_pos'):
+            generate_speculative(stub, stub, jnp.zeros((2, 4), jnp.int32))
+
+    def test_batched_speculative_moe(self):
+        """MoE LM joins the serving machinery: batched speculative
+        per-row matches solo generate()."""
         from paddle_tpu.models.generation import generate_speculative
         from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
 
@@ -275,8 +289,15 @@ class TestSpeculativeDecoding:
                         num_experts=2, num_shared_experts=0, top_k=1,
                         max_position_embeddings=64)
         moe = MoEForCausalLM(cfg)
-        with pytest.raises(NotImplementedError, match='kv_write_pos'):
-            generate_speculative(moe, moe, jnp.zeros((2, 4), jnp.int32))
+        ids = jnp.asarray(
+            np.random.default_rng(9).integers(3, 64, (2, 5)), jnp.int32)
+        spec = np.asarray(generate_speculative(
+            moe, moe, ids, max_new_tokens=8, num_draft_tokens=3))
+        for b_ in range(2):
+            solo = np.asarray(moe.generate(ids[b_:b_ + 1],
+                                           max_new_tokens=8))
+            np.testing.assert_array_equal(spec[b_:b_ + 1], solo,
+                                          err_msg=f'row {b_}')
 
 
 class TestGenerationCompositions:
@@ -436,3 +457,28 @@ class TestGPTServingParity:
                                               max_new_tokens=10))
             np.testing.assert_array_equal(spec[b:b + 1], solo,
                                           err_msg=f'row {b}')
+
+    def test_moe_padded_batch_matches_solo(self):
+        """MoE LM left-padded generation: the padded row matches its
+        solo run (routing/positions must not see pad rows)."""
+        from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
+
+        pt.seed(3)
+        cfg = MoEConfig(vocab_size=64, hidden_size=32,
+                        intermediate_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, num_key_value_heads=2,
+                        num_experts=2, num_shared_experts=0, top_k=1,
+                        max_position_embeddings=64)
+        moe = MoEForCausalLM(cfg)
+        p1 = [5, 9, 23]
+        p2 = [11, 7, 33, 41, 8, 60]
+        ids = jnp.asarray([[0, 0, 0] + p1, p2], jnp.int32)
+        mask = jnp.asarray([[0, 0, 0, 1, 1, 1], [1] * 6], jnp.int32)
+        out = np.asarray(moe.generate(ids, attention_mask=mask,
+                                      max_new_tokens=6))
+        solo1 = np.asarray(moe.generate(jnp.asarray([p1], jnp.int32),
+                                        max_new_tokens=6))
+        solo2 = np.asarray(moe.generate(jnp.asarray([p2], jnp.int32),
+                                        max_new_tokens=6))
+        np.testing.assert_array_equal(out[0, 6:], solo1[0, 3:])
+        np.testing.assert_array_equal(out[1, 6:], solo2[0, 6:])

@@ -491,13 +491,14 @@ def test_attention_mask_unsupported_models_raise():
                      attention_mask=jnp.ones((1, 4), jnp.int32),
                      max_new_tokens=2)
     assert out.shape == (1, 6)
-    # GPT gained positions/kvalid in r5: a REAL pad mask now works
+    # GPT and MoE gained positions/kvalid in r5: REAL pad masks work
     out = m.generate(jnp.ones((1, 4), jnp.int32),
                      attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32),
                      max_new_tokens=2)
     assert out.shape == (1, 6)
-    # MoE LM still lacks positions/kvalid and must refuse clearly
     moe = MoEForCausalLM(moe_tiny())
-    with pytest.raises(NotImplementedError, match='attention_mask'):
-        moe.generate(jnp.ones((1, 4), jnp.int32),
-                     attention_mask=jnp.asarray([[0, 1, 1, 1]], jnp.int32))
+    out = moe.generate(jnp.ones((1, 4), jnp.int32),
+                       attention_mask=jnp.asarray([[0, 1, 1, 1]],
+                                                  jnp.int32),
+                       max_new_tokens=2)
+    assert out.shape == (1, 6)
