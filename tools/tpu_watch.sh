@@ -24,6 +24,16 @@ while true; do
     if [ -n "$LINE" ]; then
       printf '%s\n' "$LINE" > "$STASH.tmp" && mv "$STASH.tmp" "$STASH"
       echo "[watch] captured TPU artifact $(date -u +%FT%TZ)" >> "$LOG"
+      # first capture: also validate the round's new kernels on chip and
+      # sweep the flash block sizes (one-shot; outputs for the session)
+      if [ ! -f /tmp/mosaic_check.done ]; then
+        timeout 1800 python tools/mosaic_check.py \
+          > /tmp/mosaic_check.out 2>&1 && touch /tmp/mosaic_check.done
+        echo "[watch] mosaic_check rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+        timeout 1800 python tools/flash_sweep.py \
+          > /tmp/flash_sweep.out 2>&1
+        echo "[watch] flash_sweep rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      fi
       sleep 1200   # re-capture every ~20 min while up (bench may evolve)
     else
       echo "[watch] bench ran but no tpu line $(date -u +%FT%TZ)" >> "$LOG"
