@@ -98,6 +98,9 @@ def ragged_expert_apply(tokens, expert_idx, gate_vals, w_gate, w_up, w_down,
     tok_ids = order // k                                      # source token
     x = jnp.take(tokens, tok_ids, axis=0)                     # (T·k, H)
     group_sizes = jnp.bincount(flat_e, length=num_experts).astype(jnp.int32)
+    w_gate = _dense_expert(w_gate, x.dtype)
+    w_up = _dense_expert(w_up, x.dtype)
+    w_down = _dense_expert(w_down, x.dtype)
     h = act(jax.lax.ragged_dot(x, w_gate, group_sizes))
     h = h * jax.lax.ragged_dot(x, w_up, group_sizes)
     y = jax.lax.ragged_dot(h, w_down, group_sizes)            # (T·k, H)
@@ -228,6 +231,27 @@ class GShardGate(NaiveGate):
         return val, idx
 
 
+def _expert_einsum(eq, x, w):
+    """Expert einsum that serves int8-quantized weights: a
+    QuantizedExpertWeight feeds its codes into the dot (int8 HBM
+    stream) and scales the output; dense arrays take the plain path."""
+    from ..nn.quant import QuantizedExpertWeight
+
+    if isinstance(w, QuantizedExpertWeight):
+        return w.einsum(eq, x)
+    return jnp.einsum(eq, x, w)
+
+
+def _dense_expert(w, dtype):
+    """ragged_dot needs dense operands: dequantize quantized experts
+    (documented cost — see quantization.quantize_matmul_weights)."""
+    from ..nn.quant import QuantizedExpertWeight
+
+    if isinstance(w, QuantizedExpertWeight):
+        return w.dequantize(dtype)
+    return w
+
+
 class ExpertMLP(Layer):
     """E experts' weights batched on a leading axis sharded over 'ep' —
     one einsum runs every expert (GSPMD splits it across ranks)."""
@@ -245,9 +269,9 @@ class ExpertMLP(Layer):
 
     def forward(self, x):
         """x: (E, C, H) expert-major buckets."""
-        h = self.act(jnp.einsum('ech,ehm->ecm', x, self.w_gate))
-        h = h * jnp.einsum('ech,ehm->ecm', x, self.w_up)
-        return jnp.einsum('ecm,emh->ech', h, self.w_down)
+        h = self.act(_expert_einsum('ech,ehm->ecm', x, self.w_gate))
+        h = h * _expert_einsum('ech,ehm->ecm', x, self.w_up)
+        return _expert_einsum('ecm,emh->ech', h, self.w_down)
 
 
 class MoELayer(Layer):

@@ -54,7 +54,13 @@ def _valid_spec(spec, shape, mesh: Mesh):
         return P()
     out = []
     for i, entry in enumerate(spec):
-        if entry is None or i >= len(shape):
+        if i >= len(shape):
+            # truncate entries beyond the leaf's rank: a pytree attr can
+            # carry leaves of different ranks (QuantizedExpertWeight's
+            # 3-D codes + 2-D scale share one meta spec), and an
+            # over-long spec is a hard NamedSharding error
+            break
+        if entry is None:
             out.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
@@ -84,10 +90,19 @@ def shard_model(model, mesh: Mesh | None = None, fsdp_axis=None):
         if x is None or not hasattr(x, 'shape'):
             return x
         spec = meta.spec if (meta is not None and meta.spec is not None) else P()
-        spec = _valid_spec(spec, x.shape, mesh)
-        if fsdp_axis and meta is not None and meta.kind == 'param':
-            spec = _add_fsdp(spec, x.shape, mesh, fsdp_axis)
-        return jax.device_put(x, NamedSharding(mesh, spec))
+
+        def put_leaf(leaf):
+            s = _valid_spec(spec, leaf.shape, mesh)
+            if fsdp_axis and meta is not None and meta.kind == 'param':
+                s = _add_fsdp(s, leaf.shape, mesh, fsdp_axis)
+            return jax.device_put(leaf, NamedSharding(mesh, s))
+
+        if isinstance(x, jax.Array):
+            return put_leaf(x)
+        # pytree-wrapped weights (QuantizedWeight family): one attr spec,
+        # leaves of DIFFERENT ranks (3-D codes + 2-D scale) — clamp the
+        # spec per leaf or device_put broadcasts an over-long spec
+        return jax.tree.map(put_leaf, x)
 
     return tree_util._map_model(model, place)
 

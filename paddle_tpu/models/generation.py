@@ -69,10 +69,11 @@ class GenerationMixin:
         gates) and nn.Embedding subtrees are never touched. Returns a
         new model; the original is untouched.
 
-        Caveats: 3-D batched MoE expert weights and tied heads served
-        off the embedding table stay full precision (see
-        quantization.quantize_matmul_weights) — MoE models should not
-        expect the full 2x/4x HBM saving."""
+        MoE expert weights (E, in, out) quantize too at bits=8
+        (per-(expert, out-col) scales). Caveats: int4 leaves experts fp
+        (packing unimplemented), and tied heads served off the embedding
+        table stay full precision (see
+        quantization.quantize_matmul_weights)."""
         from ..quantization import quantize_matmul_weights
 
         return quantize_matmul_weights(self, bits=bits, min_features=1)

@@ -146,6 +146,82 @@ class QuantizedWeight:
                 f'codes={getattr(self.codes, "shape", None)})')
 
 
+@_jax.tree_util.register_pytree_node_class
+class QuantizedExpertWeight:
+    """Weight-only int8 for BATCHED expert weights (E, K, N) — the MoE
+    counterpart of QuantizedWeight (ref capability: the reference's
+    weight-only pass over fused-MoE expert projections). codes int8 with
+    per-(expert, out-column) scales; the expert einsums consume it via
+    `einsum()`, which feeds the int8 codes straight into the dot (the
+    HBM-resident weight stays 1 byte/element — the serving win) and
+    applies the scale on the output. The ragged (dropless) path
+    dequantizes before `lax.ragged_dot` (documented cost: that path's
+    HBM saving depends on XLA fusing the convert)."""
+
+    def __init__(self, codes, scale, shape=None):
+        self.codes = codes
+        self.scale = scale
+        self.bits = 8
+        self._shape = tuple(shape) if shape is not None else tuple(
+            getattr(codes, 'shape', ()))
+
+    @classmethod
+    def quantize(cls, w, bits=8):
+        if bits != 8:
+            raise ValueError(
+                'expert weights support int8 only (int4 packing along '
+                'the per-expert K axis is not implemented)')
+        amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)  # (E, N)
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        codes = jnp.clip(jnp.round(w.astype(jnp.float32)
+                                     / scale[:, None, :]),
+                          -127, 127).astype(jnp.int8)
+        return cls(codes, scale, shape=w.shape)
+
+    def einsum(self, eq, x):
+        """jnp.einsum(eq, x, w) with the scale applied on the output
+        axis (the out axis is always last in the expert equations).
+        The dot runs at x's dtype (bf16 keeps MXU throughput; the codes
+        convert tile-wise inside the fused dot) with fp32 accumulation;
+        only the small output picks up the fp32 scale."""
+        out = jnp.einsum(eq, x, self.codes.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+        return (out * self.scale[:, None, :]).astype(x.dtype)
+
+    def dequantize(self, dtype=jnp.float32):
+        return (self.codes.astype(jnp.float32)
+                * self.scale[:, None, :]).astype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self.codes.dtype
+
+    def astype(self, dtype):
+        return type(self)(self.codes, self.scale.astype(dtype), self._shape)
+
+    def _state_dict_entries(self):
+        return [('codes', self.codes), ('scale', self.scale)]
+
+    def tree_flatten(self):
+        return (self.codes, self.scale), (self._shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    def __repr__(self):
+        return (f'QuantizedExpertWeight(shape={self._shape}, '
+                f'codes={getattr(self.codes, "shape", None)})')
+
+
 class Stub:
     """ref: paddle.nn.quant.Stub — placeholder layer replaced by an
     observer/quanter when QAT prepares the model."""

