@@ -549,3 +549,23 @@ def test_inference_config_set_model_preserves_flags(tmp_path):
     assert cfg._enabled_flags.get('memory_optim'), \
         'set_model dropped user flags'
     assert cfg.prog_file().endswith('x.mlir')
+
+
+def test_incubate_nn_serving_surface():
+    """The reference's incubate.nn serving names resolve (ref:
+    python/paddle/incubate/nn/__init__.py + functional)."""
+    import paddle_tpu.incubate.nn as inn
+    import paddle_tpu.incubate.nn.functional as innf
+
+    for name in ('FusedLinear', 'FusedMultiHeadAttention',
+                 'FusedFeedForward', 'FusedTransformerEncoderLayer',
+                 'FusedMultiTransformer',
+                 'FusedBiasDropoutResidualLayerNorm', 'FusedDropoutAdd',
+                 'FusedDropout'):
+        assert hasattr(inn, name), name
+    for name in ('block_multihead_attention', 'masked_multihead_attention',
+                 'fused_rotary_position_embedding', 'fused_rms_norm',
+                 'fused_layer_norm', 'fused_matmul_bias', 'swiglu',
+                 'fused_multi_head_attention', 'fused_feedforward',
+                 'fused_bias_act', 'fused_dropout_add'):
+        assert hasattr(innf, name), name
