@@ -421,7 +421,8 @@ class GenerationMixin:
 
 
 def generate_speculative(target, draft, input_ids, max_new_tokens=32,
-                         num_draft_tokens=4, eos_token_id=None):
+                         num_draft_tokens=4, eos_token_id=None,
+                         kv_cache_int8=False):
     """Greedy speculative decoding (ref capability: the reference
     ecosystem's speculative/draft-model inference).
 
@@ -442,8 +443,16 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
     a SOLO run holds unless some step's top-2 logits sit within float
     rounding of each other — XLA may tile batched matmuls differently;
     see examples/generate.py for the same caveat.)
+
+    kv_cache_int8=True serves BOTH models with quantized KV caches
+    (scales calibrate at their prefills); the greedy commit rule then
+    matches `target.generate(..., kv_cache_int8=True)`.
     """
     B, S = input_ids.shape
+    if kv_cache_int8 and S < 2:
+        raise ValueError(
+            'kv_cache_int8 needs a multi-token prompt: the per-head '
+            'scales calibrate on the prefill rows')
     if B != 1:
         import inspect
 
@@ -465,10 +474,10 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
         if B == 1:
             return _speculative_loop(target, draft, input_ids,
                                      max_new_tokens, num_draft_tokens,
-                                     eos_token_id)
+                                     eos_token_id, kv_cache_int8)
         return _speculative_loop_batched(target, draft, input_ids,
                                          max_new_tokens, num_draft_tokens,
-                                         eos_token_id)
+                                         eos_token_id, kv_cache_int8)
     finally:
         for m_ in restore:
             m_.train()
@@ -489,7 +498,8 @@ def _commit_window(c, d_row, t_row, k):
 
 
 def _speculative_loop(target, draft, input_ids, max_new_tokens,
-                      num_draft_tokens, eos_token_id):
+                      num_draft_tokens, eos_token_id,
+                      kv_cache_int8=False):
     import functools
 
     B, S = input_ids.shape
@@ -497,8 +507,8 @@ def _speculative_loop(target, draft, input_ids, max_new_tokens,
     if k < 1:
         raise ValueError('num_draft_tokens must be >= 1')
     max_len = S + max_new_tokens + k + 1      # room for the last window
-    tcaches = target.init_cache(B, max_len)
-    dcaches = draft.init_cache(B, max_len)
+    tcaches = target.init_cache(B, max_len, quantized=kv_cache_int8)
+    dcaches = draft.init_cache(B, max_len, quantized=kv_cache_int8)
 
     @jax.jit
     def prefill(m, caches, ids):
@@ -557,7 +567,8 @@ def _speculative_loop(target, draft, input_ids, max_new_tokens,
 
 
 def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
-                              num_draft_tokens, eos_token_id):
+                              num_draft_tokens, eos_token_id,
+                              kv_cache_int8=False):
     """B > 1 speculative decoding: rows accept different draft prefixes,
     so each row carries its OWN committed length — cache writes go to
     per-row offsets (kv_write_pos) and attention masks by per-row
@@ -570,8 +581,8 @@ def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
     if k < 1:
         raise ValueError('num_draft_tokens must be >= 1')
     max_len = S + max_new_tokens + k + 1
-    tcaches = target.init_cache(B, max_len)
-    dcaches = draft.init_cache(B, max_len)
+    tcaches = target.init_cache(B, max_len, quantized=kv_cache_int8)
+    dcaches = draft.init_cache(B, max_len, quantized=kv_cache_int8)
 
     @jax.jit
     def prefill(m, caches, ids):

@@ -482,3 +482,46 @@ class TestGPTServingParity:
                                         max_new_tokens=6))
         np.testing.assert_array_equal(out[0, 6:], solo1[0, 3:])
         np.testing.assert_array_equal(out[1, 6:], solo2[0, 6:])
+
+
+class TestSpeculativeKV8:
+    def test_spec_kv8_matches_kv8_generate(self):
+        """Speculative + cache-KV int8: the commit rule runs over the
+        SAME quantized-cache math as generate(kv_cache_int8=True), so
+        tokens match it (fixed seed; see kv-quant greedy note)."""
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = _spec_models()
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(3, 96, (1, 6)), jnp.int32)
+        ref = np.asarray(target.generate(ids, max_new_tokens=12,
+                                         kv_cache_int8=True))
+        spec = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=12, num_draft_tokens=3,
+            kv_cache_int8=True))
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_spec_kv8_batched(self):
+        """Compare against BATCHED kv8 generate: both calibrate the
+        int8 scales over the same rows (a solo run would calibrate from
+        one row — a materially different quantization)."""
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = _spec_models()
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(3, 96, (2, 6)), jnp.int32)
+        spec = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=8, num_draft_tokens=3,
+            kv_cache_int8=True))
+        ref = np.asarray(target.generate(ids, max_new_tokens=8,
+                                         kv_cache_int8=True))
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_spec_kv8_single_token_prompt_rejected(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = _spec_models()
+        with pytest.raises(ValueError, match='multi-token prompt'):
+            generate_speculative(target, draft,
+                                 jnp.ones((1, 1), jnp.int32),
+                                 kv_cache_int8=True)
