@@ -647,3 +647,166 @@ def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
         rows.append(row + [pad] * (max_new_tokens - len(row)))
     gen = jnp.asarray(rows, input_ids.dtype)
     return jnp.concatenate([input_ids, gen], axis=1)
+
+
+def _speculative_accept_dists(pt, pd):
+    """The rejection-sampling identity, exposed for testing: given the
+    target and draft distributions at one position, the procedure
+    'sample x~pd; accept w.p. min(1, pt(x)/pd(x)); else resample from
+    norm((pt-pd)+)' outputs exactly pt. Returns (accept_prob_per_token,
+    residual_dist)."""
+    accept = jnp.minimum(1.0, pt / jnp.maximum(pd, 1e-30))
+    residual = jnp.maximum(pt - pd, 0.0)
+    residual = residual / jnp.maximum(residual.sum(-1, keepdims=True),
+                                      1e-30)
+    return accept, residual
+
+
+def generate_speculative_sampled(target, draft, input_ids,
+                                 max_new_tokens=32, num_draft_tokens=4,
+                                 temperature=1.0, rng_key=None,
+                                 eos_token_id=None):
+    """SAMPLED speculative decoding (ref capability: the speculative
+    sampling loops of the reference serving ecosystem — Leviathan/Chen
+    rejection sampling): the draft proposes tokens sampled at
+    `temperature`; each is accepted with probability
+    min(1, p_target/p_draft), and a rejection resamples from the
+    normalised residual (p_target - p_draft)+. The OUTPUT DISTRIBUTION
+    equals sampling from the target directly — speculative execution
+    changes the cost, not the law (see
+    tests/test_decode.py::TestSampledSpeculative for the identity
+    check). temperature=0 delegates to the lossless greedy loop.
+
+    Batch 1 (rows would commit at different lengths); host-driven like
+    the greedy loop — one sync per window.
+    """
+    if temperature == 0.0:
+        return generate_speculative(target, draft, input_ids,
+                                    max_new_tokens, num_draft_tokens,
+                                    eos_token_id)
+    B, S = input_ids.shape
+    if B != 1:
+        raise NotImplementedError(
+            'sampled speculative decoding is batch-1; loop prompts '
+            'individually (greedy supports batches)')
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    restore = []
+    for m_ in (target, draft):
+        if bool(getattr(m_, 'training', False)):
+            m_.eval()
+            restore.append(m_)
+    try:
+        return _speculative_sampled_loop(target, draft, input_ids,
+                                         max_new_tokens, num_draft_tokens,
+                                         temperature, rng_key,
+                                         eos_token_id)
+    finally:
+        for m_ in restore:
+            m_.train()
+
+
+def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
+                              num_draft_tokens, temperature, rng_key,
+                              eos_token_id):
+    import functools
+
+    B, S = input_ids.shape
+    k = int(num_draft_tokens)
+    if k < 1:
+        raise ValueError('num_draft_tokens must be >= 1')
+    max_len = S + max_new_tokens + k + 1
+    tcaches = target.init_cache(B, max_len)
+    dcaches = draft.init_cache(B, max_len)
+    inv_t = 1.0 / float(temperature)
+
+    @jax.jit
+    def prefill(m, caches, ids):
+        logits, caches = m(ids, caches=caches, cache_index=0)
+        return jax.nn.softmax(logits[:, -1, :].astype(jnp.float32)
+                              * inv_t, -1), caches
+
+    @functools.partial(jax.jit, static_argnums=(5,))
+    def propose(m, caches, c, idx, key, k):
+        """Draft samples k tokens; returns them WITH the draft's full
+        distribution at every position (the acceptance rule needs
+        p_draft of the chosen token and the residual needs the target
+        dist, gathered on the host per window)."""
+        def body(carry, i):
+            tok, caches, key = carry
+            logits, caches = m(tok, caches=caches, cache_index=idx + i)
+            p = jax.nn.softmax(logits[:, -1].astype(jnp.float32)
+                               * inv_t, -1)
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+            return (nxt[:, None], caches, key), (nxt, p)
+        (_, caches, key), (toks, ps) = jax.lax.scan(
+            body, (c, caches, key), jnp.arange(k + 1))
+        return toks[:k, 0], ps[:k, 0], caches, key   # (k,), (k, V)
+
+    @jax.jit
+    def verify(m, caches, window, idx):
+        logits, caches = m(window, caches=caches, cache_index=idx)
+        return jax.nn.softmax(logits[0].astype(jnp.float32) * inv_t,
+                              -1), caches            # (k+1, V)
+
+    p_last, tcaches = prefill(target, tcaches, input_ids)
+    _, dcaches = prefill(draft, dcaches, input_ids)
+    rng_key, sub = jax.random.split(rng_key)
+    c_host = int(jax.random.categorical(
+        sub, jnp.log(jnp.maximum(p_last[0], 1e-30))))
+
+    out = []
+    L = S
+    # independent streams: the accept/resample coins must not correlate
+    # with the proposal keys (the exactness proof assumes independence)
+    rng_key, seed_key = jax.random.split(rng_key)
+    rng = np.random.default_rng(int(jax.random.randint(
+        seed_key, (), 0, 2 ** 31 - 1)))
+    while len(out) < max_new_tokens:
+        c = jnp.asarray([[c_host]], jnp.int32)
+        rng_key, pkey = jax.random.split(rng_key)
+        drafts, pd, dcaches, _ = propose(draft, dcaches, c,
+                                         jnp.asarray(L, jnp.int32), pkey,
+                                         k)
+        window = jnp.concatenate([c, drafts[None, :]], axis=1)
+        pt, tcaches = verify(target, tcaches, window,
+                             jnp.asarray(L, jnp.int32))
+        d = np.asarray(drafts)
+        pt_h = np.asarray(pt)                         # (k+1, V)
+        pd_h = np.asarray(pd)                         # (k, V)
+        def draw(p):
+            # float64 renormalize: f32 quotients can miss Generator.
+            # choice's sum-to-1 tolerance at large vocabs
+            p = np.asarray(p, np.float64)
+            return int(rng.choice(len(p), p=p / p.sum()))
+
+        committed = [c_host]
+        nxt = None
+        for i in range(k):
+            x = int(d[i])
+            # ONE source of the acceptance math (the identity-tested
+            # helper) for both the test and the production loop
+            accept, residual = _speculative_accept_dists(
+                jnp.asarray(pt_h[i]), jnp.asarray(pd_h[i]))
+            if rng.random() < float(accept[x]):
+                committed.append(x)
+                continue
+            residual = np.asarray(residual, np.float64)
+            if residual.sum() <= 0:                   # degenerate: pt<=pd
+                residual = pt_h[i]
+            nxt = draw(residual)
+            break
+        if nxt is None:                               # full window accepted
+            nxt = draw(pt_h[k])
+        out.extend(committed)
+        if eos_token_id is not None and eos_token_id in committed:
+            out = out[:out.index(eos_token_id) + 1]
+            break
+        c_host = nxt
+        L += len(committed)
+    if eos_token_id is not None and len(out) < max_new_tokens:
+        out += [eos_token_id] * (max_new_tokens - len(out))
+    gen = jnp.asarray([out[:max_new_tokens]], input_ids.dtype)
+    return jnp.concatenate([input_ids, gen], axis=1)

@@ -525,3 +525,94 @@ class TestSpeculativeKV8:
             generate_speculative(target, draft,
                                  jnp.ones((1, 1), jnp.int32),
                                  kv_cache_int8=True)
+
+
+class TestSampledSpeculative:
+    """Rejection-sampling speculative decoding: the acceptance rule must
+    preserve the target distribution EXACTLY (the Leviathan/Chen
+    identity), verified analytically — no sampling noise."""
+
+    def test_acceptance_identity_analytic(self):
+        """P(out=v) = pd(v)·min(1, pt(v)/pd(v)) +
+        P(reject)·residual(v) must equal pt(v) for ANY pt, pd."""
+        from paddle_tpu.models.generation import _speculative_accept_dists
+
+        rng = np.random.default_rng(0)
+        for trial in range(5):
+            V = 16
+            pt = rng.dirichlet(np.ones(V) * (0.3 + trial))
+            pd = rng.dirichlet(np.ones(V) * (0.3 + 2 * trial % 3 + 0.1))
+            accept, residual = _speculative_accept_dists(
+                jnp.asarray(pt), jnp.asarray(pd))
+            accept = np.asarray(accept)
+            residual = np.asarray(residual)
+            p_reject = float((pd * (1 - accept)).sum())
+            out_dist = pd * accept + p_reject * residual
+            # the helper runs at f32 (the serving dtype): identity holds
+            # to f32 eps, not exactly
+            np.testing.assert_allclose(out_dist, pt, atol=1e-6,
+                                       err_msg=f'trial {trial}')
+
+    def test_temperature_zero_delegates_to_greedy(self):
+        from paddle_tpu.models.generation import (
+            generate_speculative, generate_speculative_sampled)
+
+        target, draft = _spec_models()
+        ids = jnp.asarray(
+            np.random.default_rng(2).integers(3, 96, (1, 6)), jnp.int32)
+        a = np.asarray(generate_speculative_sampled(
+            target, draft, ids, max_new_tokens=10, temperature=0.0))
+        b = np.asarray(generate_speculative(
+            target, draft, ids, max_new_tokens=10))
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampled_runs_and_respects_eos(self):
+        from paddle_tpu.models.generation import (
+            generate_speculative_sampled)
+
+        target, draft = _spec_models()
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(3, 96, (1, 6)), jnp.int32)
+        out = np.asarray(generate_speculative_sampled(
+            target, draft, ids, max_new_tokens=12, temperature=0.9,
+            rng_key=jax.random.PRNGKey(7)))
+        assert out.shape == (1, 18)
+        assert (out[:, :6] == np.asarray(ids)).all()
+        assert (out >= 0).all() and (out < 96).all()
+        # eos freeze
+        eos = int(out[0, 8])
+        out2 = np.asarray(generate_speculative_sampled(
+            target, draft, ids, max_new_tokens=12, temperature=0.9,
+            rng_key=jax.random.PRNGKey(7), eos_token_id=eos))
+        hits = np.nonzero(out2[0, 6:] == eos)[0]
+        if len(hits):
+            assert (out2[0, 6 + hits[0]:] == eos).all()
+
+    @pytest.mark.heavy
+    def test_self_draft_single_step_distribution(self):
+        """With draft == target, acceptance is 1 everywhere, so the
+        first generated token is a plain target sample — its frequency
+        over many seeds tracks the target's softmax. (150 host-driven
+        loops: heavy tier.)"""
+        from paddle_tpu.models.generation import (
+            generate_speculative_sampled)
+
+        pt.seed(0)
+        target = LlamaForCausalLM(llama_tiny(
+            vocab_size=8, hidden_size=32, layers=1, heads=2, kv_heads=2,
+            intermediate_size=64, max_pos=32))
+        ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+        logits = np.asarray(target(ids))[0, -1].astype(np.float64)
+        want = np.exp(logits - logits.max())
+        want = want / want.sum()
+        counts = np.zeros(8)
+        N = 150
+        for s in range(N):
+            out = generate_speculative_sampled(
+                target, target, ids, max_new_tokens=1, temperature=1.0,
+                rng_key=jax.random.PRNGKey(s))
+            counts[int(np.asarray(out)[0, 3])] += 1
+        freq = counts / N
+        # 3-sigma binomial bound per bucket
+        sigma = np.sqrt(want * (1 - want) / N)
+        assert (np.abs(freq - want) < 3 * sigma + 0.02).all(), (freq, want)
