@@ -17,6 +17,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def filter_logits(logits, top_k=0, top_p=1.0):
+    """top-k / nucleus filtering on (already temperature-scaled) logits
+    — the one implementation behind sampled generate() and sampled
+    speculative decoding (filtering both target and draft keeps the
+    rejection-sampling identity: it holds for ANY pt/pd pair)."""
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), -1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def default_positions(batch, seq, cache_index=None, kv_write_pos=None):
     """The serving-contract position rule shared by every causal LM:
     per-row offsets when kv_write_pos is given (batched speculative),
@@ -373,17 +391,7 @@ class GenerationMixin:
         def sample(logits, key):
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(input_ids.dtype)
-            logits = logits / temperature
-            if top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p < 1.0:
-                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            logits = filter_logits(logits / temperature, top_k, top_p)
             return jax.random.categorical(key, logits, axis=-1).astype(input_ids.dtype)
 
         finished0 = jnp.zeros((B,), bool)
@@ -664,16 +672,17 @@ def _speculative_accept_dists(pt, pd):
 
 def generate_speculative_sampled(target, draft, input_ids,
                                  max_new_tokens=32, num_draft_tokens=4,
-                                 temperature=1.0, rng_key=None,
-                                 eos_token_id=None):
+                                 temperature=1.0, top_k=0, top_p=1.0,
+                                 rng_key=None, eos_token_id=None):
     """SAMPLED speculative decoding (ref capability: the speculative
     sampling loops of the reference serving ecosystem — Leviathan/Chen
     rejection sampling): the draft proposes tokens sampled at
     `temperature`; each is accepted with probability
     min(1, p_target/p_draft), and a rejection resamples from the
     normalised residual (p_target - p_draft)+. The OUTPUT DISTRIBUTION
-    equals sampling from the target directly — speculative execution
-    changes the cost, not the law (see
+    equals sampling from the target directly (with temperature/top_k/
+    top_p applied to BOTH models, the law is the filtered target's) —
+    speculative execution changes the cost, not the law (see
     tests/test_decode.py::TestSampledSpeculative for the identity
     check). temperature=0 delegates to the lossless greedy loop.
 
@@ -699,16 +708,16 @@ def generate_speculative_sampled(target, draft, input_ids,
     try:
         return _speculative_sampled_loop(target, draft, input_ids,
                                          max_new_tokens, num_draft_tokens,
-                                         temperature, rng_key,
-                                         eos_token_id)
+                                         temperature, top_k, top_p,
+                                         rng_key, eos_token_id)
     finally:
         for m_ in restore:
             m_.train()
 
 
 def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
-                              num_draft_tokens, temperature, rng_key,
-                              eos_token_id):
+                              num_draft_tokens, temperature, top_k, top_p,
+                              rng_key, eos_token_id):
     import functools
 
     B, S = input_ids.shape
@@ -720,11 +729,18 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
     dcaches = draft.init_cache(B, max_len)
     inv_t = 1.0 / float(temperature)
 
+    def dist(logits):
+        # temperature + top-k/top-p filtering applied to BOTH models'
+        # dists; -inf entries softmax to exact 0, so filtered-out tokens
+        # can neither be proposed nor resampled
+        return jax.nn.softmax(
+            filter_logits(logits.astype(jnp.float32) * inv_t, top_k,
+                          top_p), -1)
+
     @jax.jit
     def prefill(m, caches, ids):
         logits, caches = m(ids, caches=caches, cache_index=0)
-        return jax.nn.softmax(logits[:, -1, :].astype(jnp.float32)
-                              * inv_t, -1), caches
+        return dist(logits[:, -1, :]), caches
 
     @functools.partial(jax.jit, static_argnums=(5,))
     def propose(m, caches, c, idx, key, k):
@@ -735,8 +751,7 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
         def body(carry, i):
             tok, caches, key = carry
             logits, caches = m(tok, caches=caches, cache_index=idx + i)
-            p = jax.nn.softmax(logits[:, -1].astype(jnp.float32)
-                               * inv_t, -1)
+            p = dist(logits[:, -1])
             key, sub = jax.random.split(key)
             nxt = jax.random.categorical(
                 sub, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
@@ -748,8 +763,7 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
     @jax.jit
     def verify(m, caches, window, idx):
         logits, caches = m(window, caches=caches, cache_index=idx)
-        return jax.nn.softmax(logits[0].astype(jnp.float32) * inv_t,
-                              -1), caches            # (k+1, V)
+        return dist(logits[0]), caches               # (k+1, V)
 
     p_last, tcaches = prefill(target, tcaches, input_ids)
     _, dcaches = prefill(draft, dcaches, input_ids)

@@ -616,3 +616,35 @@ class TestSampledSpeculative:
         # 3-sigma binomial bound per bucket
         sigma = np.sqrt(want * (1 - want) / N)
         assert (np.abs(freq - want) < 3 * sigma + 0.02).all(), (freq, want)
+
+
+class TestSampledSpecFiltering:
+    def test_filter_logits_topk_topp(self):
+        from paddle_tpu.models.generation import filter_logits
+
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0]])
+        k2 = np.asarray(filter_logits(logits, top_k=2))
+        assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+        # nucleus: keep tokens until cum prob >= top_p (incl. the one
+        # that crosses)
+        p = np.asarray(jax.nn.softmax(logits, -1))[0]
+        tp = np.asarray(filter_logits(logits, top_p=float(p[0] + 1e-6)))
+        assert np.isfinite(tp[0, 0]) and np.isfinite(tp[0, 1])
+        assert np.isinf(tp[0, 2:]).all()
+
+    def test_sampled_spec_topk_never_emits_filtered_tokens(self):
+        from paddle_tpu.models.generation import (
+            generate_speculative_sampled)
+
+        pt.seed(0)
+        target = LlamaForCausalLM(llama_tiny(
+            vocab_size=16, hidden_size=32, layers=1, heads=2, kv_heads=2,
+            intermediate_size=64, max_pos=64))
+        ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+        # top_k=1 == greedy: every sampled run must equal the greedy one
+        want = np.asarray(target.generate(ids, max_new_tokens=8))
+        for seed in range(3):
+            out = np.asarray(generate_speculative_sampled(
+                target, target, ids, max_new_tokens=8, temperature=1.0,
+                top_k=1, rng_key=jax.random.PRNGKey(seed)))
+            np.testing.assert_array_equal(out, want, err_msg=f'seed {seed}')
