@@ -411,6 +411,9 @@ def main():
             # the judge to eyeball it
             'gate_int8_beats_bf16': (bool(decode_b1_int8 > decode_b1)
                                      if on_tpu and decode_b1_int8 else None),
+            'gate_kv8_beats_bf16_b8': (bool(decode_b8_kv8 > decode_b8)
+                                       if on_tpu and decode_b8_kv8
+                                       else None),
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,
