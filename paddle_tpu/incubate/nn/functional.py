@@ -603,3 +603,179 @@ def block_multihead_attention(
 
     raise ValueError('neither prefill (seq_lens_encoder) nor decode '
                      '(seq_lens_decoder) rows present')
+
+
+# ---------------------------------------------------------------------------
+# Remaining reference functional surface
+# ---------------------------------------------------------------------------
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """ref: incubate/nn/functional/blha_get_max_len.py — the serving
+    loop's helper: max encoder/decoder lengths this step (feeds
+    block_multihead_attention's max_enc/dec_len_this_time)."""
+    enc = jnp.max(jnp.reshape(jnp.asarray(seq_lens_encoder, jnp.int32),
+                              (-1,)))
+    dec = jnp.max(jnp.reshape(jnp.asarray(seq_lens_decoder, jnp.int32),
+                              (-1,)))
+    return enc.reshape(1), dec.reshape(1)
+
+
+def fused_dot_product_attention(query, key, value, attn_mask=None,
+                                dropout_p=0.0, is_causal=False,
+                                scaling_factor=None, training=True,
+                                name=None):
+    """ref: incubate/nn/functional/fused_dot_product_attention.py (cuDNN
+    fused attention, [B, S, H, D] layout) — on TPU this IS
+    scaled_dot_product_attention (flash kernel underneath)."""
+    from ...nn.functional.attention import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, scale=scaling_factor, training=training)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """ref: incubate/nn/functional/variable_length_memory_efficient_
+    attention.py (CUTLASS varlen attention, [B, H, S, D] layout):
+    per-row query/key validity from seq_lens/kv_seq_lens, optional
+    additive mask, causal option. The XLA softmax fuses; rows beyond a
+    sequence's length contribute nothing and emit zeros."""
+    if pre_cache_length:
+        raise NotImplementedError(
+            'pre_cache_length belongs to the reference CUDA pre-cache '
+            'pipeline')
+    B, H, Sq, D = query.shape
+    Sk = key.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    ql = jnp.reshape(jnp.asarray(seq_lens, jnp.int32), (-1,))
+    kl = jnp.reshape(jnp.asarray(kv_seq_lens, jnp.int32), (-1,))
+    logits = jnp.einsum('bhqd,bhkd->bhqk', query.astype(jnp.float32),
+                        key.astype(jnp.float32)) * scale
+    keep = (jnp.arange(Sk)[None, None, None, :] < kl[:, None, None, None])
+    if causal:
+        keep = keep & (jnp.arange(Sk)[None, None, None, :]
+                       <= jnp.arange(Sq)[None, None, :, None])
+    logits = jnp.where(keep, logits, -1e30)
+    if mask is not None:
+        logits = logits + jnp.asarray(mask, jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', p, value.astype(jnp.float32))
+    # rows past a sequence's own length are undefined in the reference;
+    # zero them so garbage can't leak downstream
+    qvalid = (jnp.arange(Sq)[None, None, :, None]
+              < ql[:, None, None, None])
+    return jnp.where(qvalid, out, 0.0).astype(query.dtype)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method='None', moe_topk=2, norm_topk_prob=True):
+    """ref: incubate/nn/functional/fused_moe.py — the fused serving MoE:
+    per-token top-k over precomputed gate logits ([B, S, E]), SwiGLU
+    experts with fused gate+up ffn1 ([E, d, 2*dff]), optional int8
+    weights dequantized by ffn1/2_scale. TPU-native: the dropless
+    sort + lax.ragged_dot grouped-GEMM path (distributed.moe)."""
+    from ...distributed.moe import F as _moeF  # silu
+    from ...distributed.moe import ragged_expert_apply
+
+    if quant_method not in ('None', None, 'weight_only_int8'):
+        raise NotImplementedError(f'quant_method={quant_method!r}')
+    if quant_method == 'weight_only_int8' and (ffn1_scale is None
+                                               or ffn2_scale is None):
+        raise ValueError(
+            "quant_method='weight_only_int8' requires ffn1_scale and "
+            'ffn2_scale — raw int8 codes without scales would silently '
+            'produce garbage')
+    if ffn1_bias is not None:
+        raise NotImplementedError(
+            'ffn1_bias (inside the activation) is not supported by the '
+            'ragged path; fold it into the checkpoint (the reference '
+            'CUTLASS kernel does apply it — fc1_expert_biases)')
+    B, S, d = x.shape
+    E = gate_weight.shape[-1]
+    logits = jnp.asarray(gate_weight, jnp.float32).reshape(B * S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, moe_topk)
+    if norm_topk_prob:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    w1 = jnp.asarray(ffn1_weight)
+    w2 = jnp.asarray(ffn2_weight)
+    if ffn1_scale is not None:
+        w1 = w1.astype(jnp.float32) * jnp.asarray(ffn1_scale)[:, None, :]
+    if ffn2_scale is not None:
+        w2 = w2.astype(jnp.float32) * jnp.asarray(ffn2_scale)[:, None, :]
+    w1 = w1.astype(x.dtype)
+    w2 = w2.astype(x.dtype)
+    dff2 = w1.shape[-1]
+    # fused gate+up: split [.., 2*dff] -> swiglu halves
+    w_gate, w_up = w1[..., :dff2 // 2], w1[..., dff2 // 2:]
+
+    tokens = x.reshape(B * S, d)
+    out = ragged_expert_apply(tokens, expert_idx, gate_vals, w_gate, w_up,
+                              w2, E, act=_moeF.silu)
+    if ffn2_bias is not None:
+        # per-expert output bias: gather-free second pass
+        oh = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (T, k, E)
+        w = (oh * gate_vals[..., None]).sum(1)                 # (T, E)
+        b2 = jnp.asarray(ffn2_bias).reshape(E, d)
+        out = out + (w @ b2).astype(out.dtype)
+    return out.reshape(B, S, d)
+
+
+def fused_gate_attention(query, key=None, query_weight=None,
+                         key_weight=None, value_weight=None,
+                         qkv_weight=None, gate_linear_weight=None,
+                         gate_linear_bias=None, out_linear_weight=None,
+                         out_linear_bias=None, nonbatched_bias=None,
+                         attn_mask=None, has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """ref: incubate/nn/functional/fused_gate_attention.py (AlphaFold
+    gated self-attention): q/k/v projections, attention with an optional
+    nonbatched bias, sigmoid gating, output projection. Layouts follow
+    the reference: query (B, M, R, qdim); merged qkv_weight
+    (3, H, D, qdim); separate q/k/v weights (qdim, H, D);
+    gate/out weights (qdim, H, D) / (H, D, odim)."""
+    q_in = jnp.asarray(query)
+    if merge_qkv:
+        if qkv_weight is None:
+            raise ValueError('merge_qkv=True requires qkv_weight')
+        qkv = jnp.einsum('bmrc,thdc->tbmrhd', q_in, jnp.asarray(qkv_weight))
+        q, k, v = qkv[0], qkv[1], qkv[2]        # (B, M, R, H, D)
+    else:
+        if key is None:
+            key = query
+        k_in = jnp.asarray(key)
+        q = jnp.einsum('bmrc,chd->bmrhd', q_in, jnp.asarray(query_weight))
+        k = jnp.einsum('bmrc,chd->bmrhd', k_in, jnp.asarray(key_weight))
+        v = jnp.einsum('bmrc,chd->bmrhd', k_in, jnp.asarray(value_weight))
+    D = q.shape[-1]
+    logits = jnp.einsum('bmrhd,bmshd->bmhrs', q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (1.0 / (D ** 0.5))
+    if nonbatched_bias is not None:
+        # reference layout (B, 1, H, R, S): broadcasts over the msa axis
+        # directly — no extra axis
+        logits = logits + jnp.asarray(nonbatched_bias, jnp.float32)
+    if attn_mask is not None:
+        logits = logits + jnp.asarray(attn_mask, jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bmhrs,bmshd->bmrhd', p, v.astype(jnp.float32))
+    out = out.astype(q_in.dtype)
+    if has_gating:
+        if gate_linear_weight is None:
+            raise ValueError('has_gating=True requires gate_linear_weight')
+        gate = jnp.einsum('bmrc,chd->bmrhd', q_in,
+                          jnp.asarray(gate_linear_weight))
+        if gate_linear_bias is not None:
+            gate = gate + jnp.asarray(gate_linear_bias)
+        out = out * jax.nn.sigmoid(gate)
+    if out_linear_weight is not None:
+        out = jnp.einsum('bmrhd,hdc->bmrc', out,
+                         jnp.asarray(out_linear_weight))
+        if out_linear_bias is not None:
+            out = out + jnp.asarray(out_linear_bias)
+    return out

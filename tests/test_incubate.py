@@ -3,6 +3,7 @@ python/paddle/incubate/nn/functional)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.incubate.nn import functional as F
@@ -349,3 +350,133 @@ class TestFusedLayers:
         assert all(r.std() == 0 for r in out)
         d.eval()
         np.testing.assert_allclose(np.asarray(d(x)), 1.0)
+
+
+class TestRemainingServingFunctionals:
+    """The last incubate.nn.functional names (ref: blha_get_max_len,
+    fused_dot_product_attention, variable_length_memory_efficient_
+    attention, fused_moe, fused_gate_attention)."""
+
+    def test_blha_get_max_len(self):
+        from paddle_tpu.incubate.nn.functional import blha_get_max_len
+
+        enc, dec = blha_get_max_len(
+            jnp.asarray([[3], [9], [0]], jnp.int32),
+            jnp.asarray([[5], [0], [7]], jnp.int32), 3)
+        assert int(enc[0]) == 9 and int(dec[0]) == 7
+
+    def test_fused_dot_product_attention_matches_sdpa(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_dot_product_attention)
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(2, 10, 2, 8)), jnp.float32)
+        out = fused_dot_product_attention(q, q, q, is_causal=True)
+        want = _sdpa_reference(q, q, q, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_varlen_attention_masks_rows(self):
+        from paddle_tpu.incubate.nn.functional import (
+            variable_length_memory_efficient_attention)
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+        rng = np.random.default_rng(1)
+        B, H, S, D = 2, 2, 8, 8
+        q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+        lens = jnp.asarray([[5], [8]], jnp.int32)
+        out = variable_length_memory_efficient_attention(
+            q, k, v, lens, lens)
+        # row 0 beyond len 5 must be zero
+        assert np.allclose(np.asarray(out)[0, :, 5:], 0.0)
+        # valid region matches a masked reference (BSHD layout swap)
+        want = _sdpa_reference(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2),
+            attn_mask=(jnp.arange(S)[None, :]
+                       < lens[:, 0][:, None])[:, None, None, :])
+        want = jnp.swapaxes(want, 1, 2)
+        np.testing.assert_allclose(np.asarray(out)[1], np.asarray(want)[1],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out)[0, :, :5],
+                                   np.asarray(want)[0, :, :5],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_fused_moe_matches_dense_loop(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        rng = np.random.default_rng(2)
+        B, S, d, dff, E, k = 2, 4, 8, 16, 4, 2
+        x = jnp.asarray(rng.normal(size=(B, S, d)), jnp.float32)
+        gate = jnp.asarray(rng.normal(size=(B, S, E)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(E, d, 2 * dff)) * 0.1,
+                         jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(E, dff, d)) * 0.1, jnp.float32)
+        out = np.asarray(fused_moe(x, gate, w1, w2, moe_topk=k))
+        # dense reference loop
+        probs = np.asarray(jax.nn.softmax(gate, -1)).reshape(-1, E)
+        xs = np.asarray(x).reshape(-1, d)
+        want = np.zeros_like(xs)
+        for t in range(xs.shape[0]):
+            idx = np.argsort(probs[t])[::-1][:k]
+            g = probs[t, idx] / probs[t, idx].sum()
+            for e, gv in zip(idx, g):
+                h = xs[t] @ np.asarray(w1)[e]
+                a = h[:dff] / (1 + np.exp(-h[:dff])) * h[dff:]
+                want[t] += gv * (a @ np.asarray(w2)[e])
+        np.testing.assert_allclose(out.reshape(-1, d), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_fused_gate_attention_merged_qkv(self):
+        from paddle_tpu.incubate.nn.functional import fused_gate_attention
+
+        rng = np.random.default_rng(3)
+        B, M, R, C, H, D = 1, 2, 6, 16, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, M, R, C)), jnp.float32)
+        qkv_w = jnp.asarray(rng.normal(size=(3, H, D, C)) * 0.1,
+                            jnp.float32)
+        gate_w = jnp.asarray(rng.normal(size=(C, H, D)) * 0.1, jnp.float32)
+        gate_b = jnp.zeros((H, D), jnp.float32)
+        out_w = jnp.asarray(rng.normal(size=(H, D, C)) * 0.1, jnp.float32)
+        out_b = jnp.zeros((C,), jnp.float32)
+        out = fused_gate_attention(
+            q, qkv_weight=qkv_w, gate_linear_weight=gate_w,
+            gate_linear_bias=gate_b, out_linear_weight=out_w,
+            out_linear_bias=out_b)
+        assert out.shape == (B, M, R, C)
+        assert np.isfinite(np.asarray(out)).all()
+        # gating off changes the output (the sigmoid gate is active)
+        out_ng = fused_gate_attention(
+            q, qkv_weight=qkv_w, has_gating=False,
+            out_linear_weight=out_w, out_linear_bias=out_b)
+        assert not np.allclose(np.asarray(out), np.asarray(out_ng))
+
+    def test_fused_gate_attention_nonbatched_bias(self):
+        """Reference layout (B, 1, H, R, S) broadcasts over msa directly
+        (regression: an extra axis made logits 6-D and crashed)."""
+        from paddle_tpu.incubate.nn.functional import fused_gate_attention
+
+        rng = np.random.default_rng(4)
+        B, M, R, C, H, D = 1, 2, 6, 16, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, M, R, C)), jnp.float32)
+        qkv_w = jnp.asarray(rng.normal(size=(3, H, D, C)) * 0.1,
+                            jnp.float32)
+        bias = jnp.asarray(rng.normal(size=(B, 1, H, R, R)), jnp.float32)
+        out = fused_gate_attention(q, qkv_weight=qkv_w, has_gating=False,
+                                   nonbatched_bias=bias)
+        assert out.shape == (B, M, R, H, D)
+        base = fused_gate_attention(q, qkv_weight=qkv_w, has_gating=False)
+        assert not np.allclose(np.asarray(out), np.asarray(base))
+
+    def test_fused_moe_quant_requires_scales(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        x = jnp.zeros((1, 2, 8), jnp.float32)
+        gate = jnp.zeros((1, 2, 4), jnp.float32)
+        w1 = jnp.zeros((4, 8, 32), jnp.int8)
+        w2 = jnp.zeros((4, 16, 8), jnp.int8)
+        with pytest.raises(ValueError, match='requires ffn1_scale'):
+            fused_moe(x, gate, w1, w2, quant_method='weight_only_int8')
