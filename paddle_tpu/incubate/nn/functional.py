@@ -779,3 +779,173 @@ def fused_gate_attention(query, key=None, query_weight=None,
         if out_linear_bias is not None:
             out = out + jnp.asarray(out_linear_bias)
     return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode='upscale_in_train',
+                                           name=None):
+    """ref: fused_transformer.py::fused_bias_dropout_residual_layer_norm
+    — LN(residual + dropout(x + bias))."""
+    from ...nn.functional.norm import layer_norm
+
+    if bias is not None:
+        x = x + bias
+    h = fused_dropout_add(x, residual, dropout_rate, training=training,
+                          mode=mode)
+    E = h.shape[-1]
+    return layer_norm(h, E,
+                      ln_scale.reshape(-1) if ln_scale is not None else None,
+                      ln_bias.reshape(-1) if ln_bias is not None else None,
+                      ln_epsilon)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation='gelu'):
+    """ref: fused_linear_activation — matmul + bias + activation (the
+    cuBLASLt epilogue fusion; XLA fuses the same chain on TPU)."""
+    acts = {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'none': lambda a: a,
+            '': lambda a: a}
+    if activation not in acts:
+        raise ValueError(f'activation must be one of {list(acts)}')
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    return acts[activation](out)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            residual_alpha=1.0, cache_kvs=None,
+                            beam_offset=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None,
+                            time_step=None, attn_mask=None,
+                            dropout_rate=0.0, rotary_emb_dims=0,
+                            activation='gelu', training=False,
+                            mode='upscale_in_train', trans_qkvw=True,
+                            ring_id=-1, norm_type='layernorm',
+                            use_neox_rotary_style=True,
+                            gqa_group_size=-1, name=None):
+    """ref: fused_transformer.py::fused_multi_transformer — the
+    FUNCTIONAL form of the serving decoder stack (per-layer weight
+    lists; PaddleNLP's inference path calls this directly). Same math
+    as incubate.nn.FusedMultiTransformer: prefill writes the
+    (2, B, H, max_seq, D) caches through the flash path, `time_step`
+    decode routes the fused head-major kernel. The CUDA-pipeline knobs
+    (beam_offset, pre_caches, rotary_embs, gqa) are rejected with
+    guidance.
+    """
+    for nm, v in (('beam_offset', beam_offset), ('pre_caches', pre_caches),
+                  ('rotary_embs', rotary_embs)):
+        if v is not None:
+            raise NotImplementedError(
+                f'{nm}: use the Llama-family models for RoPE/beam serving')
+    if not trans_qkvw:
+        raise NotImplementedError('trans_qkvw=False unsupported')
+    if gqa_group_size not in (-1, 0):
+        raise NotImplementedError(
+            'gqa: use the Llama family (GQA-native) models')
+    if residual_alpha != 1.0:
+        raise NotImplementedError('residual_alpha != 1 unsupported')
+    from ...nn.functional.norm import layer_norm, rms_norm
+
+    if norm_type == 'layernorm':
+        def norm(h, scale, bias_):
+            return layer_norm(h, h.shape[-1],
+                              scale.reshape(-1) if scale is not None
+                              else None,
+                              bias_.reshape(-1) if bias_ is not None
+                              else None, epsilon)
+    elif norm_type == 'rmsnorm':
+        def norm(h, scale, bias_):
+            out = rms_norm(h, scale.reshape(-1) if scale is not None
+                           else None, epsilon)
+            return out + bias_ if bias_ is not None else out
+    else:
+        raise ValueError(f'norm_type must be layernorm|rmsnorm, '
+                         f'got {norm_type!r}')
+    acts = {'gelu': jax.nn.gelu, 'relu': jax.nn.relu,
+            'silu': jax.nn.silu}
+    if activation not in acts:
+        raise ValueError(f'activation must be one of {list(acts)}')
+    act = acts[activation]
+    from ...nn.functional.attention import scaled_dot_product_attention
+
+    if time_step is not None and x.shape[1] != 1:
+        raise ValueError('time_step decode expects one token per row')
+    if time_step is not None and cache_kvs is None:
+        raise ValueError(
+            'time_step decode requires cache_kvs (the per-layer '
+            '(2, B, H, max_seq, D) caches written at prefill)')
+    if time_step is not None and attn_mask is not None:
+        raise NotImplementedError(
+            'attn_mask is not applied on time_step decode steps (the '
+            'cache window is positional) — drive padded decode via '
+            'seq_lens instead of a mask')
+
+    num_layers = len(qkv_weights)
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(num_layers):
+        qkv_w = jnp.asarray(qkv_weights[i])         # (3, H, D, E)
+        _, H, D, _ = qkv_w.shape
+        residual = x
+        h = norm(x, ln_scales[i], ln_biases[i]) if pre_layer_norm else x
+        cache = cache_kvs[i] if cache_kvs is not None else None
+        if time_step is not None:
+            xt = h[:, 0]
+            qkv_flat = jnp.einsum('be,thde->bthd', xt, qkv_w).reshape(
+                xt.shape[0], 3 * H * D)
+            if qkv_biases[i] is not None:
+                qkv_flat = qkv_flat + jnp.asarray(qkv_biases[i]).reshape(-1)
+            lens = (jnp.reshape(jnp.asarray(seq_lens, jnp.int32), (-1, 1))
+                    if seq_lens is not None
+                    else jnp.full((x.shape[0], 1), time_step, jnp.int32))
+            attn_out, nc = masked_multihead_attention(
+                qkv_flat, cache_kv=cache, sequence_lengths=lens)
+            attn_out = attn_out[:, None]
+        else:
+            qkv = jnp.einsum('bse,thde->bsthd', h, qkv_w)
+            if qkv_biases[i] is not None:
+                qkv = qkv + jnp.asarray(qkv_biases[i]).reshape(
+                    3, H, D)[None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            attn_out = scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                is_causal=attn_mask is None).reshape(*h.shape[:2], H * D)
+            nc = cache
+            if cache is not None:
+                S = h.shape[1]
+                nc = cache.at[0, :, :, :S].set(
+                    jnp.swapaxes(k, 1, 2).astype(cache.dtype))
+                nc = nc.at[1, :, :, :S].set(
+                    jnp.swapaxes(v, 1, 2).astype(cache.dtype))
+        if new_caches is not None:
+            new_caches.append(nc)
+        attn_out = attn_out @ jnp.asarray(linear_weights[i])
+        if linear_biases[i] is not None:
+            attn_out = attn_out + jnp.asarray(linear_biases[i])
+        x = fused_dropout_add(attn_out, residual, dropout_rate,
+                              training=training, mode=mode)
+        if not pre_layer_norm:
+            x = norm(x, ln_scales[i], ln_biases[i])
+
+        residual = x
+        h = norm(x, ffn_ln_scales[i], ffn_ln_biases[i]) \
+            if pre_layer_norm else x
+        h = h @ jnp.asarray(ffn1_weights[i])
+        if ffn1_biases[i] is not None:
+            h = h + jnp.asarray(ffn1_biases[i])
+        h = act(h) @ jnp.asarray(ffn2_weights[i])
+        if ffn2_biases[i] is not None:
+            h = h + jnp.asarray(ffn2_biases[i])
+        x = fused_dropout_add(h, residual, dropout_rate,
+                              training=training, mode=mode)
+        if not pre_layer_norm:
+            x = norm(x, ffn_ln_scales[i], ffn_ln_biases[i])
+    if cache_kvs is not None:
+        return x, new_caches
+    return x

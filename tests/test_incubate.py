@@ -480,3 +480,69 @@ class TestRemainingServingFunctionals:
         w2 = jnp.zeros((4, 16, 8), jnp.int8)
         with pytest.raises(ValueError, match='requires ffn1_scale'):
             fused_moe(x, gate, w1, w2, quant_method='weight_only_int8')
+
+    def test_fused_linear_activation_and_bdrln(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_bias_dropout_residual_layer_norm,
+            fused_linear_activation)
+
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(2, 8)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        b = jnp.ones((4,), jnp.float32)
+        out = fused_linear_activation(x, w, b, activation='relu')
+        np.testing.assert_allclose(
+            np.asarray(out), np.maximum(np.asarray(x @ w + b), 0),
+            rtol=1e-6)
+        h = fused_bias_dropout_residual_layer_norm(
+            x, jnp.ones_like(x), dropout_rate=0.0, training=False)
+        np.testing.assert_allclose(np.asarray(h).mean(-1), 0, atol=1e-5)
+
+    def test_fused_multi_transformer_functional_matches_layer(self):
+        """The functional form (per-layer weight lists) must match the
+        Layer on prefill AND time_step decode."""
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        from paddle_tpu.incubate.nn.functional import (
+            fused_multi_transformer)
+
+        pt.seed(6)
+        B, S, E, H, L = 2, 5, 16, 2, 2
+        layer = FusedMultiTransformer(E, H, 32, num_layers=L,
+                                      dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.default_rng(6)
+        xfull = jnp.asarray(rng.normal(size=(B, S + 2, E)), jnp.float32)
+
+        def weights(name):
+            return [getattr(layer, name)[i].w for i in range(L)]
+
+        kw = dict(
+            ln_scales=weights('ln_scales'), ln_biases=weights('ln_biases'),
+            qkv_weights=weights('qkv_weights'),
+            qkv_biases=weights('qkv_biases'),
+            linear_weights=weights('linear_weights'),
+            linear_biases=weights('linear_biases'),
+            ffn_ln_scales=weights('ffn_ln_scales'),
+            ffn_ln_biases=weights('ffn_ln_biases'),
+            ffn1_weights=weights('ffn1_weights'),
+            ffn1_biases=weights('ffn1_biases'),
+            ffn2_weights=weights('ffn2_weights'),
+            ffn2_biases=weights('ffn2_biases'))
+
+        want = np.asarray(layer(xfull))
+        got = np.asarray(fused_multi_transformer(xfull, **kw))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+        # serving: prefill + 2 decode steps through the functional form
+        caches = layer.gen_cache(B, S + 2)
+        out, caches = fused_multi_transformer(xfull[:, :S],
+                                              cache_kvs=caches, **kw)
+        np.testing.assert_allclose(np.asarray(out), want[:, :S],
+                                   rtol=2e-5, atol=2e-5)
+        for t in range(2):
+            step, caches = fused_multi_transformer(
+                xfull[:, S + t:S + t + 1], cache_kvs=caches,
+                time_step=S + t, **kw)
+            np.testing.assert_allclose(np.asarray(step)[:, 0],
+                                       want[:, S + t], rtol=2e-5,
+                                       atol=2e-5, err_msg=f'step {t}')
