@@ -789,17 +789,11 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            name=None):
     """ref: fused_transformer.py::fused_bias_dropout_residual_layer_norm
     — LN(residual + dropout(x + bias))."""
-    from ...nn.functional.norm import layer_norm
-
     if bias is not None:
         x = x + bias
     h = fused_dropout_add(x, residual, dropout_rate, training=training,
                           mode=mode)
-    E = h.shape[-1]
-    return layer_norm(h, E,
-                      ln_scale.reshape(-1) if ln_scale is not None else None,
-                      ln_bias.reshape(-1) if ln_bias is not None else None,
-                      ln_epsilon)
+    return fused_layer_norm(h, ln_scale, ln_bias, ln_epsilon)
 
 
 def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
@@ -851,7 +845,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             'gqa: use the Llama family (GQA-native) models')
     if residual_alpha != 1.0:
         raise NotImplementedError('residual_alpha != 1 unsupported')
-    from ...nn.functional.norm import layer_norm, rms_norm
+    from ...nn.functional.norm import layer_norm
+    from ...ops import rms_norm
 
     if norm_type == 'layernorm':
         def norm(h, scale, bias_):
