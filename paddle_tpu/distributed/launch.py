@@ -84,7 +84,7 @@ def _free_port():
 
 
 def launch_local(script, script_args=(), nprocs=1, log_dir=None, env=None,
-                 poll_s=0.2, timeout_s=None):
+                 poll_s=0.2, timeout_s=None, with_info=False):
     """Spawn `nprocs` local ranks of `script` wired through a localhost
     coordinator (ref: launch/main.py local mode + its per-rank
     workerlog.N files and fail-fast watch loop).
@@ -93,6 +93,12 @@ def launch_local(script, script_args=(), nprocs=1, log_dir=None, env=None,
     the remaining ranks are terminated (SIGTERM, then SIGKILL after a
     grace period) — surviving stragglers of a dead collective would hang
     forever on the next barrier.
+
+    with_info=True returns (codes, launcher_terminated) where
+    launcher_terminated is the set of rank indices THIS launcher tore
+    down (fail-fast or timeout) — their exit codes (-SIGTERM, or
+    -SIGKILL for a straggler that ignored SIGTERM) are collateral, not
+    the root failure, and must not masquerade as it.
     """
     port = _free_port()
     procs = []
@@ -140,6 +146,7 @@ def launch_local(script, script_args=(), nprocs=1, log_dir=None, env=None,
         raise
 
     codes = [None] * nprocs
+    launcher_terminated = set()
     t0 = time.time()
     try:
         while any(c is None for c in codes):
@@ -151,6 +158,7 @@ def launch_local(script, script_args=(), nprocs=1, log_dir=None, env=None,
             if failed or timed_out:
                 for i, p in enumerate(procs):
                     if codes[i] is None:
+                        launcher_terminated.add(i)
                         p.terminate()
                 grace = time.time() + 10
                 for i, p in enumerate(procs):
@@ -169,6 +177,8 @@ def launch_local(script, script_args=(), nprocs=1, log_dir=None, env=None,
     finally:
         for f in logs:
             f.close()
+    if with_info:
+        return codes, launcher_terminated
     return codes
 
 
@@ -214,28 +224,47 @@ def main(argv=None):
         return 1
     script, *rest = argv
     if nprocs > 1:
-        codes = launch_local(script, rest, nprocs=nprocs, log_dir=log_dir)
-        bad = [c for c in codes if c != 0]
-        if bad:
+        codes, terminated = launch_local(script, rest, nprocs=nprocs,
+                                         log_dir=log_dir, with_info=True)
+        if any(c != 0 for c in codes):
             print(f'launch: ranks failed with codes {codes}',
                   file=sys.stderr)
-            # surface the rank that actually FAILED, not a peer's
-            # SIGTERM from the fail-fast teardown; a crash signal
-            # (segfault -11, OOM kill -9) counts as a real failure too
-            import signal as _sig
-
-            real = [c for c in bad if c != -_sig.SIGTERM]
-            return real[0] if real else bad[0]
+            return _pick_exit_code(codes, terminated)
         return 0
     # single process: initialize the cluster unless the script opts out
     if os.environ.get('PADDLE_TPU_NO_AUTO_INIT') != '1':
         try:
             init_on_cluster()
-        except Exception as e:    # single-host dev boxes
+        except Exception as e:
+            if os.environ.get('PADDLE_TPU_COORDINATOR'):
+                # a child rank of an explicit cluster: running the
+                # script standalone as rank 0 would silently compute on
+                # 1/N of the data (and deadlock its peers) — fail loudly
+                # so the launcher's fail-fast tears the job down
+                print(f'launch: cluster init failed for rank '
+                      f'{os.environ.get("PADDLE_TPU_PROCESS_ID", "?")} '
+                      f'({e})', file=sys.stderr)
+                return 1
+            # single-host dev boxes: no coordinator requested, plain run
             print(f'launch: single-process mode ({e})', file=sys.stderr)
     sys.argv = [script] + rest
     runpy.run_path(script, run_name='__main__')
     return 0
+
+
+def _pick_exit_code(codes, launcher_terminated):
+    """The exit code the launcher should surface: prefer a rank that
+    exited ON ITS OWN with a non-zero code (the root failure) over
+    ranks the launcher itself tore down — a straggler that ignored
+    SIGTERM gets SIGKILLed (-9), and that collateral -9 must not
+    masquerade as an OOM kill. Falls back to any non-zero code (e.g.
+    every rank was terminated by a timeout)."""
+    self_exited = [c for i, c in enumerate(codes)
+                   if c not in (None, 0) and i not in launcher_terminated]
+    if self_exited:
+        return self_exited[0]
+    bad = [c for c in codes if c not in (None, 0)]
+    return bad[0] if bad else 1
 
 
 if __name__ == '__main__':

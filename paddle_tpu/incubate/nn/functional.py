@@ -7,6 +7,8 @@ paths where one exists (rms_norm, flash attention).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -314,6 +316,11 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                 f'kv_cache_int8 instead')
     if out_scale != -1:
         raise NotImplementedError('out_scale quantized output unsupported')
+    if cache_kv is None:
+        raise ValueError(
+            'masked_multihead_attention requires cache_kv (the '
+            '(2, B, H, max_seq, D) decode cache written at prefill) — '
+            'there is no cache-less decode step')
     _, B, H, S, D = cache_kv.shape
     if cache_kv.dtype == jnp.int8:
         raise NotImplementedError(
@@ -944,3 +951,55 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     if cache_kvs is not None:
         return x, new_caches
     return x
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('cache_kvs',),
+    static_argnames=('pre_layer_norm', 'epsilon', 'activation',
+                     'norm_type'))
+def _fmt_decode_step(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                     linear_weights, linear_biases, ffn_ln_scales,
+                     ffn_ln_biases, ffn1_weights, ffn1_biases, ffn2_weights,
+                     ffn2_biases, cache_kvs, seq_lens, time_step, *,
+                     pre_layer_norm, epsilon, activation, norm_type):
+    # engine-wide retrace accounting (runs only while tracing)
+    from ...inference.engine import _count_trace
+
+    _count_trace('fmt_decode_step')
+    return fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases,
+        pre_layer_norm=pre_layer_norm, epsilon=epsilon,
+        cache_kvs=cache_kvs, seq_lens=seq_lens, time_step=time_step,
+        activation=activation, training=False, norm_type=norm_type)
+
+
+def fused_multi_transformer_decode_step(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, cache_kvs, time_step,
+        seq_lens=None, pre_layer_norm=True, epsilon=1e-5,
+        activation='gelu', norm_type='layernorm'):
+    """The fused_multi_transformer time_step path under the
+    DecodeEngine's compilation/donation contract (docs/decode_engine.md):
+    a MODULE-LEVEL jit (steady-state serving never retraces — the trace
+    is keyed on the weight-list pytree structure, cache shapes, and the
+    static config) with `cache_kvs` DONATED, so every layer's
+    (2, B, H, max_seq, D) cache is updated in place instead of copied
+    per token.
+
+    Contract: the cache_kvs buffers passed in are DEAD to the caller
+    after this returns — keep only the returned caches (the serving
+    loop's natural `caches = step(..., caches)` shape). time_step may be
+    a traced/device scalar: one compilation serves every step index.
+
+    Returns (x_out, new_cache_kvs) exactly like
+    fused_multi_transformer(time_step=...)."""
+    return _fmt_decode_step(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, cache_kvs,
+        seq_lens, jnp.asarray(time_step, jnp.int32),
+        pre_layer_norm=bool(pre_layer_norm), epsilon=float(epsilon),
+        activation=activation, norm_type=norm_type)

@@ -264,6 +264,18 @@ def get_num_bytes_of_data_type(dtype):
     return sizes[dtype]
 
 
+# Compiled serving engine (persistent jit cache + KV donation +
+# bucketed prefill) — the decode hot path; see engine.py.
+from .engine import (  # noqa: E402
+    COMPILE_CACHE,
+    DecodeEngine,
+    bucket_length,
+    reset_trace_counts,
+    total_traces,
+    trace_counts,
+)
+
+
 def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
                                mixed_params_file, mixed_precision=None,
                                backend=None, keep_io_types=True,

@@ -10,6 +10,7 @@ this in (LlamaForCausalLM, MoEForCausalLM). Everything is static-shape
 """
 from __future__ import annotations
 
+import functools
 import typing
 
 import jax
@@ -23,6 +24,9 @@ def filter_logits(logits, top_k=0, top_p=1.0):
     speculative decoding (filtering both target and draft keeps the
     rejection-sampling identity: it holds for ANY pt/pd pair)."""
     if top_k > 0:
+        # clamp to the vocab (HF semantics): top_k > V means "keep all",
+        # not an IndexError at trace time
+        top_k = min(int(top_k), logits.shape[-1])
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
@@ -441,10 +445,12 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
     simply overwritten on the next window (cache writes always start at
     the committed length, and position masking hides rows beyond it).
 
-    Host-driven loop: the accepted length is data-dependent, so each
-    window syncs once — the win is fewer *target* forwards, which is
-    what dominates when the draft is much smaller. Batched prompts
-    (B > 1, equal length) commit per row at their own rates via per-row
+    The accepted length is data-dependent, but at batch 1 it only
+    steers on-device state, so the whole window loop runs as ONE
+    compiled lax.while_loop with a single host sync per call — the win
+    is fewer *target* forwards, which is what dominates when the draft
+    is much smaller. Batched prompts (B > 1, equal length; these sync
+    once per window) commit per row at their own rates via per-row
     cache write offsets (`kv_write_pos` — models that lack it are
     batch-1 only): each row commits by the same greedy rule its solo
     `generate()` follows. (As with batched generate(), bit-exactness vs
@@ -492,11 +498,16 @@ def generate_speculative(target, draft, input_ids, max_new_tokens=32,
 
 
 def _commit_window(c, d_row, t_row, k):
-    """The greedy speculative commit rule, shared by the batch-1 and
-    batched loops so they can never drift: accept the longest draft
-    prefix the target agrees with, commit [c] + that prefix, and pick
-    the next committed token from the target's own choices. Returns
-    (committed_tokens, next_c)."""
+    """The greedy speculative commit rule as a host-side REFERENCE:
+    accept the longest draft prefix the target agrees with, commit [c]
+    + that prefix, and pick the next committed token from the target's
+    own choices. Returns (committed_tokens, next_c).
+
+    The production loops now run this rule ON DEVICE inside the fused
+    window step (inference.engine._spec_window_*: m = sum(cumprod(d ==
+    t[:k])), next = t[m]); this function stays as the executable spec
+    the engine's commit is tested against
+    (tests/test_decode_engine.py)."""
     m_acc = 0
     while m_acc < k and int(d_row[m_acc]) == int(t_row[m_acc]):
         m_acc += 1
@@ -508,7 +519,14 @@ def _commit_window(c, d_row, t_row, k):
 def _speculative_loop(target, draft, input_ids, max_new_tokens,
                       num_draft_tokens, eos_token_id,
                       kv_cache_int8=False):
-    import functools
+    """Batch-1 greedy speculative decoding through the COMPILED whole
+    loop (inference.engine._spec_decode_b1): propose + verify + commit
+    for EVERY window run inside one module-level-jitted lax.while_loop
+    (steady state: zero retraces across calls — the jit closures used
+    to live inside this function, guaranteeing a fresh trace every
+    invocation), KV caches are donated (updated in place), and the
+    host syncs once per generate call."""
+    from ..inference.engine import _spec_loop_host_b1
 
     B, S = input_ids.shape
     k = int(num_draft_tokens)
@@ -517,61 +535,10 @@ def _speculative_loop(target, draft, input_ids, max_new_tokens,
     max_len = S + max_new_tokens + k + 1      # room for the last window
     tcaches = target.init_cache(B, max_len, quantized=kv_cache_int8)
     dcaches = draft.init_cache(B, max_len, quantized=kv_cache_int8)
-
-    @jax.jit
-    def prefill(m, caches, ids):
-        logits, caches = m(ids, caches=caches, cache_index=0)
-        return logits[:, -1, :], caches
-
-    @functools.partial(jax.jit, static_argnums=(4,))
-    def propose(m, caches, c, idx, k):
-        """Draft processes committed token c at buffer idx, then greedily
-        proposes k tokens. Scans k+1 steps (discarding the last output)
-        so the k-th proposal's OWN kv row is written too: on a fully
-        accepted window the committed length passes that row, and a
-        zero-filled hole there would pollute every later proposal."""
-        def body(carry, i):
-            tok, caches = carry
-            logits, caches = m(tok, caches=caches, cache_index=idx + i)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return (nxt[:, None], caches), nxt
-        (_, caches), toks = jax.lax.scan(body, (c, caches),
-                                         jnp.arange(k + 1))
-        return toks[:k, 0], caches             # (k,), caches
-
-    @jax.jit
-    def verify(m, caches, window, idx):
-        """Target forward over the whole window [c, d1..dk] at idx:
-        greedy choices at every position in one dispatch."""
-        logits, caches = m(window, caches=caches, cache_index=idx)
-        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), caches
-
-    last_logits, tcaches = prefill(target, tcaches, input_ids)
-    _, dcaches = prefill(draft, dcaches, input_ids)
-    c_host = int(np.asarray(jnp.argmax(last_logits, axis=-1))[0])
-
-    out = []
-    L = S                                      # committed length
-    while len(out) < max_new_tokens:
-        c = jnp.asarray([[c_host]], jnp.int32)
-        drafts, dcaches = propose(draft, dcaches, c, jnp.asarray(L, jnp.int32),
-                                  k)
-        window = jnp.concatenate([c, drafts[None, :]], axis=1)   # (1, k+1)
-        choices, tcaches = verify(target, tcaches, window,
-                                  jnp.asarray(L, jnp.int32))
-        d = np.asarray(drafts)
-        t = np.asarray(choices)                # t[i] = target after window[:i+1]
-        committed, c_host = _commit_window(c_host, d, t, k)
-        out.extend(committed)
-        if eos_token_id is not None and eos_token_id in committed:
-            # stop at the first eos; generate() freezes to eos after it
-            out = out[:out.index(eos_token_id) + 1]
-            break
-        L += len(committed)
-    if eos_token_id is not None and len(out) < max_new_tokens:
-        out += [eos_token_id] * (max_new_tokens - len(out))
-    gen = jnp.asarray([out[:max_new_tokens]], input_ids.dtype)
-    return jnp.concatenate([input_ids, gen], axis=1)
+    gen = _spec_loop_host_b1(target, draft, tcaches, dcaches, input_ids,
+                             max_new_tokens, k, eos_token_id)
+    return jnp.concatenate(
+        [input_ids, jnp.asarray(gen, input_ids.dtype)], axis=1)
 
 
 def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
@@ -581,8 +548,10 @@ def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
     so each row carries its OWN committed length — cache writes go to
     per-row offsets (kv_write_pos) and attention masks by per-row
     position. The per-row commit rule is byte-identical to the batch-1
-    loop, so losslessness holds row-wise."""
-    import functools
+    loop, so losslessness holds row-wise. Runs through the compiled
+    fused window (inference.engine._spec_window_batched) with donated
+    caches — one dispatch and one host sync per window."""
+    from ..inference.engine import _spec_loop_host_batched
 
     B, S = input_ids.shape
     k = int(num_draft_tokens)
@@ -591,70 +560,11 @@ def _speculative_loop_batched(target, draft, input_ids, max_new_tokens,
     max_len = S + max_new_tokens + k + 1
     tcaches = target.init_cache(B, max_len, quantized=kv_cache_int8)
     dcaches = draft.init_cache(B, max_len, quantized=kv_cache_int8)
-
-    @jax.jit
-    def prefill(m, caches, ids):
-        logits, caches = m(ids, caches=caches, cache_index=0)
-        return jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32), caches
-
-    @functools.partial(jax.jit, static_argnums=(4,))
-    def propose(m, caches, c, wp, k):
-        """Draft processes each row's committed token at its own offset,
-        then proposes k tokens per row (k+1 steps: the k-th proposal's
-        own kv row must be written — see the batch-1 docstring)."""
-        def body(carry, i):
-            tok, caches = carry
-            logits, caches = m(tok, caches=caches, kv_write_pos=wp + i)
-            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
-            return (nxt[:, None], caches), nxt
-        (_, caches), toks = jax.lax.scan(body, (c, caches),
-                                         jnp.arange(k + 1))
-        return toks[:k].T, caches              # (B, k)
-
-    @jax.jit
-    def verify(m, caches, window, wp):
-        logits, caches = m(window, caches=caches, kv_write_pos=wp)
-        return jnp.argmax(logits, -1).astype(jnp.int32), caches  # (B, k+1)
-
-    c0, tcaches = prefill(target, tcaches, input_ids)
-    _, dcaches = prefill(draft, dcaches, input_ids)
-    c_host = np.asarray(c0).astype(np.int64)           # (B,)
-
-    out = [[] for _ in range(B)]
-    finished = [False] * B
-    L = np.full((B,), S, np.int64)
-
-    def row_needs(b):
-        return not finished[b] and len(out[b]) < max_new_tokens
-
-    while any(row_needs(b) for b in range(B)):
-        cj = jnp.asarray(c_host[:, None], jnp.int32)
-        wp = jnp.asarray(L, jnp.int32)
-        drafts, dcaches = propose(draft, dcaches, cj, wp, k)
-        window = jnp.concatenate([cj, drafts], axis=1)           # (B, k+1)
-        choices, tcaches = verify(target, tcaches, window, wp)
-        d = np.asarray(drafts)
-        t = np.asarray(choices)
-        for b in range(B):
-            if not row_needs(b):
-                # full/finished rows still ran through the window
-                # (static shapes) but commit nothing: their L stays put,
-                # so next window simply overwrites the same scratch rows
-                continue
-            committed, c_host[b] = _commit_window(c_host[b], d[b], t[b], k)
-            out[b].extend(committed)
-            if eos_token_id is not None and eos_token_id in committed:
-                out[b] = out[b][:out[b].index(eos_token_id) + 1]
-                finished[b] = True
-            L[b] += len(committed)
-
-    pad = eos_token_id if eos_token_id is not None else 0
-    rows = []
-    for b in range(B):
-        row = out[b][:max_new_tokens]
-        rows.append(row + [pad] * (max_new_tokens - len(row)))
-    gen = jnp.asarray(rows, input_ids.dtype)
-    return jnp.concatenate([input_ids, gen], axis=1)
+    gen = _spec_loop_host_batched(target, draft, tcaches, dcaches,
+                                  input_ids, max_new_tokens, k,
+                                  eos_token_id)
+    return jnp.concatenate(
+        [input_ids, jnp.asarray(gen, input_ids.dtype)], axis=1)
 
 
 def _speculative_accept_dists(pt, pd):
@@ -715,11 +625,59 @@ def generate_speculative_sampled(target, draft, input_ids,
             m_.train()
 
 
+def _sampled_dist(logits, temperature, top_k, top_p):
+    """temperature + top-k/top-p filtering applied to BOTH models'
+    dists; -inf entries softmax to exact 0, so filtered-out tokens can
+    neither be proposed nor resampled."""
+    return jax.nn.softmax(
+        filter_logits(logits.astype(jnp.float32) / temperature, top_k,
+                      top_p), -1)
+
+
+# Module-level jits (the same persistent-cache discipline as
+# inference.engine): sampling config rides as static args, caches are
+# donated — repeated calls with one (model, shapes, config) never
+# retrace and never copy the KV cache.
+
+@functools.partial(jax.jit, donate_argnames=('caches',),
+                   static_argnames=('temperature', 'top_k', 'top_p'))
+def _sampled_prefill(m, caches, ids, *, temperature, top_k, top_p):
+    logits, caches = m(ids, caches=caches, cache_index=0)
+    return _sampled_dist(logits[:, -1, :], temperature, top_k,
+                         top_p), caches
+
+
+@functools.partial(jax.jit, donate_argnames=('caches',),
+                   static_argnames=('k', 'temperature', 'top_k', 'top_p'))
+def _sampled_propose(m, caches, c, idx, key, *, k, temperature, top_k,
+                     top_p):
+    """Draft samples k tokens; returns them WITH the draft's full
+    distribution at every position (the acceptance rule needs p_draft
+    of the chosen token and the residual needs the target dist,
+    gathered on the host per window)."""
+    def body(carry, i):
+        tok, caches, key = carry
+        logits, caches = m(tok, caches=caches, cache_index=idx + i)
+        p = _sampled_dist(logits[:, -1], temperature, top_k, top_p)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(
+            sub, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+        return (nxt[:, None], caches, key), (nxt, p)
+    (_, caches, key), (toks, ps) = jax.lax.scan(
+        body, (c, caches, key), jnp.arange(k + 1))
+    return toks[:k, 0], ps[:k, 0], caches, key   # (k,), (k, V)
+
+
+@functools.partial(jax.jit, donate_argnames=('caches',),
+                   static_argnames=('temperature', 'top_k', 'top_p'))
+def _sampled_verify(m, caches, window, idx, *, temperature, top_k, top_p):
+    logits, caches = m(window, caches=caches, cache_index=idx)
+    return _sampled_dist(logits[0], temperature, top_k, top_p), caches
+
+
 def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
                               num_draft_tokens, temperature, top_k, top_p,
                               rng_key, eos_token_id):
-    import functools
-
     B, S = input_ids.shape
     k = int(num_draft_tokens)
     if k < 1:
@@ -727,46 +685,17 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
     max_len = S + max_new_tokens + k + 1
     tcaches = target.init_cache(B, max_len)
     dcaches = draft.init_cache(B, max_len)
-    inv_t = 1.0 / float(temperature)
+    cfg = dict(temperature=float(temperature), top_k=int(top_k),
+               top_p=float(top_p))
 
-    def dist(logits):
-        # temperature + top-k/top-p filtering applied to BOTH models'
-        # dists; -inf entries softmax to exact 0, so filtered-out tokens
-        # can neither be proposed nor resampled
-        return jax.nn.softmax(
-            filter_logits(logits.astype(jnp.float32) * inv_t, top_k,
-                          top_p), -1)
+    def propose(m, caches, c, idx, key):
+        return _sampled_propose(m, caches, c, idx, key, k=k, **cfg)
 
-    @jax.jit
-    def prefill(m, caches, ids):
-        logits, caches = m(ids, caches=caches, cache_index=0)
-        return dist(logits[:, -1, :]), caches
-
-    @functools.partial(jax.jit, static_argnums=(5,))
-    def propose(m, caches, c, idx, key, k):
-        """Draft samples k tokens; returns them WITH the draft's full
-        distribution at every position (the acceptance rule needs
-        p_draft of the chosen token and the residual needs the target
-        dist, gathered on the host per window)."""
-        def body(carry, i):
-            tok, caches, key = carry
-            logits, caches = m(tok, caches=caches, cache_index=idx + i)
-            p = dist(logits[:, -1])
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(
-                sub, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
-            return (nxt[:, None], caches, key), (nxt, p)
-        (_, caches, key), (toks, ps) = jax.lax.scan(
-            body, (c, caches, key), jnp.arange(k + 1))
-        return toks[:k, 0], ps[:k, 0], caches, key   # (k,), (k, V)
-
-    @jax.jit
     def verify(m, caches, window, idx):
-        logits, caches = m(window, caches=caches, cache_index=idx)
-        return dist(logits[0]), caches               # (k+1, V)
+        return _sampled_verify(m, caches, window, idx, **cfg)
 
-    p_last, tcaches = prefill(target, tcaches, input_ids)
-    _, dcaches = prefill(draft, dcaches, input_ids)
+    p_last, tcaches = _sampled_prefill(target, tcaches, input_ids, **cfg)
+    _, dcaches = _sampled_prefill(draft, dcaches, input_ids, **cfg)
     rng_key, sub = jax.random.split(rng_key)
     c_host = int(jax.random.categorical(
         sub, jnp.log(jnp.maximum(p_last[0], 1e-30))))
@@ -782,8 +711,7 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
         c = jnp.asarray([[c_host]], jnp.int32)
         rng_key, pkey = jax.random.split(rng_key)
         drafts, pd, dcaches, _ = propose(draft, dcaches, c,
-                                         jnp.asarray(L, jnp.int32), pkey,
-                                         k)
+                                         jnp.asarray(L, jnp.int32), pkey)
         window = jnp.concatenate([c, drafts[None, :]], axis=1)
         pt, tcaches = verify(target, tcaches, window,
                              jnp.asarray(L, jnp.int32))

@@ -263,11 +263,14 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
 
         if use_pallas():
             # fused single-token decode: one streaming pass over the
-            # cache (ops/pallas/decode_attention.py); under a tp mesh
-            # each head-shard runs its own kernel via shard_map (the
-            # GQA group alignment survives contiguous head sharding)
+            # cache, routed through the serving dispatcher
+            # (ops/pallas/decode_attention.py — the same entry point the
+            # DecodeEngine decode loop reaches); under a tp mesh each
+            # head-shard runs its own kernel via shard_map (the GQA
+            # group alignment survives contiguous head sharding)
             try:
-                from ..ops.pallas.decode_attention import decode_attention
+                from ..ops.pallas.decode_attention import (
+                    dispatch_decode_attention)
 
                 mesh = None
                 from ..distributed.mesh import get_mesh
@@ -295,18 +298,14 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                     st = jnp.broadcast_to(jnp.asarray(
                         0 if kv_start is None else kv_start, jnp.int32),
                         (B,))
-                    if window is not None:
-                        # SWA over the cache: window start is just a
-                        # bigger per-row start offset
-                        st = jnp.maximum(st, vl - window)
                     if quant:
                         sspec = _valid_spec(P('tp', None), kscale.shape,
                                             mesh)
 
                         def _da8(q_, k_, v_, vl_, st_, ks_, vs_):
-                            return decode_attention(q_, k_, v_, vl_,
-                                                    k_scale=ks_, v_scale=vs_,
-                                                    start=st_)
+                            return dispatch_decode_attention(
+                                q_, k_, v_, vl_, start=st_, window=window,
+                                k_scale=ks_, v_scale=vs_)
 
                         out = _jax.shard_map(
                             _da8, mesh=mesh,
@@ -316,8 +315,8 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                         )(q, ck, cv, vl, st, kscale, vscale)
                     else:
                         def _da(q_, k_, v_, vl_, st_):
-                            return decode_attention(q_, k_, v_, vl_,
-                                                    start=st_)
+                            return dispatch_decode_attention(
+                                q_, k_, v_, vl_, start=st_, window=window)
 
                         out = _jax.shard_map(
                             _da, mesh=mesh,
@@ -327,21 +326,10 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                 else:
                     vl1 = (wp + 1 if kv_write_pos is not None
                            else cache_index + 1)
-                    st1 = kv_start
-                    if window is not None:
-                        wstart = jnp.maximum(
-                            jnp.asarray(vl1, jnp.int32) - window, 0)
-                        st1 = (wstart if st1 is None
-                               else jnp.maximum(
-                                   jnp.asarray(st1, jnp.int32), wstart))
-                    if quant:
-                        out = decode_attention(q, ck, cv, vl1,
-                                               k_scale=kscale,
-                                               v_scale=vscale,
-                                               start=st1)
-                    else:
-                        out = decode_attention(q, ck, cv, vl1,
-                                               start=st1)
+                    out = dispatch_decode_attention(
+                        q, ck, cv, vl1, start=kv_start, window=window,
+                        k_scale=kscale if quant else None,
+                        v_scale=vscale if quant else None)
             except Exception as e:
                 from ..ops import pallas_failed
 

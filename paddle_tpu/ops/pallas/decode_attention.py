@@ -139,6 +139,28 @@ def _pick_block(block_s, S, hkv, D, itemsize, interpret):
     return min(max(128, bs // 128 * 128), S)
 
 
+def dispatch_decode_attention(q, k_cache, v_cache, valid_len, start=None,
+                              window=None, k_scale=None, v_scale=None,
+                              scale=None, block_s=DEFAULT_BLOCK_S):
+    """Single serving entry point for the fused decode step (used by the
+    models' cached_attention — and through it model.generate and the
+    DecodeEngine decode loop).
+
+    Composes the sliding-window band into the per-row `start` offset
+    (the kernel streams only the live band either way) and routes fp
+    vs int8-cache calls: pass `k_scale`/`v_scale` for a quantized
+    cache, leave them None for bf16/f32. Keeping the composition here
+    means every caller applies the identical window rule."""
+    vl = jnp.asarray(valid_len, jnp.int32)
+    if window is not None:
+        wstart = jnp.maximum(vl - window, 0)
+        start = (wstart if start is None
+                 else jnp.maximum(jnp.asarray(start, jnp.int32), wstart))
+    return decode_attention(q, k_cache, v_cache, vl, scale=scale,
+                            block_s=block_s, k_scale=k_scale,
+                            v_scale=v_scale, start=start)
+
+
 def decode_attention(q, k_cache, v_cache, valid_len, scale=None,
                      block_s=DEFAULT_BLOCK_S, k_scale=None, v_scale=None,
                      start=None):

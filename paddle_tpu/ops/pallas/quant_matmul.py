@@ -5,6 +5,12 @@ weight_only_linear fused kernels (paddle/phi/kernels/fusion/gpu/
 weight_only_linear_kernel.cu). Weights stored int8 with per-column
 fp32 scales; the kernel dequantises tiles in VMEM right before the
 MXU dot, so HBM traffic is halved vs bf16 weights.
+
+Off-TPU, quant_matmul/quant_matmul_int4 dispatch to a native-XLA
+equivalent (_quant_matmul_xla) instead of the pallas interpreter: the
+math is identical (f32 dot over raw codes, per-column scale on the
+accumulator) but it runs at XLA-CPU matmul speed, so quantized serving
+benches on dev boxes measure the model, not the interpreter.
 """
 from __future__ import annotations
 
@@ -103,13 +109,31 @@ def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, bk, K, int4=False):
         o_ref[:] = (acc[:] * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _quant_matmul_xla(x, wq, scale, out_dtype):
+    """Native-XLA path for non-TPU backends: the same math as _kernel
+    (f32 dot over the raw codes, per-output-column scale applied to the
+    accumulator) without the pallas interpreter, whose per-instruction
+    emulation made the int8 DRAFT model slower than the bf16 target on
+    CPU and sank the speculative-decode bench."""
+    acc = jax.lax.dot_general(
+        x.astype(jnp.float32), wq.astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return (acc * scale[None, :].astype(jnp.float32)).astype(out_dtype)
+
+
 def quant_matmul(x, wq, scale, block_m=256, block_n=256, block_k=512,
-                 out_dtype=None):
-    """x: (M, K) fp; wq: (K, N) int8; scale: (N,) fp32 → (M, N)."""
+                 out_dtype=None, interpret=None):
+    """x: (M, K) fp; wq: (K, N) int8; scale: (N,) fp32 → (M, N).
+
+    interpret=None (auto): pallas kernel on TPU, native XLA elsewhere.
+    interpret=True forces the interpret-mode pallas kernel (kernel
+    correctness tests)."""
     M, K = x.shape
     K2, N = wq.shape
     assert K == K2
     out_dtype = out_dtype or x.dtype
+    if interpret is None and _interpret():
+        return _quant_matmul_xla(x, wq, scale, out_dtype)
     bm, bn, bk = min(block_m, M), min(block_n, N), min(block_k, K)
     nk = pl.cdiv(K, bk)
     return pl.pallas_call(
@@ -123,14 +147,15 @@ def quant_matmul(x, wq, scale, block_m=256, block_n=256, block_k=512,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=_interpret(),
+        interpret=bool(interpret) or _interpret(),
     )(x, wq, scale.reshape(1, N))
 
 
 def quant_matmul_int4(x, wq_packed, scale, block_m=256, block_n=256,
-                      block_k=512, out_dtype=None):
+                      block_k=512, out_dtype=None, interpret=None):
     """x: (M, K) fp; wq_packed: (⌈K/2⌉, N) int8 (two int4 codes per
-    byte along K); scale: (N,) fp32 → (M, N)."""
+    byte along K); scale: (N,) fp32 → (M, N). interpret as in
+    quant_matmul."""
     M, K = x.shape
     half, N = wq_packed.shape
     if half * 2 not in (K, K + 1):
@@ -140,6 +165,9 @@ def quant_matmul_int4(x, wq_packed, scale, block_m=256, block_n=256,
     if K % 2:
         x = jnp.concatenate([x, jnp.zeros((M, 1), x.dtype)], axis=1)
         K = K + 1
+    if interpret is None and _interpret():
+        return _quant_matmul_xla(x, _unpack_int4(wq_packed), scale,
+                                 out_dtype)
     bm, bn = min(block_m, M), min(block_n, N)
     bk = min(block_k, K)
     bk = bk + (bk % 2)                                   # even K blocks
@@ -155,7 +183,7 @@ def quant_matmul_int4(x, wq_packed, scale, block_m=256, block_n=256,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=_interpret(),
+        interpret=bool(interpret) or _interpret(),
     )(x, wq_packed, scale.reshape(1, N))
 
 

@@ -18,3 +18,43 @@ def get_lib():
         os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu'))
     os.makedirs(cache, exist_ok=True)
     return cache
+
+
+_COMPILATION_CACHE_DIR = None
+
+
+def enable_persistent_compilation_cache(path=None):
+    """Wire jax's on-disk executable cache so serving restarts skip XLA
+    compilation entirely (the in-process jit cache only survives the
+    process; this one survives reboots). Used by
+    inference.engine.DecodeEngine(persistent_cache=True) and honored
+    directly by `PADDLE_TPU_PERSISTENT_CACHE=1`.
+
+    Stores under get_lib()/xla_cache by default (the same
+    PADDLE_TPU_CACHE root the native helpers use). Thresholds are
+    dropped to zero so even small decode-step executables persist.
+    Idempotent; returns the cache directory (None if this jax build has
+    no compilation-cache support)."""
+    global _COMPILATION_CACHE_DIR
+    import jax
+
+    if path is None:
+        path = _COMPILATION_CACHE_DIR or os.path.join(get_lib(), 'xla_cache')
+    if 'jax_compilation_cache_dir' not in jax.config.values:
+        return None
+    os.makedirs(path, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', path)
+    for opt, val in (('jax_persistent_cache_min_compile_time_secs', 0.0),
+                     ('jax_persistent_cache_min_entry_size_bytes', -1)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # noqa: BLE001 - older jax: keep its defaults
+            pass
+    _COMPILATION_CACHE_DIR = path
+    return path
+
+
+def persistent_compilation_cache_dir():
+    """The directory enable_persistent_compilation_cache wired (None if
+    never enabled this process)."""
+    return _COMPILATION_CACHE_DIR

@@ -546,3 +546,62 @@ class TestRemainingServingFunctionals:
             np.testing.assert_allclose(np.asarray(step)[:, 0],
                                        want[:, S + t], rtol=2e-5,
                                        atol=2e-5, err_msg=f'step {t}')
+
+    def test_fused_multi_transformer_decode_step_donates(self):
+        """The DecodeEngine contract on the fused time_step path
+        (docs/decode_engine.md): module-level jit — time_step rides as
+        device data, so EVERY step shares one compilation — and
+        cache_kvs is donated (updated in place, input buffers dead)."""
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        from paddle_tpu.incubate.nn.functional import (
+            fused_multi_transformer,
+            fused_multi_transformer_decode_step)
+        from paddle_tpu.inference.engine import (donation_supported,
+                                                 total_traces)
+
+        pt.seed(7)
+        B, S, E, H, L = 2, 5, 16, 2, 2
+        layer = FusedMultiTransformer(E, H, 32, num_layers=L,
+                                      dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.default_rng(7)
+        xfull = jnp.asarray(rng.normal(size=(B, S + 3, E)), jnp.float32)
+
+        def weights(name):
+            return [getattr(layer, name)[i].w for i in range(L)]
+
+        kw = dict(
+            ln_scales=weights('ln_scales'), ln_biases=weights('ln_biases'),
+            qkv_weights=weights('qkv_weights'),
+            qkv_biases=weights('qkv_biases'),
+            linear_weights=weights('linear_weights'),
+            linear_biases=weights('linear_biases'),
+            ffn_ln_scales=weights('ffn_ln_scales'),
+            ffn_ln_biases=weights('ffn_ln_biases'),
+            ffn1_weights=weights('ffn1_weights'),
+            ffn1_biases=weights('ffn1_biases'),
+            ffn2_weights=weights('ffn2_weights'),
+            ffn2_biases=weights('ffn2_biases'))
+
+        want = np.asarray(layer(xfull))
+        caches = layer.gen_cache(B, S + 3)
+        _, caches = fused_multi_transformer(xfull[:, :S],
+                                            cache_kvs=caches, **kw)
+        check_donation = donation_supported()
+        t0 = None
+        for t in range(3):
+            prev = caches
+            step, caches = fused_multi_transformer_decode_step(
+                xfull[:, S + t:S + t + 1], cache_kvs=prev,
+                time_step=S + t, **kw)
+            np.testing.assert_allclose(np.asarray(step)[:, 0],
+                                       want[:, S + t], rtol=2e-5,
+                                       atol=2e-5, err_msg=f'step {t}')
+            if check_donation:
+                assert all(c.is_deleted() for c in prev), (
+                    'donated cache_kvs must be consumed, not copied')
+            if t0 is None:
+                t0 = total_traces()        # after the first (compiling) step
+        assert total_traces() == t0, (
+            'decode_step retraced across time steps — time_step must be '
+            'traced device data, not a static arg')

@@ -184,6 +184,28 @@ class TestTailMasking:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-3)
 
+    def test_xla_fallback_matches_interpret_kernel(self):
+        """Off-TPU quant_matmul takes the native-XLA path; interpret=True
+        forces the pallas kernel. Both implement the same math and must
+        agree to accumulation-order tolerance (int8 AND packed int4)."""
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            quant_matmul, quant_matmul_int4, quantize_weight,
+            quantize_weight_int4)
+
+        rng = np.random.default_rng(42)
+        x = jnp.asarray(rng.normal(size=(4, 96)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(96, 32)), jnp.float32)
+        wq, scale = quantize_weight(w)
+        fast = quant_matmul(x, wq, scale)
+        kern = quant_matmul(x, wq, scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(kern),
+                                   rtol=1e-5, atol=1e-5)
+        wq4, scale4 = quantize_weight_int4(w)
+        fast4 = quant_matmul_int4(x, wq4, scale4)
+        kern4 = quant_matmul_int4(x, wq4, scale4, interpret=True)
+        np.testing.assert_allclose(np.asarray(fast4), np.asarray(kern4),
+                                   rtol=1e-5, atol=1e-5)
+
 
 class TestFp8Matmul:
     """SURVEY §2.6/§2.12 fp8 stretch — e4m3 weights through quant_matmul."""
@@ -454,6 +476,35 @@ class TestDecodeAttention:
                                start=start, block_s=32)
         want = decode_attention(q, ck, cv, 60, start=start, block_s=32)
         assert np.max(np.abs(np.asarray(got) - np.asarray(want))) < 1e-2
+
+    def test_dispatcher_composes_window_into_start(self):
+        """dispatch_decode_attention (the single serving entry point)
+        must fold a sliding window into the per-row start exactly like
+        the callers used to: start' = max(start, valid - window)."""
+        from paddle_tpu.ops.pallas.decode_attention import (
+            decode_attention, dispatch_decode_attention)
+
+        rng = np.random.default_rng(7)
+        B, S, H, D = 3, 96, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        valid = jnp.asarray([40, 80, 96], jnp.int32)
+        start = jnp.asarray([0, 7, 50], jnp.int32)
+        window = 24
+        got = dispatch_decode_attention(q, ck, cv, valid, start=start,
+                                        window=window, block_s=32)
+        want = decode_attention(
+            q, ck, cv, valid,
+            start=jnp.maximum(start, valid - window), block_s=32)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # window alone (no explicit start) takes the band start
+        got = dispatch_decode_attention(q, ck, cv, valid, window=window,
+                                        block_s=32)
+        want = decode_attention(q, ck, cv, valid,
+                                start=jnp.maximum(valid - window, 0),
+                                block_s=32)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
     def test_generate_uses_decode_kernel_when_enabled(self, monkeypatch):
         """Dispatch check: the llama cached path must route Sq==1 steps
