@@ -120,6 +120,39 @@ def _stashed_tpu_line():
     return rec
 
 
+def _tracelint_gate(timeout_s=240):
+    """Static serving-contract gate: `python -m paddle_tpu.analysis`
+    (tracelint) must report zero NEW violations over paddle_tpu/ vs the
+    committed baseline — a retrace/donation/host-sync regression fails
+    the bench run even when the tunnel is down. Runs in a subprocess
+    pinned to CPU (the analyzer is pure-AST; its import of paddle_tpu
+    must never touch the flaky TPU backend). Returns (clean, detail):
+    clean is None when the gate could not run (never poses as a pass)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-m', 'paddle_tpu.analysis', '--root', root,
+             '--format', 'json'],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=root)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return None, f'gate did not run: {type(e).__name__}'
+    if proc.returncode == 0:
+        return True, '0 new violations'
+    try:
+        n = json.loads(proc.stdout).get('new', '?')
+    except ValueError:
+        n = '?'
+    if proc.returncode == 1:
+        return False, f'{n} new violation(s)'
+    return None, f'gate errored (rc={proc.returncode}): {proc.stderr[:200]}'
+
+
 def _acquire_bench_lock(max_wait_s=900):
     """Serialize bench runs: tools/tpu_watch.sh may be mid-bench when the
     driver launches its own — two concurrent TPU processes either fail
@@ -152,11 +185,22 @@ def main():
     # once when up.
     cancel_watchdog = _arm_watchdog(2100)
     watchdog_t0 = time.perf_counter()
+    # static gate FIRST (cheap, CPU-only): a serving-contract violation
+    # is a failed round no matter what the chip measures
+    tracelint_clean, tracelint_detail = _tracelint_gate()
+    print(f'# tracelint gate: {tracelint_detail}', flush=True)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
+            stashed.setdefault('detail', {})['gate_tracelint_clean'] = (
+                tracelint_clean)
+            stashed['detail']['tracelint'] = tracelint_detail
             print(json.dumps(stashed), flush=True)
             cancel_watchdog()
+            if tracelint_clean is False:
+                import sys
+
+                sys.exit(1)
             return
         # tunnel down, no stashed artifact: fall back to the CPU smoke
         # config so the driver still records a line (vs_baseline 0 marks
@@ -493,6 +537,11 @@ def main():
             'gate_engine_zero_retraces': (bool(engine_retraces == 0)
                                           if engine_retraces is not None
                                           else None),
+            # static serving-contract gate (tracelint): False fails the
+            # whole run below — a new jit/donation/host-sync violation
+            # is a regression even when the measured numbers look fine
+            'gate_tracelint_clean': tracelint_clean,
+            'tracelint': tracelint_detail,
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,
@@ -503,6 +552,12 @@ def main():
         },
     }), flush=True)
     cancel_watchdog()   # success line is out; don't let the timer clobber it
+    if tracelint_clean is False:
+        # the artifact line above still carries the measurements; the
+        # exit code marks the round failed on the static gate
+        import sys
+
+        sys.exit(1)
 
 
 if __name__ == '__main__':

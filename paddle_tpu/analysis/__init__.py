@@ -1,0 +1,43 @@
+"""tracelint — AST-based TPU-tracer-safety analysis for paddle_tpu.
+
+PR 1 made serving fast by imposing an invisible contract: jits hoisted
+to module level, KV buffers donated and never read after donation, zero
+host syncs inside compiled windows.  Nothing at runtime *checks* that
+contract — a retrace or a stray per-token host sync is silent, it just
+makes serving 100x slower.  This package is the compile-time check a
+jax-native framework gets instead of Paddle's C++ static checks: a
+small AST rule engine (`engine.py`), six TPU-specific rules
+(`rules/TL001..TL006`), a CLI (`python -m paddle_tpu.analysis`, also
+installed as the `tracelint` console script), and a committed baseline
+(`tools/tracelint_baseline.json`) so CI fails only on NEW violations.
+
+The analysis code itself is stdlib-`ast` only (no jax/numpy imports),
+so linting never touches a backend; the CLI does pay the parent
+`paddle_tpu` package import on startup — run it with
+`JAX_PLATFORMS=cpu` where that matters (bench.py's gate does).  See
+docs/tracelint.md for the rule catalogue and workflow.
+"""
+from .engine import (
+    Violation,
+    Rule,
+    FileContext,
+    lint_source,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+    filter_new,
+    format_text,
+    format_json,
+)
+from .config import TracelintConfig, load_config
+from .rules import all_rules, get_rule
+
+__all__ = [
+    'Violation', 'Rule', 'FileContext',
+    'lint_source', 'lint_file', 'lint_paths',
+    'load_baseline', 'write_baseline', 'filter_new',
+    'format_text', 'format_json',
+    'TracelintConfig', 'load_config',
+    'all_rules', 'get_rule',
+]

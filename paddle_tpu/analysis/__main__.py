@@ -1,0 +1,104 @@
+"""tracelint CLI.
+
+    python -m paddle_tpu.analysis [paths...]        # lint vs baseline
+    tracelint paddle_tpu/                           # console script
+    tracelint --write-baseline                      # accept current debt
+    tracelint --list-rules
+
+Exit codes: 0 clean (modulo baseline), 1 new violations, 2 usage/IO
+error.  Config comes from `[tool.tracelint]` in pyproject.toml at
+`--root` (default: cwd); CLI flags win over config.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .config import load_config
+from .engine import (filter_new, format_json, format_text, lint_paths,
+                     load_baseline, write_baseline)
+from .rules import all_rules
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog='tracelint',
+        description='AST-based TPU tracer-safety analyzer: enforces the '
+                    'jit/donation/host-sync serving contract.')
+    p.add_argument('paths', nargs='*',
+                   help='files/directories to lint (default: from '
+                        '[tool.tracelint] paths, else paddle_tpu)')
+    p.add_argument('--root', default=None,
+                   help='project root holding pyproject.toml and the '
+                        'baseline (default: cwd)')
+    p.add_argument('--format', choices=('text', 'json'), default='text')
+    p.add_argument('--baseline', default=None,
+                   help='baseline JSON path (default: from config)')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='report every violation, ignoring the baseline')
+    p.add_argument('--write-baseline', action='store_true',
+                   help='write the current violations as the new baseline '
+                        'and exit 0')
+    p.add_argument('--select', default=None,
+                   help='comma-separated rule ids to run (default: all)')
+    p.add_argument('--list-rules', action='store_true')
+    return p
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f'{rule.id} [{rule.severity}] {rule.name}: '
+                  f'{rule.description}')
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    cfg = load_config(root)
+    select = ([s.strip() for s in args.select.split(',') if s.strip()]
+              if args.select else cfg.select)
+    try:
+        rules = all_rules(select or None)
+    except KeyError as e:
+        print(f'tracelint: {e.args[0]}', file=sys.stderr)
+        return 2
+
+    paths = args.paths or cfg.paths
+    paths = [p if os.path.isabs(p) else os.path.join(root, p)
+             for p in paths]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f'tracelint: no such path(s): {missing}', file=sys.stderr)
+        return 2
+
+    violations = lint_paths(paths, rules=rules, root=root,
+                            exclude=cfg.exclude)
+
+    baseline_path = args.baseline or cfg.baseline
+    if not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(root, baseline_path)
+
+    if args.write_baseline:
+        counts = write_baseline(violations, baseline_path)
+        print(f'tracelint: wrote baseline with {len(violations)} '
+              f'violation(s) across {len(counts)} (file, rule) key(s) '
+              f'to {baseline_path}')
+        return 0
+
+    baselined = 0
+    if not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+        new = filter_new(violations, baseline)
+        baselined = len(violations) - len(new)
+        violations = new
+
+    if args.format == 'json':
+        print(format_json(violations, baselined=baselined))
+    else:
+        print(format_text(violations, baselined=baselined))
+    return 1 if violations else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

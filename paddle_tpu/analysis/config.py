@@ -1,0 +1,86 @@
+"""`[tool.tracelint]` config from pyproject.toml.
+
+Python 3.10 has no stdlib tomllib and the repo pins no TOML package, so
+this reads the one table tracelint needs with a deliberately tiny
+parser: `key = "string"` and `key = ["a", "b", ...]` entries (lists may
+span lines) inside the `[tool.tracelint]` section. That subset is the
+whole config surface; anything fancier belongs in CLI flags.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+
+@dataclasses.dataclass
+class TracelintConfig:
+    paths: list = dataclasses.field(default_factory=lambda: ['paddle_tpu'])
+    baseline: str = 'tools/tracelint_baseline.json'
+    exclude: list = dataclasses.field(default_factory=list)
+    select: list = dataclasses.field(default_factory=list)  # empty = all
+
+
+_SECTION_RE = re.compile(r'^\s*\[tool\.tracelint\]\s*$')
+_ANY_SECTION_RE = re.compile(r'^\s*\[')
+_STRING_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*"([^"]*)"\s*$')
+_LIST_OPEN_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*\[')
+
+
+def _section_text(source):
+    lines = source.splitlines()
+    collecting = False
+    out = []
+    for line in lines:
+        if _SECTION_RE.match(line):
+            collecting = True
+            continue
+        if collecting and _ANY_SECTION_RE.match(line):
+            break
+        if collecting:
+            out.append(line)
+    return out
+
+
+def parse_tracelint_table(source):
+    """dict from the [tool.tracelint] section of a pyproject source."""
+    out = {}
+    lines = _section_text(source)
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        m = _STRING_RE.match(line)
+        if m:
+            out[m.group(1)] = m.group(2)
+            i += 1
+            continue
+        m = _LIST_OPEN_RE.match(line)
+        if m:
+            buf = line
+            while ']' not in buf and i + 1 < len(lines):
+                i += 1
+                buf += ' ' + lines[i]
+            out[m.group(1)] = re.findall(r'"([^"]*)"', buf)
+        i += 1
+    return out
+
+
+def load_config(root=None):
+    """Config from <root>/pyproject.toml (root defaults to cwd);
+    defaults when the file or table is absent."""
+    root = root or os.getcwd()
+    cfg = TracelintConfig()
+    pyproject = os.path.join(root, 'pyproject.toml')
+    if not os.path.exists(pyproject):
+        return cfg
+    with open(pyproject, encoding='utf-8') as f:
+        table = parse_tracelint_table(f.read())
+    if 'paths' in table:
+        cfg.paths = list(table['paths'])
+    if 'baseline' in table:
+        cfg.baseline = table['baseline']
+    if 'exclude' in table:
+        cfg.exclude = list(table['exclude'])
+    if 'select' in table:
+        cfg.select = list(table['select'])
+    return cfg

@@ -1,0 +1,312 @@
+"""The tracelint rule engine: file contexts, suppressions, baselines.
+
+A `Rule` walks one parsed file (`FileContext`) and yields `Violation`s.
+The engine owns everything rule-agnostic:
+
+  - parsing + a parent map (rules ask "is this call inside a loop /
+    inside a function?" by walking up),
+  - `# tracelint: disable=TL00x` suppression comments (same line, or a
+    comment-only line applying to the next code line, or
+    `disable-file=` anywhere for the whole file),
+  - the baseline: violations are keyed `path::rule` and counted, so a
+    committed baseline tolerates existing debt while any NEW violation
+    (count above baseline for its key) fails,
+  - text and JSON output.
+
+The analysis modules themselves import only the stdlib (`ast`, `json`,
+`re`) — no jax, no numpy. Note the CLI entry points (`python -m
+paddle_tpu.analysis`, the `tracelint` script) still execute the parent
+`paddle_tpu/__init__.py` on import, which pulls in jax: invoke them
+with `JAX_PLATFORMS=cpu` in environments where touching the
+accelerator backend is unwanted (bench.py's gate subprocess does
+exactly that), or call `lint_paths` from an interpreter that already
+has the package loaded.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+
+SEVERITIES = ('error', 'warning')
+
+# `# tracelint: disable=TL001,TL002` / `disable=all` /
+# `# tracelint: disable-file=TL001` — prose may follow after the codes
+_DIRECTIVE_RE = re.compile(
+    r'#\s*tracelint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)')
+_CODE_RE = re.compile(r'^(TL\d{3}|all)$')
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    def key(self):
+        """Baseline key: line numbers shift on every edit, so the
+        baseline counts violations per (file, rule) instead of pinning
+        locations."""
+        return f'{self.path}::{self.rule}'
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def render(self):
+        return (f'{self.path}:{self.line}:{self.col}: '
+                f'{self.rule} [{self.severity}] {self.message}')
+
+
+class Rule:
+    """Base class: subclasses set `id`/`name`/`severity`/`description`
+    and implement `check(ctx) -> Iterable[Violation]`."""
+
+    id = 'TL000'
+    name = 'abstract'
+    severity = 'error'
+    description = ''
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def violation(self, ctx, node, message, severity=None):
+        return Violation(
+            path=ctx.path,
+            line=getattr(node, 'lineno', 1),
+            col=getattr(node, 'col_offset', 0),
+            rule=self.id,
+            severity=severity or self.severity,
+            message=message,
+        )
+
+
+def _parse_directives(source):
+    """Returns (line -> set(codes), file-level set(codes)). A directive
+    on a comment-only line also applies to the next line (so a
+    suppression can sit above a long statement)."""
+    per_line: dict[int, set] = {}
+    file_level: set = set()
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _DIRECTIVE_RE.search(text)
+        if not m:
+            continue
+        kind, raw = m.group(1), m.group(2)
+        codes = set()
+        for tok in raw.split(','):
+            tok = tok.strip().split()[0] if tok.strip() else ''
+            if _CODE_RE.match(tok):
+                codes.add(tok)
+        if not codes:
+            continue
+        if kind == 'disable-file':
+            file_level |= codes
+        else:
+            per_line.setdefault(i, set()).update(codes)
+            if text.lstrip().startswith('#'):
+                # comment-only line: the directive rides through any
+                # further comment lines to the next CODE line, so a
+                # multi-line explanation can carry it anywhere
+                j = i + 1
+                while (j <= len(lines)
+                       and lines[j - 1].lstrip().startswith('#')):
+                    j += 1
+                per_line.setdefault(j, set()).update(codes)
+    return per_line, file_level
+
+
+class FileContext:
+    """One parsed file plus the cross-rule caches (parent map, module
+    jit registry — built lazily by rules/common.py)."""
+
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self._suppress_lines, self._suppress_file = _parse_directives(source)
+        self._parents = None
+        self._registry = None          # rules/common.JitRegistry, lazy
+
+    # -- tree navigation ---------------------------------------------------
+
+    @property
+    def parents(self):
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node):
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+    def enclosing(self, node, types):
+        for a in self.ancestors(node):
+            if isinstance(a, types):
+                return a
+        return None
+
+    # -- suppressions ------------------------------------------------------
+
+    def is_suppressed(self, rule_id, line):
+        if 'all' in self._suppress_file or rule_id in self._suppress_file:
+            return True
+        codes = self._suppress_lines.get(line, ())
+        return 'all' in codes or rule_id in codes
+
+
+class ParseErrorRule(Rule):
+    """Not registered: synthesized by the engine when a file fails to
+    parse, so a syntax error surfaces as a violation instead of a
+    crash."""
+
+    id = 'TL000'
+    name = 'parse-error'
+    severity = 'error'
+
+
+def lint_source(source, path='<string>', rules=None):
+    """Lint one source string. The unit the fixture tests drive."""
+    if rules is None:
+        from .rules import all_rules
+
+        rules = all_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        rule = ParseErrorRule()
+        return [Violation(path=path, line=e.lineno or 1,
+                          col=(e.offset or 1) - 1, rule=rule.id,
+                          severity=rule.severity,
+                          message=f'syntax error: {e.msg}')]
+    ctx = FileContext(path, source, tree)
+    out = []
+    for rule in rules:
+        for v in rule.check(ctx):
+            if not ctx.is_suppressed(v.rule, v.line):
+                out.append(v)
+    return sorted(out)
+
+
+def lint_file(filename, rules=None, root=None):
+    display = filename
+    if root:
+        try:
+            display = os.path.relpath(filename, root)
+        except ValueError:      # different drive (windows): keep absolute
+            pass
+    display = display.replace(os.sep, '/')
+    with open(filename, encoding='utf-8', errors='replace') as f:
+        source = f.read()
+    return lint_source(source, path=display, rules=rules)
+
+
+def _iter_py_files(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != '__pycache__' and not d.startswith('.'))
+        for fn in sorted(filenames):
+            if fn.endswith('.py'):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, rules=None, root=None, exclude=()):
+    """Lint every .py file under `paths`. `exclude` holds fnmatch
+    patterns applied to the root-relative posix path."""
+    import fnmatch
+
+    root = root or os.getcwd()
+    out = []
+    for path in paths:
+        for fn in _iter_py_files(path):
+            rel = os.path.relpath(fn, root).replace(os.sep, '/')
+            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            out.extend(lint_file(fn, rules=rules, root=root))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path):
+    """{key: count}. A missing file is an empty baseline (everything is
+    new) — the honest default for a fresh checkout."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get('counts', {}).items()}
+
+
+def write_baseline(violations, path):
+    counts: dict[str, int] = {}
+    for v in violations:
+        counts[v.key()] = counts.get(v.key(), 0) + 1
+    payload = {
+        'version': BASELINE_VERSION,
+        'comment': ('tracelint baseline: per-(file, rule) counts of '
+                    'tolerated violations. Regenerate with '
+                    '`python -m paddle_tpu.analysis --write-baseline` '
+                    'ONLY after deciding each new entry is intended.'),
+        'counts': dict(sorted(counts.items())),
+        'entries': [v.to_dict() for v in sorted(violations)],
+    }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write('\n')
+    return counts
+
+
+def filter_new(violations, baseline):
+    """Violations beyond the baselined count for their (file, rule) key.
+    Deterministic: violations are sorted, so with N baselined and N+k
+    present, the k highest-line ones are 'new'."""
+    seen: dict[str, int] = {}
+    new = []
+    for v in sorted(violations):
+        seen[v.key()] = seen.get(v.key(), 0) + 1
+        if seen[v.key()] > baseline.get(v.key(), 0):
+            new.append(v)
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Output
+# ---------------------------------------------------------------------------
+
+def format_text(violations, baselined=0):
+    out = [v.render() for v in violations]
+    errors = sum(1 for v in violations if v.severity == 'error')
+    warnings = len(violations) - errors
+    tail = f'{errors} error(s), {warnings} warning(s)'
+    if baselined:
+        tail += f' ({baselined} baselined violation(s) not shown)'
+    out.append(tail)
+    return '\n'.join(out)
+
+
+def format_json(violations, baselined=0):
+    return json.dumps({
+        'violations': [v.to_dict() for v in violations],
+        'new': len(violations),
+        'baselined': baselined,
+    }, indent=2)

@@ -1,0 +1,41 @@
+"""tracelint rule registry.
+
+Rules self-register via the `@register` decorator; importing this
+package pulls in every `tl*.py` module.  `all_rules()` returns fresh
+instances sorted by id, `get_rule('TL001')` one of them.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: adds a Rule subclass to the registry."""
+    if cls.id in _REGISTRY:
+        raise ValueError(f'duplicate rule id {cls.id}')
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules(select=None):
+    """Instances of every registered rule (or the `select` subset),
+    sorted by id."""
+    ids = sorted(_REGISTRY)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise KeyError(f'unknown rule id(s): {sorted(unknown)}')
+        ids = sorted(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]()
+
+
+from . import tl001_jit_in_function    # noqa: E402,F401
+from . import tl002_host_sync_in_loop  # noqa: E402,F401
+from . import tl003_use_after_donation  # noqa: E402,F401
+from . import tl004_mutable_static_args  # noqa: E402,F401
+from . import tl005_untraced_nondeterminism  # noqa: E402,F401
+from . import tl006_side_effects_under_jit  # noqa: E402,F401

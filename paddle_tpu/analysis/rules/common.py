@@ -1,0 +1,366 @@
+"""Shared AST machinery for the TL rules.
+
+Three layers:
+
+  1. Import-alias resolution: `dotted(node, aliases)` turns an
+     Attribute/Name chain into a canonical dotted path ("jnp.asarray"
+     -> "jax.numpy.asarray") using the module's import statements, so
+     rules match semantics, not spellings.
+
+  2. The module jit registry: every function that is jitted — by
+     decorator (`@jax.jit`, `@functools.partial(jax.jit, ...)`), by
+     assignment (`g = jax.jit(f, ...)`), or transitively (a function
+     whose body just returns a call into a jitted one) — with its
+     parameter list and the static/donated argument spec pulled from
+     the jit call's keywords.  TL002 taints the results of these calls,
+     TL003 tracks their donated buffers, TL004 checks their static
+     arguments, TL005/TL006 walk their bodies.
+
+  3. A small value-taint query (`taint_at`): does the value a name
+     holds at a given line flow from a jitted call?  Last-assignment-
+     before-use with loop carry-around, host-sync results (np.asarray /
+     jax.device_get / int / float) treated as CLEAN host data — those
+     calls are the sync, their results are not device values anymore.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+LOOP_TYPES = (ast.For, ast.AsyncFor, ast.While)
+COMPREHENSION_TYPES = (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp)
+
+# calls that force a device->host transfer when handed a device value
+HOST_SYNC_NAMES = {'int', 'float', 'bool'}
+HOST_SYNC_DOTTED = {'numpy.asarray', 'numpy.array', 'jax.device_get'}
+HOST_SYNC_METHODS = {'item', 'tolist'}
+
+# parameters that are almost always host metadata, not device arrays —
+# `int(shape[i])` in a loop is ubiquitous and harmless
+HOST_METADATA_NAMES = {'shape', 'shapes', 'dims', 'dim', 'sizes', 'size',
+                       'strides', 'axes', 'axis', 'perm', 'args', 'kwargs',
+                       'config', 'cfg'}
+
+
+def collect_aliases(tree):
+    """name -> canonical dotted prefix, from the module's imports."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split('.')[0]] = (
+                    a.name if a.asname else a.name.split('.')[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f'{node.module}.{a.name}'
+    return aliases
+
+
+def dotted(node, aliases):
+    """Canonical dotted path of a Name/Attribute chain, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    root = aliases.get(parts[0], parts[0])
+    return '.'.join([root] + parts[1:])
+
+
+def _const_str_items(node):
+    """Constant strings from a Tuple/List/single-string node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def _const_int_items(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+    return set()
+
+
+@dataclasses.dataclass
+class JitInfo:
+    name: str
+    node: object                 # the jit-creating Call / FunctionDef
+    func_def: object = None      # FunctionDef of the wrapped body, if known
+    params: tuple = ()
+    static_names: set = dataclasses.field(default_factory=set)
+    static_nums: set = dataclasses.field(default_factory=set)
+    donate_names: set = dataclasses.field(default_factory=set)
+    donate_nums: set = dataclasses.field(default_factory=set)
+
+    def donated_positions(self):
+        pos = set(self.donate_nums)
+        for i, p in enumerate(self.params):
+            if p in self.donate_names:
+                pos.add(i)
+        return pos
+
+    def static_positions(self):
+        pos = set(self.static_nums)
+        for i, p in enumerate(self.params):
+            if p in self.static_names:
+                pos.add(i)
+        return pos
+
+
+def is_jit_call(call, aliases):
+    """`jax.jit(...)` itself (not functools.partial wrapping it)."""
+    return (isinstance(call, ast.Call)
+            and dotted(call.func, aliases) == 'jax.jit')
+
+
+def jit_partial_inner(call, aliases):
+    """For `functools.partial(jax.jit, **kw)` returns the partial Call
+    (its keywords ARE the jit keywords); else None."""
+    if (isinstance(call, ast.Call)
+            and dotted(call.func, aliases) == 'functools.partial'
+            and call.args
+            and dotted(call.args[0], aliases) == 'jax.jit'):
+        return call
+    return None
+
+
+def jit_config_call(node, aliases):
+    """The Call carrying jit keywords if `node` creates a jit: handles
+    `jax.jit(...)`, `functools.partial(jax.jit, ...)`, and the plain
+    `jax.jit` attribute (bare decorator — no keywords, returns None for
+    'call' but True via is_jit_expr)."""
+    if is_jit_call(node, aliases):
+        return node
+    return jit_partial_inner(node, aliases)
+
+
+def is_jit_expr(node, aliases):
+    """Any expression that IS a jit transform: the bare `jax.jit`
+    attribute, a `jax.jit(...)` call, or `functools.partial(jax.jit,
+    ...)`."""
+    if dotted(node, aliases) == 'jax.jit':
+        return True
+    return jit_config_call(node, aliases) is not None
+
+
+def _fill_from_keywords(info, call):
+    for kw in call.keywords:
+        if kw.arg == 'static_argnames':
+            info.static_names |= _const_str_items(kw.value)
+        elif kw.arg == 'static_argnums':
+            info.static_nums |= _const_int_items(kw.value)
+        elif kw.arg == 'donate_argnames':
+            info.donate_names |= _const_str_items(kw.value)
+        elif kw.arg == 'donate_argnums':
+            info.donate_nums |= _const_int_items(kw.value)
+
+
+def _params_of(func_def):
+    a = func_def.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    # kwonly params participate in *_argnames specs, not positions
+    return tuple(names), tuple(p.arg for p in a.kwonlyargs)
+
+
+class JitRegistry:
+    """All jitted callables visible in one module, by name."""
+
+    def __init__(self, tree, aliases):
+        self.aliases = aliases
+        self.jitted: dict[str, JitInfo] = {}
+        self.jitted_defs: list[tuple] = []   # (JitInfo, FunctionDef)
+        self._defs_by_name: dict[str, ast.AST] = {}
+        self._build(tree)
+
+    def _build(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, FUNC_TYPES):
+                self._defs_by_name.setdefault(node.name, node)
+        # pass 1: decorated defs
+        for node in ast.walk(tree):
+            if isinstance(node, FUNC_TYPES):
+                for dec in node.decorator_list:
+                    if is_jit_expr(dec, self.aliases):
+                        info = JitInfo(name=node.name, node=dec,
+                                       func_def=node)
+                        pos, _ = _params_of(node)
+                        info.params = pos
+                        call = jit_config_call(dec, self.aliases)
+                        if call is not None:
+                            _fill_from_keywords(info, call)
+                        self.jitted[node.name] = info
+                        self.jitted_defs.append((info, node))
+        # pass 2: `name = jax.jit(f, ...)` assignments
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = jit_config_call(node.value, self.aliases)
+            if call is None or not is_jit_call(node.value, self.aliases):
+                continue
+            for tgt in node.targets:
+                tname = None
+                if isinstance(tgt, ast.Name):
+                    tname = tgt.id
+                elif isinstance(tgt, ast.Attribute):
+                    tname = tgt.attr       # self._step = jax.jit(...)
+                if tname is None:
+                    continue
+                info = JitInfo(name=tname, node=node.value)
+                _fill_from_keywords(info, call)
+                if call.args and isinstance(call.args[0], ast.Name):
+                    fdef = self._defs_by_name.get(call.args[0].id)
+                    if isinstance(fdef, FUNC_TYPES):
+                        info.func_def = fdef
+                        info.params, _ = _params_of(fdef)
+                        self.jitted_defs.append((info, fdef))
+                self.jitted[tname] = info
+        # pass 3 (fixpoint, bounded): thin wrappers — a def whose body
+        # returns a call to a jitted name is itself jit-dispatching for
+        # taint purposes (no donate/static info carried over: the
+        # wrapper's own signature reorders arguments arbitrarily)
+        for _ in range(3):
+            grew = False
+            for name, fdef in self._defs_by_name.items():
+                if name in self.jitted:
+                    continue
+                for stmt in ast.walk(fdef):
+                    if (isinstance(stmt, ast.Return)
+                            and isinstance(stmt.value, ast.Call)
+                            and isinstance(stmt.value.func, ast.Name)
+                            and stmt.value.func.id in self.jitted):
+                        self.jitted[name] = JitInfo(name=name, node=fdef,
+                                                    func_def=fdef)
+                        grew = True
+                        break
+            if not grew:
+                break
+
+    def info(self, name):
+        return self.jitted.get(name)
+
+
+def registry(ctx):
+    """The per-file JitRegistry, cached on the FileContext."""
+    if ctx._registry is None:
+        aliases = collect_aliases(ctx.tree)
+        ctx._registry = JitRegistry(ctx.tree, aliases)
+    return ctx._registry
+
+
+def called_name(call):
+    return call.func.id if isinstance(call.func, ast.Name) else None
+
+
+def is_host_sync_call(call, aliases):
+    """int()/float()/bool(), np.asarray/np.array, jax.device_get,
+    .item()/.tolist() — the transfers TL002 polices."""
+    if not isinstance(call, ast.Call):
+        return False
+    if isinstance(call.func, ast.Name):
+        return call.func.id in HOST_SYNC_NAMES and len(call.args) >= 1
+    d = dotted(call.func, aliases)
+    if d in HOST_SYNC_DOTTED:
+        return True
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr in HOST_SYNC_METHODS
+            and not call.args)
+
+
+# ---------------------------------------------------------------------------
+# Value taint: does `name` at line L hold data from a jitted call?
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmt):
+    """Names bound by an assignment statement (flat + tuple targets)."""
+    out = []
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+    return out
+
+
+class TaintAnalysis:
+    """Per-function assignment index answering `taint_at(name, line)`.
+
+    Approximation contract (documented in docs/tracelint.md): the last
+    assignment at or before the use line wins; with none before (a
+    loop-carried name), the last assignment anywhere in the function is
+    used — inside a loop the value a name holds at the top of iteration
+    N is whatever iteration N-1 left there.
+    """
+
+    def __init__(self, func_def, reg: JitRegistry):
+        self.reg = reg
+        self.aliases = reg.aliases
+        self.params = set(_params_of(func_def)[0]) | set(
+            _params_of(func_def)[1])
+        self.assigns: dict[str, list] = {}
+        for node in ast.walk(func_def):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for name in _assigned_names(node):
+                    self.assigns.setdefault(name, []).append(node)
+        for lst in self.assigns.values():
+            lst.sort(key=lambda n: n.lineno)
+
+    def _value_tainted(self, expr, line, seen):
+        """Taint of an expression evaluated around `line` — recursive,
+        so `np.asarray(x).astype(...)` is clean (the sync cleanses the
+        chain) while `jnp.argmax(tainted)` stays tainted."""
+        if expr is None:
+            return False
+        # a host-sync wrapper is the sync itself; its RESULT is host data
+        if is_host_sync_call(expr, self.aliases):
+            return False
+        if isinstance(expr, ast.Call):
+            name = called_name(expr)
+            if name and self.reg.info(name) is not None:
+                return True          # direct jitted-call result
+            parts = list(expr.args) + [kw.value for kw in expr.keywords]
+            if isinstance(expr.func, ast.Attribute):
+                root = expr.func.value
+                # module-qualified call (jnp.argmax(x)): taint from args
+                # only; method call (x.astype(...)): the receiver
+                # carries the taint too
+                if not (isinstance(root, ast.Name)
+                        and root.id in self.aliases):
+                    parts.append(root)
+            return any(self._value_tainted(p, line, seen) for p in parts)
+        if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
+            return self.taint_at(expr.id, line, seen)
+        return any(self._value_tainted(c, line, seen)
+                   for c in ast.iter_child_nodes(expr)
+                   if isinstance(c, ast.expr))
+
+    def taint_at(self, name, line, seen=None):
+        seen = set() if seen is None else seen
+        key = (name, line)
+        if key in seen:
+            return False
+        seen.add(key)
+        stmts = self.assigns.get(name)
+        if not stmts:
+            return False             # param or free name: not taint alone
+        before = [s for s in stmts if s.lineno <= line]
+        stmt = before[-1] if before else stmts[-1]   # loop carry-around
+        value = getattr(stmt, 'value', None)
+        if value is None:
+            return False
+        return self._value_tainted(value, stmt.lineno, seen)

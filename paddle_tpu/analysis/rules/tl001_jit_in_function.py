@@ -1,0 +1,59 @@
+"""TL001 — jit created inside a function or loop body.
+
+The PR 1 bug class: a `jax.jit` (or `functools.partial(jax.jit, ...)`)
+created inside a function builds a FRESH wrapper — and a fresh trace
+cache — on every call, so steady-state serving retraces forever.  Jits
+belong at module level (or explicitly cached, in which case suppress
+with a comment saying where the cache lives).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import FUNC_TYPES, LOOP_TYPES, is_jit_expr, jit_partial_inner
+from .common import collect_aliases, dotted
+
+
+@register
+class JitInFunction(Rule):
+    id = 'TL001'
+    name = 'jit-in-function'
+    severity = 'error'
+    description = ('jax.jit / functools.partial(jax.jit, ...) created '
+                   'inside a function or loop body: a fresh wrapper per '
+                   'call means a fresh trace cache per call (retrace '
+                   'hazard). Hoist to module level or cache the wrapper.')
+
+    def _flag(self, ctx, node):
+        loop = ctx.enclosing(node, LOOP_TYPES)
+        where = 'a loop body' if loop is not None else 'a function body'
+        return self.violation(
+            ctx, node,
+            f'jit created inside {where}: every call builds a fresh '
+            f'trace cache (retrace hazard) — hoist to module level or '
+            f'cache the wrapper (then suppress with a comment saying '
+            f'where the cache lives)')
+
+    def check(self, ctx):
+        aliases = collect_aliases(ctx.tree)
+        decorator_nodes = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FUNC_TYPES):
+                for dec in node.decorator_list:
+                    decorator_nodes.add(id(dec))
+                    if (is_jit_expr(dec, aliases)
+                            and ctx.enclosing(node, FUNC_TYPES) is not None):
+                        yield self._flag(ctx, dec)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) in decorator_nodes:
+                continue            # decorators handled above (once)
+            is_site = (dotted(node.func, aliases) == 'jax.jit'
+                       or jit_partial_inner(node, aliases) is not None)
+            if not is_site:
+                continue
+            if ctx.enclosing(node, FUNC_TYPES + LOOP_TYPES) is not None:
+                yield self._flag(ctx, node)

@@ -1,0 +1,114 @@
+"""TL002 — per-iteration host sync on device data inside a loop.
+
+The serving contract allows ONE host sync per compiled window (the
+`jax.device_get` that reads back a whole window's results).  A host
+sync — `int()` / `float()` / `bool()` / `.item()` / `np.asarray` /
+`jax.device_get` — executed per loop iteration on a value that flows
+from a jitted call (or indexes into a device-array parameter) stalls
+the pipeline once per token: the exact per-token `int(d_row[i])`
+pattern PR 1's commit loop had.
+
+Two ways a synced value counts as device data here:
+
+  - taint: it was assigned (possibly through other assignments) from a
+    call to a function this module jits — results of `np.asarray` /
+    `jax.device_get` / `int()` are host data and CLEAN the taint;
+  - the parameter-subscript pattern: `int(param[i])` where `param` is a
+    function parameter (device arrays handed into host driver loops),
+    excluding obvious host metadata names (`shape`, `dims`, ...).
+
+Intended single-sync-per-window reads: suppress with
+`# tracelint: disable=TL002 - one sync per window by design`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import (COMPREHENSION_TYPES, FUNC_TYPES, HOST_METADATA_NAMES,
+                     LOOP_TYPES, TaintAnalysis, is_host_sync_call, registry)
+
+
+def _sync_repr(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f'{f.id}(...)'
+    if isinstance(f, ast.Attribute):
+        return f'.{f.attr}(...)'
+    return 'host sync'
+
+
+class _ParamSubscript(ast.NodeVisitor):
+    """Does the expression subscript a bare function parameter (or a
+    tainted name)?  `d_row[m_acc]` -> yes; `x.shape[0]` -> no."""
+
+    def __init__(self, params, taint, line):
+        self.params = params
+        self.taint = taint
+        self.line = line
+        self.hit = False
+
+    def visit_Subscript(self, node):
+        base = node.value
+        if isinstance(base, ast.Name):
+            reassigned = base.id in self.taint.assigns
+            if (base.id in self.params and not reassigned
+                    and base.id not in HOST_METADATA_NAMES):
+                # a never-reassigned parameter: device arrays handed
+                # into a host driver loop (a reassigned one defers to
+                # the taint query, so `x = np.asarray(x)` is clean)
+                self.hit = True
+            elif self.taint.taint_at(base.id, self.line):
+                self.hit = True
+        self.generic_visit(node)
+
+
+@register
+class HostSyncInLoop(Rule):
+    id = 'TL002'
+    name = 'host-sync-in-loop'
+    severity = 'error'
+    description = ('host sync (int/float/bool/.item/np.asarray/'
+                   'jax.device_get) per loop iteration on a value that '
+                   'flows from jitted computation: one sync per compiled '
+                   'window, or move the computation on device.')
+
+    def check(self, ctx):
+        reg = registry(ctx)
+        taints: dict[int, TaintAnalysis] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not is_host_sync_call(node, reg.aliases):
+                continue
+            loop = ctx.enclosing(node, LOOP_TYPES + COMPREHENSION_TYPES)
+            if loop is None:
+                continue
+            func = ctx.enclosing(node, FUNC_TYPES)
+            if func is None:
+                continue
+            ta = taints.get(id(func))
+            if ta is None:
+                ta = taints[id(func)] = TaintAnalysis(func, reg)
+            args = list(node.args)
+            if isinstance(node.func, ast.Attribute) and not args:
+                args = [node.func.value]          # x.item() / x.tolist()
+            tainted = False
+            for arg in args:
+                if ta._value_tainted(arg, node.lineno, set()):
+                    tainted = True
+                    break
+                ps = _ParamSubscript(ta.params, ta, node.lineno)
+                ps.visit(arg)
+                if ps.hit:
+                    tainted = True
+                    break
+            if not tainted:
+                continue
+            yield self.violation(
+                ctx, node,
+                f'{_sync_repr(node)} inside a loop forces a host sync '
+                f'per iteration on device data — batch the reads into '
+                f'one jax.device_get per compiled window, or compute on '
+                f'device')

@@ -1,0 +1,158 @@
+"""TL003 — use of a donated buffer after the donating call.
+
+`donate_argnames`/`donate_argnums` hands the argument's buffer to XLA:
+after the call the caller's array is DELETED (reads raise, or worse,
+alias freshly-written memory on some backends).  The serving contract
+(docs/decode_engine.md) is: a cache passed to an engine step is dead to
+the caller.  This rule tracks calls to module-visible jitted functions
+with donation specs and flags:
+
+  - a read of the donated name after the call, before any rebind;
+  - a donating call inside a loop that does not rebind the donated name
+    in the same statement (the next iteration would pass a dead buffer).
+
+The analysis is linear within each straight-line block and treats
+branch bodies in source order — a deliberate approximation, documented
+in docs/tracelint.md.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import FUNC_TYPES, LOOP_TYPES, _assigned_names, registry
+
+
+def _own_exprs(stmt):
+    """Expression nodes belonging to the statement ITSELF — compound
+    statements (For/While/If/With/Try) contribute only their header
+    (iter/test/items), never their bodies, which _linear_stmts yields
+    as separate statements (otherwise every donation inside a loop body
+    would be double-counted at the loop header)."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target, stmt.iter]
+    if isinstance(stmt, (ast.While, ast.If)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.Try, ast.ExceptHandler)):
+        return []
+    return [stmt]
+
+
+def _walk_own(stmt):
+    for expr in _own_exprs(stmt):
+        yield from ast.walk(expr)
+
+
+def _donating_calls(stmt, reg):
+    """(call, donated-arg-Name-nodes) for each donating call in the
+    statement's own expressions."""
+    out = []
+    for node in _walk_own(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Name):
+            continue
+        info = reg.info(node.func.id)
+        if info is None:
+            continue
+        donated = []
+        positions = info.donated_positions()
+        for i, arg in enumerate(node.args):
+            if i in positions and isinstance(arg, ast.Name):
+                donated.append(arg)
+        for kw in node.keywords:
+            if (kw.arg in info.donate_names
+                    and isinstance(kw.value, ast.Name)):
+                donated.append(kw.value)
+        if donated:
+            out.append((node, donated))
+    return out
+
+
+def _reads(stmt):
+    return [n for n in _walk_own(stmt)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def _linear_stmts(body):
+    """Statements of a block in source order, descending into compound
+    statements (If/For/While/Try/With bodies)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, FUNC_TYPES + (ast.ClassDef,)):
+            continue            # nested defs/classes: separate dataflow
+        for field in ('body', 'orelse', 'finalbody'):
+            inner = getattr(stmt, field, None)
+            if isinstance(inner, list):
+                yield from _linear_stmts(inner)
+        for handler in getattr(stmt, 'handlers', []) or []:
+            yield from _linear_stmts(handler.body)
+
+
+@register
+class UseAfterDonation(Rule):
+    id = 'TL003'
+    name = 'use-after-donation'
+    severity = 'error'
+    description = ('a buffer passed through donate_argnames/argnums is '
+                   'dead after the call: rebind it from the call result '
+                   'in the same statement, never read it again.')
+
+    def check(self, ctx):
+        reg = registry(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, FUNC_TYPES):
+                continue
+            # every def — nested closures included — is its own scope:
+            # _linear_stmts never descends into inner defs, so each
+            # statement is analyzed in exactly one scope
+            yield from self._check_function(ctx, reg, node)
+
+    def _check_function(self, ctx, reg, func):
+        dead: dict[str, ast.Call] = {}
+        for stmt in _linear_stmts(func.body):
+            if isinstance(stmt, FUNC_TYPES):
+                continue            # nested defs: separate dataflow
+            donations = _donating_calls(stmt, reg)
+            rebound = set(_assigned_names(stmt))
+            # reads BEFORE applying this statement's donations: the
+            # donating call's own arguments are legal reads
+            arg_ids = self._arg_ids(donations)
+            for name_node in _reads(stmt):
+                if (name_node.id in dead
+                        and id(name_node) not in arg_ids):
+                    yield self.violation(
+                        ctx, name_node,
+                        f'`{name_node.id}` was donated at line '
+                        f'{dead[name_node.id].lineno} and is dead — '
+                        f'rebind it from the call result or stop '
+                        f'reading it')
+                    dead.pop(name_node.id, None)   # report once per donation
+            for name in rebound:
+                dead.pop(name, None)
+            for call, donated_nodes in donations:
+                loop = ctx.enclosing(call, LOOP_TYPES)
+                for dn in donated_nodes:
+                    if dn.id in rebound:
+                        continue
+                    if loop is not None and self._read_in(loop, dn.id):
+                        yield self.violation(
+                            ctx, call,
+                            f'`{dn.id}` is donated inside a loop without '
+                            f'being rebound in the same statement — the '
+                            f'next iteration passes a dead buffer')
+                    else:
+                        dead[dn.id] = call
+
+    @staticmethod
+    def _arg_ids(donations):
+        return {id(d) for _, ds in donations for d in ds}
+
+    @staticmethod
+    def _read_in(loop, name):
+        return any(isinstance(n, ast.Name) and n.id == name
+                   and isinstance(n.ctx, ast.Load)
+                   for n in ast.walk(loop))

@@ -1,0 +1,103 @@
+"""TL004 — unhashable/mutable values for static jit arguments.
+
+`static_argnums`/`static_argnames` values are hashed into the trace
+cache key.  A list/dict/set/array there either raises
+(`TypeError: unhashable`) at call time, or — when wrapped in something
+hashable-by-identity — silently keys the cache on object identity and
+retraces on every fresh object.  Flag:
+
+  - call sites of known-jitted functions passing a list/dict/set
+    literal, comprehension, or an obvious mutable constructor
+    (list/dict/set/bytearray/np.array/jnp.array) to a static parameter;
+  - jit definitions whose static parameters have mutable defaults.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import dotted, registry
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_MUTABLE_CONSTRUCTORS = {'list', 'dict', 'set', 'bytearray'}
+_MUTABLE_DOTTED = {'numpy.array', 'numpy.asarray', 'numpy.zeros',
+                   'numpy.ones', 'jax.numpy.array', 'jax.numpy.asarray',
+                   'jax.numpy.zeros', 'jax.numpy.ones'}
+
+
+def _is_mutable_expr(node, aliases):
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in _MUTABLE_CONSTRUCTORS):
+            return True
+        if dotted(node.func, aliases) in _MUTABLE_DOTTED:
+            return True
+    return False
+
+
+@register
+class MutableStaticArgs(Rule):
+    id = 'TL004'
+    name = 'mutable-static-arg'
+    severity = 'error'
+    description = ('unhashable or mutable value bound to a '
+                   'static_argnums/static_argnames parameter: raises at '
+                   'call time or silently keys the trace cache on object '
+                   'identity (retrace per object).')
+
+    def check(self, ctx):
+        reg = registry(ctx)
+        # call sites
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            info = reg.info(node.func.id)
+            if info is None:
+                continue
+            static_pos = info.static_positions()
+            for i, arg in enumerate(node.args):
+                if i in static_pos and _is_mutable_expr(arg, reg.aliases):
+                    yield self.violation(
+                        ctx, arg,
+                        f'mutable/unhashable value passed positionally to '
+                        f'static argument {i} of `{info.name}` — statics '
+                        f'must be hashable (tuple it, or make it a traced '
+                        f'argument)')
+            for kw in node.keywords:
+                if (kw.arg in info.static_names
+                        and _is_mutable_expr(kw.value, reg.aliases)):
+                    yield self.violation(
+                        ctx, kw.value,
+                        f'mutable/unhashable value passed to static '
+                        f'argument `{kw.arg}` of `{info.name}` — statics '
+                        f'must be hashable (tuple it, or make it a traced '
+                        f'argument)')
+        # mutable defaults on static params of jitted defs
+        for info, fdef in reg.jitted_defs:
+            a = fdef.args
+            pos = a.posonlyargs + a.args
+            static_pos = info.static_positions()
+            defaults = list(a.defaults)
+            for off, default in enumerate(defaults):
+                i = len(pos) - len(defaults) + off
+                name = pos[i].arg if 0 <= i < len(pos) else None
+                if ((i in static_pos or name in info.static_names)
+                        and _is_mutable_expr(default, reg.aliases)):
+                    yield self.violation(
+                        ctx, default,
+                        f'static parameter `{name}` of jitted '
+                        f'`{info.name}` has a mutable default — use a '
+                        f'tuple or None')
+            for kwp, kwd in zip(a.kwonlyargs, a.kw_defaults):
+                if (kwd is not None and kwp.arg in info.static_names
+                        and _is_mutable_expr(kwd, reg.aliases)):
+                    yield self.violation(
+                        ctx, kwd,
+                        f'static parameter `{kwp.arg}` of jitted '
+                        f'`{info.name}` has a mutable default — use a '
+                        f'tuple or None')

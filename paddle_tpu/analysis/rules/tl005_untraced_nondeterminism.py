@@ -1,0 +1,74 @@
+"""TL005 — untraced nondeterminism reached from inside a jitted body.
+
+Code under `jax.jit` runs ONCE, at trace time.  `time.time()`,
+`np.random.*`, and the `random` module's global RNG all execute during
+tracing and are then BAKED INTO the compiled program as constants: the
+"random" value never changes again, the "timestamp" is the compile
+time, and nothing re-executes per call.  Inside a trace, randomness
+must come from `jax.random` with an explicit key, and wall-clock values
+must be passed in as arguments.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import dotted, registry
+
+_TIME_CALLS = {'time.time', 'time.time_ns', 'time.perf_counter',
+               'time.perf_counter_ns', 'time.monotonic',
+               'time.monotonic_ns', 'time.process_time'}
+# the `random` module's global-state API (seeding included: reseeding
+# the global RNG from a trace is just as untraced)
+_RANDOM_MODULE_CALLS = {
+    'random.random', 'random.randint', 'random.randrange',
+    'random.choice', 'random.choices', 'random.shuffle', 'random.sample',
+    'random.uniform', 'random.gauss', 'random.normalvariate',
+    'random.seed', 'random.betavariate', 'random.expovariate',
+}
+
+
+def _nondet_kind(dotted_name):
+    if dotted_name is None:
+        return None
+    if dotted_name in _TIME_CALLS:
+        return 'wall-clock time'
+    if (dotted_name.startswith('numpy.random.')
+            or dotted_name == 'numpy.random'):
+        return 'numpy global RNG'
+    if dotted_name in _RANDOM_MODULE_CALLS:
+        return 'python global RNG'
+    return None
+
+
+@register
+class UntracedNondeterminism(Rule):
+    id = 'TL005'
+    name = 'untraced-nondeterminism'
+    severity = 'error'
+    description = ('time.time / np.random / the random module inside a '
+                   'jitted function executes once at trace time and is '
+                   'baked into the executable as a constant: use '
+                   'jax.random with an explicit key, or pass the value '
+                   'in as an argument.')
+
+    def check(self, ctx):
+        reg = registry(ctx)
+        seen = set()
+        for info, fdef in reg.jitted_defs:
+            if id(fdef) in seen:
+                continue
+            seen.add(id(fdef))
+            for node in ast.walk(fdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _nondet_kind(dotted(node.func, reg.aliases))
+                if kind is None:
+                    continue
+                yield self.violation(
+                    ctx, node,
+                    f'{kind} called inside jitted `{info.name}`: this '
+                    f'runs once at trace time and compiles to a '
+                    f'CONSTANT — use jax.random with an explicit key or '
+                    f'pass the value as an argument')

@@ -1,0 +1,87 @@
+"""TL006 — python side effects inside a jitted body.
+
+A `print(...)` under `jax.jit` fires once, at trace time, showing
+tracers instead of values — `jax.debug.print` is the traced
+equivalent.  Mutating a captured (closure/global) list or set under
+jit is worse: the mutation happens at trace time only, so the
+container holds one trace's worth of tracers forever while every
+compiled call appends nothing.  Mutating a LOCAL container during
+tracing is fine (it is trace-time scaffolding, e.g. accumulating
+layers before a stack) and is not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule
+from . import register
+from .common import FUNC_TYPES, registry
+
+_MUTATORS = {'append', 'extend', 'insert', 'add', 'update', 'setdefault',
+             'pop', 'remove', 'clear'}
+
+
+def _local_stores(fdef):
+    """Names bound anywhere inside the function (params included)."""
+    names = set()
+    a = fdef.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        names.add(p.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+    return names
+
+
+@register
+class SideEffectsUnderJit(Rule):
+    id = 'TL006'
+    name = 'side-effect-under-jit'
+    severity = 'error'
+    description = ('print() or captured-container mutation inside a '
+                   'jitted function: happens at trace time only. Use '
+                   'jax.debug.print / jax.debug.callback, or return the '
+                   'value.')
+
+    def check(self, ctx):
+        reg = registry(ctx)
+        seen = set()
+        for info, fdef in reg.jitted_defs:
+            if id(fdef) in seen:
+                continue
+            seen.add(id(fdef))
+            locals_ = _local_stores(fdef)
+            for node in ast.walk(fdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == 'print'):
+                    yield self.violation(
+                        ctx, node,
+                        f'print() inside jitted `{info.name}` fires once '
+                        f'at trace time and shows tracers — use '
+                        f'jax.debug.print')
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                        and isinstance(node.func.value, ast.Name)):
+                    base = node.func.value.id
+                    if base in locals_:
+                        continue     # trace-time scaffolding: legal
+                    # a captured name: check it's not shadowed by an
+                    # enclosing (non-jitted) def's local either — only
+                    # flag names that escape the trace entirely
+                    inner = ctx.enclosing(node, FUNC_TYPES)
+                    if inner is not fdef and inner is not None:
+                        if base in _local_stores(inner):
+                            continue
+                    yield self.violation(
+                        ctx, node,
+                        f'`.{node.func.attr}()` on captured `{base}` '
+                        f'inside jitted `{info.name}` mutates at trace '
+                        f'time only (compiled calls never re-run it) — '
+                        f'return the value or use jax.debug.callback')

@@ -344,6 +344,8 @@ def call_module(layer, args, kwargs):
         key = (in_tree, dyn_idx, static_vals)
         fwd = _MODULE_FWD_CACHE.get(key)
         if fwd is None:
+            # tracelint: disable=TL001 - cached in _MODULE_FWD_CACHE
+            # keyed on (tree, dyn_idx, statics): one trace per shape
             fwd = jax.jit(_pure_module_fwd(in_tree, dyn_idx, static_vals))
             _MODULE_FWD_CACHE[key] = fwd
     except TypeError:   # unhashable static arg: run uncached

@@ -353,7 +353,10 @@ class DistModel:
             out = model(*batch[:-1])
             return self._loss(out, batch[-1])
 
+        # cached on self: built once per engine, reused every step
+        # tracelint: disable=TL001
         self._train_step = jax.jit(train_step)
+        # tracelint: disable=TL001
         self._eval_step = jax.jit(eval_step)
 
     def train(self):

@@ -98,8 +98,13 @@ class Model:
             loss = loss_fn(preds, *labels) if loss_fn is not None else 0.0
             return loss, preds
 
+        # cached on self for the Model's lifetime: built once per
+        # prepare(), every train/eval/predict batch reuses them
+        # tracelint: disable=TL001
         self._train_step = jax.jit(train_step) if opt else None
+        # tracelint: disable=TL001
         self._eval_step = jax.jit(eval_step)
+        # tracelint: disable=TL001
         self._pred_step = jax.jit(lambda network, inputs: network(*inputs))
 
     # -- single-batch API (ref: Model.train_batch / eval_batch) ----------
@@ -234,6 +239,8 @@ class Model:
             if isinstance(names, list):
                 # one accumulated array per metric: component j belongs
                 # to name j (e.g. Accuracy(topk=(1, 5)) -> 2 entries)
+                # tracelint: disable=TL002 - metric logging readback at
+                # batch boundary (a handful of scalars, off the hot path)
                 v = np.asarray(vals[i]).reshape(-1)
                 for j, n in enumerate(names):
                     logs[n] = float(v[j])

@@ -105,10 +105,12 @@ class CompileCache:
     def key(self, model, cache_shape, cache_dtype, sampling):
         # _engine_model_id (stamped by DecodeEngine.__init__) never
         # recycles, unlike id(model) — the raw-id fallback only covers
-        # direct module-level callers that bypassed an engine
-        return (id(type(model)), getattr(model, '_engine_model_id', None)
-                or id(model), tuple(cache_shape), str(cache_dtype),
-                tuple(sampling))
+        # direct module-level callers that bypassed an engine. The id
+        # counter starts at 0, so compare against None (a bare `or`
+        # would throw away the first engine's id as falsy)
+        mid = getattr(model, '_engine_model_id', None)
+        return (id(type(model)), mid if mid is not None else id(model),
+                tuple(cache_shape), str(cache_dtype), tuple(sampling))
 
     def note(self, key):
         if key in self._keys:
@@ -549,6 +551,10 @@ def _spec_loop_host_batched(target, draft, tcaches, dcaches, input_ids,
         wp = jnp.asarray(L, jnp.int32)
         drafts, choices, m, next_c, tcaches, dcaches = _spec_window_batched(
             target, draft, tcaches, dcaches, cj, wp, k=k)
+        # batched rows commit at their OWN rates, so the host must read
+        # the per-row accepts between windows — one batched device_get
+        # per WINDOW (never per token) is the contract this loop keeps.
+        # tracelint: disable=TL002 - single sync per window by design
         d, m_h, nc = jax.device_get((drafts, m, next_c))
         for b in range(B):
             if not row_needs(b):
@@ -572,6 +578,7 @@ def donation_supported():
     """Whether this backend honors jit buffer donation (all current
     CPU/TPU jaxlibs do; the probe keeps tests honest on exotic ones)."""
     x = jnp.zeros((8,))
+    # tracelint: disable=TL001 - one-off capability probe, not a hot path
     jax.jit(lambda a: a + 1, donate_argnums=(0,))(x)
     return x.is_deleted()
 

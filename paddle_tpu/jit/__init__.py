@@ -48,8 +48,13 @@ class StaticFunction:
             def call(model, *args, **kwargs):
                 return model(*args, **kwargs)
 
+            # cached on self: a StaticFunction wraps one callable for
+            # its lifetime, so the jit (and its trace cache) is built
+            # exactly once here
+            # tracelint: disable=TL001
             self._jitted = jax.jit(call, donate_argnums=donate_argnums)
         else:
+            # tracelint: disable=TL001 - cached on self (see above)
             self._jitted = jax.jit(fn, donate_argnums=donate_argnums,
                                    static_argnums=static_argnums)
         functools.update_wrapper(self, fn if callable(fn) else fn.forward)
@@ -110,9 +115,11 @@ def save(obj, path, input_spec=None, **config):
             def fwd(*xs):
                 return eval_layer(*xs)
 
+            # tracelint: disable=TL001 - one-shot export, not a hot path
             exported = jax.export.export(jax.jit(fwd))(*structs)
         else:
             fn = obj._fn if isinstance(obj, StaticFunction) else obj
+            # tracelint: disable=TL001 - one-shot export, not a hot path
             exported = jax.export.export(jax.jit(fn))(*structs)
         with open(path + '.mlir', 'wb') as f:
             # the FULL Exported flatbuffer (what jax.export.deserialize
@@ -174,6 +181,7 @@ def compilation_report(fn, *example_args, **kw):
     {compile_time_s, flops, bytes, hlo_text_head}."""
     import time
 
+    # tracelint: disable=TL001 - one-shot compile-time report
     jitted = jax.jit(fn, **kw)
     t0 = time.perf_counter()
     lowered = jitted.lower(*example_args)

@@ -22,11 +22,32 @@ def filter_logits(logits, top_k=0, top_p=1.0):
     """top-k / nucleus filtering on (already temperature-scaled) logits
     — the one implementation behind sampled generate() and sampled
     speculative decoding (filtering both target and draft keeps the
-    rejection-sampling identity: it holds for ANY pt/pd pair)."""
-    if top_k > 0:
+    rejection-sampling identity: it holds for ANY pt/pd pair).
+
+    top_k may be a Python int (static: folded into the trace) or a
+    traced scalar (e.g. a serving knob passed as a jit argument): the
+    traced path clamps with lax.min/max and gathers the k-th threshold
+    dynamically — no host sync, and top_k <= 0 still means keep-all.
+    """
+    V = logits.shape[-1]
+    if isinstance(top_k, jax.core.Tracer):
+        # clamp to [1, V] on device; the k<1 case is masked out by the
+        # where(top_k > 0, ...) below, the clamp just keeps the gather
+        # index in bounds
+        k = jax.lax.max(jnp.int32(1),
+                        jax.lax.min(jnp.asarray(top_k, jnp.int32),
+                                    jnp.int32(V)))
+        srt = jnp.sort(logits, axis=-1)
+        idx = jnp.broadcast_to(jnp.asarray(V - k, jnp.int32),
+                               logits.shape[:-1] + (1,))
+        kth = jnp.take_along_axis(srt, idx, axis=-1)
+        logits = jnp.where(top_k > 0,
+                           jnp.where(logits < kth, -jnp.inf, logits),
+                           logits)
+    elif top_k > 0:
         # clamp to the vocab (HF semantics): top_k > V means "keep all",
         # not an IndexError at trace time
-        top_k = min(int(top_k), logits.shape[-1])
+        top_k = min(int(top_k), V)
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
@@ -508,11 +529,17 @@ def _commit_window(c, d_row, t_row, k):
     t[:k])), next = t[m]); this function stays as the executable spec
     the engine's commit is tested against
     (tests/test_decode_engine.py)."""
-    m_acc = 0
-    while m_acc < k and int(d_row[m_acc]) == int(t_row[m_acc]):
-        m_acc += 1
-    committed = [int(c)] + [int(x) for x in d_row[:m_acc]]
-    next_c = int(t_row[m_acc]) if m_acc < k else int(t_row[k])
+    # one host transfer per ROW, not one per token: the old while loop
+    # did int(d_row[i]) == int(t_row[i]) per position — two device
+    # round-trips per draft token (tracelint TL002). Pull both rows
+    # across once, then the commit rule is pure host arithmetic (and
+    # the cumprod mirrors the engine's on-device form exactly).
+    d = np.asarray(d_row)
+    t = np.asarray(t_row)
+    agree = (d[:k] == t[:k]).astype(np.int64)
+    m_acc = int(agree.cumprod().sum())
+    committed = [int(c)] + [int(x) for x in d[:m_acc]]
+    next_c = int(t[m_acc]) if m_acc < k else int(t[k])
     return committed, next_c
 
 
@@ -715,9 +742,12 @@ def _speculative_sampled_loop(target, draft, input_ids, max_new_tokens,
         window = jnp.concatenate([c, drafts[None, :]], axis=1)
         pt, tcaches = verify(target, tcaches, window,
                              jnp.asarray(L, jnp.int32))
-        d = np.asarray(drafts)
-        pt_h = np.asarray(pt)                         # (k+1, V)
-        pd_h = np.asarray(pd)                         # (k, V)
+        # ONE batched host read per window (the speculative serving
+        # contract): the drafts and both models' distributions cross
+        # the fence together — was three separate np.asarray syncs per
+        # window before tracelint.
+        # tracelint: disable=TL002 - one sync per window by design
+        d, pt_h, pd_h = jax.device_get((drafts, pt, pd))  # (k,),(k+1,V),(k,V)
         def draw(p):
             # float64 renormalize: f32 quotients can miss Generator.
             # choice's sum-to-1 tolerance at large vocabs

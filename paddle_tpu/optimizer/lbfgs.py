@@ -167,6 +167,8 @@ class LBFGS:
                 and getattr(self, '_fg_key', None) == cache_key):
             f_and_g = self._fg
         else:
+            # tracelint: disable=TL001 - cached on self under _fg_key
+            # (the `is` check above): one trace per (closure, shape)
             @jax.jit
             def f_and_g(vec):
                 m = unflatten(vec)
@@ -234,6 +236,10 @@ class LBFGS:
             if self.line_search_fn == 'strong_wolfe':
                 def fdir(tt):
                     fv, gv = f_and_g(jnp.asarray(x + tt * d, jnp.float32))
+                    # strong-Wolfe brackets on host floats: the line
+                    # search is host-driven by definition, one sync per
+                    # objective evaluation is the algorithm.
+                    # tracelint: disable=TL002 - host-driven line search
                     return float(fv), float(np.asarray(gv, np.float64) @ d)
 
                 d_norm = np.abs(d).max()
@@ -247,6 +253,7 @@ class LBFGS:
             else:
                 x = x + t * d
                 lv, gv = f_and_g(jnp.asarray(x, jnp.float32))
+                # tracelint: disable=TL002 - host-driven optimizer step
                 loss, flat_grad = float(lv), np.asarray(gv, np.float64)
                 current_evals += 1
 

@@ -280,6 +280,7 @@ def op_summary(fn, *args, print_table=True, top=20, **kwargs):
 
     import jax as _jax
 
+    # tracelint: disable=TL001 - one-shot profiling compile, not served
     compiled = _jax.jit(fn).lower(*args, **kwargs).compile()
     hist = collections.Counter()
     for mod in compiled.as_text().splitlines():

@@ -246,6 +246,7 @@ class CompiledProgram:
         self.build_strategy = build_strategy or BuildStrategy()
         if program._fn is not None:
             self._program = program.clone()
+            # tracelint: disable=TL001 - cached on the cloned program
             self._program._fn = jax.jit(program._fn)
 
     def __getattr__(self, name):
@@ -352,6 +353,7 @@ def serialize_program(feed_vars=None, fetch_vars=None, program=None):
     if prog._fn is None or not feed_vars:
         raise ValueError('need a callable program and feed specs')
     structs = [s.to_shape_struct() for s in feed_vars]
+    # tracelint: disable=TL001 - one-shot export, not a hot path
     exported = jax.export.export(jax.jit(prog._fn))(*structs)
     return exported.serialize()
 

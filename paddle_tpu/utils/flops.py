@@ -20,6 +20,7 @@ def flops(net, input_size=None, inputs=None, custom_ops=None, print_detail=False
     elif not isinstance(inputs, (list, tuple)):
         inputs = (inputs,)
 
+    # tracelint: disable=TL001 - one-shot FLOPs analysis, never executed
     lowered = jax.jit(lambda m, *xs: m(*xs)).lower(net, *inputs)
     try:
         cost = lowered.compile().cost_analysis()

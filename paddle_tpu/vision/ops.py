@@ -692,6 +692,8 @@ def _matrix_nms_decay_fn(score_threshold, top, use_gaussian, gaussian_sigma,
         factor = jnp.minimum(jnp.min(decay, axis=0), 1.0)
         return ss * factor, order, valid
 
+    # tracelint: disable=TL001 - the factory itself is lru_cache'd on
+    # the static config, so each config jits (and traces) exactly once
     return jax.jit(jax.vmap(decay_scores, in_axes=(None, 0)))
 
 

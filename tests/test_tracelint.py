@@ -1,0 +1,510 @@
+"""tracelint (paddle_tpu.analysis) tier-1 tests.
+
+Every rule TL001–TL006 gets at least one positive (fixture snippet
+that must trigger it) and one negative (near-identical snippet that
+must not); plus suppression-comment handling, the baseline round-trip,
+the CLI exit-code contract, and the meta-test: paddle_tpu/ itself has
+ZERO non-baselined violations — the analyzer runs clean over the very
+codebase whose serving contract it enforces.
+
+Also here: regression tests for the two behaviours this PR changed
+under tracelint's pressure — `filter_logits` accepting a traced top_k
+without a host sync, and `_commit_window` committing with one host
+transfer per row instead of one per token.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import (all_rules, filter_new, lint_paths,
+                                 lint_source, load_baseline, write_baseline)
+
+pytestmark = pytest.mark.tier1
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src):
+    return {v.rule for v in lint_source(src)}
+
+
+# ---------------------------------------------------------------------------
+# TL001 — jit in function/loop body
+# ---------------------------------------------------------------------------
+
+class TestTL001:
+    def test_positive_jit_call_in_function(self):
+        assert 'TL001' in codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    return jax.jit(g)(x)\n')
+
+    def test_positive_partial_decorator_in_function(self):
+        assert 'TL001' in codes(
+            'import jax, functools\n'
+            'def outer():\n'
+            '    @functools.partial(jax.jit, static_argnames=("k",))\n'
+            '    def inner(x, *, k):\n'
+            '        return x * k\n'
+            '    return inner\n')
+
+    def test_positive_bare_decorator_in_function(self):
+        assert 'TL001' in codes(
+            'import jax\n'
+            'def outer():\n'
+            '    @jax.jit\n'
+            '    def inner(x):\n'
+            '        return x\n'
+            '    return inner\n')
+
+    def test_negative_module_level(self):
+        assert 'TL001' not in codes(
+            'import jax, functools\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    return x\n'
+            '@functools.partial(jax.jit, donate_argnames=("c",))\n'
+            'def g(c):\n'
+            '    return c\n'
+            'h = jax.jit(f)\n')
+
+
+# ---------------------------------------------------------------------------
+# TL002 — per-iteration host sync on device data
+# ---------------------------------------------------------------------------
+
+_TL002_POS_PARAM = (
+    'def commit(c, d_row, t_row, k):\n'
+    '    m = 0\n'
+    '    while m < k and int(d_row[m]) == int(t_row[m]):\n'
+    '        m += 1\n'
+    '    return m\n')
+
+_TL002_POS_TAINT = (
+    'import jax, functools\n'
+    '@functools.partial(jax.jit, donate_argnames=("caches",))\n'
+    'def step(model, caches, tok):\n'
+    '    return tok, caches\n'
+    'def drive(model, caches, toks):\n'
+    '    out = []\n'
+    '    for t in toks:\n'
+    '        logits, caches = step(model, caches, t)\n'
+    '        out.append(int(logits))\n'
+    '    return out\n')
+
+_TL002_NEG_SINGLE_SYNC = (
+    'import jax, functools\n'
+    '@functools.partial(jax.jit, donate_argnames=("caches",))\n'
+    'def loop(model, caches, toks):\n'
+    '    return toks, caches\n'
+    'def drive(model, caches, toks):\n'
+    '    buf, caches = loop(model, caches, toks)\n'
+    '    buf = jax.device_get(buf)\n'
+    '    return [int(x) for x in buf]\n')
+
+
+class TestTL002:
+    def test_positive_param_subscript_in_loop(self):
+        assert 'TL002' in codes(_TL002_POS_PARAM)
+
+    def test_positive_jitted_result_in_loop(self):
+        assert 'TL002' in codes(_TL002_POS_TAINT)
+
+    def test_negative_one_sync_outside_loop(self):
+        # the blessed shape: ONE device_get after the compiled loop,
+        # then host-side int() over host data
+        assert 'TL002' not in codes(_TL002_NEG_SINGLE_SYNC)
+
+    def test_negative_host_metadata_subscript(self):
+        assert 'TL002' not in codes(
+            'def f(x, shape):\n'
+            '    out = []\n'
+            '    for i in range(3):\n'
+            '        out.append(int(shape[i]))\n'
+            '    return out\n')
+
+    def test_negative_cleansed_by_asarray(self):
+        # x = np.asarray(x) makes the name host data: later loop reads
+        # are free
+        assert 'TL002' not in codes(
+            'import numpy as np\n'
+            'def f(colptr, nodes):\n'
+            '    colptr = np.asarray(colptr)\n'
+            '    return [int(colptr[v]) for v in nodes]\n')
+
+
+# ---------------------------------------------------------------------------
+# TL003 — use after donation
+# ---------------------------------------------------------------------------
+
+_TL003_BASE = (
+    'import jax, functools\n'
+    '@functools.partial(jax.jit, donate_argnames=("caches",))\n'
+    'def step(model, caches, tok):\n'
+    '    return tok, caches\n')
+
+
+class TestTL003:
+    def test_positive_read_after_donation(self):
+        assert 'TL003' in codes(
+            _TL003_BASE
+            + 'def bad(model, caches, tok):\n'
+              '    out, _ = step(model, caches, tok)\n'
+              '    return out, caches\n')
+
+    def test_positive_donated_in_loop_without_rebind(self):
+        assert 'TL003' in codes(
+            _TL003_BASE
+            + 'def bad(model, caches, toks):\n'
+              '    outs = []\n'
+              '    for t in toks:\n'
+              '        o, _ = step(model, caches, t)\n'
+              '        outs.append(o)\n'
+              '    return outs\n')
+
+    def test_positive_inside_nested_closure(self):
+        # closures are this codebase's dominant helper style: the rule
+        # must analyze them as scopes of their own, not skip them
+        assert 'TL003' in codes(
+            _TL003_BASE
+            + 'def outer(model):\n'
+              '    def inner(caches, tok):\n'
+              '        out, _ = step(model, caches, tok)\n'
+              '        return out, caches\n'
+              '    return inner\n')
+
+    def test_negative_rebound_same_statement(self):
+        assert 'TL003' not in codes(
+            _TL003_BASE
+            + 'def good(model, caches, toks):\n'
+              '    for t in toks:\n'
+              '        tok, caches = step(model, caches, t)\n'
+              '    return caches\n')
+
+    def test_negative_keyword_donation_rebound(self):
+        assert 'TL003' not in codes(
+            _TL003_BASE
+            + 'def good(model, caches, tok):\n'
+              '    tok, caches = step(model, caches=caches, tok=tok)\n'
+              '    return tok, caches\n')
+
+
+# ---------------------------------------------------------------------------
+# TL004 — unhashable/mutable static args
+# ---------------------------------------------------------------------------
+
+_TL004_BASE = (
+    'import jax, functools\n'
+    '@functools.partial(jax.jit, static_argnames=("cfg", "k"))\n'
+    'def f(x, *, cfg, k):\n'
+    '    return x\n')
+
+
+class TestTL004:
+    def test_positive_list_literal_static(self):
+        assert 'TL004' in codes(
+            _TL004_BASE + 'def call(x):\n    return f(x, cfg=[1], k=2)\n')
+
+    def test_positive_dict_literal_static(self):
+        assert 'TL004' in codes(
+            _TL004_BASE
+            + 'def call(x):\n    return f(x, cfg={"a": 1}, k=2)\n')
+
+    def test_positive_mutable_default(self):
+        assert 'TL004' in codes(
+            'import jax, functools\n'
+            '@functools.partial(jax.jit, static_argnames=("cfg",))\n'
+            'def f(x, cfg=[]):\n'
+            '    return x\n')
+
+    def test_negative_tuple_static(self):
+        assert 'TL004' not in codes(
+            _TL004_BASE
+            + 'def call(x):\n    return f(x, cfg=(1, 2), k=3)\n')
+
+
+# ---------------------------------------------------------------------------
+# TL005 — untraced nondeterminism under jit
+# ---------------------------------------------------------------------------
+
+class TestTL005:
+    def test_positive_time_and_np_random(self):
+        got = lint_source(
+            'import time\nimport jax\nimport numpy as np\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    return x + time.time() + np.random.normal()\n')
+        assert sum(1 for v in got if v.rule == 'TL005') == 2
+
+    def test_positive_random_module(self):
+        assert 'TL005' in codes(
+            'import random\nimport jax\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    return x * random.random()\n')
+
+    def test_negative_jax_random_with_key(self):
+        assert 'TL005' not in codes(
+            'import jax\n'
+            '@jax.jit\n'
+            'def f(x, key):\n'
+            '    return x + jax.random.normal(key, x.shape)\n')
+
+    def test_negative_np_random_outside_jit(self):
+        assert 'TL005' not in codes(
+            'import numpy as np\n'
+            'def seed_data():\n'
+            '    return np.random.normal(size=(3,))\n')
+
+
+# ---------------------------------------------------------------------------
+# TL006 — side effects under jit
+# ---------------------------------------------------------------------------
+
+class TestTL006:
+    def test_positive_print(self):
+        assert 'TL006' in codes(
+            'import jax\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    print("tracing!", x)\n'
+            '    return x\n')
+
+    def test_positive_captured_append(self):
+        assert 'TL006' in codes(
+            'import jax\n'
+            'LOG = []\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    LOG.append(x)\n'
+            '    return x\n')
+
+    def test_negative_jax_debug_print_and_local_append(self):
+        assert 'TL006' not in codes(
+            'import jax\n'
+            '@jax.jit\n'
+            'def f(x):\n'
+            '    acc = []\n'
+            '    acc.append(x)\n'
+            '    jax.debug.print("x = {}", x)\n'
+            '    return acc[0]\n')
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    def test_same_line(self):
+        assert codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    return jax.jit(g)(x)  # tracelint: disable=TL001\n'
+        ) == set()
+
+    def test_comment_line_above(self):
+        assert codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    # tracelint: disable=TL001 - cached by the caller\n'
+            '    return jax.jit(g)(x)\n'
+        ) == set()
+
+    def test_directive_rides_through_comment_block(self):
+        assert codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    # tracelint: disable=TL001 - cached by the caller\n'
+            '    # (a longer explanation continues on this line)\n'
+            '    return jax.jit(g)(x)\n'
+        ) == set()
+
+    def test_disable_all(self):
+        assert codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    return jax.jit(g)(x)  # tracelint: disable=all\n'
+        ) == set()
+
+    def test_disable_file(self):
+        assert codes(
+            '# tracelint: disable-file=TL001\n'
+            'import jax\n'
+            'def f(g, x):\n'
+            '    return jax.jit(g)(x)\n'
+            'def h(g, x):\n'
+            '    return jax.jit(g)(x)\n'
+        ) == set()
+
+    def test_wrong_code_does_not_suppress(self):
+        assert 'TL001' in codes(
+            'import jax\n'
+            'def f(g, x):\n'
+            '    return jax.jit(g)(x)  # tracelint: disable=TL005\n')
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip + meta
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        vs = lint_source(_TL002_POS_PARAM, path='fix.py')
+        assert vs
+        bpath = tmp_path / 'baseline.json'
+        write_baseline(vs, str(bpath))
+        baseline = load_baseline(str(bpath))
+        assert filter_new(vs, baseline) == []
+        # a NEW violation (count above baseline) must surface
+        doubled = lint_source(
+            _TL002_POS_PARAM
+            + 'def commit2(c, d_row, t_row, k):\n'
+              '    m = 0\n'
+              '    while m < k and int(d_row[m]) == int(t_row[m]):\n'
+              '        m += 1\n'
+              '    return m\n',
+            path='fix.py')
+        assert len(filter_new(doubled, baseline)) == (len(doubled) - len(vs))
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / 'nope.json')) == {}
+
+    def test_baseline_file_is_committed_and_loadable(self):
+        path = os.path.join(REPO, 'tools', 'tracelint_baseline.json')
+        assert os.path.exists(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert data['version'] == 1
+        assert all(k.count('::') == 1 for k in data['counts'])
+
+    def test_meta_paddle_tpu_is_clean_modulo_baseline(self):
+        """THE acceptance property: the tree the analyzer polices has
+        zero non-baselined violations."""
+        vs = lint_paths([os.path.join(REPO, 'paddle_tpu')], root=REPO)
+        baseline = load_baseline(
+            os.path.join(REPO, 'tools', 'tracelint_baseline.json'))
+        new = filter_new(vs, baseline)
+        assert new == [], 'new tracelint violations:\n' + '\n'.join(
+            v.render() for v in new)
+
+    def test_all_six_rules_registered(self):
+        assert [r.id for r in all_rules()] == [
+            'TL001', 'TL002', 'TL003', 'TL004', 'TL005', 'TL006']
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def _run_cli(*argv, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.analysis', *argv],
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=300)
+
+
+class TestCLI:
+    def test_exit_zero_on_repo_and_nonzero_on_fixture(self, tmp_path):
+        proc = _run_cli('--root', REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        bad = tmp_path / 'bad.py'
+        bad.write_text('import jax\n'
+                       'def f(g, x):\n'
+                       '    return jax.jit(g)(x)\n')
+        proc = _run_cli('--root', REPO, str(bad))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert 'TL001' in proc.stdout
+
+    def test_json_format_and_list_rules(self, tmp_path):
+        bad = tmp_path / 'bad.py'
+        bad.write_text(_TL002_POS_PARAM)
+        proc = _run_cli('--root', REPO, '--format', 'json', str(bad))
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data['new'] >= 1
+        assert {v['rule'] for v in data['violations']} == {'TL002'}
+        proc = _run_cli('--list-rules')
+        assert proc.returncode == 0
+        for rid in ('TL001', 'TL002', 'TL003', 'TL004', 'TL005', 'TL006'):
+            assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# The behaviours tracelint forced this PR to fix
+# ---------------------------------------------------------------------------
+
+class TestFilterLogitsTracedTopK:
+    def test_traced_matches_static(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import filter_logits
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(2, 11)), jnp.float32)
+        f = jax.jit(lambda lg, k: filter_logits(lg, top_k=k))
+        for k in (1, 3, 11, 50):      # 50 > vocab: clamp means keep-all
+            got = f(logits, jnp.asarray(k, jnp.int32))
+            want = filter_logits(logits, top_k=k)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_traced_zero_keeps_all(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import filter_logits
+
+        logits = jnp.asarray([[0.5, -1.0, 2.0]], jnp.float32)
+        f = jax.jit(lambda lg, k: filter_logits(lg, top_k=k))
+        got = f(logits, jnp.asarray(0, jnp.int32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(logits))
+
+    def test_single_trace_across_k_values(self):
+        """The point of the traced path: one compilation serves every
+        k, instead of a retrace (or host sync) per distinct value."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import filter_logits
+
+        traces = []
+
+        @jax.jit
+        def f(lg, k):
+            traces.append(1)      # tracelint: disable=TL006 - the test
+            return filter_logits(lg, top_k=k)
+
+        logits = jnp.zeros((1, 8), jnp.float32)
+        for k in (1, 2, 5, 8):
+            f(logits, jnp.asarray(k, jnp.int32))
+        assert len(traces) == 1
+
+
+class TestCommitWindowSpec:
+    def test_partial_accept(self):
+        from paddle_tpu.models.generation import _commit_window
+
+        committed, next_c = _commit_window(5, [1, 2, 3], [1, 2, 9, 7], 3)
+        assert committed == [5, 1, 2]
+        assert next_c == 9
+
+    def test_full_accept_and_device_arrays(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import _commit_window
+
+        committed, next_c = _commit_window(
+            5, jnp.asarray([1, 2, 3]), jnp.asarray([1, 2, 3, 7]), 3)
+        assert committed == [5, 1, 2, 3]
+        assert next_c == 7
+
+    def test_zero_accept(self):
+        from paddle_tpu.models.generation import _commit_window
+
+        committed, next_c = _commit_window(5, [9, 2], [1, 2, 3], 2)
+        assert committed == [5]
+        assert next_c == 1
