@@ -120,14 +120,13 @@ def _stashed_tpu_line():
     return rec
 
 
-def _tracelint_gate(timeout_s=240):
-    """Static serving-contract gate: `python -m paddle_tpu.analysis`
-    (tracelint) must report zero NEW violations over paddle_tpu/ vs the
-    committed baseline — a retrace/donation/host-sync regression fails
-    the bench run even when the tunnel is down. Runs in a subprocess
-    pinned to CPU (the analyzer is pure-AST; its import of paddle_tpu
-    must never touch the flaky TPU backend). Returns (clean, detail):
-    clean is None when the gate could not run (never poses as a pass)."""
+def _analysis_gate(extra_args, timeout_s=240):
+    """Shared static-gate runner: `python -m paddle_tpu.analysis
+    [extra_args]` in a subprocess pinned to CPU (the analyzers must
+    never wake the flaky TPU backend — tracelint is pure-AST,
+    mosaiclint traces abstractly). Returns (clean, detail, payload):
+    clean is None when the gate could not run (never poses as a pass);
+    payload is the parsed JSON output, {} when unparseable."""
     import os
     import subprocess
     import sys
@@ -136,21 +135,46 @@ def _tracelint_gate(timeout_s=240):
     root = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
-            [sys.executable, '-m', 'paddle_tpu.analysis', '--root', root,
-             '--format', 'json'],
+            [sys.executable, '-m', 'paddle_tpu.analysis', *extra_args,
+             '--root', root, '--format', 'json'],
             capture_output=True, text=True, timeout=timeout_s, env=env,
             cwd=root)
     except (subprocess.TimeoutExpired, OSError) as e:
-        return None, f'gate did not run: {type(e).__name__}'
-    if proc.returncode == 0:
-        return True, '0 new violations'
+        return None, f'gate did not run: {type(e).__name__}', {}
     try:
-        n = json.loads(proc.stdout).get('new', '?')
+        payload = json.loads(proc.stdout)
     except ValueError:
-        n = '?'
+        payload = {}
+    if proc.returncode == 0:
+        return True, '0 new violations', payload
     if proc.returncode == 1:
-        return False, f'{n} new violation(s)'
-    return None, f'gate errored (rc={proc.returncode}): {proc.stderr[:200]}'
+        return False, f'{payload.get("new", "?")} new violation(s)', payload
+    return (None,
+            f'gate errored (rc={proc.returncode}): {proc.stderr[:200]}',
+            payload)
+
+
+def _tracelint_gate(timeout_s=240):
+    """Static serving-contract gate: tracelint must report zero NEW
+    violations over paddle_tpu/ vs the committed baseline — a retrace/
+    donation/host-sync regression fails the bench run even when the
+    tunnel is down. Returns (clean, detail)."""
+    clean, detail, _ = _analysis_gate([], timeout_s=timeout_s)
+    return clean, detail
+
+
+def _mosaiclint_gate(timeout_s=240):
+    """Static Mosaic-legality gate: mosaiclint must report zero NEW
+    error-severity violations over the pallas kernel registry vs the
+    committed baseline — a kernel that would refuse to lower on the
+    chip fails the bench run while the tunnel is still down. Returns
+    (clean, detail, vmem): vmem is the per-kernel VMEM-estimate map
+    stamped into the bench detail blob, or None."""
+    clean, detail, payload = _analysis_gate(['--mosaic'],
+                                            timeout_s=timeout_s)
+    if clean:
+        detail += f' ({payload.get("suppressed", 0)} suppressed)'
+    return clean, detail, payload.get('vmem')
 
 
 def _acquire_bench_lock(max_wait_s=900):
@@ -185,19 +209,27 @@ def main():
     # once when up.
     cancel_watchdog = _arm_watchdog(2100)
     watchdog_t0 = time.perf_counter()
-    # static gate FIRST (cheap, CPU-only): a serving-contract violation
-    # is a failed round no matter what the chip measures
+    # static gates FIRST (cheap, CPU-only): a serving-contract or
+    # Mosaic-legality violation is a failed round no matter what the
+    # chip measures
     tracelint_clean, tracelint_detail = _tracelint_gate()
     print(f'# tracelint gate: {tracelint_detail}', flush=True)
+    mosaiclint_clean, mosaiclint_detail, mosaiclint_vmem = _mosaiclint_gate()
+    print(f'# mosaiclint gate: {mosaiclint_detail}', flush=True)
+    static_gate_failed = (tracelint_clean is False
+                          or mosaiclint_clean is False)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
-            stashed.setdefault('detail', {})['gate_tracelint_clean'] = (
-                tracelint_clean)
-            stashed['detail']['tracelint'] = tracelint_detail
+            det = stashed.setdefault('detail', {})
+            det['gate_tracelint_clean'] = tracelint_clean
+            det['tracelint'] = tracelint_detail
+            det['gate_mosaiclint_clean'] = mosaiclint_clean
+            det['mosaiclint'] = mosaiclint_detail
+            det['mosaiclint_vmem'] = mosaiclint_vmem
             print(json.dumps(stashed), flush=True)
             cancel_watchdog()
-            if tracelint_clean is False:
+            if static_gate_failed:
                 import sys
 
                 sys.exit(1)
@@ -542,6 +574,14 @@ def main():
             # is a regression even when the measured numbers look fine
             'gate_tracelint_clean': tracelint_clean,
             'tracelint': tracelint_detail,
+            # static Mosaic-legality gate (mosaiclint): False also fails
+            # the run — interpret-mode-green kernels that would refuse
+            # to lower on the chip are a regression the CPU can prove
+            'gate_mosaiclint_clean': mosaiclint_clean,
+            'mosaiclint': mosaiclint_detail,
+            # per-kernel VMEM working-set estimates (bytes): footprint
+            # regressions show in the bench history before they OOM
+            'mosaiclint_vmem': mosaiclint_vmem,
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,
@@ -552,9 +592,9 @@ def main():
         },
     }), flush=True)
     cancel_watchdog()   # success line is out; don't let the timer clobber it
-    if tracelint_clean is False:
+    if static_gate_failed:
         # the artifact line above still carries the measurements; the
-        # exit code marks the round failed on the static gate
+        # exit code marks the round failed on the static gates
         import sys
 
         sys.exit(1)

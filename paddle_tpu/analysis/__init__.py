@@ -16,6 +16,14 @@ so linting never touches a backend; the CLI does pay the parent
 `paddle_tpu` package import on startup — run it with
 `JAX_PLATFORMS=cpu` where that matters (bench.py's gate does).  See
 docs/tracelint.md for the rule catalogue and workflow.
+
+The SECOND analyzer family lives in `analysis.mosaic` (mosaiclint,
+docs/mosaiclint.md): ML001–ML006 prove Mosaic/TPU lowering legality at
+the jaxpr/BlockSpec level over the registered pallas kernels.  It is
+NOT imported here — mosaiclint needs jax (it traces kernels), and
+plain tracelint must stay importable without it.  Reach it via
+`paddle_tpu.analysis.mosaic`, `python -m paddle_tpu.analysis
+--mosaic`, or the `mosaiclint` console script.
 """
 from .engine import (
     Violation,
@@ -30,7 +38,8 @@ from .engine import (
     format_text,
     format_json,
 )
-from .config import TracelintConfig, load_config
+from .config import (MosaiclintConfig, TracelintConfig, load_config,
+                     load_mosaic_config)
 from .rules import all_rules, get_rule
 
 __all__ = [
@@ -38,6 +47,7 @@ __all__ = [
     'lint_source', 'lint_file', 'lint_paths',
     'load_baseline', 'write_baseline', 'filter_new',
     'format_text', 'format_json',
-    'TracelintConfig', 'load_config',
+    'TracelintConfig', 'MosaiclintConfig', 'load_config',
+    'load_mosaic_config',
     'all_rules', 'get_rule',
 ]

@@ -1,13 +1,20 @@
-"""tracelint CLI.
+"""tracelint / mosaiclint CLI.
 
-    python -m paddle_tpu.analysis [paths...]        # lint vs baseline
+    python -m paddle_tpu.analysis [paths...]        # tracelint (AST)
+    python -m paddle_tpu.analysis --mosaic [paths]  # mosaiclint (jaxpr)
     tracelint paddle_tpu/                           # console script
+    mosaiclint                                      # console script
     tracelint --write-baseline                      # accept current debt
-    tracelint --list-rules
+    mosaiclint --list-rules
 
-Exit codes: 0 clean (modulo baseline), 1 new violations, 2 usage/IO
-error.  Config comes from `[tool.tracelint]` in pyproject.toml at
-`--root` (default: cwd); CLI flags win over config.
+Exit codes: 0 clean (modulo baseline/suppressions), 1 new
+ERROR-severity violations (warnings print but never gate — they exist
+to be confirmed on chip, not to block it), 2 usage/IO error.  Config
+comes from `[tool.tracelint]` /
+`[tool.mosaiclint]` in pyproject.toml at `--root` (default: cwd); CLI
+flags win over config.  mosaiclint traces the kernel registry with
+jax, so pin `JAX_PLATFORMS=cpu` where touching an accelerator backend
+is unwanted (bench.py's gates do).
 """
 from __future__ import annotations
 
@@ -15,7 +22,7 @@ import argparse
 import os
 import sys
 
-from .config import load_config
+from .config import load_config, load_mosaic_config
 from .engine import (filter_new, format_json, format_text, lint_paths,
                      load_baseline, write_baseline)
 from .rules import all_rules
@@ -24,11 +31,17 @@ from .rules import all_rules
 def _build_parser():
     p = argparse.ArgumentParser(
         prog='tracelint',
-        description='AST-based TPU tracer-safety analyzer: enforces the '
-                    'jit/donation/host-sync serving contract.')
+        description='Static TPU analyzers: tracelint enforces the '
+                    'jit/donation/host-sync serving contract over the '
+                    'AST; --mosaic (mosaiclint) enforces Mosaic/TPU '
+                    'lowering legality over traced pallas kernels.')
     p.add_argument('paths', nargs='*',
                    help='files/directories to lint (default: from '
-                        '[tool.tracelint] paths, else paddle_tpu)')
+                        'config; with --mosaic, filters registry '
+                        'entries by kernel source file)')
+    p.add_argument('--mosaic', action='store_true',
+                   help='run mosaiclint (ML rules over the pallas '
+                        'kernel registry) instead of tracelint')
     p.add_argument('--root', default=None,
                    help='project root holding pyproject.toml and the '
                         'baseline (default: cwd)')
@@ -46,15 +59,36 @@ def _build_parser():
     return p
 
 
-def main(argv=None):
-    args = _build_parser().parse_args(argv)
-    if args.list_rules:
-        for rule in all_rules():
-            print(f'{rule.id} [{rule.severity}] {rule.name}: '
-                  f'{rule.description}')
+def _finish(args, violations, baseline_path, baselined_filter=True,
+            suppressed=0, extra=None):
+    """Shared baseline-filter + output + exit-code tail of both modes."""
+    if args.write_baseline:
+        counts = write_baseline(violations, baseline_path)
+        print(f'{"mosaiclint" if args.mosaic else "tracelint"}: wrote '
+              f'baseline with {len(violations)} violation(s) across '
+              f'{len(counts)} (file, rule) key(s) to {baseline_path}')
         return 0
 
-    root = os.path.abspath(args.root or os.getcwd())
+    baselined = 0
+    if baselined_filter and not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+        new = filter_new(violations, baseline)
+        baselined = len(violations) - len(new)
+        violations = new
+
+    if args.format == 'json':
+        print(format_json(violations, baselined=baselined,
+                          suppressed=suppressed, extra=extra))
+    else:
+        print(format_text(violations, baselined=baselined,
+                          suppressed=suppressed))
+    # warnings (ML003 lane-reshape, ML006 near-budget) are advisory by
+    # design: they surface in the output and the baseline but must not
+    # fail CI — only error-severity violations gate
+    return 1 if any(v.severity == 'error' for v in violations) else 0
+
+
+def _main_tracelint(args, root):
     cfg = load_config(root)
     select = ([s.strip() for s in args.select.split(',') if s.strip()]
               if args.select else cfg.select)
@@ -74,30 +108,80 @@ def main(argv=None):
 
     violations = lint_paths(paths, rules=rules, root=root,
                             exclude=cfg.exclude)
-
     baseline_path = args.baseline or cfg.baseline
     if not os.path.isabs(baseline_path):
         baseline_path = os.path.join(root, baseline_path)
+    return _finish(args, violations, baseline_path)
 
-    if args.write_baseline:
-        counts = write_baseline(violations, baseline_path)
-        print(f'tracelint: wrote baseline with {len(violations)} '
-              f'violation(s) across {len(counts)} (file, rule) key(s) '
-              f'to {baseline_path}')
+
+def _main_mosaic(args, root):
+    # imported here: mosaiclint needs jax, plain tracelint must not
+    from .mosaic import lint_and_report
+    from .mosaic.registry import entries_for
+    from .mosaic.rules import all_rules as all_ml_rules
+
+    cfg = load_mosaic_config(root)
+    select = ([s.strip() for s in args.select.split(',') if s.strip()]
+              if args.select else cfg.select)
+    try:
+        rules = all_ml_rules(select or None)
+    except KeyError as e:
+        print(f'mosaiclint: {e.args[0]}', file=sys.stderr)
+        return 2
+
+    paths = args.paths or cfg.paths
+    try:
+        entries = entries_for(paths or None, root=root)
+    except Exception as e:  # noqa: BLE001 - registry import failure
+        print(f'mosaiclint: registry failed to load: '
+              f'{type(e).__name__}: {e}', file=sys.stderr)
+        return 2
+    if paths and not entries:
+        print(f'mosaiclint: no registered kernels under {paths}',
+              file=sys.stderr)
+        return 2
+
+    try:
+        # one trace per suite covers both the rules and the vmem map
+        violations, suppressed, vmem = lint_and_report(
+            entries, rules=rules, root=root)
+    except ValueError as e:
+        # a registry misconfiguration (reasonless suppression) is a
+        # usage error, not a kernel violation — rc 2, never rc 1
+        print(f'mosaiclint: {e}', file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or cfg.baseline
+    if not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(root, baseline_path)
+    extra = {'vmem': vmem} if args.format == 'json' else None
+    return _finish(args, violations, baseline_path,
+                   suppressed=len(suppressed), extra=extra)
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        if args.mosaic:
+            from .mosaic.rules import all_rules as all_ml_rules
+
+            rules = all_ml_rules()
+        else:
+            rules = all_rules()
+        for rule in rules:
+            print(f'{rule.id} [{rule.severity}] {rule.name}: '
+                  f'{rule.description}')
         return 0
 
-    baselined = 0
-    if not args.no_baseline:
-        baseline = load_baseline(baseline_path)
-        new = filter_new(violations, baseline)
-        baselined = len(violations) - len(new)
-        violations = new
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.mosaic:
+        return _main_mosaic(args, root)
+    return _main_tracelint(args, root)
 
-    if args.format == 'json':
-        print(format_json(violations, baselined=baselined))
-    else:
-        print(format_text(violations, baselined=baselined))
-    return 1 if violations else 0
+
+def mosaic_main(argv=None):
+    """Entry point for the `mosaiclint` console script."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(['--mosaic'] + argv)
 
 
 if __name__ == '__main__':

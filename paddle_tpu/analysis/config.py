@@ -1,9 +1,9 @@
-"""`[tool.tracelint]` config from pyproject.toml.
+"""`[tool.tracelint]` / `[tool.mosaiclint]` config from pyproject.toml.
 
 Python 3.10 has no stdlib tomllib and the repo pins no TOML package, so
-this reads the one table tracelint needs with a deliberately tiny
+this reads the two tables the analyzers need with a deliberately tiny
 parser: `key = "string"` and `key = ["a", "b", ...]` entries (lists may
-span lines) inside the `[tool.tracelint]` section. That subset is the
+span lines) inside one `[tool.<name>]` section. That subset is the
 whole config surface; anything fancier belongs in CLI flags.
 """
 from __future__ import annotations
@@ -21,18 +21,27 @@ class TracelintConfig:
     select: list = dataclasses.field(default_factory=list)  # empty = all
 
 
-_SECTION_RE = re.compile(r'^\s*\[tool\.tracelint\]\s*$')
+@dataclasses.dataclass
+class MosaiclintConfig:
+    # paths filter REGISTRY entries by anchor file (not a filesystem
+    # walk): the registry, not the tree, defines what mosaiclint sees
+    paths: list = dataclasses.field(default_factory=list)
+    baseline: str = 'tools/mosaiclint_baseline.json'
+    select: list = dataclasses.field(default_factory=list)  # empty = all
+
+
 _ANY_SECTION_RE = re.compile(r'^\s*\[')
 _STRING_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*"([^"]*)"\s*$')
 _LIST_OPEN_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*\[')
 
 
-def _section_text(source):
+def _section_text(source, section):
+    section_re = re.compile(r'^\s*\[tool\.%s\]\s*$' % re.escape(section))
     lines = source.splitlines()
     collecting = False
     out = []
     for line in lines:
-        if _SECTION_RE.match(line):
+        if section_re.match(line):
             collecting = True
             continue
         if collecting and _ANY_SECTION_RE.match(line):
@@ -42,10 +51,10 @@ def _section_text(source):
     return out
 
 
-def parse_tracelint_table(source):
-    """dict from the [tool.tracelint] section of a pyproject source."""
+def parse_tool_table(source, section):
+    """dict from the [tool.<section>] section of a pyproject source."""
     out = {}
-    lines = _section_text(source)
+    lines = _section_text(source, section)
     i = 0
     while i < len(lines):
         line = lines[i]
@@ -65,22 +74,44 @@ def parse_tracelint_table(source):
     return out
 
 
-def load_config(root=None):
-    """Config from <root>/pyproject.toml (root defaults to cwd);
-    defaults when the file or table is absent."""
+def parse_tracelint_table(source):
+    """Back-compat alias: the [tool.tracelint] table."""
+    return parse_tool_table(source, 'tracelint')
+
+
+def _load_table(root, section):
     root = root or os.getcwd()
-    cfg = TracelintConfig()
     pyproject = os.path.join(root, 'pyproject.toml')
     if not os.path.exists(pyproject):
-        return cfg
+        return {}
     with open(pyproject, encoding='utf-8') as f:
-        table = parse_tracelint_table(f.read())
+        return parse_tool_table(f.read(), section)
+
+
+def load_config(root=None):
+    """Tracelint config from <root>/pyproject.toml (root defaults to
+    cwd); defaults when the file or table is absent."""
+    cfg = TracelintConfig()
+    table = _load_table(root, 'tracelint')
     if 'paths' in table:
         cfg.paths = list(table['paths'])
     if 'baseline' in table:
         cfg.baseline = table['baseline']
     if 'exclude' in table:
         cfg.exclude = list(table['exclude'])
+    if 'select' in table:
+        cfg.select = list(table['select'])
+    return cfg
+
+
+def load_mosaic_config(root=None):
+    """Mosaiclint config from the [tool.mosaiclint] table."""
+    cfg = MosaiclintConfig()
+    table = _load_table(root, 'mosaiclint')
+    if 'paths' in table:
+        cfg.paths = list(table['paths'])
+    if 'baseline' in table:
+        cfg.baseline = table['baseline']
     if 'select' in table:
         cfg.select = list(table['select'])
     return cfg

@@ -293,20 +293,26 @@ def filter_new(violations, baseline):
 # Output
 # ---------------------------------------------------------------------------
 
-def format_text(violations, baselined=0):
+def format_text(violations, baselined=0, suppressed=0):
     out = [v.render() for v in violations]
     errors = sum(1 for v in violations if v.severity == 'error')
     warnings = len(violations) - errors
     tail = f'{errors} error(s), {warnings} warning(s)'
     if baselined:
         tail += f' ({baselined} baselined violation(s) not shown)'
+    if suppressed:
+        tail += f' ({suppressed} suppressed with reason)'
     out.append(tail)
     return '\n'.join(out)
 
 
-def format_json(violations, baselined=0):
-    return json.dumps({
+def format_json(violations, baselined=0, suppressed=0, extra=None):
+    payload = {
         'violations': [v.to_dict() for v in violations],
         'new': len(violations),
         'baselined': baselined,
-    }, indent=2)
+        'suppressed': suppressed,
+    }
+    if extra:
+        payload.update(extra)
+    return json.dumps(payload, indent=2)

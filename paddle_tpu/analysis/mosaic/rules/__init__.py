@@ -1,0 +1,41 @@
+"""mosaiclint rule registry (same pattern as tracelint's).
+
+Rules self-register via `@register`; importing this package pulls in
+every `ml*.py` module.  `all_rules()` returns fresh instances sorted
+by id, `get_rule('ML001')` one of them.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: adds a MosaicRule subclass to the registry."""
+    if cls.id in _REGISTRY:
+        raise ValueError(f'duplicate rule id {cls.id}')
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules(select=None):
+    """Instances of every registered rule (or the `select` subset),
+    sorted by id."""
+    ids = sorted(_REGISTRY)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise KeyError(f'unknown rule id(s): {sorted(unknown)}')
+        ids = sorted(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]()
+
+
+from . import ml001_tile_alignment      # noqa: E402,F401
+from . import ml002_grid_divisibility   # noqa: E402,F401
+from . import ml003_illegal_dtypes      # noqa: E402,F401
+from . import ml004_unaligned_dynamic_slice  # noqa: E402,F401
+from . import ml005_unsupported_primitives   # noqa: E402,F401
+from . import ml006_vmem_budget         # noqa: E402,F401

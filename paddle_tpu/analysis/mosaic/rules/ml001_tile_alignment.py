@@ -1,0 +1,65 @@
+"""ML001 — BlockSpec tile alignment.
+
+Mosaic stores arrays in (sublane x 128-lane) tiles: the minor (last)
+block dim must be a multiple of 128 and the second-minor a multiple of
+the dtype's sublane count (8 for 4-byte, 16 for 2-byte, 32 for 1-byte
+dtypes).  Two escapes are legal and used by every shipped kernel:
+
+  - a block dim equal to the full array dim (the array's own padded
+    tile is reused, whatever its size — how `rms_norm`'s (N,) weight
+    and the (R, 1) residual blocks lower),
+  - a second-minor block of exactly 1 (a single sublane row; the
+    (1, block_q) segment-id blocks jax's reference flash kernel uses).
+
+Leading (major) dims are untiled and may block at any size.
+"""
+from __future__ import annotations
+
+from ..engine import MosaicRule, sublane_multiple
+from . import register
+
+
+def _dims(block_shape, array_shape):
+    """Trailing-two (sublane, lane) pairs of (block, array); None block
+    dims (unblocked) count as the full array dim."""
+    bs = [a if b is None else b for b, a in zip(block_shape, array_shape)]
+    return bs, list(array_shape)
+
+
+@register
+class TileAlignment(MosaicRule):
+    id = 'ML001'
+    name = 'tile-alignment'
+    severity = 'error'
+    description = ('block trailing dims must tile the (sublane x 128) '
+                   'layout: last dim x128 or full, second-minor a '
+                   'dtype-sublane multiple (8/f32, 16/bf16, 32/int8+fp8), '
+                   '1, or full.')
+
+    def check(self, ctx):
+        for call in ctx.calls:
+            for b in call.blocks:
+                bs, arr = _dims(b.block_shape, b.array_shape)
+                if not bs:
+                    continue
+                lane, alane = bs[-1], arr[-1]
+                if lane != alane and lane % 128 != 0:
+                    yield self.violation(
+                        ctx,
+                        f'{call.name}: {b.kind} block {tuple(bs)} of '
+                        f'{b.origin or "operand"} {tuple(arr)} '
+                        f'({b.dtype}): minor block dim {lane} is neither '
+                        f'a multiple of 128 nor the full array dim '
+                        f'{alane}')
+                if len(bs) < 2:
+                    continue
+                sub, asub = bs[-2], arr[-2]
+                need = sublane_multiple(b.dtype)
+                if sub != asub and sub != 1 and sub % need != 0:
+                    yield self.violation(
+                        ctx,
+                        f'{call.name}: {b.kind} block {tuple(bs)} of '
+                        f'{b.origin or "operand"} {tuple(arr)} '
+                        f'({b.dtype}): second-minor block dim {sub} is '
+                        f'not a multiple of the {b.dtype} sublane count '
+                        f'{need} (nor 1, nor the full dim {asub})')

@@ -1,0 +1,68 @@
+"""ML002 — partial tail blocks without masking evidence.
+
+When a block dim does not divide its array dim, the grid's last step
+along that axis DMAs a block that extends past the array: the tail
+rows/columns are UNSPECIFIED memory.  That is legal to *load* under
+Mosaic — but any kernel that folds the block into a reduction or
+matmul without masking lets garbage (including inf/nan bit patterns)
+leak into live outputs.  Every shipped kernel that tolerates tails
+masks with the same mechanism: a `broadcasted_iota` of global positions
+compared against the true extent, selecting garbage away
+(`jnp.where`).
+
+The static check: a tail exists (array % block != 0 on some input
+operand dim) and the kernel body contains no iota + select pair.  The
+iota+select pattern is evidence, not proof — a kernel could iota/select
+something unrelated — but it exactly matches the masking idiom this
+codebase (and the reference jax kernels) use, and the failure mode of
+the heuristic is a missed report, never a false block of a clean
+kernel that genuinely masks.
+
+Only INPUT blocks are checked: output tail blocks write the padded
+region, which pallas discards on the copy back to HBM.  Kernels whose
+tail garbage provably never reaches a live output (e.g. row-blocked
+maps with no cross-row reduction) suppress in the registry with that
+reason.
+"""
+from __future__ import annotations
+
+from ..engine import MosaicRule, iter_eqns
+from . import register
+
+_MASK_BUILDERS = {'iota'}
+_MASK_APPLIERS = {'select_n', 'select', 'and', 'or'}
+
+
+def _mask_evidence(call):
+    prims = {e.primitive.name for e in iter_eqns(call.body)}
+    return bool(prims & _MASK_BUILDERS) and bool(prims & _MASK_APPLIERS)
+
+
+@register
+class GridDivisibility(MosaicRule):
+    id = 'ML002'
+    name = 'grid-divisibility'
+    severity = 'error'
+    description = ('an input block that does not divide its operand '
+                   'reads unspecified tail memory; require divisibility '
+                   'or iota+select masking in the kernel body.')
+
+    def check(self, ctx):
+        for call in ctx.calls:
+            masked = None                # computed lazily, once per call
+            for b in call.input_blocks():
+                for d, (blk, arr) in enumerate(
+                        zip(b.block_shape, b.array_shape)):
+                    if blk is None or blk <= 0 or arr % blk == 0:
+                        continue
+                    if masked is None:
+                        masked = _mask_evidence(call)
+                    if masked:
+                        continue
+                    yield self.violation(
+                        ctx,
+                        f'{call.name}: input block {b.block_shape} of '
+                        f'{b.origin or "operand"} {b.array_shape} does '
+                        f'not divide dim {d} ({arr} % {blk} != 0) and '
+                        f'the kernel body shows no iota+select masking '
+                        f'— the tail block reads unspecified memory')

@@ -1,0 +1,127 @@
+"""ML003 — dtypes and dtype-shaped ops with no Mosaic lowering.
+
+Three classes, all learned the hard way on real chips:
+
+  - 64-bit and complex dtypes: the VPU/MXU datapaths stop at 32 bits;
+    an f64 operand anywhere in a pallas_call fails to lower,
+  - i1 (bool) reshapes: mask vectors have a packed lane layout Mosaic
+    cannot re-tile — build masks with `broadcasted_iota` directly in
+    their final 2-D shape instead (the pattern every shipped kernel
+    documents),
+  - sub-byte integer COMPUTE: int4 values must be unpacked (sign-
+    extended to >= int8) before any arithmetic; a dot/mul on a raw
+    int4-typed array has no lowering.
+
+Plus one warning: a reshape that changes the minor (lane) dim inside a
+kernel body.  Collapsing major dims into the sublane (the decode
+kernels' `(bs, hkv, D) -> (bs*hkv, D)`) is supported; re-tiling the
+lane dim often is not — flagged for the first on-chip check rather
+than blocked outright.
+"""
+from __future__ import annotations
+
+from ..engine import MosaicRule, iter_eqns
+from . import register
+
+_COMPUTE_PRIMS = {'dot_general', 'mul', 'add', 'sub', 'div', 'max', 'min',
+                  'reduce_sum', 'reduce_max', 'reduce_min', 'exp', 'log'}
+
+
+def _is_wide(dtype):
+    name = str(dtype)
+    return name in ('float64', 'int64', 'uint64', 'complex64', 'complex128')
+
+
+def _is_sub_byte_int(dtype):
+    return str(dtype) in ('int4', 'uint4', 'int2', 'uint2')
+
+
+def _avals(eqn):
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, 'aval', None)
+        if aval is not None and hasattr(aval, 'dtype'):
+            yield aval
+
+
+@register
+class IllegalDtypes(MosaicRule):
+    id = 'ML003'
+    name = 'illegal-dtypes'
+    severity = 'error'
+    description = ('no Mosaic lowering: 64-bit/complex dtypes anywhere '
+                   'in a pallas_call, bool (i1) reshapes, int4 compute '
+                   'without unpack; lane-changing reshapes warn.')
+
+    def check(self, ctx):
+        for call in ctx.calls:
+            seen = set()                 # dedupe per (call, detail)
+            for b in call.blocks:
+                if _is_wide(b.dtype):
+                    key = ('wide-op', str(b.dtype))
+                    if key not in seen:
+                        seen.add(key)
+                        yield self.violation(
+                            ctx,
+                            f'{call.name}: operand '
+                            f'{b.origin or "?"} has dtype {b.dtype} — '
+                            f'64-bit/complex values cannot lower under '
+                            f'Mosaic (compute in f32, cast outside the '
+                            f'kernel)')
+            for eqn in iter_eqns(call.body):
+                prim = eqn.primitive.name
+                for aval in _avals(eqn):
+                    if _is_wide(aval.dtype):
+                        key = ('wide-body', str(aval.dtype))
+                        if key not in seen:
+                            seen.add(key)
+                            yield self.violation(
+                                ctx,
+                                f'{call.name}: kernel body computes in '
+                                f'{aval.dtype} (at `{prim}`) — '
+                                f'64-bit/complex values cannot lower '
+                                f'under Mosaic')
+                if prim == 'reshape':
+                    in_aval = eqn.invars[0].aval
+                    if str(in_aval.dtype) == 'bool':
+                        key = ('i1-reshape', in_aval.shape)
+                        if key not in seen:
+                            seen.add(key)
+                            yield self.violation(
+                                ctx,
+                                f'{call.name}: reshape of a bool (i1) '
+                                f'mask {tuple(in_aval.shape)} — Mosaic '
+                                f'cannot re-tile packed i1 vectors; '
+                                f'build the mask with broadcasted_iota '
+                                f'in its final shape')
+                    else:
+                        out_shape = tuple(eqn.params.get(
+                            'new_sizes', getattr(eqn.outvars[0].aval,
+                                                 'shape', ())))
+                        in_shape = tuple(in_aval.shape)
+                        in_lane = in_shape[-1] if in_shape else 1
+                        out_lane = out_shape[-1] if out_shape else 1
+                        if in_lane != out_lane:
+                            key = ('lane-reshape', in_shape, out_shape)
+                            if key not in seen:
+                                seen.add(key)
+                                yield self.violation(
+                                    ctx,
+                                    f'{call.name}: reshape '
+                                    f'{in_shape} -> {out_shape} changes '
+                                    f'the minor (lane) dim — lane '
+                                    f're-tiling frequently has no Mosaic '
+                                    f'lowering; prefer collapsing major '
+                                    f'dims only',
+                                    severity='warning')
+                if prim in _COMPUTE_PRIMS:
+                    for aval in _avals(eqn):
+                        if _is_sub_byte_int(aval.dtype):
+                            key = ('int4-compute', prim)
+                            if key not in seen:
+                                seen.add(key)
+                                yield self.violation(
+                                    ctx,
+                                    f'{call.name}: `{prim}` on a '
+                                    f'{aval.dtype} value — sub-byte ints '
+                                    f'must be unpacked (sign-extended to '
+                                    f'int8 or wider) before compute')

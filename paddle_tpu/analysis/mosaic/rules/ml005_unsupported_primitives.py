@@ -1,0 +1,67 @@
+"""ML005 — primitives with no Mosaic TPU lowering in a kernel body.
+
+Mosaic lowers a deliberately small set of jax primitives: elementwise
+VPU math, `dot_general` on the MXU, reductions, iota/select/broadcast,
+ref get/swap, and the pallas control primitives.  A kernel that traces
+`sort`, a general `gather` (jnp fancy indexing), `scatter`, convs,
+FFTs, linear algebra, or the threefry PRNG interprets fine on CPU and
+then refuses to compile on the chip — the exact interpret-green /
+Mosaic-red gap this analyzer exists to close.
+
+The denylist is conservative (primitives *known* absent from the
+Mosaic lowering rules); unknown primitives pass silently rather than
+crying wolf on every jax release.  In-kernel randomness goes through
+`pltpu.prng_seed`/`prng_random_bits`, never `jax.random` (threefry).
+"""
+from __future__ import annotations
+
+from ..engine import MosaicRule, iter_eqns
+from . import register
+
+UNSUPPORTED = {
+    'sort', 'top_k', 'approx_top_k',
+    'gather', 'scatter', 'scatter-add', 'scatter_add', 'scatter_mul',
+    'scatter_min', 'scatter_max',
+    'conv_general_dilated', 'fft',
+    'cholesky', 'triangular_solve', 'lu', 'qr', 'svd', 'eig', 'eigh',
+    'schur', 'tridiagonal_solve',
+    'threefry2x32', 'rng_bit_generator', 'rng_uniform',
+    'erf_inv', 'igamma', 'igammac', 'bessel_i0e', 'bessel_i1e',
+    'custom_call',
+}
+
+_HINTS = {
+    'gather': 'jnp fancy indexing lowers to gather — index with '
+              'pl.ds/static slices, or scalar-prefetch the indices into '
+              'the BlockSpec index_map (the paged-attention pattern)',
+    'sort': 'sort/top-k have no Mosaic lowering — hoist them out of the '
+            'kernel or use an online (running max/sum) formulation',
+    'threefry2x32': 'jax.random traces threefry — use pltpu.prng_seed/'
+                    'prng_random_bits inside TPU kernels',
+}
+
+
+@register
+class UnsupportedPrimitives(MosaicRule):
+    id = 'ML005'
+    name = 'unsupported-primitives'
+    severity = 'error'
+    description = ('kernel body contains a primitive with no Mosaic TPU '
+                   'lowering (sort/gather/scatter/conv/fft/linalg/'
+                   'threefry/...): interpret-mode green, chip red.')
+
+    def check(self, ctx):
+        for call in ctx.calls:
+            seen = set()
+            for eqn in iter_eqns(call.body):
+                prim = eqn.primitive.name
+                base = prim.replace('-', '_')
+                if (prim in UNSUPPORTED or base in UNSUPPORTED) \
+                        and prim not in seen:
+                    seen.add(prim)
+                    hint = _HINTS.get(prim) or _HINTS.get(base)
+                    msg = (f'{call.name}: `{prim}` has no Mosaic TPU '
+                           f'lowering')
+                    if hint:
+                        msg += f' — {hint}'
+                    yield self.violation(ctx, msg)

@@ -349,14 +349,21 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
             if flip:
                 ars.append(1.0 / float(ar))
 
+    # one batched host conversion up front: float(max_sizes[i]) inside
+    # the loop reads a possibly-device sequence element per iteration
+    # (the TL002 host-sync-per-iteration pattern)
+    mins = np.asarray(min_sizes, np.float64).reshape(-1)
+    maxs = (np.asarray(max_sizes, np.float64).reshape(-1)
+            if max_sizes is not None and len(max_sizes) else None)
+
     whs = []
-    for i, ms in enumerate(min_sizes):
-        ms = float(ms)
+    for i in range(mins.shape[0]):
+        ms = float(mins[i])
         ar_whs = [(ms * math.sqrt(ar), ms / math.sqrt(ar))
                   for ar in ars if abs(ar - 1.0) > 1e-6]
         mx_wh = None
-        if max_sizes:
-            mx = float(max_sizes[i] if i < len(max_sizes) else max_sizes[-1])
+        if maxs is not None:
+            mx = float(maxs[i] if i < maxs.shape[0] else maxs[-1])
             mx_wh = (math.sqrt(ms * mx), math.sqrt(ms * mx))
         whs.append((ms, ms))
         # reference ordering (phi prior_box kernel): default emits
@@ -718,12 +725,17 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         float(score_threshold), int(top), bool(use_gaussian),
         float(gaussian_sigma), bool(normalized))
 
+    # device pass first (no syncs), then ONE batched host transfer for
+    # the boxes and every image's decay results — the per-iteration
+    # np.asarray(bboxes[n]) was a host sync per image (TL002)
+    decayed = [decay_all(bboxes[n], scores[n]) for n in range(N)]
+    bboxes_h, decayed_h = jax.device_get((bboxes, decayed))
+
     outs, idxs, counts = [], [], []
     for n in range(N):
         rows = []
-        boxes_np = np.asarray(bboxes[n])
-        dec_a, order_a, valid_a = jax.tree.map(
-            np.asarray, decay_all(bboxes[n], scores[n]))
+        boxes_np = bboxes_h[n]
+        dec_a, order_a, valid_a = decayed_h[n]
         for c in range(C):
             if c == background_label:
                 continue

@@ -6,16 +6,30 @@ Measures the standalone fwd+bwd kernel at seq 2048/4096 across block
 configurations (and the swapaxes overhead), prints TFLOP/s per config so
 the default block heuristic can be tuned with evidence instead of
 guesses.
+
+Importable anywhere (pytest collection, tracelint): jax is only
+imported inside the functions, and main() returns 2 with a clear
+message when no TPU backend is reachable — the same no-TPU guard
+tools/mosaic_check.py carries.
 """
 import functools
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+# `python tools/flash_sweep.py` puts tools/ (not the repo root) on
+# sys.path and paddle_tpu is not pip-installed on the dev boxes — make
+# the repo importable no matter where the script is launched from
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def bench_flash(B, H, S, D, bq, bk, reps=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
     rng = np.random.default_rng(0)
@@ -44,7 +58,15 @@ def bench_flash(B, H, S, D, bq, bk, reps=8):
 
 
 def main():
-    assert jax.default_backend() == 'tpu', 'run on the real chip'
+    import jax
+
+    # guard, not assert: `python -O` strips asserts, and importing this
+    # module must never touch the backend — only main() does
+    if jax.default_backend() != 'tpu':
+        print(f'flash_sweep: needs the real chip '
+              f'(backend={jax.default_backend()}); bring the tunnel up '
+              f'and rerun')
+        return 2
     print(f'device: {jax.devices()[0].device_kind}')
     for (B, H, S) in [(4, 32, 2048), (1, 32, 4096), (1, 32, 8192)]:
         for (bq, bk) in [(1024, 1024), (512, 1024), (512, 512),
@@ -57,7 +79,8 @@ def main():
                       f'{dt * 1e3:7.2f} ms  {tf:6.1f} TF/s')
             except Exception as e:  # noqa: BLE001
                 print(f'S={S:6d} bq={bq} bk={bk}: FAILED {e}')
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main())

@@ -1,17 +1,35 @@
-"""Mosaic-legality check for the round-5 kernels on the REAL chip.
+"""Mosaic-legality check for the pallas kernels on the REAL chip.
 
-Interpret-mode tests cannot prove a pallas kernel compiles under Mosaic
-(i1 reshapes / lane alignment differ) — run this when the tunnel is up:
+Driven by the SHARED kernel registry
+(`paddle_tpu.analysis.mosaic.registry`) — the same suites mosaiclint
+lints statically in tier-1.  The flow:
+
+  1. static pass first (abstract tracing, costs no chip time): every
+     registered suite is linted with ML001–ML006;
+  2. entries with live static violations are SKIPPED on chip — their
+     verdict already says they will not lower, so on-chip minutes go
+     only to statically-clean kernels;
+  3. clean entries with an `onchip` runner compile + run real data
+     against their XLA reference, printed as PASS/FAIL with the static
+     verdict alongside so the two columns are directly comparable.
+
+Run when the tunnel is up:
 
     python tools/mosaic_check.py
 
-Each section compiles + runs one kernel variant added this round and
-compares against its XLA reference on-device. Prints PASS/FAIL per
-kernel; exits non-zero on any failure.
+Exits 0 all-clean, 1 on any on-chip failure or static violation, 2
+when no TPU backend is reachable (importable anywhere; only main()
+touches the backend).
 """
+import os
 import sys
 
-import numpy as np
+# `python tools/mosaic_check.py` puts tools/ (not the repo root) on
+# sys.path and paddle_tpu is not pip-installed on the dev boxes — make
+# the repo importable no matter where the script is launched from
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 # what a kernel-vs-reference check can actually throw: numeric
 # mismatches (AssertionError), Mosaic lowering refusals
@@ -25,9 +43,33 @@ KERNEL_CHECK_ERRORS = (AssertionError, NotImplementedError, TypeError,
                        AttributeError)
 
 
+def static_verdicts(entries, root=None):
+    """{entry name: (violations, suppressed)} from the static pass."""
+    from paddle_tpu.analysis.mosaic import lint_entries
+
+    verdicts = {}
+    for entry in entries:
+        vs, sup = lint_entries([entry], root=root)
+        verdicts[entry.name] = (vs, sup)
+    return verdicts
+
+
+def _verdict_str(vs, sup):
+    if vs:
+        rules = sorted({v.rule for v in vs})
+        errors = sum(1 for v in vs if v.severity == 'error')
+        kind = (f'{errors} error(s)' if errors
+                else f'{len(vs)} warning(s)')
+        return f'static: {kind} [{", ".join(rules)}]'
+    if sup:
+        return f'static: clean ({len(sup)} suppressed)'
+    return 'static: clean'
+
+
 def main():
     import jax
-    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.mosaic.registry import all_entries
 
     # guard, not assert: `python -O` strips asserts, and an import of
     # this module (pytest collection, tracelint) must never touch the
@@ -38,118 +80,46 @@ def main():
               f'and rerun')
         return 2
     print(f'device: {jax.devices()[0].device_kind}')
-    failures = []
 
-    def check(name, fn):
+    root = _ROOT
+    entries = all_entries()
+    print(f'static pass over {len(entries)} registered suite(s)...')
+    verdicts = static_verdicts(entries, root=root)
+
+    failures, skipped = [], []
+    for entry in entries:
+        vs, sup = verdicts[entry.name]
+        verdict = _verdict_str(vs, sup)
+        if any(v.severity == 'error' for v in vs):
+            # statically illegal: the chip would only re-discover what
+            # the lint already proved — spend zero on-chip time on it.
+            # WARNINGS do not skip: they exist precisely to be
+            # confirmed or cleared by this on-chip run.
+            skipped.append(entry.name)
+            print(f'SKIP {entry.name} [{verdict}]')
+            for v in vs:
+                print(f'     {v.render()}')
+            continue
+        if entry.onchip is None:
+            print(f'---- {entry.name} [{verdict}] (no on-chip runner)')
+            continue
         try:
-            fn()
-            print(f'PASS {name}')
+            entry.onchip()
+            print(f'PASS {entry.name} [{verdict}]')
         except KERNEL_CHECK_ERRORS as e:
-            failures.append(name)
-            print(f'FAIL {name}: {type(e).__name__}: {e}')
-
-    rng = np.random.default_rng(0)
-
-    # -- decode_attention with per-row start (padded batches) ----------
-    def decode_start():
-        from paddle_tpu.ops.pallas.decode_attention import decode_attention
-
-        B, S, H, D = 2, 512, 8, 128
-        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.bfloat16)
-        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
-        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
-        start = jnp.asarray([3, 200], jnp.int32)
-        valid = jnp.asarray([400, 512], jnp.int32)
-        out = np.asarray(decode_attention(q, ck, cv, valid, start=start))
-        assert np.isfinite(out).all()
-        # reference
-        mask = ((np.arange(S)[None, :] < np.asarray(valid)[:, None])
-                & (np.arange(S)[None, :] >= np.asarray(start)[:, None]))
-        from paddle_tpu.nn.functional.attention import _sdpa_reference
-
-        want = np.asarray(_sdpa_reference(
-            q.astype(jnp.float32), ck.astype(jnp.float32),
-            cv.astype(jnp.float32),
-            attn_mask=jnp.asarray(mask)[:, None, None, :]))
-        assert np.max(np.abs(out.astype(np.float32) - want)) < 3e-2
-
-    check('decode_attention+start', decode_start)
-
-    # -- decode_attention int8 cache (kv8) -----------------------------
-    def decode_kv8():
-        from paddle_tpu.models.generation import (calibrate_kv_scale,
-                                                  quantize_kv_rows)
-        from paddle_tpu.ops.pallas.decode_attention import decode_attention
-
-        B, S, H, D = 2, 512, 8, 128
-        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.bfloat16)
-        ck = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
-        cv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
-        ks, vs = calibrate_kv_scale(ck), calibrate_kv_scale(cv)
-        k8, v8 = quantize_kv_rows(ck, ks), quantize_kv_rows(cv, vs)
-        got = np.asarray(decode_attention(q, k8, v8, 400,
-                                          k_scale=ks, v_scale=vs))
-        want = np.asarray(decode_attention(
-            q, ck.astype(jnp.bfloat16), cv.astype(jnp.bfloat16), 400))
-        assert np.isfinite(got).all()
-        assert np.max(np.abs(got.astype(np.float32)
-                             - want.astype(np.float32))) < 5e-2
-
-    check('decode_attention+int8cache', decode_kv8)
-
-    # -- flash attention sliding window --------------------------------
-    def flash_window():
-        from paddle_tpu.ops.pallas.flash_attention import flash_attention
-
-        B, S, H, D = 1, 2048, 4, 128
-        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
-        out = flash_attention(q, q, q, causal=True, window_size=256)
-        assert np.isfinite(np.asarray(out).astype(np.float32)).all()
-        # grads too (train path)
-        g = jax.grad(lambda a: flash_attention(
-            a, a, a, causal=True,
-            window_size=256).astype(jnp.float32).sum())(q)
-        assert np.isfinite(np.asarray(g).astype(np.float32)).all()
-
-    check('flash_attention+window(fwd+bwd)', flash_window)
-
-    # -- paged decode attention ----------------------------------------
-    def paged():
-        from paddle_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention)
-
-        NB, Hkv, BS, D, B, Hq = 32, 8, 128, 128, 2, 8
-        q = jnp.asarray(rng.normal(size=(B, 1, Hq, D)), jnp.bfloat16)
-        kc = jnp.asarray(rng.normal(size=(NB, Hkv, BS, D)), jnp.bfloat16)
-        vc = jnp.asarray(rng.normal(size=(NB, Hkv, BS, D)), jnp.bfloat16)
-        tbl = jnp.asarray([[3, 7, 1, 12], [0, 5, 9, 2]], jnp.int32)
-        out = np.asarray(paged_decode_attention(
-            q, kc, vc, tbl, jnp.asarray([300, 512], jnp.int32)))
-        assert np.isfinite(out.astype(np.float32)).all()
-
-    check('paged_decode_attention', paged)
-
-    # -- head-major contiguous variant ---------------------------------
-    def headmajor():
-        from paddle_tpu.ops.pallas.paged_attention import (
-            decode_attention_headmajor)
-
-        B, Hkv, S, D, Hq = 2, 8, 1024, 128, 8
-        q = jnp.asarray(rng.normal(size=(B, 1, Hq, D)), jnp.bfloat16)
-        ck = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
-        cv = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
-        out = np.asarray(decode_attention_headmajor(
-            q, ck, cv, jnp.asarray([800, 1024], jnp.int32)))
-        assert np.isfinite(out.astype(np.float32)).all()
-
-    check('decode_attention_headmajor', headmajor)
+            failures.append(entry.name)
+            print(f'FAIL {entry.name} [{verdict}]: '
+                  f'{type(e).__name__}: {e}')
 
     # -- TP decode via shard_map needs >1 device: skipped on one chip --
 
-    if failures:
-        print(f'\n{len(failures)} FAILURES: {failures}')
+    if failures or skipped:
+        print(f'\n{len(failures)} on-chip FAILURE(S): {failures}; '
+              f'{len(skipped)} statically-dirty suite(s) skipped: '
+              f'{skipped}')
         return 1
-    print('\nall round-5 kernels Mosaic-legal on chip')
+    print('\nall registered kernels Mosaic-legal: static pass clean, '
+          'on-chip runners green')
     return 0
 
 
