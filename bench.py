@@ -177,6 +177,75 @@ def _mosaiclint_gate(timeout_s=240):
     return clean, detail, payload.get('vmem')
 
 
+_TRAIN_GATE_SRC = r'''
+import json
+import jax
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.training.engine import TrainEngine, total_traces
+
+def mk():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(vocab_size=64, hidden_size=32,
+                                       layers=1, heads=2, kv_heads=2,
+                                       intermediate_size=64))
+
+rng = np.random.default_rng(0)
+batches = [jnp.asarray(rng.integers(0, 64, (8, 17)), jnp.int32)
+           for _ in range(4)]
+eng = TrainEngine(mk(), AdamW(learning_rate=1e-3), log_window=100)
+eng.step((batches[0],))
+t0 = total_traces()
+for b in batches:
+    eng.step((b,))
+eng.sync()
+retraces = total_traces() - t0
+fused = TrainEngine(mk(), AdamW(learning_rate=1e-3), log_window=1)
+accum = TrainEngine(mk(), AdamW(learning_rate=1e-3), accum_steps=4,
+                    log_window=1)
+delta = abs(fused.step((batches[0],))['loss']
+            - accum.step((batches[0],))['loss'])
+print(json.dumps({'retraces': retraces, 'accum_loss_delta': delta}))
+'''
+
+
+def _train_engine_gate(timeout_s=240):
+    """Dynamic training-contract gate, CPU-pinned like the lint gates:
+    a tiny TrainEngine run must show ZERO steady-state retraces and a
+    grad-accum loss matching the fused batch — provable without the
+    chip, so a regression on the train hot path fails the round even
+    when the tunnel is down and the stashed artifact is emitted.
+    Returns (clean, detail): clean is None when the gate could not run
+    (never poses as a pass)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-c', _TRAIN_GATE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=root)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return None, f'gate did not run: {type(e).__name__}'
+    if proc.returncode != 0:
+        return None, f'gate errored: {proc.stderr[-200:]}'
+    try:
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None, 'gate output unparseable'
+    retraces = payload.get('retraces')
+    delta = payload.get('accum_loss_delta')
+    clean = retraces == 0 and delta is not None and delta < 1e-4
+    return clean, (f'{retraces} steady-state retrace(s), '
+                   f'accum-vs-fused loss delta {delta:.2e}')
+
+
 def _acquire_bench_lock(max_wait_s=900):
     """Serialize bench runs: tools/tpu_watch.sh may be mid-bench when the
     driver launches its own — two concurrent TPU processes either fail
@@ -216,8 +285,11 @@ def main():
     print(f'# tracelint gate: {tracelint_detail}', flush=True)
     mosaiclint_clean, mosaiclint_detail, mosaiclint_vmem = _mosaiclint_gate()
     print(f'# mosaiclint gate: {mosaiclint_detail}', flush=True)
+    train_gate_clean, train_gate_detail = _train_engine_gate()
+    print(f'# train engine gate: {train_gate_detail}', flush=True)
     static_gate_failed = (tracelint_clean is False
-                          or mosaiclint_clean is False)
+                          or mosaiclint_clean is False
+                          or train_gate_clean is False)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
@@ -227,6 +299,8 @@ def main():
             det['gate_mosaiclint_clean'] = mosaiclint_clean
             det['mosaiclint'] = mosaiclint_detail
             det['mosaiclint_vmem'] = mosaiclint_vmem
+            det['gate_train_retrace_zero'] = train_gate_clean
+            det['train_gate'] = train_gate_detail
             print(json.dumps(stashed), flush=True)
             cancel_watchdog()
             if static_gate_failed:
@@ -320,14 +394,74 @@ def main():
         float(zero + 1)
     sync_latency = (time.perf_counter() - t0) / 5
 
+    # direct-jit path: the comparison baseline the engine must not lose
+    # to (still a per-loop host sync on the final loss)
     t0 = time.perf_counter()
     for i in range(steps):
         model, state, loss = step(model, state, batches[i % len(batches)])
     float(loss)                                        # one hard sync
-    dt = (time.perf_counter() - t0 - sync_latency) / steps
+    direct_dt = (time.perf_counter() - t0 - sync_latency) / steps
 
     tokens = batch * seq
+    direct_tok_s = tokens / direct_dt
+
+    # -- TrainEngine: the compiled training hot path (the MEASURED
+    # metric). Same model/optimizer/shapes; params + optimizer state
+    # donated every step, batches pulled through sharded device
+    # prefetch, losses accumulated on device — ONE host sync for the
+    # whole timed loop, and the retrace counter across it must be 0.
+    from paddle_tpu.training.engine import TrainEngine
+    from paddle_tpu.training.engine import total_traces as train_traces
+
+    host_batches = [np.asarray(b) for b in batches]
+
+    def batch_stream(n):
+        for i in range(n):
+            yield host_batches[i % len(host_batches)]
+
+    teng = TrainEngine(model, opt, opt_state=state, log_window=steps + 4)
+    for b in teng.prefetch(batch_stream(2)):
+        teng.step((b,))
+    teng.sync()                                    # drain the warmup
+    traces0 = train_traces()
+    t0 = time.perf_counter()
+    for b in teng.prefetch(batch_stream(steps)):
+        teng.step((b,))
+    engine_logs = teng.sync()                      # the ONE host sync
+    dt = (time.perf_counter() - t0 - sync_latency) / steps
+    train_retraces = train_traces() - traces0
+    model, state = teng.model, teng.opt_state      # donated: re-point
+    loss = engine_logs['loss']
     tok_per_sec = tokens / dt
+
+    # grad accumulation: k microbatches scanned inside the one dispatch
+    # (the HBM-headroom knob); stamped so the history shows its cost
+    accum_k = 2
+    train_accum_tok_s = None
+    try:
+        taccum = TrainEngine(model, opt, opt_state=state,
+                             accum_steps=accum_k, log_window=steps + 4)
+        for b in taccum.prefetch(batch_stream(1)):
+            taccum.step((b,))
+        taccum.sync()
+        t0 = time.perf_counter()
+        for b in taccum.prefetch(batch_stream(steps)):
+            taccum.step((b,))
+        taccum.sync()
+        accum_dt = (time.perf_counter() - t0 - sync_latency) / steps
+        train_accum_tok_s = tokens / accum_dt
+        model, state = taccum.model, taccum.opt_state
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        print(f'# grad-accum bench failed: {type(e).__name__}: {e}',
+              flush=True)
+        # a failed step may still have donated the old buffers: the
+        # engine's view is the freshest live pytree for the decode
+        # benches below (best effort — an engine that died mid-donation
+        # is unrecoverable either way)
+        try:
+            model, state = taccum.model, taccum.opt_state
+        except NameError:
+            pass
 
     # -- decode path: steady-state single-token generation over a long KV
     # cache (the inference-stack half of the reference's perf story) -----
@@ -532,6 +666,21 @@ def main():
             'mfu': round(mfu, 4), 'loss': float(loss), 'step_ms': round(dt * 1e3, 2),
             'params': n_params, 'batch': batch, 'seq': seq,
             'vocab_size': cfg.vocab_size,
+            # train hot path: the metric above is the TrainEngine number
+            # (donated fused step, device-resident losses, one sync per
+            # window); the direct-jit number is the floor it must beat
+            'train_direct_tok_s': round(direct_tok_s, 1),
+            'train_engine_tok_s': round(tok_per_sec, 1),
+            'train_retraces_steady_state': train_retraces,
+            'gate_train_retrace_zero': bool(train_retraces == 0),
+            'train_gate': train_gate_detail,
+            'gate_train_engine_ge_direct': bool(
+                tok_per_sec >= direct_tok_s),
+            'train_engine_vs_direct': round(tok_per_sec / direct_tok_s, 4),
+            'train_accum_tok_s': (round(train_accum_tok_s, 1)
+                                  if train_accum_tok_s is not None
+                                  else None),
+            'train_accum_microbatches': accum_k,
             'decode_tok_s_b1': round(decode_b1, 1),
             'decode_tok_s_b8': round(decode_b8, 1),
             'decode_tok_s_b8_kv8': (round(decode_b8_kv8, 1)

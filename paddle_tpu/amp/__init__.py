@@ -156,6 +156,26 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    # -- traced-step hooks (training/engine.py) ---------------------------
+    def state(self):
+        """Device-resident scaling state for a compiled train step: the
+        engine carries {scale, good} as donated device arrays and runs
+        scale/unscale, the non-finite check, the skip-update select and
+        the dynamic growth/backoff entirely inside the trace — zero
+        per-step host work (the imperative update() path above syncs the
+        host every step)."""
+        return {
+            'scale': jnp.asarray(self._scale, jnp.float32),
+            'good': jnp.asarray(self._good_steps, jnp.int32),
+        }
+
+    def load_state(self, state):
+        """Adopt engine-updated device state back into the host mirror
+        (one off-hot-path sync; call at checkpoint/epoch boundaries)."""
+        host = jax.device_get(state)
+        self._scale = float(host['scale'])
+        self._good_steps = int(host['good'])
+
     def is_enable(self):
         return self._enable
 

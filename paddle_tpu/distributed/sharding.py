@@ -53,6 +53,20 @@ def zero_spec(shape, mesh, axes=None):
     return P()
 
 
+def data_sharding(mesh, axes=('dp', 'fsdp')):
+    """NamedSharding that splits the BATCH (leading) dim over the data
+    axes — the placement every per-example tensor (ids, labels, masks)
+    wants under dp/fsdp. `prefetch_to_device` applies it during H2D so
+    each device receives only its shard of the global batch and the DMA
+    overlaps the previous step's compute (training/engine.py's input
+    contract); scalars and 0-d leaves ride along replicated."""
+    axes = tuple(a for a in axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(axes if len(axes) > 1 else axes[0]))
+
+
 class GroupShardedOptimizer:
     """ZeRO stage-1/2 wrapper (ref: sharding/group_sharded.py
     GroupShardedOptimizerStage2): delegates the math to the wrapped

@@ -2,22 +2,25 @@
 
 `Model(network).prepare(opt, loss, metrics)` then `fit/evaluate/
 predict/save/load` — Paddle's Keras-style trainer. TPU-native twist:
-the whole train step (fwd+bwd+update) is one jitted donated-state
-program, rebuilt only when shapes change.
+the train/eval loops delegate to training.engine.TrainEngine, so every
+model in the zoo gets the compiled-hot-path contract for free — one
+donated fused step per global batch (params + optimizer state updated
+in place), the lr schedule traced from the device step counter, batches
+prefetched to device ahead of consumption, and ONE host sync per log
+window instead of a `float(loss)` stall on every step.
 """
 from __future__ import annotations
 
 import os
-import typing
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import autograd
 from ..callbacks import CallbackList, ProgBarLogger
 from ..framework import io as io_mod
 from ..io.dataloader import DataLoader
+from ..training.engine import TrainEngine
 
 
 def _to_list(x):
@@ -35,121 +38,61 @@ class Model:
         self._loss = None
         self._metrics = []
         self._opt_state = None
-        self._train_step = None
-        self._eval_step = None
+        self._engine = None
         self.stop_training = False
 
     # -- setup ------------------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, **kw):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                accum_steps=1, scaler=None, mesh=None, **kw):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
-        if optimizer is not None:
-            self._opt_state = optimizer.init(self.network)
+        # the engine owns the compiled train/eval path: donated fused
+        # step, traced lr, windowed metric sync (docs/train_engine.md)
+        self._engine = TrainEngine(
+            self.network, optimizer, loss_fn=loss,
+            loss_mode='fn' if loss is not None else 'none',
+            accum_steps=accum_steps, scaler=scaler, mesh=mesh,
+            metrics=self._metrics)
+        self._opt_state = self._engine.opt_state
         self._build_steps()
         return self
 
     def _build_steps(self):
-        import inspect
-
-        opt = self._optimizer
-        loss_fn = self._loss
-        # thread lr as a traced argument ONLY for optimizers whose
-        # apply_gradients accepts it (the base Optimizer family); wrapper
-        # optimizers (GradientMerge/LookAhead/sharding) keep their own
-        # signature and stored rate
-        self._lr_threaded = False
-        if opt is not None:
-            try:
-                params = inspect.signature(opt.apply_gradients).parameters
-                self._lr_threaded = ('lr' in params
-                                     and hasattr(opt, 'get_lr'))
-            except (TypeError, ValueError):
-                pass
-
-        if self._lr_threaded:
-            def train_step(network, opt_state, inputs, labels, lr):
-                def compute(m):
-                    preds = m(*inputs)
-                    loss = loss_fn(preds, *labels)
-                    return loss, (m, preds)
-
-                (loss, (m, preds)), grads = autograd.value_and_grad(
-                    compute, has_aux=True)(network)
-                # lr arrives traced so host-side set_lr / scheduler steps
-                # take effect without retracing
-                m, opt_state = opt.apply_gradients(m, grads, opt_state,
-                                                   lr=lr)
-                return m, opt_state, loss, preds
-        else:
-            def train_step(network, opt_state, inputs, labels):
-                def compute(m):
-                    preds = m(*inputs)
-                    loss = loss_fn(preds, *labels)
-                    return loss, (m, preds)
-
-                (loss, (m, preds)), grads = autograd.value_and_grad(
-                    compute, has_aux=True)(network)
-                m, opt_state = opt.apply_gradients(m, grads, opt_state)
-                return m, opt_state, loss, preds
-
-        def eval_step(network, inputs, labels):
-            preds = network(*inputs)
-            loss = loss_fn(preds, *labels) if loss_fn is not None else 0.0
-            return loss, preds
-
         # cached on self for the Model's lifetime: built once per
-        # prepare(), every train/eval/predict batch reuses them
-        # tracelint: disable=TL001
-        self._train_step = jax.jit(train_step) if opt else None
-        # tracelint: disable=TL001
-        self._eval_step = jax.jit(eval_step)
+        # prepare(), every predict batch reuses it (train/eval go
+        # through the module-level engine jits instead)
         # tracelint: disable=TL001
         self._pred_step = jax.jit(lambda network, inputs: network(*inputs))
+
+    def _after_engine_step(self):
+        """The engine donated-and-rebuilt the pytrees: re-point the
+        Model-level references at the live ones."""
+        self.network = self._engine.model
+        self._opt_state = self._engine.opt_state
 
     # -- single-batch API (ref: Model.train_batch / eval_batch) ----------
     def train_batch(self, inputs, labels=None):
         inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
         labels = tuple(jnp.asarray(x) for x in _to_list(labels))
         self.network.train()
-        if self._lr_threaded:
-            opt = self._optimizer
-            state = self._opt_state
-            step_no = (int(state['step']) + 1
-                       if isinstance(state, dict) and 'step' in state else 1)
-            lr_now = jnp.asarray(opt.get_lr(step_no), jnp.float32)
-            net, self._opt_state, loss, preds = self._train_step(
-                self.network, self._opt_state, inputs, labels, lr_now)
-        else:
-            net, self._opt_state, loss, preds = self._train_step(
-                self.network, self._opt_state, inputs, labels)
-        self.network = net
-        metrics = self._update_metrics(preds, labels)
-        return [float(loss)] + metrics
+        self._engine.step(inputs, labels)
+        logs = self._engine.sync()          # per-batch API: sync now
+        self._after_engine_step()
+        return [logs['loss']] + [m.accumulate() for m in self._metrics]
 
     def eval_batch(self, inputs, labels=None):
         inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
         labels = tuple(jnp.asarray(x) for x in _to_list(labels))
         self.network.eval()
-        loss, preds = self._eval_step(self.network, inputs, labels)
-        metrics = self._update_metrics(preds, labels)
-        return [float(loss)] + metrics
+        flushed = self._engine.eval_step(inputs, labels)
+        losses = (flushed or []) + self._engine.eval_sync()
+        return [losses[-1]] + [m.accumulate() for m in self._metrics]
 
     def predict_batch(self, inputs):
         inputs = tuple(jnp.asarray(x) for x in _to_list(inputs))
         self.network.eval()
         return np.asarray(self._pred_step(self.network, inputs))
-
-    def _update_metrics(self, preds, labels):
-        out = []
-        for m in self._metrics:
-            args = m.compute(preds, *labels)
-            if not isinstance(args, tuple):
-                args = (args,)
-            m.update(*args)
-            acc = m.accumulate()
-            out.append(acc)
-        return out
 
     # -- loops ------------------------------------------------------------
     def _loader(self, data, batch_size, shuffle):
@@ -168,28 +111,44 @@ class Model:
             params={'epochs': epochs, 'steps': len(train_loader),
                     'verbose': verbose},
         )
+        engine = self._engine
+        engine.log_window = max(1, int(log_freq))
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
+        self.network.train()
         for epoch in range(epochs):
             if self.stop_training:
                 break
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
-            for step, batch in enumerate(train_loader):
+            # device prefetch: the next global batch's H2D DMA overlaps
+            # this step's compute (sharded over dp/fsdp when a mesh is
+            # wired); losses/metrics stay on device between log windows
+            for step, batch in enumerate(engine.prefetch(train_loader)):
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                vals = self.train_batch(inputs, labels)
-                logs = self._logs(vals)
+                window_logs = engine.step(inputs, labels)
+                # re-point network/opt_state EVERY batch: the engine
+                # donated the previous pytrees, and callbacks (weight
+                # logging, mid-epoch checkpoints) read self.model
+                self._after_engine_step()
+                if window_logs is not None:
+                    logs = self._window_logs(window_logs)
                 cbks.on_train_batch_end(step, logs)
+            tail = engine.sync()            # flush the partial window
+            if tail is not None:
+                logs = self._window_logs(tail)
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, callbacks=cbks,
                                           verbose=0)
                 cbks.on_eval_end(eval_logs)
+                self.network.train()
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, str(epoch)))
+        self._after_engine_step()
         cbks.on_train_end(logs)
         return self
 
@@ -198,11 +157,15 @@ class Model:
         loader = self._loader(eval_data, batch_size, False)
         for m in self._metrics:
             m.reset()
+        engine = self._engine
+        self.network.eval()
         losses = []
-        for batch in loader:
+        for batch in engine.prefetch(loader):
             inputs, labels = self._split_batch(batch)
-            vals = self.eval_batch(inputs, labels)
-            losses.append(vals[0])
+            flushed = engine.eval_step(inputs, labels)
+            if flushed:
+                losses.extend(flushed)
+        losses.extend(engine.eval_sync())   # one device_get per window
         logs = {'loss': float(np.mean(losses)) if losses else 0.0}
         for m in self._metrics:
             names = m.name()
@@ -231,24 +194,25 @@ class Model:
             return tuple(batch), ()
         return (batch,), ()
 
+    def _window_logs(self, window_logs):
+        """Engine window logs -> hapi logs dict (drop engine-internal
+        keys so callbacks see the historical schema)."""
+        return {k: v for k, v in window_logs.items()
+                if k not in ('loss_mean', 'window')}
+
     def _logs(self, vals):
         logs = {'loss': vals[0]}
-        i = 1
-        for m in self._metrics:
+        # vals[1:] are the metrics' HOST accumulates (train_batch synced
+        # them already): one slot per metric, one log entry per name
+        # (e.g. Accuracy(topk=(1, 5)) -> 2 entries from its one slot)
+        rest = [np.asarray(v).reshape(-1) for v in vals[1:]]
+        for m, v in zip(self._metrics, rest):
             names = m.name()
             if isinstance(names, list):
-                # one accumulated array per metric: component j belongs
-                # to name j (e.g. Accuracy(topk=(1, 5)) -> 2 entries)
-                # tracelint: disable=TL002 - metric logging readback at
-                # batch boundary (a handful of scalars, off the hot path)
-                v = np.asarray(vals[i]).reshape(-1)
                 for j, n in enumerate(names):
                     logs[n] = float(v[j])
-                i += 1
             else:
-                v = vals[i]
-                logs[names] = float(np.asarray(v).reshape(-1)[0])
-                i += 1
+                logs[names] = float(v[0])
         return logs
 
     # -- persistence ------------------------------------------------------
@@ -271,6 +235,10 @@ class Model:
             flat = io_mod.load(opt_path)
             leaves = [jnp.asarray(flat[str(i)]) for i in range(len(flat))]
             self._opt_state = jax.tree.unflatten(treedef, leaves)
+            if self._engine is not None:
+                self._engine.opt_state = self._opt_state
+        if self._engine is not None:
+            self._engine.model = self.network
         return self
 
     def parameters(self):

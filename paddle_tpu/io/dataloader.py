@@ -170,13 +170,37 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn,
             data_queue.put((seq, None, repr(e)))
 
 
+def _push_with_backoff(push, timeout, sleep=None):
+    """Retry `push()` (returns False while the ring is full) with
+    bounded exponential backoff until it lands or the push budget runs
+    out — a dead consumer then RAISES in the worker (surfacing as a
+    ring timeout in the parent) instead of spinning the core forever at
+    1 kHz. The budget is deliberately LOOSER than the consumer-side
+    `timeout`: a full ring is usually backpressure, not death — the
+    consumer legitimately stalls for minutes while the first train step
+    jit-compiles — so the worker waits several consumer-timeouts (floor
+    5 min) before concluding nobody is coming back."""
+    import time as time_mod
+
+    sleep = sleep if sleep is not None else time_mod.sleep
+    budget = max(timeout * 5, 300)
+    delay = 0.0005
+    waited = 0.0
+    while not push():
+        if waited >= budget:
+            raise RuntimeError(
+                f'shm ring full for {budget}s: consumer stalled or gone')
+        sleep(delay)
+        waited += delay
+        delay = min(delay * 2, 0.05)
+
+
 def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn,
-                     worker_id=0, num_workers=1):
+                     worker_id=0, num_workers=1, timeout=60):
     """Worker for the native shared-memory fast path: batches go through
     the C++ SPSC ring (one memcpy into shm) instead of a pickled pipe
     (ref: the reference's C++ DataLoader + shared-memory transport)."""
     import struct
-    import time as time_mod
 
     from .. import _native
 
@@ -197,8 +221,7 @@ def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn,
             except Exception as e:  # pragma: no cover
                 msg = repr(e).encode()
                 payload = struct.pack('<QB', seq, 1) + msg
-            while not ring.push(payload):
-                time_mod.sleep(0.001)       # ring full — consumer catching up
+            _push_with_backoff(lambda: ring.push(payload), timeout)
     finally:
         ring.close(unlink=False)
 
@@ -361,7 +384,7 @@ class DataLoader:
             ctx.Process(
                 target=_worker_loop_shm,
                 args=(self.dataset, index_queue, rings[i].name,
-                      self.collate_fn, i, self.num_workers),
+                      self.collate_fn, i, self.num_workers, self.timeout),
                 daemon=True,
             )
             for i in range(self.num_workers)
@@ -424,12 +447,26 @@ class DataLoader:
 def prefetch_to_device(iterator, size=2, sharding=None):
     """Double-buffered device prefetch: keeps `size` batches resident in HBM
     ahead of consumption. The host thread stays `size` steps ahead;
-    device_put is async so H2D DMA overlaps compute."""
+    device_put is async so H2D DMA overlaps compute.
+
+    `sharding` (e.g. distributed.sharding.data_sharding(mesh)) places
+    every array leaf as a mesh-sharded GLOBAL array during the H2D copy
+    — each device receives only its dp/fsdp shard of the batch, and the
+    transfer still overlaps the in-flight step. Leaves with fewer dims
+    than the spec needs (scalars riding along in a batch dict) fall back
+    to the default replicated put instead of erroring."""
     import jax
 
     def put(batch):
         if sharding is not None:
-            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+            ndim_needed = len(getattr(sharding, 'spec', ()) or ())
+
+            def place(x):
+                if getattr(x, 'ndim', 0) >= ndim_needed:
+                    return jax.device_put(x, sharding)
+                return jax.device_put(x)
+
+            return jax.tree.map(place, batch)
         return jax.tree.map(jax.device_put, batch)
 
     buf = []

@@ -14,6 +14,14 @@ import jax.numpy as jnp
 
 
 class LRScheduler:
+    # Traceable schedules are evaluated INSIDE the compiled train step
+    # from the device step counter (training/engine.py) — zero host work
+    # per step. Subclasses whose rate genuinely cannot be a pure
+    # function of the step (metric-driven, host-stateful) set this False
+    # and the engine threads their host rate in as a traced argument
+    # instead (still no retrace, but the host computes it).
+    traceable = True
+
     def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
         self.base_lr = learning_rate
         self.last_epoch = last_epoch
@@ -132,6 +140,11 @@ class MultiStepDecay(LRScheduler):
 
 
 class LambdaDecay(LRScheduler):
+    # wraps an arbitrary user callable: int(step)/branching in the
+    # lambda would break under tracing, so the engine threads the
+    # host-computed rate instead (see LRScheduler.traceable)
+    traceable = False
+
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
         super().__init__(learning_rate, last_epoch, verbose)
         self.lr_lambda = lr_lambda
@@ -141,6 +154,9 @@ class LambdaDecay(LRScheduler):
 
 
 class MultiplicativeDecay(LRScheduler):
+    # wraps an arbitrary user callable (see LambdaDecay)
+    traceable = False
+
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
         super().__init__(learning_rate, last_epoch, verbose)
         self.lr_lambda = lr_lambda
@@ -225,6 +241,10 @@ class CyclicLR(LRScheduler):
 class ReduceOnPlateau(LRScheduler):
     """Metric-driven scheduler — inherently host-side (ref: lr.py::ReduceOnPlateau).
     Use imperatively: call .step(metric) each eval, read .last_lr."""
+
+    # the rate depends on observed metrics, not the step count: the
+    # train engine must thread it in from the host (see LRScheduler)
+    traceable = False
 
     def __init__(self, learning_rate, mode='min', factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode='rel', cooldown=0, min_lr=0,

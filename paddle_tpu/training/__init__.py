@@ -1,0 +1,21 @@
+"""Training runtime: the compiled train-side twin of `inference/`.
+
+`TrainEngine` owns the training hot path end to end — one donated,
+module-level-jitted fused step (fwd + bwd + optimizer update), gradient
+accumulation as a `lax.scan` over microbatches inside that single
+dispatch, the lr schedule and AMP loss scaling folded into the trace,
+and metrics accumulated on device with ONE host sync per log window.
+See docs/train_engine.md for the contract.
+"""
+from .engine import (  # noqa: F401
+    TRAIN_COMPILE_CACHE,
+    TrainEngine,
+    reset_trace_counts,
+    total_traces,
+    trace_counts,
+)
+
+__all__ = [
+    'TrainEngine', 'TRAIN_COMPILE_CACHE', 'trace_counts', 'total_traces',
+    'reset_trace_counts',
+]
