@@ -141,6 +141,36 @@ def _build_paged(quant=False):
     return build
 
 
+def _build_paged_serving(quant=False):
+    """The ServingEngine block-table call pattern at a production-scale
+    serving geometry: 8 in-flight slots, 2048-token contexts over
+    block_size-16 pages (128 table entries per row, full-coverage pool
+    + scratch page — the engine's DEFAULT sizing; bench.py's measured
+    serve run uses a smaller 4-slot instance of the same pattern). The
+    int8-cache variant keeps the pool at int8's 32-sublane page size.
+    Inference-only kernels: fwd suites, no VJP."""
+    def build():
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention)
+
+        slots, Hkv, D, Hq = 8, 8, 128, 32
+        BS = 32 if quant else 16             # int8 sublane = 32
+        maxb = 2048 // BS                    # ServingEngine max_context
+        NB = slots * maxb + 1                # full-coverage pool + scratch
+        q = _sds((slots, 1, Hq, D), 'bfloat16')
+        cache = _sds((NB, Hkv, BS, D), 'int8' if quant else 'bfloat16')
+        tbl = _sds((slots, maxb), 'int32')
+        lens = _sds((slots,), 'int32')
+        if quant:
+            scale = _sds((Hkv, D), 'float32')
+            return (lambda q, k, v, t, c, ks, vs: paged_decode_attention(
+                        q, k, v, t, c, k_scale=ks, v_scale=vs),
+                    (q, cache, cache, tbl, lens, scale, scale), {})
+        return (paged_decode_attention, (q, cache, cache, tbl, lens), {})
+
+    return build
+
+
 def _build_headmajor():
     from paddle_tpu.ops.pallas.paged_attention import (
         decode_attention_headmajor)
@@ -305,6 +335,27 @@ def _onchip_paged():
     assert np.isfinite(out.astype(np.float32)).all()
 
 
+def _onchip_serve_decode():
+    """Serving-shape paged decode on chip: ServingEngine's default
+    block_size-16 pages, shuffled non-contiguous tables, ragged
+    per-row lengths."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    slots, NB, Hkv, BS, D, Hq, maxb = 4, 64, 8, 16, 128, 32, 8
+    q = jnp.asarray(rng.normal(size=(slots, 1, Hq, D)), jnp.bfloat16)
+    kc = jnp.asarray(rng.normal(size=(NB, Hkv, BS, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(NB, Hkv, BS, D)), jnp.bfloat16)
+    tbl = jnp.asarray(rng.permutation(np.arange(1, NB))[:slots * maxb]
+                      .reshape(slots, maxb), jnp.int32)
+    lens = jnp.asarray([17, 128, 63, 96], jnp.int32)
+    out = np.asarray(paged_decode_attention(q, kc, vc, tbl, lens))
+    assert np.isfinite(out.astype(np.float32)).all()
+
+
 def _onchip_headmajor():
     import jax.numpy as jnp
     import numpy as np
@@ -357,6 +408,10 @@ ENTRIES = (
     Entry('paged_attention/paged', _PAGED, _build_paged(),
           onchip=_onchip_paged),
     Entry('paged_attention/paged_int8', _PAGED, _build_paged(quant=True)),
+    Entry('paged_attention/serve_decode', _PAGED, _build_paged_serving(),
+          onchip=_onchip_serve_decode),
+    Entry('paged_attention/serve_decode_int8', _PAGED,
+          _build_paged_serving(quant=True)),
     Entry('paged_attention/headmajor', _HEADMAJOR, _build_headmajor,
           onchip=_onchip_headmajor),
     Entry('quant_matmul/int8', _QMM, _build_quant_matmul('int8')),

@@ -275,6 +275,15 @@ from .engine import (  # noqa: E402
     trace_counts,
 )
 
+# Continuous-batching scheduler over the paged KV block pool — the
+# request-level serving path; see serving.py / docs/serving.md.
+from .serving import (  # noqa: E402
+    BlockAllocator,
+    OutOfBlocks,
+    RequestQueue,
+    ServingEngine,
+)
+
 
 def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
                                mixed_params_file, mixed_precision=None,

@@ -102,15 +102,24 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
 
-    def key(self, model, cache_shape, cache_dtype, sampling):
+    def key(self, model, cache_shape, cache_dtype, sampling,
+            geometry=('contiguous',)):
         # _engine_model_id (stamped by DecodeEngine.__init__) never
         # recycles, unlike id(model) — the raw-id fallback only covers
         # direct module-level callers that bypassed an engine. The id
         # counter starts at 0, so compare against None (a bare `or`
         # would throw away the first engine's id as falsy)
+        #
+        # `geometry` is the engine's batch-capacity tuple: DecodeEngine
+        # passes ('contiguous', B, max_len), ServingEngine passes
+        # ('paged', slots, num_blocks, block_size, max_blocks) — without
+        # it a paged engine and a contiguous engine over the same model
+        # and sampling config would collide on one registry key and the
+        # hit/miss accounting would lie about both
         mid = getattr(model, '_engine_model_id', None)
         return (id(type(model)), mid if mid is not None else id(model),
-                tuple(cache_shape), str(cache_dtype), tuple(sampling))
+                tuple(cache_shape), str(cache_dtype), tuple(sampling),
+                tuple(geometry))
 
     def note(self, key):
         if key in self._keys:
@@ -406,16 +415,27 @@ class DecodeEngine:
         return (self.max_new_tokens, self.temperature, self.top_k,
                 self.top_p, self.eos_token_id)
 
+    def _geometry(self, batch, max_len):
+        """Batch-capacity component of the registry key: a contiguous
+        cache of (batch, max_len). Keeps this engine's keys disjoint
+        from ServingEngine's ('paged', ...) keys over the same model."""
+        return ('contiguous', int(batch), int(max_len))
+
     def stats(self):
         """{'trace_counts', 'total_traces', 'cache_keys', 'hits',
-        'misses'} — steady-state serving must show total_traces frozen
-        across calls (bench.py asserts exactly that)."""
+        'misses', 'geometry'} — steady-state serving must show
+        total_traces frozen across calls (bench.py asserts exactly
+        that). `geometry` records the engine kind + capacity knobs that
+        feed the registry key, so two engines' stats are attributable."""
         return {
             'trace_counts': trace_counts(),
             'total_traces': total_traces(),
             'cache_keys': len(COMPILE_CACHE),
             'hits': COMPILE_CACHE.hits,
             'misses': COMPILE_CACHE.misses,
+            'geometry': {'kind': 'contiguous',
+                         'max_new_tokens': self.max_new_tokens,
+                         'buckets': self.buckets},
         }
 
     # -- generate ----------------------------------------------------------
@@ -440,7 +460,8 @@ class DecodeEngine:
         caches = self.model.init_cache(B, max_len)
         key = self._sampling_key() + ('generate',)
         COMPILE_CACHE.note(COMPILE_CACHE.key(
-            self.model, (B, max_len), self.model.cache_dtype(), key))
+            self.model, (B, max_len), self.model.cache_dtype(), key,
+            geometry=self._geometry(B, max_len)))
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
         real_len = jnp.full((B,), S, jnp.int32)
@@ -491,7 +512,7 @@ class DecodeEngine:
         dcaches = draft.init_cache(B, max_len)
         COMPILE_CACHE.note(COMPILE_CACHE.key(
             self.model, (B, max_len), self.model.cache_dtype(),
-            (k, 'speculative')))
+            (k, 'speculative'), geometry=self._geometry(B, max_len)))
         if B == 1:
             gen = _spec_loop_host_b1(self.model, draft, tcaches, dcaches,
                                      input_ids, mnt, k, self.eos_token_id)

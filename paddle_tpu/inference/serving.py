@@ -1,0 +1,784 @@
+"""ServingEngine — continuous batching over a paged KV-cache block pool.
+
+ref (capability): the reference serving stack's block_multihead_attention
+paged caches + its request-level serving loop; design lineage: Orca
+iteration-level scheduling over vLLM PagedAttention pages. PR 1's
+DecodeEngine made a SINGLE static batch fast (one fused dispatch per
+window, donated caches, zero steady-state retraces) but a request that
+finishes early holds its padded slot until the whole batch drains and
+new requests wait for a full generate() call. This module schedules at
+the ITERATION level instead:
+
+  1. `BlockAllocator` owns a pool of fixed-size KV pages shared by all
+     in-flight requests (free-list alloc/free, page ids recycled
+     LIFO, page 0 reserved as the scratch page inactive rows write to).
+     The device pool arrays are allocated ONCE per engine
+     (`model.init_paged_cache`) and never resized — allocation is pure
+     id bookkeeping, so admitting/retiring a request moves zero cache
+     bytes.
+
+  2. `ServingEngine.step()` is one scheduler iteration over a FIXED-SLOT
+     in-flight batch (`max_slots` rows, shapes never change):
+       - retire/admit: finished rows already freed their pages; queued
+         requests prefill into freshly allocated pages through the
+         bucketed `_paged_prefill` (one compilation per bucket, the
+         PR-1 discipline);
+       - decode: ALL slots advance `decode_window` tokens in ONE fused
+         jitted dispatch (`_serve_window`: a lax.scan whose single-token
+         steps route the model through `cached_attention`'s
+         PagedKVCache branch — the pallas paged kernel on TPU, a gather
+         reference elsewhere), with ONE host sync per window to read
+         the emitted tokens.
+     Because slot count, page-pool shape, and window length are static,
+     requests joining and leaving the batch never change a traced
+     shape: steady-state serving is ZERO retraces (`trace_counts()`,
+     shared with inference.engine, proves it; bench.py gates on it).
+
+  3. Preemption: when the pool runs out of pages mid-decode, the
+     lowest-priority (then youngest) in-flight request is EVICTED — its
+     pages are freed, its prompt + generated prefix goes back to the
+     queue — and later resumes by re-prefilling prompt+prefix (greedy
+     decoding makes the resumed stream exactly the uninterrupted one).
+
+Sampling config is pinned at engine construction (it is part of the
+compilation key), greedy (temperature=0) is the parity-tested path:
+per-request outputs are exactly `DecodeEngine.generate`'s batch-1
+outputs. See docs/serving.md for the scheduler loop and the block-table
+layout.
+"""
+from __future__ import annotations
+
+import functools
+import heapq
+import inspect
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import (COMPILE_CACHE, DEFAULT_BUCKETS, _count_trace,
+                     bucket_length, total_traces, trace_counts)
+
+
+class OutOfBlocks(RuntimeError):
+    """The block pool cannot satisfy an allocation. The ServingEngine
+    catches this and preempts; direct BlockAllocator users see it
+    raised deterministically (need/have in the message)."""
+
+
+class BlockAllocator:
+    """Free-list allocator over a fixed pool of KV-cache pages.
+
+    Pure id bookkeeping: the device page pools live in the engine and
+    are NEVER reallocated — alloc/free hand out integer page ids, so
+    the pool stays pointer-stable across any alloc/free sequence. Page
+    0 is reserved as the scratch page (inactive/frozen slots write
+    there), so usable capacity is num_blocks - 1 and every handed-out
+    id is >= 1. Freed ids are reused LIFO (most-recently-freed first —
+    deterministic, and the hottest pages stay hot)."""
+
+    def __init__(self, num_blocks, block_size):
+        num_blocks = int(num_blocks)
+        if num_blocks < 2:
+            raise ValueError(
+                f'num_blocks must be >= 2 (page 0 is the reserved '
+                f'scratch page), got {num_blocks}')
+        self.num_blocks = num_blocks
+        self.block_size = int(block_size)
+        # LIFO stack, low ids on top: the first alloc after init hands
+        # out 1, 2, ... in order (deterministic, test-friendly)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._held: set = set()
+        self.alloc_count = 0
+        self.free_count = 0
+        self.high_water = 0
+
+    @property
+    def usable(self):
+        return self.num_blocks - 1
+
+    def available(self):
+        return len(self._free)
+
+    def in_use(self):
+        return len(self._held)
+
+    def utilization(self):
+        """Held fraction of the usable pool (scratch page excluded)."""
+        return len(self._held) / max(self.usable, 1)
+
+    def alloc(self, n):
+        """n page ids, or OutOfBlocks (the pool is untouched on
+        failure — no partial allocation to unwind)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f'cannot allocate {n} pages')
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f'need {n} page(s), {len(self._free)} free '
+                f'({len(self._held)}/{self.usable} in use)')
+        pages = [self._free.pop() for _ in range(n)]
+        self._held.update(pages)
+        self.alloc_count += n
+        self.high_water = max(self.high_water, len(self._held))
+        return pages
+
+    def free(self, pages):
+        """Return pages to the free list. Double-frees and foreign ids
+        raise — both are allocator-corruption bugs worth failing on."""
+        pages = list(pages)
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f'page {p} is not currently allocated '
+                    f'(double-free or foreign id)')
+        for p in pages:
+            self._held.discard(p)
+            self._free.append(p)
+        self.free_count += len(pages)
+
+    def stats(self):
+        return {
+            'num_blocks': self.num_blocks,
+            'block_size': self.block_size,
+            'in_use': self.in_use(),
+            'free': self.available(),
+            'utilization': round(self.utilization(), 4),
+            'high_water': self.high_water,
+            'allocs': self.alloc_count,
+            'frees': self.free_count,
+        }
+
+
+class Request:
+    """One serving request. `generated` accumulates committed tokens
+    across admissions (a preempted request keeps its prefix and resumes
+    by re-prefill over prompt + prefix)."""
+
+    __slots__ = ('rid', 'prompt', 'max_new_tokens', 'priority', 'generated',
+                 'seq', 'state', 'admit_seq')
+
+    def __init__(self, rid, prompt, max_new_tokens, priority):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.generated: list = []
+        self.seq = None          # arrival order, stamped by RequestQueue
+        self.admit_seq = None    # last admission order (preemption ties)
+        self.state = 'queued'
+
+    @property
+    def remaining(self):
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def context_len(self):
+        return len(self.prompt) + len(self.generated)
+
+
+class RequestQueue:
+    """Admission queue: higher `priority` first, FIFO within a
+    priority. A preempted request keeps its original arrival seq, so it
+    resumes ahead of later arrivals of the same priority."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._seq = itertools.count()
+
+    def push(self, req):
+        if req.seq is None:
+            req.seq = next(self._seq)
+        if req.state != 'preempted':     # keep eviction observable
+            req.state = 'queued'
+        heapq.heappush(self._heap, (-req.priority, req.seq, req))
+
+    def peek(self):
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self):
+        return heapq.heappop(self._heap)[2]
+
+    def __len__(self):
+        return len(self._heap)
+
+
+# ---------------------------------------------------------------------------
+# Module-level compiled steps (the persistent jit cache, PR-1 style)
+# ---------------------------------------------------------------------------
+
+def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
+    """Bucketed BATCHED admission prefill INTO pages (traced body,
+    shared by the standalone `_paged_prefill` jit and the fused
+    `_serve_step`): run the model once over up to max_slots
+    RIGHT-padded prompts (K, Sb) with a throwaway contiguous cache (the
+    standard causal path — pad rows come after the real tokens, so rows
+    < real_len never see them), then scatter every K/V row into its
+    request's pages: row s of request b lands in page btabs[b, s // BS]
+    slot s % BS, pad and DUMMY rows (real_len == 0) land on the scratch
+    page 0, and each request's next-token logits land in its slot's row
+    of `last_logits` (dummy rows carry slot == SLOTS, dropped by the
+    out-of-bounds scatter). The batch width is FIXED at max_slots and
+    real lengths ride as device data, so one compilation per bucket
+    serves every admission count and every prompt length in the bucket
+    — admitting requests costs one dispatch per (step, bucket), not
+    one per request."""
+    K, Sb = ids.shape
+    tmp = model.init_cache(K, Sb)
+    logits, tmp = model(ids, caches=tmp, cache_index=0)
+    rl = jnp.reshape(jnp.asarray(real_len, jnp.int32), (K,))
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(rl - 1, 0)[:, None, None], axis=1)[:, 0]
+    bs = pages[0].kp.shape[2]
+    maxb = btabs.shape[1]
+    s = jnp.arange(Sb)
+    blk = jnp.minimum(s // bs, maxb - 1)
+    page = jnp.where(s[None, :] < rl[:, None],
+                     jnp.take_along_axis(btabs, blk[None, :], axis=1),
+                     0)                                       # (K, Sb)
+    pflat = page.reshape(-1)
+    sflat = jnp.broadcast_to(s % bs, (K, Sb)).reshape(-1)
+    out_pages = []
+    for (k, v), pc in zip(tmp, pages):
+        rows = (K * Sb,) + k.shape[2:]
+        kp = pc.kp.at[pflat, :, sflat, :].set(
+            k.reshape(rows).astype(pc.kp.dtype))
+        vp = pc.vp.at[pflat, :, sflat, :].set(
+            v.reshape(rows).astype(pc.vp.dtype))
+        out_pages.append(type(pc)(kp, vp))
+    last_logits = last_logits.at[slots].set(
+        last.astype(last_logits.dtype), mode='drop')
+    return last_logits, out_pages
+
+
+def _window_body(model, pages, last_logits, btab, ctx, live, budget,
+                 rng_key, *, window, temperature, top_k, top_p,
+                 eos_token_id):
+    """One decode window for the whole fixed-slot batch as ONE compiled
+    lax.scan (traced body, shared by `_serve_window` and the fused
+    `_serve_step`): per step, sample every slot's next token from the
+    carried logits, step the model over the paged caches (per-row write
+    positions = ctx, attention through the block tables), advance the
+    committed length of live rows. Rows freeze when they hit eos, burn
+    their budget, or were never live (empty slots): frozen rows still
+    ride through the static-shape forward but write only to their
+    frozen position / the scratch page and commit nothing — exactly how
+    requests leave the batch without changing a traced shape. Returns
+    (tokens (SLOTS, window), last_logits, pages, ctx); the host reads
+    the tokens ONCE per window and does all bookkeeping there."""
+
+    def sample(logits, key):
+        from ..models.generation import filter_logits
+
+        logits = filter_logits(
+            logits.astype(jnp.float32) / temperature, top_k, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    pad_tok = eos_token_id if eos_token_id is not None else 0
+
+    def step(carry, t):
+        last_logits, pages, ctx, finished, key = carry
+        if temperature == 0.0:
+            tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            tok = sample(last_logits, sub)
+        frozen = finished | (t >= budget)
+        tok = jnp.where(frozen, jnp.asarray(pad_tok, tok.dtype), tok)
+        commit = ~frozen
+        if eos_token_id is not None:
+            finished = finished | (commit & (tok == eos_token_id))
+        logits, pages = model(tok[:, None], caches=pages,
+                              kv_write_pos=ctx, block_tables=btab)
+        ctx = ctx + commit.astype(jnp.int32)
+        return (logits[:, -1, :], pages, ctx, finished, key), tok
+
+    state = (last_logits, pages, jnp.asarray(ctx, jnp.int32), ~live,
+             rng_key)
+    (last_logits, pages, ctx, _, _), toks = jax.lax.scan(
+        step, state, jnp.arange(window, dtype=jnp.int32))
+    return toks.T, last_logits, pages, ctx
+
+
+@functools.partial(jax.jit, donate_argnames=('pages', 'last_logits'))
+def _paged_prefill(model, pages, last_logits, ids, real_len, btabs, slots):
+    """Standalone admission prefill (see _prefill_body) — used only for
+    the rare step that admits across SEVERAL buckets at once; the first
+    (largest) bucket group rides fused inside _serve_step."""
+    _count_trace('serve_prefill')
+    return _prefill_body(model, pages, last_logits, ids, real_len, btabs,
+                         slots)
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('pages', 'last_logits'),
+    static_argnames=('window', 'temperature', 'top_k', 'top_p',
+                     'eos_token_id'))
+def _serve_window(model, pages, last_logits, btab, ctx, live, budget,
+                  rng_key, *, window, temperature, top_k, top_p,
+                  eos_token_id):
+    """A pure decode window (no admissions this step): see
+    _window_body."""
+    _count_trace('serve_window')
+    return _window_body(model, pages, last_logits, btab, ctx, live,
+                        budget, rng_key, window=window,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id)
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('pages', 'last_logits'),
+    static_argnames=('window', 'temperature', 'top_k', 'top_p',
+                     'eos_token_id'))
+def _serve_step(model, pages, last_logits, ids, real_len, btabs, slots,
+                btab, ctx, live, budget, rng_key, *, window, temperature,
+                top_k, top_p, eos_token_id):
+    """THE scheduler iteration as one fused jitted dispatch: freshly
+    admitted rows bucket-prefill into their newly allocated pages
+    (_prefill_body), then every slot — new and old — decodes a window
+    through the paged kernel (_window_body). One compilation per
+    (bucket, window) pair covers every admission count; a step with no
+    admissions uses _serve_window instead."""
+    _count_trace('serve_step')
+    last_logits, pages = _prefill_body(model, pages, last_logits, ids,
+                                       real_len, btabs, slots)
+    return _window_body(model, pages, last_logits, btab, ctx, live,
+                        budget, rng_key, window=window,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id)
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class ServingEngine:
+    """Continuous-batching serving over one model.
+
+        engine = ServingEngine(model, max_slots=8, num_blocks=...,
+                               max_new_tokens=64, eos_token_id=2)
+        rid = engine.submit(prompt_ids)          # 1-D int array
+        engine.run()                             # drain queue + batch
+        out = engine.result(rid)                 # (S + max_new,) ids
+
+        outs = engine.serve(list_of_prompts)     # submit+run+collect
+
+    Greedy outputs per request are exactly `DecodeEngine.generate`'s
+    batch-1 outputs (eos-padded to max_new_tokens, prompt echoed back).
+    The model must accept `block_tables` in its cached forward (the
+    Llama family does) and must not use sliding-window attention.
+    """
+
+    def __init__(self, model, max_slots=8, block_size=16, num_blocks=None,
+                 max_context_len=None, max_new_tokens=32, decode_window=8,
+                 temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 buckets=None):
+        params = inspect.signature(model.forward).parameters
+        if 'block_tables' not in params:
+            raise NotImplementedError(
+                f'{type(model).__name__} lacks block_tables in its '
+                f'cached forward: paged serving needs the Llama-family '
+                f'cached_attention; use DecodeEngine for this model')
+        if getattr(getattr(model, 'config', None), 'sliding_window',
+                   None) is not None:
+            raise NotImplementedError(
+                'sliding-window models are not paged-servable yet: the '
+                'paged kernel has no window fast path — use DecodeEngine')
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_new_tokens = int(max_new_tokens)
+        self.decode_window = int(decode_window)
+        if self.decode_window < 1 or self.max_slots < 1:
+            raise ValueError('decode_window and max_slots must be >= 1')
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = (int(eos_token_id) if eos_token_id is not None
+                             else None)
+        self.buckets = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        if max_context_len is None:
+            mp = getattr(getattr(model, 'config', None),
+                         'max_position_embeddings', None)
+            max_context_len = int(mp) if mp else 2048
+        self.max_context_len = int(max_context_len)
+        self.max_blocks_per_seq = _ceil_div(self.max_context_len,
+                                            self.block_size)
+        if num_blocks is None:
+            # full coverage: every slot can hold a max-length request
+            # (+1 for the reserved scratch page); pass a smaller pool to
+            # actually exercise preemption
+            num_blocks = self.max_slots * self.max_blocks_per_seq + 1
+        self.allocator = BlockAllocator(num_blocks, self.block_size)
+        self.queue = RequestQueue()
+
+        # device state, allocated ONCE (shapes never change)
+        self._pages = model.init_paged_cache(num_blocks, self.block_size)
+        vocab = model.config.vocab_size
+        self._last_logits = jnp.zeros((self.max_slots, vocab),
+                                      model.cache_dtype())
+        self._rng = jax.random.PRNGKey(0)
+
+        # host-authoritative per-slot state (device copies ride in as
+        # small int32/bool args each window)
+        self._slot_req: list = [None] * self.max_slots
+        self._slot_pages: list = [[] for _ in range(self.max_slots)]
+        self._btab = np.zeros((self.max_slots, self.max_blocks_per_seq),
+                              np.int32)
+        self._ctx = np.zeros((self.max_slots,), np.int32)
+        self._budget = np.zeros((self.max_slots,), np.int32)
+        # device mirror of (btab, ctx, live): rebuilt only when a slot
+        # changes (admission/retire/preempt/page top-up); between those
+        # the window's returned ctx is carried device-resident, so a
+        # steady-state window uploads ONE small array (the budgets)
+        self._dev = None
+
+        self._results: dict = {}
+        self._rid = itertools.count()
+        self._admit_seq = itertools.count()
+        self.preemption_count = 0
+        self._tokens_out = 0
+        self._serve_time = 0.0
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _sampling_key(self):
+        return (self.max_new_tokens, self.temperature, self.top_k,
+                self.top_p, self.eos_token_id)
+
+    def _geometry(self):
+        return ('paged', self.max_slots, self.allocator.num_blocks,
+                self.block_size, self.max_blocks_per_seq)
+
+    def _note(self, *tag):
+        """Record one engine-level registry key (the shared recipe:
+        pool shape + dtype + sampling config + `tag` + geometry)."""
+        COMPILE_CACHE.note(COMPILE_CACHE.key(
+            self.model, self._pages[0].kp.shape, self.model.cache_dtype(),
+            self._sampling_key() + tag, geometry=self._geometry()))
+
+    def in_flight(self):
+        return sum(r is not None for r in self._slot_req)
+
+    def stats(self):
+        """Serving observability: throughput, occupancy, pool
+        utilization, scheduling counters, and the shared retrace
+        counters (steady-state serving must hold total_traces flat —
+        bench.py's gate_serve_retrace_zero asserts it)."""
+        return {
+            'trace_counts': trace_counts(),
+            'total_traces': total_traces(),
+            'tokens_generated': self._tokens_out,
+            'tokens_per_s': (self._tokens_out / self._serve_time
+                             if self._serve_time > 0 else 0.0),
+            'in_flight': self.in_flight(),
+            'queue_depth': len(self.queue),
+            'preemptions': self.preemption_count,
+            'blocks': self.allocator.stats(),
+            'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
+                         'block_size': self.block_size,
+                         'num_blocks': self.allocator.num_blocks,
+                         'max_blocks_per_seq': self.max_blocks_per_seq,
+                         'decode_window': self.decode_window},
+        }
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=None, priority=0):
+        """Queue one request; returns its id for `result()`. Validated
+        against the pool so an undeliverable request fails HERE, not as
+        a livelock mid-serve."""
+        mnt = (self.max_new_tokens if max_new_tokens is None
+               else int(max_new_tokens))
+        if mnt < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        req = Request(next(self._rid), prompt, mnt, priority)
+        if len(req.prompt) == 0:
+            raise ValueError('empty prompt')
+        total = len(req.prompt) + mnt
+        if total > self.max_context_len:
+            raise ValueError(
+                f'prompt + max_new_tokens = {total} exceeds '
+                f'max_context_len {self.max_context_len}')
+        if _ceil_div(total, self.block_size) > self.allocator.usable:
+            raise ValueError(
+                f'request needs {_ceil_div(total, self.block_size)} '
+                f'pages but the pool only has {self.allocator.usable} '
+                f'usable — grow num_blocks')
+        self.queue.push(req)
+        return req.rid
+
+    def result(self, rid):
+        """(prompt + max_new_tokens) ids for a finished request (eos-
+        padded past an early stop, matching DecodeEngine.generate);
+        None while pending. The output is handed over ONCE — it is
+        removed from the engine on retrieval, so a long-running server
+        does not accumulate one array per request ever served."""
+        return self._results.pop(rid, None)
+
+    def serve(self, prompts, max_new_tokens=None):
+        """Submit + run + collect, preserving submission order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run()
+        return [self._results.pop(r) for r in rids]
+
+    def run(self, max_steps=None):
+        """Step until queue and batch drain (or max_steps)."""
+        steps = 0
+        while len(self.queue) or self.in_flight():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    # -- the scheduler iteration -------------------------------------------
+
+    def step(self):
+        """One iteration: admit queued requests into free slots, top up
+        pages for the coming window (preempting if the pool is dry),
+        then run ONE fused jitted dispatch — admission prefill into the
+        fresh pages composed with a decode window over ALL slots
+        (_serve_step; _serve_window when nothing was admitted) — and
+        finally commit tokens / retire finished rows from the single
+        per-window host read. Returns the requests that finished this
+        step."""
+        t0 = time.perf_counter()
+        groups = self._admit()
+        if not self.in_flight():
+            self._serve_time += time.perf_counter() - t0
+            return []
+        self._ensure_window_pages()
+        # the top-up above may have preempted a just-admitted request:
+        # drop it from the prefill groups (its slot is parked on the
+        # scratch page; it re-prefills when re-admitted)
+        kept = []
+        for Sb, g in groups:
+            g = [(s, r) for s, r in g if self._slot_req[s] is r]
+            if g:
+                kept.append((Sb, g))
+        groups = kept
+        W = self.decode_window
+        if self.temperature != 0.0:
+            self._rng, sub = jax.random.split(self._rng)
+        else:
+            sub = self._rng               # unused inside a greedy trace
+        # admissions beyond the first bucket group (rare: a step that
+        # admits across buckets) prefill standalone; the first group
+        # rides inside the fused step
+        for Sb, group in groups[1:]:
+            self._prefill_group(Sb, group)
+        dev = self._device_state()
+        budget = jnp.asarray(self._budget)      # shrinks every window
+        common = dict(window=W, temperature=self.temperature,
+                      top_k=self.top_k, top_p=self.top_p,
+                      eos_token_id=self.eos_token_id)
+        if groups:
+            Sb, group = groups[0]
+            ids, real_len, btabs, slots = self._prefill_args(Sb, group)
+            self._note('serve_step', W, Sb)
+            toks, self._last_logits, self._pages, ctx_out = _serve_step(
+                self.model, self._pages, self._last_logits, ids, real_len,
+                btabs, slots, dev['btab'], dev['ctx'], dev['live'],
+                budget, sub, **common)
+        else:
+            self._note('serve_window', W)
+            toks, self._last_logits, self._pages, ctx_out = _serve_window(
+                self.model, self._pages, self._last_logits,
+                dev['btab'], dev['ctx'], dev['live'], budget, sub,
+                **common)
+        # the returned ctx equals the host's post-commit view whenever
+        # no slot is retired below (retiring invalidates the mirror)
+        dev['ctx'] = ctx_out
+        # ONE batched host read per window — the scheduler needs the
+        # emitted tokens to detect eos/budget and refill the batch; all
+        # other state is host-authoritative.
+        # tracelint: disable=TL002 - single sync per window by design
+        tokens = np.asarray(jax.device_get(toks))
+        finished = []
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            take = min(W, req.remaining)
+            committed = []
+            for t in range(take):
+                tok = int(tokens[slot, t])
+                committed.append(tok)
+                if self.eos_token_id is not None and tok == self.eos_token_id:
+                    break
+            req.generated.extend(committed)
+            self._ctx[slot] += len(committed)
+            # keep the device-side freeze live: next window's budget is
+            # the CURRENT remaining, so a continuing row can never
+            # commit past its max_new on device and ctx_out stays equal
+            # to the host view
+            self._budget[slot] = req.remaining
+            self._tokens_out += len(committed)
+            done = (req.remaining == 0
+                    or (self.eos_token_id is not None and committed
+                        and committed[-1] == self.eos_token_id))
+            if done:
+                self._finish(slot, req)
+                finished.append(req)
+        self._serve_time += time.perf_counter() - t0
+        return finished
+
+    # -- internals ---------------------------------------------------------
+
+    def _free_slots(self):
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _device_state(self):
+        """Device copies of the per-slot scheduler state, cached until
+        a slot mutation invalidates them (self._dev = None)."""
+        if self._dev is None:
+            self._dev = {
+                'btab': jnp.asarray(self._btab),
+                'ctx': jnp.asarray(self._ctx),
+                'live': jnp.asarray(
+                    np.asarray([r is not None for r in self._slot_req])),
+            }
+        return self._dev
+
+    def _admit(self):
+        """Fill free slots from the queue head (priority order — a head
+        that cannot get its prefill pages waits, no barging past it).
+        Returns this step's admissions grouped by prefill bucket,
+        LARGEST group first (that one rides fused inside _serve_step;
+        the batch width is pinned at max_slots with dummy rows masked
+        to the scratch page, so the admission count never changes a
+        traced shape)."""
+        free = self._free_slots()
+        placed = []
+        while free and len(self.queue):
+            req = self.queue.peek()
+            need = _ceil_div(req.context_len, self.block_size)
+            if need > self.allocator.available():
+                break
+            self.queue.pop()
+            slot = free.pop(0)
+            pages = self.allocator.alloc(need)
+            self._place(slot, req, pages)
+            placed.append((slot, req))
+        by_bucket: dict = {}
+        for slot, req in placed:
+            Sb = bucket_length(req.context_len, self.buckets)
+            by_bucket.setdefault(Sb, []).append((slot, req))
+        return sorted(by_bucket.items(), key=lambda kv: -len(kv[1]))
+
+    def _place(self, slot, req, pages):
+        """Arm a slot (host bookkeeping only; the batched prefill in
+        `_admit` moves the actual KV rows)."""
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        self._btab[slot] = 0
+        self._btab[slot, :len(pages)] = pages
+        self._ctx[slot] = req.context_len
+        self._budget[slot] = req.remaining
+        self._dev = None
+        req.state = 'running'
+        req.admit_seq = next(self._admit_seq)
+
+    def _prefill_args(self, Sb, group):
+        """Device args for one fixed-width admission-prefill batch
+        (all of `group` shares bucket Sb; at most max_slots members —
+        one per free slot). Rows beyond the group are dummies: real_len
+        0 (their K/V land on the scratch page) and slot index SLOTS
+        (their logits row is dropped by the OOB scatter)."""
+        K = self.max_slots
+        ids = np.zeros((K, Sb), np.int32)
+        real_len = np.zeros((K,), np.int32)
+        btabs = np.zeros((K, self.max_blocks_per_seq), np.int32)
+        slots = np.full((K,), self.max_slots, np.int32)      # dummy: drop
+        for i, (slot, req) in enumerate(group):
+            toks = np.concatenate([req.prompt,
+                                   np.asarray(req.generated, np.int32)])
+            ids[i, :len(toks)] = toks                        # RIGHT-pad
+            real_len[i] = len(toks)
+            btabs[i] = self._btab[slot]
+            slots[i] = slot
+        return (jnp.asarray(ids), jnp.asarray(real_len),
+                jnp.asarray(btabs), jnp.asarray(slots))
+
+    def _prefill_group(self, Sb, group):
+        """Standalone prefill dispatch for an admission group that did
+        not fit the fused step (multi-bucket admission steps)."""
+        ids, real_len, btabs, slots = self._prefill_args(Sb, group)
+        self._note('serve_prefill', Sb)
+        self._last_logits, self._pages = _paged_prefill(
+            self.model, self._pages, self._last_logits, ids, real_len,
+            btabs, slots)
+
+    def _ensure_window_pages(self):
+        """Every live slot must own pages covering the positions the
+        coming window can write (ctx .. ctx + min(window, remaining)).
+        A dry pool preempts the lowest-priority / youngest victim until
+        the top-up fits (the needy slot may evict itself)."""
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            target = _ceil_div(
+                int(self._ctx[slot]) + min(self.decode_window,
+                                           req.remaining),
+                self.block_size)
+            while (self._slot_req[slot] is req
+                   and target > len(self._slot_pages[slot])):
+                try:
+                    new = self.allocator.alloc(
+                        target - len(self._slot_pages[slot]))
+                except OutOfBlocks:
+                    self._preempt_one()
+                    continue
+                pages = self._slot_pages[slot]
+                self._btab[slot, len(pages):len(pages) + len(new)] = new
+                pages.extend(new)
+                self._dev = None
+
+    def _preempt_one(self):
+        """Evict the lowest-priority (then youngest) in-flight request:
+        free its pages, park the slot on the scratch page, requeue the
+        request WITH its generated prefix (it resumes by re-prefill —
+        greedy decoding makes the resumed stream identical to an
+        uninterrupted one)."""
+        victims = [(req.priority, -req.admit_seq, slot)
+                   for slot, req in enumerate(self._slot_req)
+                   if req is not None]
+        if not victims:
+            raise OutOfBlocks(
+                'block pool exhausted with no in-flight request to '
+                'preempt — grow num_blocks')
+        _, _, slot = min(victims)
+        req = self._slot_req[slot]
+        self._clear_slot(slot)
+        req.state = 'preempted'
+        self.preemption_count += 1
+        self.queue.push(req)
+
+    def _finish(self, slot, req):
+        req.state = 'finished'
+        pad = self.eos_token_id if self.eos_token_id is not None else 0
+        gen = (req.generated
+               + [pad] * (req.max_new_tokens - len(req.generated)))
+        self._results[req.rid] = np.concatenate(
+            [req.prompt, np.asarray(gen, req.prompt.dtype)])
+        self._clear_slot(slot)
+
+    def _clear_slot(self, slot):
+        self.allocator.free(self._slot_pages[slot])
+        self._slot_req[slot] = None
+        self._slot_pages[slot] = []
+        self._btab[slot] = 0
+        self._ctx[slot] = 0
+        self._budget[slot] = 0
+        self._dev = None
+
+
+__all__ = ['ServingEngine', 'BlockAllocator', 'RequestQueue', 'Request',
+           'OutOfBlocks']

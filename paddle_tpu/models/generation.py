@@ -88,6 +88,23 @@ class QuantKVCache(typing.NamedTuple):
     vscale: jax.Array    # f32 (Hkv, D)
 
 
+class PagedKVCache(typing.NamedTuple):
+    """Paged (block-table) KV cache for continuous-batching serving
+    (ref capability: the reference serving stack's
+    block_multihead_attention pages; design: vLLM PagedAttention). K/V
+    live as a POOL of fixed-size pages (num_blocks, Hkv, block_size, D)
+    shared by every in-flight request; a per-request block table maps
+    logical block j of the sequence to a physical page id. Page 0 is
+    reserved as the SCRATCH page (inactive/finished rows write there
+    harmlessly), so allocators hand out ids >= 1 — see
+    inference/serving.py::BlockAllocator. Decode steps route through
+    `cached_attention(..., block_tables=...)`, which dispatches the
+    fused pallas paged kernel on TPU and a gather reference elsewhere."""
+
+    kp: jax.Array        # (num_blocks, Hkv, block_size, D) pages
+    vp: jax.Array        # (num_blocks, Hkv, block_size, D) pages
+
+
 def quantize_kv_rows(x, scale):
     """Symmetric int8 quantization of new K/V rows (B, S, Hkv, D) with
     per-(head, dim) scales; saturates rows that exceed the prefill
@@ -192,6 +209,25 @@ class GenerationMixin:
                                  make_scale())
                     for _ in range(cfg.num_hidden_layers)]
         return [(make(), make()) for _ in range(cfg.num_hidden_layers)]
+
+    def init_paged_cache(self, num_blocks, block_size, dtype=None):
+        """Per-layer PagedKVCache pools of (num_blocks, kv_heads,
+        block_size, head_dim) zero pages. The pool is request-agnostic:
+        the ServingEngine's BlockAllocator hands page ids to requests
+        and the per-request block tables ride into each decode step as
+        device data (inference/serving.py). Page 0 is the reserved
+        scratch page, so a usable pool needs num_blocks >= 2."""
+        cfg = self.config
+        head_dim = getattr(cfg, 'head_dim', None)
+        if head_dim is None:
+            head_dim = cfg.hidden_size // cfg.num_attention_heads
+        kv_heads = (getattr(cfg, 'num_key_value_heads', None)
+                    or cfg.num_attention_heads)
+        dtype = dtype or self.cache_dtype()
+        shape = (int(num_blocks), kv_heads, int(block_size), head_dim)
+        return [PagedKVCache(jnp.zeros(shape, dtype),
+                             jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_hidden_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng_key=None, eos_token_id=None, num_beams=1,

@@ -31,7 +31,7 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer.base import Layer, Parameter
-from .generation import GenerationMixin
+from .generation import GenerationMixin, PagedKVCache
 
 
 @dataclasses.dataclass
@@ -194,7 +194,8 @@ def apply_rotary(x, cos, sin):
 # ---------------------------------------------------------------------------
 
 def cached_attention(q, k, v, cache, cache_index, kvalid=None,
-                     kv_start=None, kv_write_pos=None, window=None):
+                     kv_start=None, kv_write_pos=None, window=None,
+                     block_tables=None):
     """Shared KV-cached attention step (LlamaAttention, GPTAttention):
     write the S new rows at cache_index, attend over the full cache
     masked by position; single-token steps dispatch to the fused pallas
@@ -216,10 +217,25 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     A QuantKVCache stores K/V int8 with per-(head, dim) scales: prefill
     (S > 1) calibrates the scales from its own rows, decode steps
     quantize against them; attention dequantizes (in-kernel on the
-    pallas path, whole-cache on the XLA fallback)."""
-    from .generation import QuantKVCache, calibrate_kv_scale, quantize_kv_rows
+    pallas path, whole-cache on the XLA fallback).
+
+    A PagedKVCache (with `block_tables` (B, MAXB) int32) is the
+    continuous-batching serving layout: the new K/V row of batch row b
+    lands in page block_tables[b, wp // BS] slot wp % BS (wp =
+    kv_write_pos[b], required), and attention streams exactly the pages
+    the row occupies — the fused pallas paged kernel on TPU
+    (ops/pallas/paged_attention.py, block table scalar-prefetched into
+    the BlockSpec index map), a gather reference elsewhere. Decode-only
+    (S == 1); rows whose table entry is 0 write to the reserved scratch
+    page (inference/serving.py parks inactive slots there)."""
+    from .generation import (PagedKVCache, QuantKVCache,
+                             calibrate_kv_scale, quantize_kv_rows)
 
     B, S, H, D = q.shape
+    if isinstance(cache, PagedKVCache):
+        return _paged_cached_attention(q, k, v, cache, kv_write_pos,
+                                       block_tables, window, kvalid,
+                                       kv_start)
     if kv_write_pos is not None:
         wp = jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,))
         wp = jnp.broadcast_to(wp, (B,))
@@ -370,6 +386,77 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     return out, new_cache
 
 
+def _paged_cached_attention(q, k, v, cache, kv_write_pos, block_tables,
+                            window, kvalid, kv_start):
+    """Single-token decode over a PagedKVCache: scatter the new row
+    into its page, then attend over the row's pages masked by the
+    per-row valid length (kv_write_pos + 1). See cached_attention."""
+    B, S, H, D = q.shape
+    if kvalid is not None or kv_start is not None:
+        # these are masking CONTRACTS on the other branches — dropping
+        # them silently would attend pad rows; paged serving right-pads
+        # at prefill so neither is ever needed (positions [0, wp) are
+        # always exactly the live tokens)
+        raise NotImplementedError(
+            'kvalid/kv_start are not supported with a PagedKVCache: '
+            'paged prefill is right-padded, so the valid window is '
+            'always [0, kv_write_pos) with no pad hole to mask')
+    if S != 1:
+        raise NotImplementedError(
+            'PagedKVCache is decode-only (S == 1): prefill scatters '
+            'whole prompts into pages via '
+            'inference.serving._paged_prefill, and speculative windows '
+            'are not paged yet')
+    if kv_write_pos is None or block_tables is None:
+        raise ValueError(
+            'PagedKVCache needs kv_write_pos (per-row write positions) '
+            'and block_tables (per-row page ids)')
+    if window is not None:
+        raise NotImplementedError(
+            'sliding-window attention over a paged cache is not '
+            'supported: serve SWA models through the contiguous '
+            'DecodeEngine path')
+    kp, vp = cache
+    NB, Hkv, BS, _ = kp.shape
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    maxb = tbl.shape[1]
+    wp = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(kv_write_pos, jnp.int32), (-1,)), (B,))
+    rows = jnp.arange(B)
+    # frozen rows can sit one position past their last allocated page:
+    # clamp the COLUMN (the scheduler parks such rows on table entry 0,
+    # the scratch page, so the clamped write stays harmless)
+    page = tbl[rows, jnp.minimum(wp // BS, maxb - 1)]
+    slot = wp % BS
+    kp = kp.at[page, :, slot, :].set(k[:, 0].astype(kp.dtype))
+    vp = vp.at[page, :, slot, :].set(v[:, 0].astype(vp.dtype))
+    new_cache = PagedKVCache(kp, vp)
+    counts = wp + 1
+    out = None
+    if D % 8 == 0:
+        from ..ops import use_pallas
+
+        if use_pallas():
+            try:
+                from ..ops.pallas.paged_attention import (
+                    paged_decode_attention)
+
+                out = paged_decode_attention(q, kp, vp, tbl, counts)
+            except Exception as e:
+                from ..ops import pallas_failed
+
+                pallas_failed('paged_attention', e)
+    if out is None:
+        # gather reference (CPU tests / non-TPU): pages -> a contiguous
+        # (B, MAXB*BS, Hkv, D) view, masked by per-row valid length
+        ck = jnp.swapaxes(kp[tbl], 2, 3).reshape(B, maxb * BS, Hkv, D)
+        cv = jnp.swapaxes(vp[tbl], 2, 3).reshape(B, maxb * BS, Hkv, D)
+        mask = (jnp.arange(maxb * BS)[None, :]
+                < counts[:, None])[:, None, None, :]
+        out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)
+    return out, new_cache
+
+
 class LlamaAttention(Layer):
     """GQA attention with RoPE. Column-parallel QKV, row-parallel output."""
 
@@ -425,7 +512,7 @@ class LlamaAttention(Layer):
 
     def forward(self, x, positions, attn_mask=None, cache=None,
                 cache_index=None, kvalid=None, kv_start=None,
-                kv_write_pos=None):
+                kv_write_pos=None, block_tables=None):
         """x: (B, S, H). cache: optional (k, v) of (B, max_len, Hkv, D).
 
         Returns (out, new_cache). With a cache, writes the S new kv rows at
@@ -517,7 +604,8 @@ class LlamaAttention(Layer):
                                               kvalid=kvalid,
                                               kv_start=kv_start,
                                               kv_write_pos=kv_write_pos,
-                                              window=self.sliding_window)
+                                              window=self.sliding_window,
+                                              block_tables=block_tables)
 
         out = out.reshape(B, S, self.num_heads * self.head_dim)
         return out @ self.o_proj, new_cache
@@ -548,10 +636,11 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, positions, attn_mask=None, cache=None,
                 cache_index=None, kvalid=None, kv_start=None,
-                kv_write_pos=None):
+                kv_write_pos=None, block_tables=None):
         attn_out, new_cache = self.self_attn(
             self.input_layernorm(x), positions, attn_mask, cache,
-            cache_index, kvalid, kv_start, kv_write_pos
+            cache_index, kvalid, kv_start, kv_write_pos,
+            block_tables=block_tables
         )
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
@@ -580,7 +669,7 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
                 cache_index=None, kvalid=None, kv_start=None,
-                kv_write_pos=None):
+                kv_write_pos=None, block_tables=None):
         B, S = input_ids.shape
         if positions is None:
             from .generation import default_positions
@@ -608,7 +697,8 @@ class LlamaModel(Layer):
                 nc = None
             else:
                 x, nc = layer(x, positions, attn_mask, cache, cache_index,
-                              kvalid, kv_start, kv_write_pos)
+                              kvalid, kv_start, kv_write_pos,
+                              block_tables=block_tables)
             if new_caches is not None:
                 new_caches.append(nc)
         return self.norm(x), new_caches
@@ -638,10 +728,10 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def forward(self, input_ids, positions=None, attn_mask=None, caches=None,
                 cache_index=None, kvalid=None, kv_start=None,
-                kv_write_pos=None):
+                kv_write_pos=None, block_tables=None):
         hidden, new_caches = self.model(input_ids, positions, attn_mask, caches,
                                         cache_index, kvalid, kv_start,
-                                        kv_write_pos)
+                                        kv_write_pos, block_tables)
         logits = self.logits(hidden)
         if caches is None:
             return logits
