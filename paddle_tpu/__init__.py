@@ -81,6 +81,7 @@ from . import sparse  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 from .utils.flops import flops  # noqa: F401
 from . import static  # noqa: F401

@@ -61,6 +61,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _obs
+from ..observability import tracing as _obs_trace
+
 # ---------------------------------------------------------------------------
 # Compile accounting: retrace counters + the keyed registry
 # ---------------------------------------------------------------------------
@@ -70,8 +73,13 @@ _TRACE_COUNTS: collections.Counter = collections.Counter()
 
 def _count_trace(name):
     """Called from INSIDE to-be-jitted python bodies: runs only while
-    tracing, so the counter is exactly the number of (re)compilations."""
+    tracing, so the counter is exactly the number of (re)compilations.
+    Each firing is also a `compile.traces` tick in the process-global
+    metrics registry and a `trace:<name>` instant on the host trace
+    (observability's compile/retrace event accounting)."""
     _TRACE_COUNTS[name] += 1
+    _obs.inc('compile.traces')
+    _obs_trace.compile_event(f'trace:{name}')
 
 
 def trace_counts():
@@ -124,9 +132,11 @@ class CompileCache:
     def note(self, key):
         if key in self._keys:
             self.hits += 1
+            _obs.inc('compile.cache_hits')
             return True
         self._keys[key] = total_traces()
         self.misses += 1
+        _obs.inc('compile.cache_misses')
         return False
 
     def keys(self):

@@ -58,6 +58,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _obs
+from ..observability import tracing as _obs_trace
 from .engine import (COMPILE_CACHE, DEFAULT_BUCKETS, _count_trace,
                      bucket_length, total_traces, trace_counts)
 
@@ -94,6 +96,11 @@ class BlockAllocator:
         self.alloc_count = 0
         self.free_count = 0
         self.high_water = 0
+        # device bytes one page costs across ALL layers (k + v), set by
+        # the owning engine from the real pool arrays (the allocator
+        # itself only moves ids); stats() reports real-unit pool sizes
+        # once it is known
+        self.bytes_per_page = None
 
     @property
     def usable(self):
@@ -140,7 +147,7 @@ class BlockAllocator:
         self.free_count += len(pages)
 
     def stats(self):
-        return {
+        s = {
             'num_blocks': self.num_blocks,
             'block_size': self.block_size,
             'in_use': self.in_use(),
@@ -150,15 +157,32 @@ class BlockAllocator:
             'allocs': self.alloc_count,
             'frees': self.free_count,
         }
+        if self.bytes_per_page:
+            # real units: page counts x per-page KV bytes across all
+            # layers and both of k/v, at the pool dtype — what an HBM
+            # budget is actually written in
+            bpp = int(self.bytes_per_page)
+            s['bytes_per_page'] = bpp
+            s['bytes_total'] = self.num_blocks * bpp
+            s['bytes_in_use'] = self.in_use() * bpp
+            s['bytes_high_water'] = self.high_water * bpp
+        return s
 
 
 class Request:
     """One serving request. `generated` accumulates committed tokens
     across admissions (a preempted request keeps its prefix and resumes
-    by re-prefill over prompt + prefix)."""
+    by re-prefill over prompt + prefix).
+
+    `times` is the lifecycle trail: (event, perf_counter) pairs stamped
+    at arrival / enqueued / admitted / prefill_dispatch / first_token /
+    window / preempted / finished — always at points the host already
+    owns (submission, scheduling, the one per-window commit sync), so
+    collecting them costs no device round trip. The engine rolls them
+    into the registry's ttft/itl/queue-wait histograms."""
 
     __slots__ = ('rid', 'prompt', 'max_new_tokens', 'priority', 'generated',
-                 'seq', 'state', 'admit_seq')
+                 'seq', 'state', 'admit_seq', 'times', 'enqueued_at')
 
     def __init__(self, rid, prompt, max_new_tokens, priority):
         self.rid = rid
@@ -169,6 +193,25 @@ class Request:
         self.seq = None          # arrival order, stamped by RequestQueue
         self.admit_seq = None    # last admission order (preemption ties)
         self.state = 'queued'
+        self.times: list = []
+        self.enqueued_at = None
+
+    def mark(self, event, t=None):
+        """Append one lifecycle timestamp (no-op while telemetry is
+        off, so a disabled server keeps zero per-request overhead).
+        Callers that already hold a fresh perf_counter (the window
+        commit loop stamps every slot at one instant) pass it as `t`
+        instead of re-reading the clock per request."""
+        if _obs.enabled():
+            self.times.append(
+                (event, time.perf_counter() if t is None else t))
+
+    def when(self, event):
+        """First timestamp for `event`, or None."""
+        for e, t in self.times:
+            if e == event:
+                return t
+        return None
 
     @property
     def remaining(self):
@@ -193,6 +236,10 @@ class RequestQueue:
             req.seq = next(self._seq)
         if req.state != 'preempted':     # keep eviction observable
             req.state = 'queued'
+        # queue-wait accounting starts here (covers first arrival AND
+        # every preemption requeue — a resumed request waits again)
+        req.enqueued_at = time.perf_counter()
+        req.mark('enqueued', req.enqueued_at)
         heapq.heappush(self._heap, (-req.priority, req.seq, req))
 
     def peek(self):
@@ -420,6 +467,12 @@ class ServingEngine:
 
         # device state, allocated ONCE (shapes never change)
         self._pages = model.init_paged_cache(num_blocks, self.block_size)
+        # real-unit pool accounting: one page costs k+v bytes per layer
+        # at the pool dtype (pages x page_bytes x layers x dtype) —
+        # threaded into allocator.stats() and the pool.* gauges
+        self.allocator.bytes_per_page = int(sum(
+            2 * int(np.prod(pc.kp.shape[1:])) * pc.kp.dtype.itemsize
+            for pc in self._pages))
         vocab = model.config.vocab_size
         self._last_logits = jnp.zeros((self.max_slots, vocab),
                                       model.cache_dtype())
@@ -445,6 +498,15 @@ class ServingEngine:
         self.preemption_count = 0
         self._tokens_out = 0
         self._serve_time = 0.0
+        # telemetry hot-path caches: metric handles (refreshed when the
+        # registry generation changes, i.e. after a reset) and the last
+        # occupancy tuple (gauges re-set only when it moves) — keeps
+        # per-step recording to a handful of attribute writes so the
+        # 3% overhead gate holds even on tiny/fast models
+        self._mgen = -1
+        self._mx = None
+        self._last_occ = None
+        self._update_gauges()
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -458,10 +520,57 @@ class ServingEngine:
 
     def _note(self, *tag):
         """Record one engine-level registry key (the shared recipe:
-        pool shape + dtype + sampling config + `tag` + geometry)."""
-        COMPILE_CACHE.note(COMPILE_CACHE.key(
+        pool shape + dtype + sampling config + `tag` + geometry).
+        Returns the registry verdict — True on hit, False when the key
+        is NEW (this dispatch pays trace + compile; step() turns that
+        into a compile span with the measured wall duration)."""
+        return COMPILE_CACHE.note(COMPILE_CACHE.key(
             self.model, self._pages[0].kp.shape, self.model.cache_dtype(),
             self._sampling_key() + tag, geometry=self._geometry()))
+
+    def _metrics(self):
+        """Cached registry handles for the hot per-step records (the
+        generation check makes a registry reset() safe: stale handles
+        are re-resolved instead of written into orphaned objects)."""
+        R = _obs.REGISTRY
+        if self._mgen != R.generation:
+            self._mx = {
+                'ttft': R.histogram('serve.ttft_ms'),
+                'itl': R.histogram('serve.itl_ms'),
+                'qwait': R.histogram('serve.queue_wait_ms'),
+                'step_ms': R.histogram('serve.step_ms'),
+                'steps': R.counter('serve.steps'),
+                'tokens': R.counter('serve.tokens'),
+                'in_flight': R.gauge('serve.in_flight'),
+                'queue_depth': R.gauge('serve.queue_depth'),
+                'pages_in_use': R.gauge('pool.pages_in_use'),
+                'util': R.gauge('pool.utilization'),
+                'bytes_in_use': R.gauge('pool.bytes_in_use'),
+                'bytes_total': R.gauge('pool.bytes_total'),
+            }
+            self._mgen = R.generation
+            self._last_occ = None          # force a gauge refresh
+        return self._mx
+
+    def _update_gauges(self):
+        """Occupancy/pool gauges, refreshed at the step boundary only
+        when occupancy actually moved (host bookkeeping only; a steady
+        full batch skips all six writes)."""
+        if not _obs.enabled():
+            return
+        m = self._metrics()
+        a = self.allocator
+        occ = (self.in_flight(), len(self.queue), a.in_use())
+        if occ == self._last_occ:
+            return
+        self._last_occ = occ
+        m['in_flight'].set(occ[0])
+        m['queue_depth'].set(occ[1])
+        m['pages_in_use'].set(occ[2])
+        m['util'].set(a.utilization())
+        if a.bytes_per_page:
+            m['bytes_in_use'].set(occ[2] * a.bytes_per_page)
+            m['bytes_total'].set(a.num_blocks * a.bytes_per_page)
 
     def in_flight(self):
         return sum(r is not None for r in self._slot_req)
@@ -511,6 +620,8 @@ class ServingEngine:
                 f'request needs {_ceil_div(total, self.block_size)} '
                 f'pages but the pool only has {self.allocator.usable} '
                 f'usable — grow num_blocks')
+        req.mark('arrival')
+        _obs.inc('serve.requests')
         self.queue.push(req)
         return req.rid
 
@@ -548,11 +659,20 @@ class ServingEngine:
         (_serve_step; _serve_window when nothing was admitted) — and
         finally commit tokens / retire finished rows from the single
         per-window host read. Returns the requests that finished this
-        step."""
+        step.
+
+        Telemetry rides the step's EXISTING host points: lifecycle
+        timestamps and the ttft/itl/queue-wait histograms are all
+        recorded at the per-window commit (right after the one
+        device_get this loop already does), so instrumentation adds no
+        sync and no retrace — bench.py's gate_observability_overhead
+        and gate_serve_retrace_zero both hold it to that."""
         t0 = time.perf_counter()
+        _step_span = _obs_trace.span('serve.step', cat='scheduler').begin()
         groups = self._admit()
         if not self.in_flight():
             self._serve_time += time.perf_counter() - t0
+            _step_span.end()
             return []
         self._ensure_window_pages()
         # the top-up above may have preempted a just-admitted request:
@@ -573,22 +693,29 @@ class ServingEngine:
         # admits across buckets) prefill standalone; the first group
         # rides inside the fused step
         for Sb, group in groups[1:]:
+            for _s, r in group:
+                r.mark('prefill_dispatch')
             self._prefill_group(Sb, group)
         dev = self._device_state()
         budget = jnp.asarray(self._budget)      # shrinks every window
         common = dict(window=W, temperature=self.temperature,
                       top_k=self.top_k, top_p=self.top_p,
                       eos_token_id=self.eos_token_id)
+        t_dispatch = time.perf_counter()
         if groups:
             Sb, group = groups[0]
+            for _s, r in group:
+                r.mark('prefill_dispatch')
             ids, real_len, btabs, slots = self._prefill_args(Sb, group)
-            self._note('serve_step', W, Sb)
+            hit = self._note('serve_step', W, Sb)
+            dispatch_key = ('serve_step', W, Sb)
             toks, self._last_logits, self._pages, ctx_out = _serve_step(
                 self.model, self._pages, self._last_logits, ids, real_len,
                 btabs, slots, dev['btab'], dev['ctx'], dev['live'],
                 budget, sub, **common)
         else:
-            self._note('serve_window', W)
+            hit = self._note('serve_window', W)
+            dispatch_key = ('serve_window', W)
             toks, self._last_logits, self._pages, ctx_out = _serve_window(
                 self.model, self._pages, self._last_logits,
                 dev['btab'], dev['ctx'], dev['live'], budget, sub,
@@ -601,6 +728,27 @@ class ServingEngine:
         # other state is host-authoritative.
         # tracelint: disable=TL002 - single sync per window by design
         tokens = np.asarray(jax.device_get(toks))
+        t_commit = time.perf_counter()
+        if not hit:
+            # a NEW registry key means this dispatch paid trace +
+            # compile: surface it as a compile span whose wall duration
+            # is dispatch-to-commit (trace + compile + first window)
+            _obs_trace.compile_event(
+                f'compile:{dispatch_key[0]}', key=dispatch_key,
+                dur_s=t_commit - t_dispatch,
+                geometry=str(self._geometry()))
+        # steady-state per-token latency: the window advances every live
+        # slot one token per scan step, so each committed token costs
+        # window_wall / W — recorded once per token at this commit point
+        # (window granularity, no per-token host syncs). A cache-MISS
+        # window's wall is trace+compile, not decoding: its tokens are
+        # excluded from the ITL histogram (they'd report compile time as
+        # inter-token latency) and counted aside; TTFT keeps including
+        # it — a request that waited on a compile really waited.
+        per_tok_ms = ((t_commit - t_dispatch) * 1e3 / W) if hit else None
+        telemetry = _obs.enabled()
+        mx = self._metrics() if telemetry else None
+        step_tokens = 0
         finished = []
         for slot, req in enumerate(self._slot_req):
             if req is None:
@@ -620,6 +768,20 @@ class ServingEngine:
             # to the host view
             self._budget[slot] = req.remaining
             self._tokens_out += len(committed)
+            step_tokens += len(committed)
+            if telemetry and committed:
+                itl_n = len(committed)
+                if req.when('first_token') is None:
+                    req.mark('first_token', t_commit)
+                    arrived = req.when('arrival')
+                    if arrived is not None:
+                        mx['ttft'].observe((t_commit - arrived) * 1e3)
+                    itl_n -= 1        # the first-ever token is TTFT
+                if per_tok_ms is not None:
+                    mx['itl'].observe(per_tok_ms, n=itl_n)
+                else:
+                    _obs.inc('serve.itl_skipped_compile', itl_n)
+                req.mark('window', t_commit)
             done = (req.remaining == 0
                     or (self.eos_token_id is not None and committed
                         and committed[-1] == self.eos_token_id))
@@ -627,6 +789,12 @@ class ServingEngine:
                 self._finish(slot, req)
                 finished.append(req)
         self._serve_time += time.perf_counter() - t0
+        if telemetry:
+            mx['steps'].inc()
+            mx['tokens'].inc(step_tokens)
+            mx['step_ms'].observe((time.perf_counter() - t0) * 1e3)
+            self._update_gauges()
+        _step_span.end()
         return finished
 
     # -- internals ---------------------------------------------------------
@@ -654,18 +822,24 @@ class ServingEngine:
         the batch width is pinned at max_slots with dummy rows masked
         to the scratch page, so the admission count never changes a
         traced shape)."""
+        if not len(self.queue):
+            # steady-state fast path: nothing to admit, skip even the
+            # admit span (most steps of a drained-queue run land here)
+            return []
         free = self._free_slots()
         placed = []
-        while free and len(self.queue):
-            req = self.queue.peek()
-            need = _ceil_div(req.context_len, self.block_size)
-            if need > self.allocator.available():
-                break
-            self.queue.pop()
-            slot = free.pop(0)
-            pages = self.allocator.alloc(need)
-            self._place(slot, req, pages)
-            placed.append((slot, req))
+        with _obs_trace.span('serve.admit', cat='scheduler') as _sp:
+            while free and len(self.queue):
+                req = self.queue.peek()
+                need = _ceil_div(req.context_len, self.block_size)
+                if need > self.allocator.available():
+                    break
+                self.queue.pop()
+                slot = free.pop(0)
+                pages = self.allocator.alloc(need)
+                self._place(slot, req, pages)
+                placed.append((slot, req))
+            _sp.args['admitted'] = len(placed)
         by_bucket: dict = {}
         for slot, req in placed:
             Sb = bucket_length(req.context_len, self.buckets)
@@ -684,6 +858,14 @@ class ServingEngine:
         self._dev = None
         req.state = 'running'
         req.admit_seq = next(self._admit_seq)
+        req.mark('admitted')
+        if _obs.enabled():
+            _obs.inc('serve.admissions')
+            if req.enqueued_at is not None:
+                self._metrics()['qwait'].observe(
+                    (time.perf_counter() - req.enqueued_at) * 1e3)
+            _obs_trace.instant('serve.admission', cat='scheduler',
+                               rid=req.rid, slot=slot, pages=len(pages))
 
     def _prefill_args(self, Sb, group):
         """Device args for one fixed-width admission-prefill batch
@@ -756,13 +938,20 @@ class ServingEngine:
                 'preempt — grow num_blocks')
         _, _, slot = min(victims)
         req = self._slot_req[slot]
-        self._clear_slot(slot)
-        req.state = 'preempted'
-        self.preemption_count += 1
-        self.queue.push(req)
+        with _obs_trace.span('serve.preempt', cat='scheduler',
+                             rid=req.rid, slot=slot,
+                             generated=len(req.generated)):
+            self._clear_slot(slot)
+            req.state = 'preempted'
+            self.preemption_count += 1
+            req.mark('preempted')
+            _obs.inc('serve.preemptions')
+            self.queue.push(req)
 
     def _finish(self, slot, req):
         req.state = 'finished'
+        req.mark('finished')
+        _obs.inc('serve.finished')
         pad = self.eos_token_id if self.eos_token_id is not None else 0
         gen = (req.generated
                + [pad] * (req.max_new_tokens - len(req.generated)))

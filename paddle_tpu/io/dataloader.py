@@ -182,6 +182,8 @@ def _push_with_backoff(push, timeout, sleep=None):
     5 min) before concluding nobody is coming back."""
     import time as time_mod
 
+    from ..observability import metrics as _obs
+
     sleep = sleep if sleep is not None else time_mod.sleep
     budget = max(timeout * 5, 300)
     delay = 0.0005
@@ -190,6 +192,10 @@ def _push_with_backoff(push, timeout, sleep=None):
         if waited >= budget:
             raise RuntimeError(
                 f'shm ring full for {budget}s: consumer stalled or gone')
+        # backoff tick: counts in THIS process's registry (a forked shm
+        # worker's counts stay in the worker — the parent-side signal
+        # for ring pressure is io.prefetch_wait_ms instead)
+        _obs.inc('io.shm_backoff')
         sleep(delay)
         waited += delay
         delay = min(delay * 2, 0.05)
@@ -454,8 +460,24 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     — each device receives only its dp/fsdp shard of the batch, and the
     transfer still overlaps the in-flight step. Leaves with fewer dims
     than the spec needs (scalars riding along in a batch dict) fall back
-    to the default replicated put instead of erroring."""
+    to the default replicated put instead of erroring.
+
+    Telemetry: `io.prefetch_wait_ms` is the host time spent blocked on
+    the UPSTREAM iterator (a loader that can't keep up shows here
+    before it shows as device idle), `io.prefetch_depth` the batches
+    currently staged in HBM, `io.prefetch_batches` the total served."""
+    import time as time_mod
+
     import jax
+
+    from ..observability import metrics as _obs
+
+    def pull(it):
+        t0 = time_mod.perf_counter()
+        batch = next(it)                 # StopIteration propagates
+        _obs.observe('io.prefetch_wait_ms',
+                     (time_mod.perf_counter() - t0) * 1e3)
+        return batch
 
     def put(batch):
         if sharding is not None:
@@ -473,15 +495,17 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     it = iter(iterator)
     try:
         for _ in range(size):
-            buf.append(put(next(it)))
+            buf.append(put(pull(it)))
     except StopIteration:
         pass
     while buf:
         out = buf.pop(0)
         try:
-            buf.append(put(next(it)))
+            buf.append(put(pull(it)))
         except StopIteration:
             pass
+        _obs.set_gauge('io.prefetch_depth', len(buf))
+        _obs.inc('io.prefetch_batches')
         yield out
 
 

@@ -1,0 +1,335 @@
+"""MetricsRegistry — process-global host-side serving/training metrics.
+
+The telemetry the production SLOs actually track (TTFT, p99 inter-token
+latency, queue wait — ROADMAP item 2) is HOST truth: request arrival
+and commit times, scheduler decisions, pool occupancy. None of it needs
+a device sync, so this module is deliberately dependency-free (no jax,
+no numpy) and every record is a few dict operations — cheap enough to
+live inside the serving loop's one-host-sync-per-window commit points
+without moving the tok/s needle (bench.py's
+`gate_observability_overhead` holds it within 3%).
+
+Three metric kinds, the Prometheus trio:
+
+  - `Counter`   — monotonically increasing (tokens, admissions,
+                  preemptions, compile events);
+  - `Gauge`     — last-write-wins level (queue depth, pool bytes in
+                  use, tokens/s over the last window);
+  - `Histogram` — FIXED bucket boundaries chosen at creation, with
+                  p50/p95/p99 estimated from the bucket counts by
+                  linear interpolation (ttft_ms, itl_ms,
+                  queue_wait_ms). Fixed buckets keep `observe()` O(len
+                  buckets) with zero allocation — no reservoir, no
+                  sorting, bounded memory for a server that runs for
+                  weeks.
+
+One process-global `REGISTRY` (module-level, like
+inference.engine.COMPILE_CACHE) so the engines, the dataloader, and
+bench.py all see one namespace; `snapshot()` is the JSON artifact and
+`to_prometheus()` the text exposition a scrape endpoint would serve.
+
+The whole subsystem is switchable: `set_enabled(False)` (or env
+`PADDLE_TPU_TELEMETRY=0`) turns every mutating call into an early
+return, which is what the bench overhead gate diffs against.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'REGISTRY',
+    'enabled', 'set_enabled', 'DEFAULT_MS_BUCKETS', 'inc', 'set_gauge',
+    'observe',
+]
+
+_ENABLED = os.environ.get('PADDLE_TPU_TELEMETRY', '1') != '0'
+
+
+def enabled():
+    """Whether telemetry recording is on (default yes; env
+    PADDLE_TPU_TELEMETRY=0 or set_enabled(False) turns it off)."""
+    return _ENABLED
+
+
+def set_enabled(on):
+    """Flip recording globally. Off turns every counter/gauge/histogram
+    mutation AND every tracer span into a no-op — the state the bench
+    overhead gate measures against."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+# latency buckets in MILLISECONDS: sub-ms host work through multi-second
+# cold compiles. The +inf bucket is implicit (Histogram adds it).
+DEFAULT_MS_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class Counter:
+    """Monotonic counter. `inc(n)` with n < 0 raises — a decreasing
+    counter is a bug worth failing on, not silently recording."""
+
+    kind = 'counter'
+
+    def __init__(self, name, help=''):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, n=1):
+        if not _ENABLED:
+            return
+        if n < 0:
+            raise ValueError(f'counter {self.name}: inc({n}) < 0')
+        self.value += n
+
+    def snapshot(self):
+        return {'type': 'counter', 'value': self.value}
+
+
+class Gauge:
+    """Last-write-wins level; None until first set."""
+
+    kind = 'gauge'
+
+    def __init__(self, name, help=''):
+        self.name = name
+        self.help = help
+        self.value = None
+
+    def set(self, v):
+        if not _ENABLED:
+            return
+        self.value = float(v)
+
+    def snapshot(self):
+        return {'type': 'gauge', 'value': self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile snapshots.
+
+    `buckets` are UPPER bucket edges (ascending); an implicit +inf
+    bucket catches the tail. `percentile(p)` walks the cumulative
+    counts to the target rank and linearly interpolates inside the
+    landing bucket (the first bucket interpolates from the observed
+    min, the +inf bucket reports the observed max) — standard
+    Prometheus-style estimation, exact to bucket resolution, O(1)
+    memory regardless of observation count."""
+
+    kind = 'histogram'
+
+    def __init__(self, name, buckets=None, help=''):
+        self.name = name
+        self.help = help
+        edges = tuple(sorted(float(b) for b in
+                             (buckets or DEFAULT_MS_BUCKETS)))
+        if not edges:
+            raise ValueError(f'histogram {self.name}: empty buckets')
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)   # [+inf] is the last slot
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v, n=1):
+        """Record `n` observations of value `v` (n > 1 is the window
+        commit shape: every token in a decode window shares one
+        measured per-token latency)."""
+        if not _ENABLED or n < 1:
+            return
+        v = float(v)
+        if math.isnan(v):
+            return
+        lo, hi = 0, len(self.edges)            # bisect over the edges
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if v <= self.edges[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.counts[lo] += n
+        self.count += n
+        self.sum += v * n
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def percentile(self, p):
+        """Estimated p-th percentile (p in [0, 100]); None when empty."""
+        if self.count == 0:
+            return None
+        rank = (p / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            prev_cum = cum
+            cum += c
+            if cum >= rank:
+                if i == len(self.edges):       # +inf bucket: observed max
+                    return self.max
+                lo = self.edges[i - 1] if i > 0 else (self.min or 0.0)
+                hi = self.edges[i]
+                lo = max(lo, self.min if self.min is not None else lo)
+                hi = min(hi, self.max if self.max is not None else hi)
+                if hi <= lo:
+                    return hi
+                frac = (rank - prev_cum) / c
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+        return self.max
+
+    def snapshot(self):
+        return {
+            'type': 'histogram',
+            'count': self.count,
+            'sum': round(self.sum, 6),
+            'mean': round(self.sum / self.count, 6) if self.count else None,
+            'min': self.min,
+            'max': self.max,
+            'p50': self.percentile(50),
+            'p95': self.percentile(95),
+            'p99': self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors (call sites
+    always go through the registry, so a `reset()` mid-flight never
+    strands a stale metric object in an engine)."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+        # bumped on every reset(): hot paths may CACHE metric handles
+        # keyed on this, so a reset invalidates their cache instead of
+        # stranding writes on orphaned objects
+        self.generation = 0
+
+    def _get(self, name, cls, **kw):
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f'metric {name!r} already registered as {m.kind}, '
+                    f'requested as {cls.kind}')
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f'metric {name!r} already registered as {m.kind}, '
+                    f'requested as {cls.kind}')
+            return m
+
+    def counter(self, name, help=''):
+        return self._get(name, Counter, help=help)
+
+    def gauge(self, name, help=''):
+        return self._get(name, Gauge, help=help)
+
+    def histogram(self, name, buckets=None, help=''):
+        return self._get(name, Histogram, buckets=buckets, help=help)
+
+    def get(self, name):
+        """The metric object, or None (read-only lookup)."""
+        return self._metrics.get(name)
+
+    def percentile(self, name, p, round_to=2):
+        """Rounded percentile of histogram `name`, or None when the
+        metric is absent/empty/not a histogram — the one accessor
+        bench.py and tools/telemetry_dump.py stamp artifacts from."""
+        m = self._metrics.get(name)
+        if not isinstance(m, Histogram):
+            return None
+        v = m.percentile(p)
+        return round(v, round_to) if v is not None else None
+
+    def names(self):
+        return sorted(self._metrics)
+
+    def reset(self):
+        """Drop every metric (tests and the overhead gate isolate runs
+        with this; engines re-create on next record — cached handles
+        notice via `generation`)."""
+        with self._lock:
+            self._metrics.clear()
+            self.generation += 1
+
+    def snapshot(self):
+        """{name: metric snapshot} — the telemetry.json artifact."""
+        return {name: self._metrics[name].snapshot()
+                for name in sorted(self._metrics)}
+
+    def to_json(self, **kw):
+        return json.dumps(self.snapshot(), **kw)
+
+    def to_prometheus(self):
+        """Prometheus text exposition (format 0.0.4). Metric names are
+        sanitized (dots -> underscores) to the legal charset; histogram
+        buckets emit cumulative `_bucket{le=...}` rows plus `_sum` and
+        `_count`, the standard shape scrapers expect."""
+        lines = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            pname = _prom_name(name)
+            if m.help:
+                lines.append(f'# HELP {pname} {m.help}')
+            lines.append(f'# TYPE {pname} {m.kind}')
+            if m.kind == 'counter':
+                lines.append(f'{pname} {m.value}')
+            elif m.kind == 'gauge':
+                v = m.value if m.value is not None else float("nan")
+                lines.append(f'{pname} {v}')
+            else:
+                cum = 0
+                for edge, c in zip(m.edges, m.counts):
+                    cum += c
+                    lines.append(
+                        f'{pname}_bucket{{le="{edge}"}} {cum}')
+                lines.append(
+                    f'{pname}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f'{pname}_sum {m.sum}')
+                lines.append(f'{pname}_count {m.count}')
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def _prom_name(name):
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isascii() and (ch.isalpha() or ch == '_'
+                               or (ch.isdigit() and i > 0))
+        out.append(ch if ok else '_')
+    return ''.join(out)
+
+
+REGISTRY = MetricsRegistry()
+
+
+# -- module-level conveniences (the form the engines use: one call, no
+# held metric object, registry lookup each time so reset() is safe) ----
+
+def inc(name, n=1, help=''):
+    if not _ENABLED:
+        return
+    REGISTRY.counter(name, help=help).inc(n)
+
+
+def set_gauge(name, v, help=''):
+    if not _ENABLED:
+        return
+    REGISTRY.gauge(name, help=help).set(v)
+
+
+def observe(name, v, n=1, buckets=None, help=''):
+    if not _ENABLED:
+        return
+    REGISTRY.histogram(name, buckets=buckets, help=help).observe(v, n=n)

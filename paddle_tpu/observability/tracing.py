@@ -1,0 +1,216 @@
+"""HostTracer — Chrome/Perfetto `trace_event` spans for host-side
+scheduler decisions.
+
+`jax.profiler` captures the DEVICE timeline (XLA ops, DMA, compiles as
+XLA sees them) but the host half of serving — admission decisions,
+preemptions, window dispatch cadence, CompileCache misses — is
+invisible there. This tracer records those as standard Chrome
+trace_event JSON (`ph: "X"` complete spans and `ph: "i"` instants), so
+`host_trace.json` loads in Perfetto / chrome://tracing directly and can
+sit in the same UI session as a jax.profiler device trace
+(docs/observability.md shows the overlay recipe).
+
+Design constraints, same discipline as the metrics registry:
+
+  - host-only: recording is an append of one small dict; NOTHING here
+    touches the device or forces a sync;
+  - bounded: a ring of `max_events` (default 100k) so a server that
+    runs for weeks cannot leak the host heap — overflow drops the
+    OLDEST events and counts `dropped`;
+  - switchable: every record checks `metrics.enabled()`, so the bench
+    overhead gate's telemetry-off run skips this too.
+
+`annotate(name)` is the dual-timeline bridge: one context manager that
+opens a host span here AND a `jax.profiler.TraceAnnotation` on the XLA
+timeline (profiler.RecordEvent routes through it), so a single API call
+marks both traces with the same name.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = ['HostTracer', 'TRACER', 'span', 'instant', 'compile_event',
+           'annotate', 'export', 'to_chrome_trace']
+
+# one process-wide epoch so every event's ts is comparable; perf_counter
+# is monotonic (wall-clock jumps cannot reorder spans)
+_EPOCH = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+class _Span:
+    """Open span handle: context manager OR explicit begin()/end()
+    (profiler.RecordEvent needs the latter). A span created while
+    telemetry is disabled is inert."""
+
+    __slots__ = ('_tracer', 'name', 'cat', 'args', '_t0')
+
+    def __init__(self, tracer, name, cat, args):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = None
+
+    def begin(self):
+        if _metrics.enabled():
+            self._t0 = _now_us()
+        return self
+
+    def end(self):
+        if self._t0 is not None:
+            self._tracer._emit(self.name, self.cat, self._t0,
+                               _now_us() - self._t0, self.args)
+            self._t0 = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class HostTracer:
+    """Bounded host-side trace_event recorder."""
+
+    def __init__(self, max_events=100_000):
+        self.max_events = int(max_events)
+        self._events: collections.deque = collections.deque(
+            maxlen=self.max_events)
+        self.dropped = 0
+        self._pid = os.getpid()
+
+    # -- recording ---------------------------------------------------------
+
+    def _emit(self, name, cat, ts, dur, args, ph='X'):
+        ev = {'name': name, 'cat': cat, 'ph': ph, 'ts': ts,
+              'pid': self._pid, 'tid': threading.get_ident() % 2**31}
+        if ph == 'X':
+            ev['dur'] = dur
+        elif ph == 'i':
+            ev['s'] = 'p'
+        if args:
+            ev['args'] = args
+        if len(self._events) == self.max_events:
+            self.dropped += 1
+        self._events.append(ev)
+
+    def span(self, name, cat='host', **args):
+        """Context manager (or begin()/end() handle) recording one
+        complete span on exit."""
+        return _Span(self, name, cat, args)
+
+    def instant(self, name, cat='host', **args):
+        if not _metrics.enabled():
+            return
+        self._emit(name, cat, _now_us(), 0.0, args, ph='i')
+
+    def compile_event(self, name, key=None, dur_s=None, **args):
+        """One compile/retrace event on the `compile` track. With a
+        wall duration it renders as a span covering the compiling
+        dispatch; without one (a bare retrace count tick) it is an
+        instant."""
+        if not _metrics.enabled():
+            return
+        if key is not None:
+            args['key'] = str(key)
+        if dur_s is None:
+            self._emit(name, 'compile', _now_us(), 0.0, args, ph='i')
+        else:
+            dur_us = float(dur_s) * 1e6
+            self._emit(name, 'compile', _now_us() - dur_us, dur_us, args)
+
+    # -- reading / export --------------------------------------------------
+
+    def events(self):
+        return list(self._events)
+
+    def __len__(self):
+        return len(self._events)
+
+    def clear(self):
+        self._events.clear()
+        self.dropped = 0
+
+    def to_chrome_trace(self):
+        """The `trace_event` ARRAY form (what Perfetto and
+        chrome://tracing both accept)."""
+        return self.events()
+
+    def to_json(self, **kw):
+        # default=str: span args are caller-supplied (annotate(**args))
+        # and a non-serializable arg must degrade to its repr, never
+        # make the export raise
+        kw.setdefault('default', str)
+        return json.dumps(self.to_chrome_trace(), **kw)
+
+    def export(self, path):
+        """Write host_trace.json (trace_event array) and return the
+        path."""
+        with open(path, 'w') as f:
+            json.dump(self.to_chrome_trace(), f, default=str)
+        return path
+
+
+TRACER = HostTracer()
+
+
+# -- module-level conveniences over the global tracer ----------------------
+
+def span(name, cat='host', **args):
+    return TRACER.span(name, cat, **args)
+
+
+def instant(name, cat='host', **args):
+    TRACER.instant(name, cat, **args)
+
+
+def compile_event(name, key=None, dur_s=None, **args):
+    TRACER.compile_event(name, key=key, dur_s=dur_s, **args)
+
+
+def export(path):
+    return TRACER.export(path)
+
+
+def to_chrome_trace():
+    return TRACER.to_chrome_trace()
+
+
+@contextlib.contextmanager
+def annotate(name, cat='host', **args):
+    """The dual-timeline bridge: one `with annotate('train_step'):`
+    records a host span here AND a jax.profiler.TraceAnnotation on the
+    device timeline, so the two traces share a name to line up on.
+
+    The telemetry kill switch gates only the HOST span (the recording
+    this package added); the device-timeline annotation is jax's
+    long-standing behavior and fires regardless, keeping every
+    RecordEvent form consistent with its pre-observability semantics.
+    Degrades to host-only when jax (or its profiler) is unavailable —
+    annotation must never be able to break the annotated code."""
+    ctx = None
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+        ctx.__enter__()
+    except Exception:  # noqa: BLE001 - annotation is best-effort
+        ctx = None
+    with TRACER.span(name, cat, **args):
+        try:
+            yield
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)

@@ -26,15 +26,23 @@ class ProfilerTarget:
 class RecordEvent:
     """ref: paddle.profiler.RecordEvent — named trace annotation.
 
-    Also usable as a decorator. Lowers to jax.profiler.TraceAnnotation,
-    which shows up on the XLA timeline.
+    Also usable as a decorator. ONE API, BOTH timelines: lowers to
+    jax.profiler.TraceAnnotation (the XLA/TensorBoard device timeline)
+    AND records a host span in observability's tracer (the Perfetto
+    host_trace.json), so the same name lines the two traces up — the
+    reference's host/device event collation, rebuilt on the two
+    recorders this stack actually has.
     """
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
+        self._span = None
 
     def begin(self):
+        from ..observability import tracing as _tracing
+
+        self._span = _tracing.span(self.name, cat='record_event').begin()
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
 
@@ -42,6 +50,9 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._span is not None:
+            self._span.end()
+            self._span = None
 
     def __enter__(self):
         self.begin()
@@ -54,9 +65,13 @@ class RecordEvent:
     def __call__(self, fn):
         import functools
 
+        from ..observability import tracing as _tracing
+
         @functools.wraps(fn)
         def wrapped(*a, **kw):
-            with jax.profiler.TraceAnnotation(self.name):
+            # annotate() is the same dual-timeline bridge in context-
+            # manager form (TraceAnnotation + host span)
+            with _tracing.annotate(self.name, cat='record_event'):
                 return fn(*a, **kw)
 
         return wrapped
@@ -91,6 +106,19 @@ class Profiler:
     def stop(self):
         if self._running and not self.timer_only:
             jax.profiler.stop_trace()
+            # drop the host-side span trace next to jax's device trace:
+            # one log_dir holds both halves of the timeline
+            # any failure here (unwritable log_dir, import oddity) must
+            # cost only the host-trace artifact, never break stop():
+            # the device trace is already closed and on_trace_ready
+            # still has to fire
+            try:
+                from ..observability import tracing as _tracing
+
+                _tracing.export(os.path.join(self.log_dir,
+                                             'host_trace.json'))
+            except Exception:  # noqa: BLE001 - artifact is best-effort
+                pass
         self._running = False
         if self.on_trace_ready:
             self.on_trace_ready(self)

@@ -58,6 +58,7 @@ import collections
 import functools
 import inspect
 import itertools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +66,8 @@ import numpy as np
 
 from ..framework.tree import split_trainable
 from ..inference.engine import CompileCache
+from ..observability import metrics as _obs
+from ..observability import tracing as _obs_trace
 
 # ---------------------------------------------------------------------------
 # Compile accounting (the training twin of inference.engine's counters)
@@ -75,8 +78,13 @@ _TRACE_COUNTS: collections.Counter = collections.Counter()
 
 def _count_trace(name):
     """Called from INSIDE to-be-jitted python bodies: runs only while
-    tracing, so the counter is exactly the number of (re)compilations."""
+    tracing, so the counter is exactly the number of (re)compilations.
+    Also ticks the shared `compile.traces` registry counter and drops a
+    `trace:<name>` instant on the host trace (the same compile/retrace
+    accounting the inference engines feed)."""
     _TRACE_COUNTS[name] += 1
+    _obs.inc('compile.traces')
+    _obs_trace.compile_event(f'trace:{name}')
 
 
 def trace_counts():
@@ -328,6 +336,14 @@ class TrainEngine:
         self._eval_pending = []
         self._last_vals = None
         self._last_loss = None
+        # telemetry window accounting (host wall clock + input-element
+        # counts, rolled into the registry at each sync — the window
+        # boundary is the ONLY place train metrics are recorded, so
+        # instrumentation inherits the one-sync-per-window contract)
+        self._window_t0 = None
+        self._window_tokens = 0
+        self._last_scale_seen = None
+        self._traces_mark = total_traces()
 
     # -- lr resolution -----------------------------------------------------
 
@@ -383,6 +399,10 @@ class TrainEngine:
                     raise ValueError(
                         f'global batch {a.shape[0]} not divisible by '
                         f'accum_steps={self.accum_steps}')
+        if self._window_t0 is None:        # first step of a new window
+            self._window_t0 = time.perf_counter()
+        if inputs and hasattr(inputs[0], 'size'):
+            self._window_tokens += int(inputs[0].size)
         lr_mode = self._lr_mode()
         with_preds = bool(self.metrics) and self.loss_mode == 'fn'
         if inputs:
@@ -412,11 +432,23 @@ class TrainEngine:
     def sync(self):
         """Close the window: ONE batched device_get for every step since
         the last sync, feed the host metrics, return the logs. Mirrors
-        the decode engine's one-sync-per-window contract."""
+        the decode engine's one-sync-per-window contract.
+
+        The telemetry registry is fed HERE and only here (step time,
+        tokens/s, loss, loss scale, retrace count) — the current AMP
+        scale rides inside the same device_get, so instrumentation adds
+        zero extra syncs."""
         if not self._pending:
             return self._last_vals and dict(self._last_vals)
         pending, self._pending = self._pending, []
-        window = jax.device_get(pending)        # the one host transfer
+        # the scaler state is donated to the NEXT step, so fetch the
+        # LIVE scale now, folded into the window's one host transfer
+        # (holding per-step scale refs would read donated buffers)
+        scale_dev = (self.scaler_state['scale']
+                     if self.scaler_state is not None else None)
+        with _obs_trace.span('train.sync', cat='train',
+                             window=len(pending)):
+            window, scale = jax.device_get((pending, scale_dev))
         for loss, preds, labels in window:
             self._feed_metrics(preds, labels)
         self._last_loss = float(window[-1][0])
@@ -430,7 +462,47 @@ class TrainEngine:
             else:
                 logs[names] = accs
         self._last_vals = logs
+        self._record_window(len(window), scale)
         return dict(logs)
+
+    def _record_window(self, n_steps, scale):
+        """Roll one closed window into the process-global registry
+        (host arithmetic on data the sync already fetched)."""
+        if not _obs.enabled():
+            self._window_t0 = None
+            self._window_tokens = 0
+            return
+        now = time.perf_counter()
+        if self._window_t0 is not None and n_steps:
+            wall = now - self._window_t0
+            if wall > 0:
+                _obs.set_gauge('train.tokens_per_s',
+                               self._window_tokens / wall)
+            # per-step time is known at window granularity only (the
+            # steps never synced individually — that is the point)
+            _obs.observe('train.step_ms', wall * 1e3 / n_steps,
+                         n=n_steps)
+        _obs.inc('train.steps', n_steps)
+        _obs.inc('train.tokens', self._window_tokens)
+        _obs.set_gauge('train.loss', self._last_loss)
+        _obs.set_gauge('train.accum_steps', self.accum_steps)
+        traces = total_traces()
+        # clamp: a reset_trace_counts() between windows would otherwise
+        # make the delta negative and Counter.inc raise mid-sync
+        _obs.inc('train.traces', max(0, traces - self._traces_mark))
+        self._traces_mark = traces
+        if scale is not None:
+            s = float(scale)
+            _obs.set_gauge('train.loss_scale', s)
+            # a scale DROP between windows means the in-trace skip path
+            # fired at least once inside the window (window-granular by
+            # design: per-step skip visibility would cost a sync)
+            if (self._last_scale_seen is not None
+                    and s < self._last_scale_seen):
+                _obs.inc('train.scale_backoffs')
+            self._last_scale_seen = s
+        self._window_t0 = None
+        self._window_tokens = 0
 
     def _feed_metrics(self, preds, labels):
         if preds is None or (isinstance(preds, tuple) and not preds):

@@ -1,0 +1,133 @@
+"""Run the bench serving workload and dump its telemetry artifacts.
+
+Drives the same tiny continuous-batching workload the bench serving
+gate uses (Poisson-ish mixed-length requests through a ServingEngine)
+with telemetry on, then writes two artifacts into --out:
+
+    telemetry.json   — the full MetricsRegistry snapshot (counters,
+                       gauges, histogram percentiles: ttft/itl/queue
+                       wait, pool bytes, compile events, ...)
+    host_trace.json  — the host-span tracer's Chrome trace_event array
+                       (scheduler steps, admissions, preemptions,
+                       compile spans) — open in Perfetto or
+                       chrome://tracing, optionally alongside a
+                       jax.profiler device trace (docs/observability.md
+                       shows the overlay recipe)
+    telemetry.prom   — Prometheus text exposition of the same registry
+                       (what a scrape endpoint would serve)
+
+Importable anywhere (pytest collection, tracelint) without touching a
+backend — only main() initialises jax, and the same rc-2 guard
+discipline as tools/mosaic_check.py applies: when NO jax backend can
+be initialised at all, exit 2 with a message instead of a traceback.
+The workload itself is CPU-runnable, so off-TPU boxes get real
+artifacts (pass --cpu to pin there explicitly and skip any flaky-TPU
+backend probing).
+
+    python tools/telemetry_dump.py --out /tmp/telemetry [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+# `python tools/telemetry_dump.py` puts tools/ (not the repo root) on
+# sys.path and paddle_tpu is not pip-installed on the dev boxes — make
+# the repo importable no matter where the script is launched from
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def run_workload(n_requests=16, decode_window=8, seed=0):
+    """The gate-shaped serving workload: mixed budgets, every 4th
+    request long, priority-0 FIFO arrivals. Returns the engine (its
+    run has fed the process-global registry and tracer)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                        layers=2))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(3, 96, (6,)) for _ in range(n_requests)]
+    mnts = [16 if i % 4 == 0 else 6 for i in range(n_requests)]
+    srv = ServingEngine(model, max_slots=4, block_size=8,
+                        max_context_len=32, max_new_tokens=16,
+                        decode_window=decode_window)
+    rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+    srv.run()
+    for r in rids:
+        srv.result(r)
+    return srv
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--out', default='./telemetry_out',
+                    help='output directory (created if missing)')
+    ap.add_argument('--requests', type=int, default=16,
+                    help='workload size (default 16)')
+    ap.add_argument('--cpu', action='store_true',
+                    help='pin JAX_PLATFORMS=cpu (skip TPU probing)')
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+
+    # backend guard, mosaic_check-style: a guard rather than an assert
+    # (python -O strips asserts), and rc 2 distinguishes "no backend"
+    # from a real workload failure for the calling automation
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001 - any backend-init failure
+        print(f'telemetry_dump: no usable jax backend ({e}); '
+              f'retry with --cpu or bring the tunnel up')
+        return 2
+
+    from paddle_tpu import observability as obs
+
+    obs.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+
+    srv = run_workload(n_requests=args.requests)
+
+    os.makedirs(args.out, exist_ok=True)
+    tpath = os.path.join(args.out, 'telemetry.json')
+    with open(tpath, 'w') as f:
+        json.dump({'backend': backend,
+                   'engine_stats': srv.stats(),
+                   'metrics': obs.REGISTRY.snapshot()}, f, indent=2,
+                  default=str)
+    hpath = obs.TRACER.export(os.path.join(args.out, 'host_trace.json'))
+    ppath = os.path.join(args.out, 'telemetry.prom')
+    with open(ppath, 'w') as f:
+        f.write(obs.REGISTRY.to_prometheus())
+
+    snap = obs.REGISTRY.snapshot()
+    R = obs.REGISTRY
+
+    print(f'backend          {backend}')
+    print(f'ttft_ms p50/p99  {R.percentile("serve.ttft_ms", 50)} / '
+          f'{R.percentile("serve.ttft_ms", 99)}')
+    print(f'itl_ms p99       {R.percentile("serve.itl_ms", 99)}')
+    print(f'queue_wait p99   {R.percentile("serve.queue_wait_ms", 99)}')
+    print(f'tokens           '
+          f'{snap.get("serve.tokens", {}).get("value")}')
+    print(f'compile events   '
+          f'{snap.get("compile.traces", {}).get("value")}')
+    print(f'host spans       {len(obs.TRACER)}')
+    print(f'wrote {tpath}')
+    print(f'wrote {hpath}')
+    print(f'wrote {ppath}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
