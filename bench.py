@@ -398,7 +398,7 @@ print(json.dumps({'on_tok_s': round(useful / on_dt, 1),
 '''
 
 
-def _gate_subprocess(src, timeout_s):
+def _gate_subprocess(src, timeout_s, extra_env=None):
     """Shared CPU-pinned dynamic-gate runner: exec `src` in a
     subprocess with JAX_PLATFORMS=cpu and parse its last stdout line as
     JSON. Returns (payload, err_detail): payload is None whenever the
@@ -408,7 +408,7 @@ def _gate_subprocess(src, timeout_s):
     import subprocess
     import sys
 
-    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env = dict(os.environ, JAX_PLATFORMS='cpu', **(extra_env or {}))
     root = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
@@ -492,6 +492,134 @@ def _observability_gate(timeout_s=300):
         f"trace_valid={payload.get('trace_valid')}"), payload
 
 
+_COLD_START_SRC_A = r'''
+import json, os, time
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.inference.engine import total_traces
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+pt.seed(0)
+model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                    layers=2))
+srv = ServingEngine(model, max_slots=4, block_size=8, max_context_len=32,
+                    max_new_tokens=12, decode_window=4)
+# the COLD half: first request on a fresh replica pays trace + XLA
+# compile before its first token (exactly the autoscaling tax)
+rid = srv.submit(np.arange(3, 9), 12)
+t0 = time.perf_counter()
+srv.step()
+cold = time.perf_counter() - t0
+srv.run()
+ok = srv.result(rid) is not None
+cold_traces = total_traces()
+# then build the artifact the warm half attaches (full-coverage
+# enumeration; executables persist into the shared gate dir)
+t0 = time.perf_counter()
+art = aot.build(srv, os.environ['PADDLE_TPU_AOT_GATE_DIR'])
+print(json.dumps({'cold_first_token_s': cold,
+                  'cold_traces': cold_traces, 'served': bool(ok),
+                  'build_s': round(time.perf_counter() - t0, 3),
+                  'geometries': art.manifest['build']['n_geometries']}))
+'''
+
+
+_COLD_START_SRC_B = r'''
+import json, os, time
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.inference.engine import COMPILE_CACHE, total_traces
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+pt.seed(0)
+model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                    layers=2))
+srv = ServingEngine(model, max_slots=4, block_size=8, max_context_len=32,
+                    max_new_tokens=12, decode_window=4)
+# the WARM half: fingerprint-checked attach wires the artifact's
+# persistent cache and pre-traces every geometry, so the compiles are
+# disk reads and the first request below is pure dispatch
+t0 = time.perf_counter()
+rep = srv.warmup(artifact=os.environ['PADDLE_TPU_AOT_GATE_DIR'])
+warmup_s = time.perf_counter() - t0
+t0s, m0 = total_traces(), COMPILE_CACHE.misses
+rid = srv.submit(np.arange(3, 9), 12)
+t0 = time.perf_counter()
+srv.step()
+warm = time.perf_counter() - t0
+srv.run()
+ok = srv.result(rid) is not None
+print(json.dumps({'warm_first_token_s': warm,
+                  'warm_traces': total_traces() - t0s,
+                  'warm_misses': COMPILE_CACHE.misses - m0,
+                  'served': bool(ok),
+                  'warmup_s': round(warmup_s, 3),
+                  'warm_geometries': rep['geometries']}))
+'''
+
+
+def _cold_start_gate(timeout_s=300):
+    """AOT cold-start gate, CPU-pinned like the other dynamic gates:
+    TWO subprocesses share one artifact dir. Process A (a cold replica)
+    times its first request — trace + XLA compile before the first
+    token — then `aot.build`s the EngineArtifact. Process B (a fresh
+    replica) warm-attaches the artifact and must dispatch its first
+    request with ZERO compile events (`compile.traces` and registry
+    `cache_misses` both zero — the PR-6 accounting) and reach first
+    token >=10x faster than the cold process. A ratio miss with the
+    zero-compile contract intact gets ONE process-B retry (machine
+    weather can inflate the warm millisecond-scale dispatch; it cannot
+    fake the compile counters). Returns (clean, detail, payload);
+    clean is None when either half could not run (never poses as a
+    pass)."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix='paddle_tpu_aot_gate_')
+    env = {'PADDLE_TPU_AOT_GATE_DIR': d}
+    try:
+        a, err = _gate_subprocess(_COLD_START_SRC_A, timeout_s,
+                                  extra_env=env)
+        if a is None:
+            return None, f'cold half: {err}', {}
+        b, err = _gate_subprocess(_COLD_START_SRC_B, timeout_s,
+                                  extra_env=env)
+        if b is None:
+            return None, f'warm half: {err}', {}
+
+        def _zero_compile(p):
+            return (p.get('warm_traces') == 0
+                    and p.get('warm_misses') == 0
+                    and p.get('served') is True)
+
+        cold = a.get('cold_first_token_s') or 0.0
+        warm = b.get('warm_first_token_s') or float('inf')
+        if _zero_compile(b) and cold < 10 * warm:
+            retry, _ = _gate_subprocess(_COLD_START_SRC_B, timeout_s,
+                                        extra_env=env)
+            if (retry is not None and _zero_compile(retry)
+                    and (retry.get('warm_first_token_s')
+                         or float('inf')) < warm):
+                b = retry
+                warm = b['warm_first_token_s']
+        clean = (a.get('served') is True and _zero_compile(b)
+                 and cold >= 10 * warm)
+        payload = dict(a)
+        payload.update(b)
+        return clean, (
+            f"cold {cold:.2f}s vs warm {warm * 1e3:.1f}ms to first "
+            f"token ({cold / warm:.0f}x), warm traces="
+            f"{b.get('warm_traces')} misses={b.get('warm_misses')}, "
+            f"{b.get('warm_geometries')} geometries warmed in "
+            f"{b.get('warmup_s')}s"), payload
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _train_engine_gate(timeout_s=240):
     """Dynamic training-contract gate, CPU-pinned like the lint gates:
     a tiny TrainEngine run must show ZERO steady-state retraces and a
@@ -557,11 +685,15 @@ def main():
     obs_gate_clean, obs_gate_detail, obs_gate_payload = (
         _observability_gate())
     print(f'# observability gate: {obs_gate_detail}', flush=True)
+    cold_gate_clean, cold_gate_detail, cold_gate_payload = (
+        _cold_start_gate())
+    print(f'# cold start gate: {cold_gate_detail}', flush=True)
     static_gate_failed = (tracelint_clean is False
                           or mosaiclint_clean is False
                           or train_gate_clean is False
                           or serving_gate_clean is False
-                          or obs_gate_clean is False)
+                          or obs_gate_clean is False
+                          or cold_gate_clean is False)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
@@ -602,6 +734,17 @@ def main():
             det['observability_gate'] = obs_gate_detail
             det['telemetry_overhead_ratio'] = obs_gate_payload.get(
                 'ratio')
+            # AOT cold-start gate (CPU two-subprocess proof): the
+            # round's zero-compile warm-attach evidence while the
+            # tunnel is down, stamped exactly like the measured path
+            det['gate_cold_start'] = cold_gate_clean
+            det['cold_start_gate'] = cold_gate_detail
+            det['engine_cold_start_s'] = cold_gate_payload.get(
+                'cold_first_token_s')
+            det['engine_warm_start_s'] = cold_gate_payload.get(
+                'warm_first_token_s')
+            det['aot_build_s'] = cold_gate_payload.get('build_s')
+            det['aot_warmup_s'] = cold_gate_payload.get('warmup_s')
             # backfill the unsuffixed gates ONLY when the stashed TPU
             # artifact predates them (or its serving bench was
             # time-boxed away) — a real TPU-measured value must never
@@ -1153,6 +1296,18 @@ def main():
             'gate_observability_overhead': obs_gate_clean,
             'observability_gate': obs_gate_detail,
             'telemetry_overhead_ratio': obs_gate_payload.get('ratio'),
+            # AOT cold-start gate (CPU two-subprocess proof): a fresh
+            # process warm-attaching the EngineArtifact must serve its
+            # first request with zero compile events and reach first
+            # token >=10x faster than the cold process
+            'gate_cold_start': cold_gate_clean,
+            'cold_start_gate': cold_gate_detail,
+            'engine_cold_start_s': cold_gate_payload.get(
+                'cold_first_token_s'),
+            'engine_warm_start_s': cold_gate_payload.get(
+                'warm_first_token_s'),
+            'aot_build_s': cold_gate_payload.get('build_s'),
+            'aot_warmup_s': cold_gate_payload.get('warmup_s'),
             # measured-path gate is TPU-only (like the int8/kv8 gates:
             # the CPU smoke config's dispatch overhead swamps the
             # step-count win by construction); the CPU-provable version

@@ -71,6 +71,7 @@ from . import dataset  # noqa: F401
 from . import hub  # noqa: F401
 from . import inference  # noqa: F401
 from . import training  # noqa: F401
+from . import aot  # noqa: F401
 from . import onnx  # noqa: F401
 from . import reader  # noqa: F401
 from . import sysconfig  # noqa: F401

@@ -1,0 +1,43 @@
+"""paddle_tpu.aot — AOT engine artifacts: warmup, export, and
+zero-compile cold start.
+
+A fresh serving replica used to pay trace + XLA compile for every
+prefill bucket, decode geometry, and speculative window at first
+traffic (minutes of warmup — the autoscaling killer, ROADMAP item 4).
+This subsystem composes three pieces that already existed separately —
+the engines' CompileCache key registries, `jit.save`'s jax.export
+serialization, and sysconfig's persistent XLA executable cache — into
+one artifact flow:
+
+    # build machine (CI, or the first replica):
+    srv = ServingEngine(model, **cfg)
+    art = aot.build(srv, '/models/llama-serve.aot')
+
+    # every later replica, before the first request:
+    srv = ServingEngine(model, **cfg)
+    srv.warmup(artifact='/models/llama-serve.aot')
+    # first token is now ONE dispatch: zero traces, zero compiles
+    # (bench.py's gate_cold_start proves the >=10x cold-start win)
+
+`GeometrySet` (aot.geometry) enumerates every jit geometry an engine
+config will dispatch; `build`/`EngineArtifact`/`warm_attach`
+(aot.artifact) persist and re-attach the compiled executables with a
+fingerprint-checked manifest. See docs/aot_warmup.md.
+"""
+from __future__ import annotations
+
+from .artifact import (  # noqa: F401
+    MANIFEST_NAME, ArtifactMismatch, EngineArtifact, build, config_hash,
+    fingerprint, warm_attach,
+)
+from .geometry import (  # noqa: F401
+    Geometry, GeometrySet, for_decode_engine, for_engine,
+    for_serving_engine, for_train_engine,
+)
+
+__all__ = [
+    'ArtifactMismatch', 'EngineArtifact', 'build', 'warm_attach',
+    'fingerprint', 'config_hash', 'MANIFEST_NAME',
+    'Geometry', 'GeometrySet', 'for_engine', 'for_decode_engine',
+    'for_serving_engine', 'for_train_engine',
+]

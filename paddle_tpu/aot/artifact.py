@@ -1,0 +1,360 @@
+"""EngineArtifact — build, persist, and warm-attach AOT-compiled
+engine executables.
+
+The zero-compile cold start has three moving parts, and this module is
+where they compose:
+
+  1. `build(engine, out_dir, **workload)` enumerates the engine's
+     GeometrySet (aot.geometry), wires jax's persistent compilation
+     cache into `out_dir/xla_cache`, and DRIVES every geometry through
+     the same module-level jitted steps the live engine dispatches —
+     so the executables persisted to disk are keyed exactly as the
+     serving process will look them up (an AOT-only `.lower().compile()`
+     path could drift from the dispatch path's cache keys; executing
+     the real dispatch cannot). A manifest records the engine config
+     hash, the jax/jaxlib/backend fingerprint, and every geometry with
+     its stable CompileCache key string.
+
+  2. `EngineArtifact.load(path)` + `engine.warmup(artifact=...)`
+     (`warm_attach` here) verify the fingerprint and config hash —
+     refusing loudly on mismatch, because a stale artifact silently
+     degrades to full cold-start compiles — then re-drive the
+     geometries: jax traces, finds every executable in the persistent
+     cache, and the process's in-memory jit cache is hot before the
+     first request arrives. First token is then ONE dispatch: zero
+     traces, zero registry misses (bench.py's `gate_cold_start` holds
+     it to exactly that).
+
+  3. Optionally, `build(..., export_stablehlo=True)` also serializes
+     each geometry through `jax.export` (the full Exported flatbuffer,
+     the same portable layer `jit.save` writes) into
+     `out_dir/stablehlo/` — a compiler-version-independent fallback the
+     XLA executable cache is not.
+
+Artifact layout and invalidation rules: docs/aot_warmup.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+import jax
+
+from ..inference.engine import key_str
+from ..observability import metrics as _obs
+from ..observability import tracing as _obs_trace
+from . import geometry as _geometry
+
+
+def _all_traces():
+    """Process-wide trace count across BOTH engine families (the
+    inference and training counters are separate by design; a build/
+    warmup report wants their sum)."""
+    from ..inference.engine import total_traces as _it
+    from ..training.engine import total_traces as _tt
+
+    return _it() + _tt()
+
+
+MANIFEST_NAME = 'manifest.json'
+MANIFEST_VERSION = 1
+
+
+class ArtifactMismatch(RuntimeError):
+    """An EngineArtifact refused to attach: the manifest's fingerprint
+    or config hash disagrees with the live process/engine. Attaching
+    anyway would silently recompile everything — the exact failure mode
+    this subsystem exists to make loud."""
+
+
+def fingerprint():
+    """The compilation environment an artifact is only valid within:
+    persistent-cache entries are compiler-output, so a different
+    jaxlib/backend would miss every key and recompile silently."""
+    import jaxlib
+    import sys
+
+    dev = jax.devices()[0]
+    return {
+        'jax': jax.__version__,
+        'jaxlib': jaxlib.__version__,
+        'backend': jax.default_backend(),
+        'device_kind': getattr(dev, 'device_kind', '?'),
+        'python': f'{sys.version_info[0]}.{sys.version_info[1]}',
+    }
+
+
+def config_hash(config):
+    """sha256 over the canonical JSON of an engine's `aot_config()`."""
+    blob = json.dumps(config, sort_keys=True, separators=(',', ':'))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _portable_key(key):
+    """Manifest form of a registry key: the model-id component (a
+    per-process creation-order counter) is normalized to -1, because
+    the attaching process's counter need not agree with the builder's.
+    Manifest keys are for observability and cross-run diffing — live
+    equality checks (the enumeration==live proof) always recompute
+    keys in-process against the live engine."""
+    return (key[0], -1) + tuple(key[2:])
+
+
+class EngineArtifact:
+    """A built artifact directory: manifest + persistent executable
+    cache (+ optional StableHLO layer). Construct via `load` or
+    `build`."""
+
+    def __init__(self, path, manifest):
+        self.path = os.path.abspath(path)
+        self.manifest = manifest
+
+    @property
+    def cache_dir(self):
+        return os.path.join(self.path, 'xla_cache')
+
+    @property
+    def stablehlo_dir(self):
+        return os.path.join(self.path, 'stablehlo')
+
+    def geometry_set(self):
+        # manifest entries carry build metadata (key, build_s,
+        # stablehlo) on top of the geometry params; strip it so the
+        # restored Geometry equals a freshly enumerated one
+        meta = ('key', 'build_s', 'stablehlo')
+        return _geometry.GeometrySet.from_manifest(
+            [{k: v for k, v in d.items() if k not in meta}
+             for d in self.manifest['geometries']])
+
+    @classmethod
+    def load(cls, path):
+        mpath = os.path.join(path, MANIFEST_NAME)
+        if not os.path.isfile(mpath):
+            raise FileNotFoundError(
+                f'{path} is not an EngineArtifact (no {MANIFEST_NAME}); '
+                f'build one with paddle_tpu.aot.build')
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get('version') != MANIFEST_VERSION:
+            raise ArtifactMismatch(
+                f"artifact manifest version {manifest.get('version')} != "
+                f'supported {MANIFEST_VERSION}; rebuild the artifact')
+        return cls(path, manifest)
+
+    def check(self, engine):
+        """Refuse (ArtifactMismatch) unless this artifact was built in
+        an equivalent compilation environment FOR an equivalently
+        configured engine. Weight VALUES are not checked (same-
+        architecture checkpoints share artifacts by design), but the
+        model's param STRUCTURE is — aot_config's `model_struct` hash —
+        since a differently-sized model would miss every cache entry."""
+        want = self.manifest['fingerprint']
+        have = fingerprint()
+        for field in ('jax', 'jaxlib', 'backend', 'device_kind'):
+            if want.get(field) != have.get(field):
+                raise ArtifactMismatch(
+                    f'artifact fingerprint mismatch on {field!r}: built '
+                    f'with {want.get(field)!r}, this process has '
+                    f'{have.get(field)!r} — persistent-cache entries '
+                    f'would silently miss; rebuild the artifact for '
+                    f'this environment')
+        cfg = engine.aot_config()
+        h = config_hash(cfg)
+        if h != self.manifest['config_hash']:
+            built = self.manifest.get('engine', {})
+            diff = sorted(k for k in set(built) | set(cfg)
+                          if built.get(k) != cfg.get(k))
+            raise ArtifactMismatch(
+                f'artifact was built for a different engine config '
+                f'(hash {self.manifest["config_hash"][:12]} != '
+                f'{h[:12]}); differing fields: {diff} — rebuild, or '
+                f'construct the engine with the manifest\'s config')
+
+
+def _register_export_containers():
+    """jax.export serialization needs every pytree container in an
+    exported calling convention registered by name; the KV-cache
+    NamedTuples are ours to register (idempotent — a re-register of
+    the same class raises and is swallowed)."""
+    from jax import export as jax_export
+
+    from ..models.generation import PagedKVCache, QuantKVCache
+
+    for cls in (PagedKVCache, QuantKVCache):
+        try:
+            jax_export.register_namedtuple_serialization(
+                cls, serialized_name=f'paddle_tpu.{cls.__name__}')
+        except ValueError:
+            pass
+
+
+def _export_stablehlo(out_dir, engine, g, draft):
+    """Serialize one geometry's traced computations as full jax.export
+    Exported flatbuffers (restorable via jax.export.deserialize — the
+    same portable layer jit.save writes). A geometry can span several
+    jitted steps (a bucketed generate is prefill + decode loop); each
+    exports to its own file. Returns the list of relative file names
+    and/or error strings — export failures are recorded, never fatal
+    (the executable cache, not StableHLO, is the zero-compile path)."""
+    from jax import export as jax_export
+
+    out = []
+    try:
+        _register_export_containers()
+        specs = list(engine._export_specs(g, draft=draft))
+    except NotImplementedError as e:
+        return [f'skipped: {e}']
+    except Exception as e:  # noqa: BLE001 - per-geometry, never fatal
+        return [f'error: {type(e).__name__}: {e}']
+    for suffix, fn, args in specs:
+        fname = f'{g.label()}{suffix}.stablehlo'
+        try:
+            exported = jax_export.export(fn)(*args)
+            data = exported.serialize()
+        except Exception as e:  # noqa: BLE001
+            out.append(f'error[{fname}]: {type(e).__name__}: {e}')
+            continue
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, fname), 'wb') as f:
+            f.write(data)
+        out.append(fname)
+    return out
+
+
+def build(engine, out_dir, geometries=None, draft=None,
+          export_stablehlo=False, **workload):
+    """Build an EngineArtifact for `engine` into `out_dir`.
+
+    `geometries` — an explicit GeometrySet; default is
+    `aot.geometry.for_engine(engine, **workload)` (workload kwargs like
+    `prompt_lens=range(1, 33)` are forwarded there). `draft` — the
+    draft model, required when speculative geometries are enumerated.
+    Compilation happens through the live dispatch path with the
+    persistent cache wired to the artifact directory, so building is
+    also a warmup of the CURRENT process."""
+    from .. import sysconfig
+
+    if geometries is None:
+        geometries = _geometry.for_engine(engine, **workload)
+    if not len(geometries):
+        raise ValueError('refusing to build an empty artifact: the '
+                         'GeometrySet enumerated no geometries')
+    out_dir = os.path.abspath(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    prev_cache_dir = sysconfig.persistent_compilation_cache_dir()
+    cache_dir = sysconfig.enable_persistent_compilation_cache(
+        os.path.join(out_dir, 'xla_cache'))
+    if cache_dir is None:
+        raise RuntimeError(
+            'this jax build has no persistent compilation cache '
+            'support; an EngineArtifact cannot persist executables')
+
+    keys = geometries.registry_keys(engine)
+    t0 = time.perf_counter()
+    traces0 = _all_traces()
+    gdicts = []
+    # a process that already served traffic holds these geometries in
+    # jax's IN-PROCESS jit cache: driving them again would hit there,
+    # compile nothing, and persist NOTHING into the artifact — the warm
+    # replica would then silently recompile exactly the hottest
+    # geometries during attach. Evicting THIS engine family's jitted
+    # steps (per function, never process-wide — other engines in the
+    # process keep their hot caches) forces every geometry through a
+    # real dispatch-path compile against the artifact's cache, and
+    # re-populates the in-process cache as it goes, so a builder that
+    # keeps serving afterwards stays warm for the driven geometries.
+    for fn in engine._aot_jitted_fns():
+        fn.clear_cache()
+    try:
+        with _obs_trace.span('aot.build', cat='compile',
+                             geometries=len(geometries)):
+            for g in geometries:
+                gt0 = time.perf_counter()
+                engine._warm_geometry(g, draft=draft)
+                d = g.to_dict()
+                d['key'] = key_str(_portable_key(
+                    _geometry._registry_key(engine, g)))
+                d['build_s'] = round(time.perf_counter() - gt0, 4)
+                if export_stablehlo:
+                    d['stablehlo'] = _export_stablehlo(
+                        os.path.join(out_dir, 'stablehlo'), engine, g,
+                        draft)
+                gdicts.append(d)
+                _obs.inc('aot.built_geometries')
+    finally:
+        # the redirection is SCOPED to the build: a builder that keeps
+        # serving must not leak undeclared executables into the
+        # artifact (contents would drift from the manifest) nor starve
+        # a previously wired cache dir
+        if prev_cache_dir != cache_dir:
+            sysconfig.restore_persistent_compilation_cache(prev_cache_dir)
+    cfg = engine.aot_config()
+    manifest = {
+        'version': MANIFEST_VERSION,
+        'created_at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+        'fingerprint': fingerprint(),
+        'engine': cfg,
+        'config_hash': config_hash(cfg),
+        'geometries': gdicts,
+        'registry_keys': [key_str(_portable_key(k)) for k in keys],
+        'build': {
+            'seconds': round(time.perf_counter() - t0, 3),
+            'traces': _all_traces() - traces0,
+            'n_geometries': len(geometries),
+        },
+    }
+    with open(os.path.join(out_dir, MANIFEST_NAME), 'w') as f:
+        json.dump(manifest, f, indent=2)
+    return EngineArtifact(out_dir, manifest)
+
+
+def warm_attach(engine, artifact=None, geometries=None, draft=None):
+    """The engines' `warmup()` implementation. With `artifact` (an
+    EngineArtifact or its directory path): fingerprint/config check,
+    wire the persistent cache, drive the manifest's geometries. With
+    bare `geometries`: drive those (in-process pre-trace only — no
+    disk cache). Returns the warmup report.
+
+    The cache redirection is SCOPED like build()'s: after the drive,
+    the previous wiring (usually none) is restored — a replica's later
+    undeclared compiles must not write into a shared (often read-only)
+    artifact mount, nor drift its contents from the manifest."""
+    from .. import sysconfig
+
+    if artifact is None and geometries is None:
+        raise ValueError('warmup needs an artifact=... or geometries=...')
+    cache_dir = None
+    prev_cache_dir = sysconfig.persistent_compilation_cache_dir()
+    if artifact is not None:
+        if isinstance(artifact, (str, os.PathLike)):
+            artifact = EngineArtifact.load(artifact)
+        artifact.check(engine)
+        cache_dir = sysconfig.enable_persistent_compilation_cache(
+            artifact.cache_dir)
+        if geometries is None:
+            geometries = artifact.geometry_set()
+    t0 = time.perf_counter()
+    traces0 = _all_traces()
+    try:
+        with _obs_trace.span('aot.warmup', cat='compile',
+                             geometries=len(geometries)):
+            for g in geometries:
+                engine._warm_geometry(g, draft=draft)
+                _obs.inc('aot.warmed_geometries')
+    finally:
+        if cache_dir is not None and prev_cache_dir != cache_dir:
+            sysconfig.restore_persistent_compilation_cache(prev_cache_dir)
+    report = {
+        'geometries': len(geometries),
+        'seconds': round(time.perf_counter() - t0, 3),
+        'traces': _all_traces() - traces0,
+        'persistent_cache_dir': cache_dir,
+    }
+    _obs.set_gauge('aot.warmup_s', report['seconds'])
+    return report
+
+
+__all__ = ['ArtifactMismatch', 'EngineArtifact', 'build', 'warm_attach',
+           'fingerprint', 'config_hash', 'MANIFEST_NAME']

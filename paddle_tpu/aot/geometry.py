@@ -1,0 +1,280 @@
+"""Geometry enumeration — the declarative half of the AOT subsystem.
+
+A *geometry* is one (jitted function, traced shapes, static config)
+combination an engine will dispatch: a prefill bucket at a batch width,
+a decode window over the paged pool, a speculative window, a train
+micro-batch scan. The engines already key their CompileCache registries
+on exactly these combinations; this module enumerates them STATICALLY
+from an engine's config, so a build machine can compile every one of
+them before the first request exists (aot.build) and a fresh replica
+can warm-attach the results (engine.warmup).
+
+The contract tests/test_aot.py pins: for a declared workload, the
+GeometrySet's `registry_keys(engine)` equal EXACTLY the keys the live
+engine notes while serving that workload — no missing (a first request
+would compile) and no extra (the artifact would carry dead executables
+and the build would overclaim coverage).
+
+Every Geometry is a dict of primitives (it round-trips through the
+artifact manifest's JSON); see docs/aot_warmup.md.
+"""
+from __future__ import annotations
+
+import re
+
+from ..inference.engine import bucket_length
+
+_SAFE = re.compile(r'[^A-Za-z0-9_.]')
+
+
+class Geometry:
+    """One compilable dispatch shape: `kind` + a params dict of
+    primitives. Kinds and their params:
+
+      decode        batch, prompt_len, max_new_tokens
+      decode_spec   batch, prompt_len, max_new_tokens, num_draft_tokens
+      serve_step    window, bucket
+      serve_window  window
+      serve_prefill bucket
+      train_step    input_shapes, input_dtypes, label_shapes,
+                    label_dtypes (shape entries are tuples/lists of int)
+    """
+
+    __slots__ = ('kind', 'params')
+
+    def __init__(self, kind, **params):
+        self.kind = str(kind)
+        self.params = params
+
+    def to_dict(self):
+        return {'kind': self.kind, **self.params}
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        kind = d.pop('kind')
+        # JSON turns tuples into lists; normalise shape-like params back
+        # so keys computed from a loaded manifest equal freshly
+        # enumerated ones
+        for k, v in d.items():
+            if isinstance(v, list):
+                d[k] = tuple(tuple(x) if isinstance(x, list) else x
+                             for x in v)
+        return cls(kind, **d)
+
+    def label(self):
+        """Filesystem-safe short name (stablehlo export file stems,
+        warmup report lines)."""
+
+        def flat(v):
+            if isinstance(v, (list, tuple)):
+                return 'x'.join(flat(x) for x in v)
+            return _SAFE.sub('', str(v))
+
+        bits = [self.kind]
+        for k in sorted(self.params):
+            bits.append(f'{k[0]}{flat(self.params[k])}')
+        return '-'.join(bits)
+
+    def _key(self):
+        def freeze(v):
+            if isinstance(v, (list, tuple)):
+                return tuple(freeze(x) for x in v)
+            return v
+
+        return (self.kind,
+                tuple(sorted((k, freeze(v))
+                             for k, v in self.params.items())))
+
+    def __eq__(self, other):
+        return (isinstance(other, Geometry)
+                and self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return f'Geometry({self.label()})'
+
+
+class GeometrySet:
+    """An ordered, de-duplicated collection of Geometry entries plus
+    the key-derivation against a live engine."""
+
+    def __init__(self, entries=()):
+        self.entries = []
+        seen = set()
+        for g in entries:
+            if g not in seen:
+                seen.add(g)
+                self.entries.append(g)
+
+    def __iter__(self):
+        return iter(self.entries)
+
+    def __len__(self):
+        return len(self.entries)
+
+    def to_manifest(self):
+        return [g.to_dict() for g in self.entries]
+
+    @classmethod
+    def from_manifest(cls, dicts):
+        return cls(Geometry.from_dict(d) for d in dicts)
+
+    def registry_keys(self, engine):
+        """The exact CompileCache keys the live `engine` notes when it
+        dispatches these geometries, deduped in enumeration order.
+        (Multiple geometries can share one registry key: a bucketed
+        generate records one key per (B, bucket) while dispatching two
+        jitted functions.)"""
+        keys, seen = [], set()
+        for g in self.entries:
+            k = _registry_key(engine, g)
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+        return keys
+
+
+def _registry_key(engine, g):
+    p = g.params
+    if g.kind == 'decode':
+        return engine.registry_key_generate(
+            p['batch'], p['prompt_len'], p['max_new_tokens'])
+    if g.kind == 'decode_spec':
+        return engine.registry_key_speculative(
+            p['batch'], p['prompt_len'], p['max_new_tokens'],
+            p['num_draft_tokens'])
+    if g.kind == 'serve_step':
+        return engine.registry_key('serve_step', p['window'], p['bucket'])
+    if g.kind == 'serve_window':
+        return engine.registry_key('serve_window', p['window'])
+    if g.kind == 'serve_prefill':
+        return engine.registry_key('serve_prefill', p['bucket'])
+    if g.kind == 'train_step':
+        return engine.registry_key(p['input_shapes'][0],
+                                   p['input_dtypes'][0])
+    raise ValueError(f'unknown geometry kind {g.kind!r}')
+
+
+# ---------------------------------------------------------------------------
+# Per-engine enumeration
+# ---------------------------------------------------------------------------
+
+def for_decode_engine(engine, prompt_lens, batch_sizes=(1,),
+                      max_new_tokens=None, spec_draft_tokens=None,
+                      spec_batch_sizes=(1,)):
+    """Geometries a DecodeEngine serves for the declared workload.
+
+    `prompt_lens` — iterable of prompt lengths the deployment admits
+    (only their BUCKETS matter for `generate`: one geometry per
+    (batch, bucket) pair). `max_new_tokens` — per-call budgets; None
+    means the engine default. `spec_draft_tokens` — iterable of k
+    values to additionally enumerate speculative windows for (the
+    speculative path is NOT bucketed, so every distinct prompt length
+    is its own geometry there)."""
+    entries = []
+    mnts = (max_new_tokens if isinstance(max_new_tokens, (list, tuple))
+            else [max_new_tokens])
+    for B in batch_sizes:
+        for mnt in mnts:
+            budget = engine.max_new_tokens if mnt is None else int(mnt)
+            seen_buckets = set()
+            for L in prompt_lens:
+                b = bucket_length(int(L), engine.buckets)
+                # one representative prompt length per (bucket,
+                # exactness) pair: any padded length in a bucket shares
+                # one compilation (left-pad + traced real_len), but an
+                # EXACT-length prompt takes the unpadded prefill and
+                # the padded=False decode loop — a distinct trace under
+                # the same registry key, so both variants must be
+                # warmable when the workload declares both
+                variant = (b, int(L) == b)
+                if variant in seen_buckets:
+                    continue
+                seen_buckets.add(variant)
+                entries.append(Geometry(
+                    'decode', batch=int(B), prompt_len=int(L),
+                    max_new_tokens=budget))
+    if spec_draft_tokens:
+        # the speculative path honors the same per-call budgets as
+        # generate (and is NOT bucketed: the exact prompt length is
+        # part of its cache shape, so every declared length enumerates)
+        for B in spec_batch_sizes:
+            for k in spec_draft_tokens:
+                for mnt in mnts:
+                    budget = (engine.max_new_tokens if mnt is None
+                              else int(mnt))
+                    for L in prompt_lens:
+                        entries.append(Geometry(
+                            'decode_spec', batch=int(B),
+                            prompt_len=int(L), max_new_tokens=budget,
+                            num_draft_tokens=int(k)))
+    return GeometrySet(entries)
+
+
+def for_serving_engine(engine, prompt_lens=None,
+                       include_standalone_prefill=True):
+    """Geometries a ServingEngine dispatches: one fused admit+decode
+    step per admission bucket, the pure decode window, and (when
+    `include_standalone_prefill`) the standalone prefill each bucket
+    can additionally hit on a multi-bucket admission step.
+
+    `prompt_lens` bounds the admission context lengths (prompt +
+    resumed prefix) the deployment will see; default is full coverage
+    of 1..max_context_len — the safe choice for an artifact, since a
+    preempted request re-prefills at prompt+prefix length."""
+    W = engine.decode_window
+    if prompt_lens is None:
+        prompt_lens = range(1, engine.max_context_len + 1)
+    buckets = []
+    for L in prompt_lens:
+        b = bucket_length(int(L), engine.buckets)
+        if b not in buckets:
+            buckets.append(b)
+    entries = [Geometry('serve_step', window=W, bucket=b)
+               for b in buckets]
+    entries.append(Geometry('serve_window', window=W))
+    if include_standalone_prefill:
+        entries.extend(Geometry('serve_prefill', bucket=b)
+                       for b in buckets)
+    return GeometrySet(entries)
+
+
+def for_train_engine(engine, batch_shape, batch_dtype='int32',
+                     extra_input_shapes=(), extra_input_dtypes=(),
+                     label_shapes=(), label_dtypes=()):
+    """The fused-train-step geometry for one global batch shape (pass
+    several shapes through repeated calls + `GeometrySet(a.entries +
+    b.entries)` if the loader yields more than one)."""
+    shapes = (tuple(int(s) for s in batch_shape),) + tuple(
+        tuple(int(s) for s in sh) for sh in extra_input_shapes)
+    dtypes = (str(batch_dtype),) + tuple(str(d) for d in extra_input_dtypes)
+    return GeometrySet([Geometry(
+        'train_step',
+        input_shapes=shapes, input_dtypes=dtypes,
+        label_shapes=tuple(tuple(int(s) for s in sh)
+                           for sh in label_shapes),
+        label_dtypes=tuple(str(d) for d in label_dtypes))])
+
+
+def for_engine(engine, **workload):
+    """Dispatch on engine type (the `aot.build` entry point)."""
+    from ..inference.engine import DecodeEngine
+    from ..inference.serving import ServingEngine
+    from ..training.engine import TrainEngine
+
+    if isinstance(engine, ServingEngine):
+        return for_serving_engine(engine, **workload)
+    if isinstance(engine, DecodeEngine):
+        return for_decode_engine(engine, **workload)
+    if isinstance(engine, TrainEngine):
+        return for_train_engine(engine, **workload)
+    raise TypeError(
+        f'no geometry enumeration for {type(engine).__name__}; expected '
+        f'a DecodeEngine, ServingEngine, or TrainEngine')
+
+
+__all__ = ['Geometry', 'GeometrySet', 'for_engine', 'for_decode_engine',
+           'for_serving_engine', 'for_train_engine']

@@ -51,6 +51,7 @@ cached_attention, exactly like model.generate().
 """
 from __future__ import annotations
 
+import ast
 import collections
 import functools
 import inspect
@@ -96,14 +97,61 @@ def reset_trace_counts():
     _TRACE_COUNTS.clear()
 
 
+def model_tag(model):
+    """Stable, serializable identity for a model CLASS: the qualified
+    import path. Replaces the old `id(type(model))` key component —
+    object ids are neither stable across processes nor serializable,
+    which the AOT artifact manifest (paddle_tpu.aot) needs them to be."""
+    t = type(model)
+    return f'{t.__module__}.{t.__qualname__}'
+
+
+def model_struct(model):
+    """Structural hash of a model pytree: sha256 over every array
+    leaf's (shape, dtype), in tree order. Compilation keys on exactly
+    this (values don't enter the HLO shape), so the AOT artifact
+    config hashes it — two same-class models of different sizes must
+    NOT share an artifact (every cache lookup would silently miss),
+    while same-architecture checkpoints with different weights must."""
+    import hashlib
+
+    parts = []
+    for leaf in jax.tree.leaves(model):
+        if hasattr(leaf, 'shape') and hasattr(leaf, 'dtype'):
+            parts.append(f'{tuple(leaf.shape)}:{leaf.dtype}')
+        else:
+            parts.append(repr(leaf))
+    return hashlib.sha256('|'.join(parts).encode()).hexdigest()[:16]
+
+
+def key_str(key):
+    """Stable string form of a CompileCache key. Keys are tuples of
+    primitives (str/int/float/bool/None, nested tuples) by contract, so
+    `repr` round-trips exactly through `key_from_str` — the property the
+    AOT manifest relies on to persist per-geometry keys."""
+    return repr(key)
+
+
+def key_from_str(s):
+    """Inverse of `key_str` (ast.literal_eval: data only, no code)."""
+    return ast.literal_eval(s)
+
+
 class CompileCache:
     """Bookkeeping mirror of jax's jit cache for the engine functions.
 
     jax itself caches compiled executables keyed on (function, pytree
     structure, avals, statics); this registry records the engine-level
-    key — (model-id, cache shape, cache dtype, sampling-config) — for
-    each compilation the engine requests, so serving code can observe
-    hits/misses and tests can assert the steady state."""
+    key — (model-tag, model-id, cache shape, cache dtype,
+    sampling-config, geometry) — for each compilation the engine
+    requests, so serving code can observe hits/misses and tests can
+    assert the steady state.
+
+    Key contract (relied on by paddle_tpu.aot): every key is a tuple of
+    PRIMITIVES — str/int/float/bool/None and nested tuples of the same.
+    No object ids, no callables, no arrays. `key_str`/`key_from_str`
+    round-trip any key through its stable string form, which is what
+    the artifact manifest persists."""
 
     def __init__(self):
         self._keys: dict = {}
@@ -112,11 +160,14 @@ class CompileCache:
 
     def key(self, model, cache_shape, cache_dtype, sampling,
             geometry=('contiguous',)):
-        # _engine_model_id (stamped by DecodeEngine.__init__) never
-        # recycles, unlike id(model) — the raw-id fallback only covers
-        # direct module-level callers that bypassed an engine. The id
-        # counter starts at 0, so compare against None (a bare `or`
-        # would throw away the first engine's id as falsy)
+        # _engine_model_id is a monotonic per-process counter stamped on
+        # first use — it never recycles (id(model) can, after gc) and
+        # it is a PRIMITIVE, so keys stay serializable (the aot
+        # manifest contract). The raw-id fallback only covers __slots__
+        # models that refuse the stamp (model_tag keeps two classes'
+        # ids from colliding). The counter starts at 0, so compare
+        # against None (a bare `or` would throw away the first model's
+        # id as falsy)
         #
         # `geometry` is the engine's batch-capacity tuple: DecodeEngine
         # passes ('contiguous', B, max_len), ServingEngine passes
@@ -125,9 +176,14 @@ class CompileCache:
         # and sampling config would collide on one registry key and the
         # hit/miss accounting would lie about both
         mid = getattr(model, '_engine_model_id', None)
-        return (id(type(model)), mid if mid is not None else id(model),
-                tuple(cache_shape), str(cache_dtype), tuple(sampling),
-                tuple(geometry))
+        if mid is None:
+            try:
+                model._engine_model_id = mid = next(_MODEL_IDS)
+            except AttributeError:
+                mid = id(model)
+        return (model_tag(model), mid,
+                tuple(int(s) for s in cache_shape), str(cache_dtype),
+                tuple(sampling), tuple(geometry))
 
     def note(self, key):
         if key in self._keys:
@@ -409,12 +465,24 @@ class DecodeEngine:
                              else None)
         self.buckets = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
         if persistent_cache is None:
-            persistent_cache = (
-                os.environ.get('PADDLE_TPU_PERSISTENT_CACHE') == '1')
+            # env contract: boolean-ish values toggle the DEFAULT dir
+            # ('true'/'yes'/'on' count as on — a deployment writing a
+            # conventional boolean must not get a junk './true' cache
+            # dir); anything else is an explicit cache DIRECTORY
+            env = os.environ.get('PADDLE_TPU_PERSISTENT_CACHE', '')
+            low = env.strip().lower()
+            if low in ('', '0', 'false', 'no', 'off'):
+                persistent_cache = False
+            elif low in ('1', 'true', 'yes', 'on'):
+                persistent_cache = True
+            else:
+                persistent_cache = env
         if persistent_cache:
             from .. import sysconfig
 
-            sysconfig.enable_persistent_compilation_cache()
+            sysconfig.enable_persistent_compilation_cache(
+                persistent_cache if isinstance(persistent_cache, str)
+                else None)
         params = inspect.signature(model.forward).parameters
         self._supports_padding = ('positions' in params
                                   and 'kv_start' in params)
@@ -447,6 +515,134 @@ class DecodeEngine:
                          'max_new_tokens': self.max_new_tokens,
                          'buckets': self.buckets},
         }
+
+    # -- AOT artifact hooks (paddle_tpu.aot) -------------------------------
+
+    def aot_config(self):
+        """Compilation-relevant config as a dict of primitives: what
+        two engines must share for one EngineArtifact to serve both.
+        Model weight VALUES are deliberately absent (a finetuned
+        checkpoint of the same architecture attaches to the same
+        artifact) but the STRUCTURE rides in as `model_struct` —
+        compilation keys on shapes/dtypes, so a differently-sized model
+        of the same class must refuse, not silently miss every cache
+        entry."""
+        return {
+            'engine': 'DecodeEngine',
+            'model': model_tag(self.model),
+            'model_struct': model_struct(self.model),
+            'cache_dtype': str(self.model.cache_dtype()),
+            'max_new_tokens': self.max_new_tokens,
+            'temperature': self.temperature,
+            'top_k': self.top_k,
+            'top_p': self.top_p,
+            'eos_token_id': self.eos_token_id,
+            'buckets': list(self.buckets),
+        }
+
+    def registry_key_generate(self, batch, prompt_len, max_new_tokens=None):
+        """The EXACT CompileCache key a `generate(ids)` call with this
+        (batch, prompt length, budget) would note — the unit
+        GeometrySet enumeration is checked against."""
+        mnt = (self.max_new_tokens if max_new_tokens is None
+               else int(max_new_tokens))
+        max_len = bucket_length(int(prompt_len), self.buckets) + mnt
+        return COMPILE_CACHE.key(
+            self.model, (int(batch), max_len), self.model.cache_dtype(),
+            self._sampling_key() + ('generate',),
+            geometry=self._geometry(batch, max_len))
+
+    def registry_key_speculative(self, batch, prompt_len, max_new_tokens,
+                                 num_draft_tokens):
+        """The key a `generate_speculative` call would note (prompts are
+        NOT bucketed on that path, so the exact prompt length is part
+        of the cache shape)."""
+        max_len = int(prompt_len) + int(max_new_tokens) + (
+            int(num_draft_tokens) + 1)
+        return COMPILE_CACHE.key(
+            self.model, (int(batch), max_len), self.model.cache_dtype(),
+            (int(num_draft_tokens), 'speculative'),
+            geometry=self._geometry(batch, max_len))
+
+    def _aot_jitted_fns(self):
+        """The module-level jitted steps this engine's geometries
+        dispatch — what `aot.build` cache-evicts (per FUNCTION, not
+        process-wide) to force real persisting compiles."""
+        return (_prefill_exact, _prefill_padded, _decode_loop,
+                _spec_decode_b1, _spec_window_batched)
+
+    def _warm_geometry(self, g, draft=None):
+        """Drive ONE enumerated geometry through the LIVE serving path
+        (a dummy generate call), populating jax's module-level trace
+        cache and the CompileCache registry with exactly the entries a
+        real request of this shape will hit. Dummy token ids are zeros;
+        outputs are discarded."""
+        p = g.params
+        ids = jnp.zeros((p['batch'], p['prompt_len']), jnp.int32)
+        if g.kind == 'decode_spec':
+            if draft is None:
+                raise ValueError(
+                    'geometry kind decode_spec needs the draft model: '
+                    'pass warmup(..., draft=draft_model)')
+            self.generate_speculative(
+                draft, ids, max_new_tokens=p['max_new_tokens'],
+                num_draft_tokens=p['num_draft_tokens'])
+        else:
+            self.generate(ids, max_new_tokens=p['max_new_tokens'])
+
+    def warmup(self, artifact=None, geometries=None, draft=None):
+        """Pre-populate the module-level jit caches (and the
+        CompileCache registry) for every geometry this engine will
+        dispatch, BEFORE the first request. With `artifact` (an
+        `aot.EngineArtifact` or its path) the manifest is
+        fingerprint-checked and jax's persistent executable cache is
+        wired to the artifact's, so the warmup compiles are disk reads,
+        not XLA runs — the zero-compile cold start. Returns a report
+        dict; see docs/aot_warmup.md."""
+        from ..aot.artifact import warm_attach
+
+        return warm_attach(self, artifact=artifact, geometries=geometries,
+                           draft=draft)
+
+    def _export_specs(self, g, draft=None):
+        """(suffix, jitted_fn, args) tuples for `aot.build(...,
+        export_stablehlo=True)`: the geometry's traced computations
+        over ShapeDtypeStruct avals (nothing allocated, nothing
+        executed). The model is CLOSED OVER — the jit.save idiom:
+        weights ride as constants, so the exported module is
+        self-contained and its pytree carries only arrays and
+        registered containers (a Layer in the calling convention would
+        refuse to serialize). A bucketed generate spans two jitted
+        steps, so one geometry exports two StableHLO modules."""
+        p = g.params
+        if g.kind != 'decode':
+            raise NotImplementedError(
+                f'no StableHLO export for geometry kind {g.kind!r}')
+        B, L = int(p['batch']), int(p['prompt_len'])
+        mnt = int(p['max_new_tokens'])
+        Sb = bucket_length(L, self.buckets)
+        max_len = Sb + mnt
+        caches = jax.eval_shape(
+            functools.partial(self.model.init_cache, B, max_len))
+        ids = jax.ShapeDtypeStruct((B, Sb), jnp.int32)
+        rl = jax.ShapeDtypeStruct((B,), jnp.int32)
+        exact = L == Sb
+        base_pre = (_prefill_exact if exact else _prefill_padded)
+        pre_args = (caches, ids) if exact else (caches, ids, rl)
+        # tracelint: disable=TL001 - one-shot export wrappers (statics
+        # and the model baked into the closure; never a hot path)
+        pre = jax.jit(functools.partial(
+            getattr(base_pre, '__wrapped__', base_pre), self.model))
+        logits_sds, caches_sds = jax.eval_shape(pre, *pre_args)
+        yield ('-prefill', pre, pre_args)
+        # tracelint: disable=TL001 - one-shot export wrapper (see above)
+        dec = jax.jit(functools.partial(
+            getattr(_decode_loop, '__wrapped__', _decode_loop),
+            self.model, max_new_tokens=mnt, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p,
+            eos_token_id=self.eos_token_id, padded=not exact))
+        yield ('-decode', dec,
+               (caches_sds, logits_sds, rl, jax.random.PRNGKey(0)))
 
     # -- generate ----------------------------------------------------------
 
@@ -617,5 +813,6 @@ def donation_supported():
 __all__ = [
     'DecodeEngine', 'CompileCache', 'COMPILE_CACHE', 'DEFAULT_BUCKETS',
     'bucket_length', 'trace_counts', 'total_traces', 'reset_trace_counts',
-    'donation_supported',
+    'donation_supported', 'model_tag', 'model_struct', 'key_str',
+    'key_from_str',
 ]

@@ -518,15 +518,23 @@ class ServingEngine:
         return ('paged', self.max_slots, self.allocator.num_blocks,
                 self.block_size, self.max_blocks_per_seq)
 
-    def _note(self, *tag):
-        """Record one engine-level registry key (the shared recipe:
-        pool shape + dtype + sampling config + `tag` + geometry).
-        Returns the registry verdict — True on hit, False when the key
-        is NEW (this dispatch pays trace + compile; step() turns that
-        into a compile span with the measured wall duration)."""
-        return COMPILE_CACHE.note(COMPILE_CACHE.key(
+    def registry_key(self, *tag):
+        """The EXACT CompileCache key `_note(*tag)` records (the shared
+        recipe: pool shape + dtype + sampling config + `tag` +
+        geometry). Tags are the dispatch kinds step() uses:
+        ('serve_step', W, Sb), ('serve_window', W),
+        ('serve_prefill', Sb). Exposed so aot.GeometrySet enumeration
+        and the live engine provably agree key-for-key."""
+        return COMPILE_CACHE.key(
             self.model, self._pages[0].kp.shape, self.model.cache_dtype(),
-            self._sampling_key() + tag, geometry=self._geometry()))
+            self._sampling_key() + tag, geometry=self._geometry())
+
+    def _note(self, *tag):
+        """Record one engine-level registry key. Returns the registry
+        verdict — True on hit, False when the key is NEW (this dispatch
+        pays trace + compile; step() turns that into a compile span
+        with the measured wall duration)."""
+        return COMPILE_CACHE.note(self.registry_key(*tag))
 
     def _metrics(self):
         """Cached registry handles for the hot per-step records (the
@@ -596,6 +604,160 @@ class ServingEngine:
                          'max_blocks_per_seq': self.max_blocks_per_seq,
                          'decode_window': self.decode_window},
         }
+
+    # -- AOT artifact hooks (paddle_tpu.aot) -------------------------------
+
+    def aot_config(self):
+        """Compilation-relevant config as a dict of primitives (what
+        two engines must share for one EngineArtifact to serve both;
+        weights are structure, not values — see DecodeEngine)."""
+        from .engine import model_struct, model_tag
+
+        return {
+            'engine': 'ServingEngine',
+            'model': model_tag(self.model),
+            'model_struct': model_struct(self.model),
+            'cache_dtype': str(self.model.cache_dtype()),
+            'max_slots': self.max_slots,
+            'block_size': self.block_size,
+            'num_blocks': self.allocator.num_blocks,
+            'max_context_len': self.max_context_len,
+            'max_new_tokens': self.max_new_tokens,
+            'decode_window': self.decode_window,
+            'temperature': self.temperature,
+            'top_k': self.top_k,
+            'top_p': self.top_p,
+            'eos_token_id': self.eos_token_id,
+            'buckets': list(self.buckets),
+        }
+
+    def _aot_jitted_fns(self):
+        """The module-level jitted steps this engine's geometries
+        dispatch — what `aot.build` cache-evicts (per FUNCTION, not
+        process-wide) to force real persisting compiles."""
+        return (_paged_prefill, _serve_window, _serve_step)
+
+    def _warm_geometry(self, g, draft=None):
+        """Drive ONE enumerated geometry through the SAME module-level
+        jitted steps the scheduler dispatches, with an all-dummy slot
+        batch: real_len 0 rows land on the scratch page, slot indices
+        max_slots drop their logits on the OOB scatter, and live=False
+        freezes every row — so warming an IDLE engine (enforced below)
+        mutates no scheduler state beyond the (donated, re-assigned)
+        device pools. The args come from the same builders step() uses
+        (`_prefill_args`, `_device_state`), so the traced avals are the
+        live ones by construction."""
+        p = g.params
+        W = self.decode_window
+        if p.get('window', W) != W:
+            raise ValueError(
+                f'geometry {g.label()} was enumerated for decode_window '
+                f"{p['window']}, engine has {W}")
+        if self.in_flight():
+            # the dummy batch is only inert when every slot is empty: a
+            # LIVE row would really decode through the dummy window
+            # (pages written, last_logits advanced) while the host
+            # mirror commits nothing — silent token corruption for
+            # every in-flight request
+            raise RuntimeError(
+                f'cannot warm a ServingEngine with {self.in_flight()} '
+                f'request(s) in flight: drain the batch (run()) before '
+                f'warmup/aot.build')
+        dev = self._device_state()
+        budget = jnp.asarray(self._budget)
+        common = dict(window=W, temperature=self.temperature,
+                      top_k=self.top_k, top_p=self.top_p,
+                      eos_token_id=self.eos_token_id)
+        # a fixed dummy key with the live aval: warming must NOT
+        # consume the engine's sampling stream (self._rng), or a warmed
+        # and an unwarmed replica seeded identically would emit
+        # different sampled tokens
+        sub = jax.random.PRNGKey(0)
+        if g.kind == 'serve_step':
+            ids, real_len, btabs, slots = self._prefill_args(p['bucket'], [])
+            self._note('serve_step', W, p['bucket'])
+            _, self._last_logits, self._pages, _ = _serve_step(
+                self.model, self._pages, self._last_logits, ids, real_len,
+                btabs, slots, dev['btab'], dev['ctx'], dev['live'], budget,
+                sub, **common)
+        elif g.kind == 'serve_window':
+            self._note('serve_window', W)
+            _, self._last_logits, self._pages, _ = _serve_window(
+                self.model, self._pages, self._last_logits, dev['btab'],
+                dev['ctx'], dev['live'], budget, sub, **common)
+        elif g.kind == 'serve_prefill':
+            ids, real_len, btabs, slots = self._prefill_args(p['bucket'], [])
+            self._note('serve_prefill', p['bucket'])
+            self._last_logits, self._pages = _paged_prefill(
+                self.model, self._pages, self._last_logits, ids, real_len,
+                btabs, slots)
+        else:
+            raise ValueError(f'unknown serving geometry kind {g.kind!r}')
+
+    def warmup(self, artifact=None, geometries=None, draft=None):
+        """Pre-populate the module-level jit caches (and the
+        CompileCache registry) for every geometry this engine's config
+        implies, BEFORE the first request — with an `aot.EngineArtifact`
+        the compiles are persistent-cache disk reads, so a fresh
+        replica's first request is ZERO compiles. Returns a report
+        dict; see docs/aot_warmup.md."""
+        from ..aot.artifact import warm_attach
+
+        return warm_attach(self, artifact=artifact, geometries=geometries,
+                           draft=draft)
+
+    def _export_specs(self, g, draft=None):
+        """(suffix, jitted_fn, args) for `aot.build(...,
+        export_stablehlo=True)`. The model is closed over (the jit.save
+        idiom — a Layer in the calling convention would refuse to
+        serialize); the page pools stay ARGS, as ShapeDtypeStruct avals
+        of the engine's live pools (they are state, not weights — the
+        exported module must take them, and PagedKVCache is a
+        registered serializable container)."""
+        p = g.params
+        W = self.decode_window
+        K = self.max_slots
+
+        def sds(x):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x)
+
+        pages = sds(self._pages)
+        logits = sds(self._last_logits)
+        btab = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                    jnp.int32)
+        ctx = jax.ShapeDtypeStruct((K,), jnp.int32)
+        live = jax.ShapeDtypeStruct((K,), jnp.bool_)
+        budget = jax.ShapeDtypeStruct((K,), jnp.int32)
+        common = dict(window=W, temperature=self.temperature,
+                      top_k=self.top_k, top_p=self.top_p,
+                      eos_token_id=self.eos_token_id)
+        if g.kind in ('serve_step', 'serve_prefill'):
+            ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
+            rl = jax.ShapeDtypeStruct((K,), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            slots = jax.ShapeDtypeStruct((K,), jnp.int32)
+
+        def wrap(base, **statics):
+            # tracelint: disable=TL001 - one-shot export wrapper (model
+            # and statics baked into the closure; never a hot path)
+            return jax.jit(functools.partial(
+                getattr(base, '__wrapped__', base), self.model, **statics))
+
+        if g.kind == 'serve_step':
+            yield ('', wrap(_serve_step, **common),
+                   (pages, logits, ids, rl, btabs, slots, btab, ctx,
+                    live, budget, self._rng))
+        elif g.kind == 'serve_window':
+            yield ('', wrap(_serve_window, **common),
+                   (pages, logits, btab, ctx, live, budget, self._rng))
+        elif g.kind == 'serve_prefill':
+            yield ('', wrap(_paged_prefill),
+                   (pages, logits, ids, rl, btabs, slots))
+        else:
+            raise NotImplementedError(
+                f'no StableHLO export for geometry kind {g.kind!r}')
 
     # -- public API --------------------------------------------------------
 

@@ -164,15 +164,24 @@ def enable_compilation_cache(cache_dir='~/.cache/paddle_tpu/xla_cache',
                              min_compile_time_secs=1.0):
     """AOT compile cache (ref capability: CINN compile cache + Paddle's
     program cache). Wires jax's persistent compilation cache so repeat
-    runs skip XLA compilation entirely."""
+    runs skip XLA compilation entirely.
+
+    Delegates to sysconfig.enable_persistent_compilation_cache — the
+    ONE place that owns the wiring (explicit directory, telemetry
+    instant/gauge, and the reset of jax's once-per-process cache-used
+    verdict, without which enabling after any compile silently never
+    persists; paddle_tpu.aot artifacts depend on all three) — then
+    re-raises the persistence threshold to `min_compile_time_secs`
+    (this entry point's contract: only compilations worth caching)."""
     import jax
 
-    path = os.path.expanduser(cache_dir)
-    os.makedirs(path, exist_ok=True)
-    jax.config.update('jax_compilation_cache_dir', path)
-    jax.config.update('jax_persistent_cache_min_compile_time_secs',
-                      min_compile_time_secs)
-    jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    from ..sysconfig import enable_persistent_compilation_cache
+
+    path = enable_persistent_compilation_cache(
+        os.path.expanduser(cache_dir))
+    if path is not None and min_compile_time_secs:
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          min_compile_time_secs)
     return path
 
 

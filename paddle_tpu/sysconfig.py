@@ -27,19 +27,32 @@ def enable_persistent_compilation_cache(path=None):
     """Wire jax's on-disk executable cache so serving restarts skip XLA
     compilation entirely (the in-process jit cache only survives the
     process; this one survives reboots). Used by
-    inference.engine.DecodeEngine(persistent_cache=True) and honored
-    directly by `PADDLE_TPU_PERSISTENT_CACHE=1`.
+    inference.engine.DecodeEngine(persistent_cache=True), by
+    `paddle_tpu.aot` artifacts (build persists INTO an artifact's cache
+    dir, warm-attach re-wires it), and honored directly by the
+    PADDLE_TPU_PERSISTENT_CACHE env var ('1' for the default dir, any
+    other non-empty value is an explicit directory).
 
-    Stores under get_lib()/xla_cache by default (the same
-    PADDLE_TPU_CACHE root the native helpers use). Thresholds are
-    dropped to zero so even small decode-step executables persist.
-    Idempotent; returns the cache directory (None if this jax build has
-    no compilation-cache support)."""
+    `path` is the explicit cache directory; an explicit path always
+    wins over (and replaces) a previously wired one — an artifact
+    attach must not silently keep writing into the default cache.
+    Default is get_lib()/xla_cache (the same PADDLE_TPU_CACHE root the
+    native helpers use). Thresholds are dropped to zero so even small
+    decode-step executables persist. Idempotent; returns the cache
+    directory (None if this jax build has no compilation-cache
+    support).
+
+    The wired directory is observable in the PR-6 telemetry: a
+    `compile.persistent_cache_dir` instant on the host trace (with the
+    path) and a `compile.persistent_cache_enabled` gauge in the
+    registry, so artifact-backed runs are distinguishable from
+    cold ones in every telemetry dump."""
     global _COMPILATION_CACHE_DIR
     import jax
 
     if path is None:
         path = _COMPILATION_CACHE_DIR or os.path.join(get_lib(), 'xla_cache')
+    path = os.path.abspath(os.path.expanduser(path))
     if 'jax_compilation_cache_dir' not in jax.config.values:
         return None
     os.makedirs(path, exist_ok=True)
@@ -51,6 +64,23 @@ def enable_persistent_compilation_cache(path=None):
         except Exception:  # noqa: BLE001 - older jax: keep its defaults
             pass
     _COMPILATION_CACHE_DIR = path
+    # jax freezes its is-the-cache-used verdict at the FIRST compile of
+    # the process; wiring a directory after any compile (engine
+    # construction alone compiles helpers) would silently never
+    # persist. reset_cache() clears that verdict so the next compile
+    # re-evaluates against the directory just wired.
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # noqa: BLE001 - private API moved: best effort
+        pass
+    from .observability import metrics as _obs
+    from .observability import tracing as _obs_trace
+
+    _obs.set_gauge('compile.persistent_cache_enabled', 1.0)
+    _obs_trace.instant('compile.persistent_cache_dir', cat='compile',
+                       path=path)
     return path
 
 
@@ -58,3 +88,21 @@ def persistent_compilation_cache_dir():
     """The directory enable_persistent_compilation_cache wired (None if
     never enabled this process)."""
     return _COMPILATION_CACHE_DIR
+
+
+def restore_persistent_compilation_cache(path):
+    """Re-wire the persistent cache to `path`, or fully UNWIRE it when
+    `path` is None — the restore half of a scoped redirection (aot.build
+    points the cache at an artifact directory for the duration of the
+    build only; leaving it wired would leak every later compile of a
+    still-serving builder into the artifact, and starve whatever dir
+    the process had wired before)."""
+    global _COMPILATION_CACHE_DIR
+    if path is not None:
+        return enable_persistent_compilation_cache(path)
+    import jax
+
+    _COMPILATION_CACHE_DIR = None
+    if 'jax_compilation_cache_dir' in jax.config.values:
+        jax.config.update('jax_compilation_cache_dir', None)
+    return None

@@ -65,7 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tree import split_trainable
-from ..inference.engine import CompileCache
+from ..inference.engine import CompileCache, model_struct, model_tag
 from ..observability import metrics as _obs
 from ..observability import tracing as _obs_trace
 
@@ -262,6 +262,32 @@ def _to_tuple(x):
     return tuple(x) if isinstance(x, (list, tuple)) else (x,)
 
 
+def _callable_tag(fn):
+    """Serializable identity for a loss callable: qualified name plus
+    a hash over bytecode, constants, AND closure cell values — two
+    different lambdas (both '<lambda>'), same bytecode with different
+    constants (`* 0.5` vs `* 0.7`), or factory-made closures over
+    different values all compile different HLO and must not share an
+    AOT artifact config hash."""
+    if fn is None:
+        return None
+    name = (f'{getattr(fn, "__module__", "?")}.'
+            f'{getattr(fn, "__qualname__", type(fn).__qualname__)}')
+    code = getattr(fn, '__code__', None)
+    if code is not None:
+        import hashlib
+
+        h = hashlib.sha256(code.co_code)
+        h.update(repr(code.co_consts).encode())
+        for cell in (getattr(fn, '__closure__', None) or ()):
+            try:
+                h.update(repr(cell.cell_contents).encode())
+            except ValueError:       # empty cell
+                pass
+        name += ':' + h.hexdigest()[:8]
+    return name
+
+
 class TrainEngine:
     """Owns the compiled train/eval path for one (model, optimizer,
     loss) triple.
@@ -376,6 +402,148 @@ class TrainEngine:
             return float(sched.get_lr_at(self._host_step + 1))
         return float(sched)
 
+    # -- AOT artifact hooks (paddle_tpu.aot) -------------------------------
+
+    def _step_statics(self, lr_mode):
+        """The static_argnames kwargs of `_fused_train_step`, in ONE
+        place so `step()` and `_warm_geometry` can never drift apart
+        (a drifted static is a fresh trace — exactly the cold-start
+        cost warmup exists to pre-pay)."""
+        return dict(opt=self.optimizer, loss_fn=self.loss_fn,
+                    loss_mode=self.loss_mode, accum=self.accum_steps,
+                    lr_mode=lr_mode, scaler_cfg=self._scaler_cfg,
+                    with_preds=(bool(self.metrics)
+                                and self.loss_mode == 'fn'))
+
+    def registry_key(self, batch_shape, batch_dtype):
+        """The EXACT TRAIN_COMPILE_CACHE key a `step()` over this batch
+        shape notes — tuples of primitives only (see
+        inference.engine.CompileCache's key contract)."""
+        return (model_tag(self.model), self._engine_id,
+                tuple(int(s) for s in batch_shape), str(batch_dtype),
+                (self.accum_steps, self._lr_mode(), self.loss_mode,
+                 self._scaler_cfg))
+
+    def aot_config(self):
+        """Compilation-relevant config as a dict of primitives (the
+        artifact-compatibility contract; weight VALUES and host-side
+        knobs like log_window are deliberately absent, the model's
+        param STRUCTURE rides in as `model_struct`)."""
+        opt = self.optimizer
+        return {
+            'engine': 'TrainEngine',
+            'model': model_tag(self.model),
+            'model_struct': model_struct(self.model),
+            'optimizer': (f'{type(opt).__module__}.'
+                          f'{type(opt).__qualname__}'
+                          if opt is not None else None),
+            'loss_fn': _callable_tag(self.loss_fn),
+            'loss_mode': self.loss_mode,
+            'lr_mode': self._lr_mode() if opt is not None else None,
+            'accum_steps': self.accum_steps,
+            'scaler_cfg': (list(self._scaler_cfg)
+                           if self._scaler_cfg is not None else None),
+        }
+
+    def _aot_jitted_fns(self):
+        """The module-level jitted steps this engine's geometries
+        dispatch — what `aot.build` cache-evicts (per FUNCTION, not
+        process-wide) to force real persisting compiles."""
+        return (_fused_train_step,)
+
+    def _warm_geometry(self, g, draft=None):
+        """Drive ONE train-step geometry through `_fused_train_step`
+        with dummy zero batches and DEEP-COPIED params / optimizer /
+        scaler trees: the copies are what gets donated, so the engine's
+        live state is untouched by the warmup step (the optimizer
+        result on garbage data is discarded). Statics come from
+        `_step_statics`, identical to a real `step()`."""
+        if g.kind != 'train_step':
+            raise ValueError(
+                f'unknown train geometry kind {g.kind!r} (was this '
+                f'GeometrySet enumerated for a different engine?)')
+        if self.optimizer is None:
+            raise RuntimeError('cannot warm a train step without an '
+                               'optimizer (eval-only engine)')
+        p = g.params
+
+        def zeros(shapes, dtypes):
+            return tuple(jnp.zeros(tuple(s), d)
+                         for s, d in zip(shapes, dtypes))
+
+        inputs = zeros(p['input_shapes'], p['input_dtypes'])
+        labels = zeros(p.get('label_shapes', ()), p.get('label_dtypes', ()))
+
+        def copy_tree(tree):
+            # donated leaves must be REAL copies (an aliasing view would
+            # hand the live buffer to XLA for in-place reuse); non-array
+            # leaves ride through untouched so their avals — including
+            # python-scalar weak types — match the real step exactly
+            return jax.tree.map(
+                lambda x: x.copy() if isinstance(x, jax.Array) else x,
+                tree)
+
+        lr_mode = self._lr_mode()
+        if inputs:
+            TRAIN_COMPILE_CACHE.note(self.registry_key(
+                inputs[0].shape, inputs[0].dtype))
+        scaler_copy = (copy_tree(self.scaler_state)
+                       if self.scaler_state is not None else None)
+        _fused_train_step(
+            copy_tree(self.model), copy_tree(self.opt_state), scaler_copy,
+            inputs, labels, self._host_lr(lr_mode),
+            **self._step_statics(lr_mode))
+
+    def warmup(self, artifact=None, geometries=None, draft=None):
+        """Pre-populate the fused-train-step jit cache (and the
+        TRAIN_COMPILE_CACHE registry) before the first real batch —
+        with an `aot.EngineArtifact`, compiles are persistent-cache
+        disk reads. Params are NOT touched (the dummy step runs on
+        copies). Returns a report dict; see docs/aot_warmup.md."""
+        from ..aot.artifact import warm_attach
+
+        return warm_attach(self, artifact=artifact, geometries=geometries,
+                           draft=draft)
+
+    def _export_specs(self, g, draft=None):
+        """(suffix, jitted_fn, args) for `aot.build(...,
+        export_stablehlo=True)` — the fused train step over
+        ShapeDtypeStruct batch avals (export only traces; nothing is
+        donated or stepped). The model is closed over (the jit.save
+        idiom: a Layer in the calling convention would refuse to
+        serialize) and the updated params return FLATTENED, so the
+        exported module's pytrees carry only arrays, dicts, and
+        tuples."""
+        if g.kind != 'train_step':
+            raise NotImplementedError(
+                f'no StableHLO export for geometry kind {g.kind!r}')
+        p = g.params
+
+        def sds(shapes, dtypes):
+            return tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                         for s, d in zip(shapes, dtypes))
+
+        inputs = sds(p['input_shapes'], p['input_dtypes'])
+        labels = sds(p.get('label_shapes', ()), p.get('label_dtypes', ()))
+        lr_mode = self._lr_mode()
+        statics = self._step_statics(lr_mode)
+        base = getattr(_fused_train_step, '__wrapped__',
+                       _fused_train_step)
+        model = self.model
+
+        def step_flat(opt_state, scaler_state, ins, labs, host_lr):
+            new_model, new_state, new_scaler, loss, _ = base(
+                model, opt_state, scaler_state, ins, labs, host_lr,
+                **statics)
+            return (tuple(jax.tree.leaves(new_model)), new_state,
+                    new_scaler, loss)
+
+        # tracelint: disable=TL001 - one-shot export wrapper, not a hot
+        # path
+        yield ('', jax.jit(step_flat),
+               (self.opt_state, self.scaler_state, inputs, labels,
+                self._host_lr(lr_mode)))
+
     # -- the hot path ------------------------------------------------------
 
     def step(self, inputs, labels=()):
@@ -404,20 +572,13 @@ class TrainEngine:
         if inputs and hasattr(inputs[0], 'size'):
             self._window_tokens += int(inputs[0].size)
         lr_mode = self._lr_mode()
-        with_preds = bool(self.metrics) and self.loss_mode == 'fn'
         if inputs:
-            TRAIN_COMPILE_CACHE.note((
-                id(type(self.model)), self._engine_id,
-                tuple(inputs[0].shape), str(inputs[0].dtype),
-                (self.accum_steps, lr_mode, self.loss_mode,
-                 self._scaler_cfg)))
+            TRAIN_COMPILE_CACHE.note(self.registry_key(
+                inputs[0].shape, inputs[0].dtype))
         (self.model, self.opt_state, self.scaler_state, loss,
          preds) = _fused_train_step(
             self.model, self.opt_state, self.scaler_state, inputs, labels,
-            self._host_lr(lr_mode), opt=self.optimizer,
-            loss_fn=self.loss_fn, loss_mode=self.loss_mode,
-            accum=self.accum_steps, lr_mode=lr_mode,
-            scaler_cfg=self._scaler_cfg, with_preds=with_preds)
+            self._host_lr(lr_mode), **self._step_statics(lr_mode))
         self._host_step += 1
         # without metrics only the loss scalar is worth fetching: don't
         # retain (or D2H-transfer) whole pred/label tensors per window
