@@ -70,6 +70,7 @@ from . import incubate  # noqa: F401
 from . import dataset  # noqa: F401
 from . import hub  # noqa: F401
 from . import inference  # noqa: F401
+from . import testing  # noqa: F401
 from . import training  # noqa: F401
 from . import aot  # noqa: F401
 from . import onnx  # noqa: F401

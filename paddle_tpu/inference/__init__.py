@@ -276,10 +276,18 @@ from .engine import (  # noqa: E402
 )
 
 # Continuous-batching scheduler over the paged KV block pool — the
-# request-level serving path; see serving.py / docs/serving.md.
+# request-level serving path; see serving.py / docs/serving.md
+# (resilience exceptions included: QueueFull is submit()'s load-shed
+# signal, the Request* family is what result() raises for terminal
+# non-success states).
 from .serving import (  # noqa: E402
     BlockAllocator,
     OutOfBlocks,
+    QueueFull,
+    RequestCancelled,
+    RequestError,
+    RequestExpired,
+    RequestFailed,
     RequestQueue,
     ServingEngine,
 )

@@ -45,6 +45,19 @@ compilation key), greedy (temperature=0) is the parity-tested path:
 per-request outputs are exactly `DecodeEngine.generate`'s batch-1
 outputs. See docs/serving.md for the scheduler loop and the block-table
 layout.
+
+Resilience (docs/serving.md#resilience): requests carry optional
+deadlines and can be cancelled; `submit()` load-sheds against a
+bounded queue (`QueueFull`) and a pool-pressure watermark pauses
+admission before OutOfBlocks can force a preemption storm; a request
+that cannot be served — pool still dry after maximal preemption, or a
+fault injected during its prefill — FAILS alone (`state='failed'`,
+pages freed) while the rest of the batch keeps decoding; and
+`snapshot()`/`restore()` capture the host-authoritative scheduler
+state so a supervisor can rebuild a crashed replica (warmed from a
+PR-7 AOT artifact) and finish every stream bit-equal to an
+uninterrupted run. Failure paths are exercised on purpose through the
+`paddle_tpu.testing.faults` seams wired at the host boundaries below.
 """
 from __future__ import annotations
 
@@ -60,6 +73,7 @@ import numpy as np
 
 from ..observability import metrics as _obs
 from ..observability import tracing as _obs_trace
+from ..testing import faults as _faults
 from .engine import (COMPILE_CACHE, DEFAULT_BUCKETS, _count_trace,
                      bucket_length, total_traces, trace_counts)
 
@@ -68,6 +82,50 @@ class OutOfBlocks(RuntimeError):
     """The block pool cannot satisfy an allocation. The ServingEngine
     catches this and preempts; direct BlockAllocator users see it
     raised deterministically (need/have in the message)."""
+
+
+class QueueFull(RuntimeError):
+    """`submit()` rejected the request: the admission queue is at
+    `max_queue` and the shed policy found nothing to displace. The
+    deterministic load-shedding signal — callers back off and retry,
+    instead of the queue growing without bound until preemption storms
+    or host OOM kill every in-flight request."""
+
+
+class RequestError(RuntimeError):
+    """Base for terminal non-success request states, raised by
+    `result()`. Carries `rid`, the terminal `state`, a human `reason`,
+    and (for failures) the original `error` object."""
+
+    state = 'unknown'
+
+    def __init__(self, rid, reason, error=None):
+        super().__init__(f'request {rid} {self.state}: {reason}')
+        self.rid = rid
+        self.reason = reason
+        self.error = error
+
+
+class RequestFailed(RequestError):
+    """The request is unservable (pool can never fit it even drained,
+    or a fault hit its prefill/admission). `error` is the underlying
+    exception (a repr string after snapshot/restore)."""
+
+    state = 'failed'
+
+
+class RequestExpired(RequestError):
+    """The request's `deadline_s` passed before it finished (checked
+    at the per-window commit sync and at admission)."""
+
+    state = 'expired'
+
+
+class RequestCancelled(RequestError):
+    """The request was cancelled (`cancel(rid)`) or shed from a full
+    queue by a higher-priority arrival (`reason` says which)."""
+
+    state = 'cancelled'
 
 
 class BlockAllocator:
@@ -101,6 +159,11 @@ class BlockAllocator:
         # itself only moves ids); stats() reports real-unit pool sizes
         # once it is known
         self.bytes_per_page = None
+        # which scheduler phase is allocating ('admit' / 'window' /
+        # None for direct users) — set by the owning engine around its
+        # call sites purely so fault scripts can target one phase
+        # ("pool dries mid-decode but admission still works")
+        self.phase = None
 
     @property
     def usable(self):
@@ -122,6 +185,9 @@ class BlockAllocator:
         n = int(n)
         if n < 0:
             raise ValueError(f'cannot allocate {n} pages')
+        if _faults.ACTIVE is not None:   # pre-check: alloc is per-page-op
+            _faults.fire('alloc', n=n, free=len(self._free),
+                         phase=self.phase)
         if n > len(self._free):
             raise OutOfBlocks(
                 f'need {n} page(s), {len(self._free)} free '
@@ -136,6 +202,8 @@ class BlockAllocator:
         """Return pages to the free list. Double-frees and foreign ids
         raise — both are allocator-corruption bugs worth failing on."""
         pages = list(pages)
+        if _faults.ACTIVE is not None:   # pre-check: free is per-page-op
+            _faults.fire('free', pages=pages)
         for p in pages:
             if p not in self._held:
                 raise ValueError(
@@ -179,10 +247,17 @@ class Request:
     window / preempted / finished — always at points the host already
     owns (submission, scheduling, the one per-window commit sync), so
     collecting them costs no device round trip. The engine rolls them
-    into the registry's ttft/itl/queue-wait histograms."""
+    into the registry's ttft/itl/queue-wait histograms.
+
+    Terminal states are `finished` / `failed` / `expired` /
+    `cancelled`: `result` holds the output ids (finished only),
+    `reason` the human-readable cause and `error` the underlying
+    exception (failed only). `deadline` is an absolute perf_counter
+    instant armed at submit from `deadline_s`."""
 
     __slots__ = ('rid', 'prompt', 'max_new_tokens', 'priority', 'generated',
-                 'seq', 'state', 'admit_seq', 'times', 'enqueued_at')
+                 'seq', 'state', 'admit_seq', 'times', 'enqueued_at',
+                 'deadline', 'reason', 'error', 'result')
 
     def __init__(self, rid, prompt, max_new_tokens, priority):
         self.rid = rid
@@ -195,6 +270,10 @@ class Request:
         self.state = 'queued'
         self.times: list = []
         self.enqueued_at = None
+        self.deadline = None     # absolute perf_counter instant, or None
+        self.reason = None       # terminal cause (non-finished states)
+        self.error = None        # underlying exception (failed only)
+        self.result = None       # output ids (finished only)
 
     def mark(self, event, t=None):
         """Append one lifecycle timestamp (no-op while telemetry is
@@ -225,11 +304,16 @@ class Request:
 class RequestQueue:
     """Admission queue: higher `priority` first, FIFO within a
     priority. A preempted request keeps its original arrival seq, so it
-    resumes ahead of later arrivals of the same priority."""
+    resumes ahead of later arrivals of the same priority.
+
+    `remove()` is LAZY (cancel/shed mark the rid dead; the stale heap
+    entry is discarded when it surfaces at peek/pop) so cancellation is
+    O(1) and never reshuffles the heap under the scheduler."""
 
     def __init__(self):
         self._heap: list = []
         self._seq = itertools.count()
+        self._dead: set = set()
 
     def push(self, req):
         if req.seq is None:
@@ -242,14 +326,44 @@ class RequestQueue:
         req.mark('enqueued', req.enqueued_at)
         heapq.heappush(self._heap, (-req.priority, req.seq, req))
 
+    def remove(self, req):
+        """Lazily drop a queued/preempted request (cancel / shed)."""
+        self._dead.add(req.rid)
+
+    def reset_seq(self, start):
+        """Continue arrival order from `start` — restore() calls this
+        after re-pushing a snapshot's requests (which keep their
+        original seqs) so new submissions never tie or jump ahead of
+        restored peers of equal priority."""
+        self._seq = itertools.count(start)
+
+    def _prune(self):
+        while self._heap and self._heap[0][2].rid in self._dead:
+            _, _, dropped = heapq.heappop(self._heap)
+            self._dead.discard(dropped.rid)
+
     def peek(self):
+        self._prune()
         return self._heap[0][2] if self._heap else None
 
     def pop(self):
+        self._prune()
         return heapq.heappop(self._heap)[2]
 
     def __len__(self):
-        return len(self._heap)
+        return len(self._heap) - len(self._dead)
+
+    def __iter__(self):
+        """Live requests in pop order (snapshot serialization)."""
+        return (r for _, _, r in sorted(self._heap)
+                if r.rid not in self._dead)
+
+    def live(self):
+        """Live requests in heap (arbitrary) order, O(n). The
+        submit-reject backpressure path scans the whole queue — the
+        expiry sweep filters by deadline, the shed scan takes a min()
+        — and neither needs __iter__'s O(n log n) pop-order sort."""
+        return (r for _, _, r in self._heap if r.rid not in self._dead)
 
 
 # ---------------------------------------------------------------------------
@@ -425,7 +539,8 @@ class ServingEngine:
     def __init__(self, model, max_slots=8, block_size=16, num_blocks=None,
                  max_context_len=None, max_new_tokens=32, decode_window=8,
                  temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 buckets=None):
+                 buckets=None, max_queue=None, admit_watermark=1.0,
+                 shed_policy='reject', max_terminal=1024):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -464,6 +579,27 @@ class ServingEngine:
             num_blocks = self.max_slots * self.max_blocks_per_seq + 1
         self.allocator = BlockAllocator(num_blocks, self.block_size)
         self.queue = RequestQueue()
+        # admission control / load shedding (docs/serving.md#resilience):
+        # max_queue bounds what submit() will hold (QueueFull past it —
+        # preemption requeues ride above the bound, at most max_slots of
+        # them); admit_watermark pauses admission while the POST-admit
+        # pool utilization would exceed it, so steady traffic degrades
+        # to queueing instead of preemption storms; shed_policy says
+        # what a full queue does with a new arrival ('reject' it, or
+        # 'evict' the lowest-priority queued request when the arrival
+        # outranks it)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError('max_queue must be >= 1 (or None)')
+        self.admit_watermark = float(admit_watermark)
+        if not 0.0 < self.admit_watermark <= 1.0:
+            raise ValueError(
+                f'admit_watermark must be in (0, 1], got {admit_watermark}')
+        if shed_policy not in ('reject', 'evict'):
+            raise ValueError(
+                f"shed_policy must be 'reject' or 'evict', "
+                f'got {shed_policy!r}')
+        self.shed_policy = shed_policy
 
         # device state, allocated ONCE (shapes never change)
         self._pages = model.init_paged_cache(num_blocks, self.block_size)
@@ -492,8 +628,29 @@ class ServingEngine:
         # steady-state window uploads ONE small array (the budgets)
         self._dev = None
 
-        self._results: dict = {}
-        self._rid = itertools.count()
+        # request registries: every submitted request is in exactly one
+        # of these until its result is retrieved — `_live` (queued /
+        # running / preempted) or `_terminal` (finished / failed /
+        # expired / cancelled, popped by result()). `counts` are the
+        # host-truth resilience counters (stats() reports them even
+        # with telemetry off; the registry counters mirror them).
+        # `_terminal` is bounded at `max_terminal` records (oldest
+        # evicted first) so fire-and-forget cancellation or a client
+        # that never collects cannot grow host memory forever; an
+        # evicted rid reads as already-retrieved (KeyError).
+        self.max_terminal = int(max_terminal)
+        if self.max_terminal < 1:
+            raise ValueError('max_terminal must be >= 1')
+        self._live: dict = {}
+        self._terminal: dict = {}
+        # rids an active serve() batch will collect: the max_terminal
+        # eviction skips these (released per-rid by result())
+        self._collect_guard: set = set()
+        self._deadlines_live = 0     # live requests with a deadline armed
+        self.counts = {'finished': 0, 'failed': 0, 'expired': 0,
+                       'cancelled': 0, 'rejected': 0, 'shed': 0,
+                       'admission_paused': 0}
+        self._rid = 0
         self._admit_seq = itertools.count()
         self.preemption_count = 0
         self._tokens_out = 0
@@ -555,6 +712,7 @@ class ServingEngine:
                 'util': R.gauge('pool.utilization'),
                 'bytes_in_use': R.gauge('pool.bytes_in_use'),
                 'bytes_total': R.gauge('pool.bytes_total'),
+                'pressure': R.gauge('serve.pool_pressure'),
             }
             self._mgen = R.generation
             self._last_occ = None          # force a gauge refresh
@@ -576,6 +734,9 @@ class ServingEngine:
         m['queue_depth'].set(occ[1])
         m['pages_in_use'].set(occ[2])
         m['util'].set(a.utilization())
+        # watermark-relative pool pressure: 1.0 == AT the admission
+        # watermark (>= 1.0 means admission is pausing)
+        m['pressure'].set(a.utilization() / self.admit_watermark)
         if a.bytes_per_page:
             m['bytes_in_use'].set(occ[2] * a.bytes_per_page)
             m['bytes_total'].set(a.num_blocks * a.bytes_per_page)
@@ -597,6 +758,10 @@ class ServingEngine:
             'in_flight': self.in_flight(),
             'queue_depth': len(self.queue),
             'preemptions': self.preemption_count,
+            'resilience': {'max_queue': self.max_queue,
+                           'admit_watermark': self.admit_watermark,
+                           'shed_policy': self.shed_policy,
+                           **self.counts},
             'blocks': self.allocator.stats(),
             'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
                          'block_size': self.block_size,
@@ -761,18 +926,36 @@ class ServingEngine:
 
     # -- public API --------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=None, priority=0):
+    def submit(self, prompt, max_new_tokens=None, priority=0,
+               deadline_s=None):
         """Queue one request; returns its id for `result()`. Validated
         against the pool so an undeliverable request fails HERE, not as
-        a livelock mid-serve."""
+        a livelock mid-serve. `deadline_s` (seconds from now) bounds
+        total latency: a request still unfinished past it transitions
+        to state 'expired' at the next window commit (or at admission,
+        if it expires while queued). Raises `QueueFull` when the queue
+        is at `max_queue` and the shed policy keeps the newcomer out —
+        the caller's backpressure signal."""
         mnt = (self.max_new_tokens if max_new_tokens is None
                else int(max_new_tokens))
         if mnt < 1:
             raise ValueError('max_new_tokens must be >= 1')
-        req = Request(next(self._rid), prompt, mnt, priority)
-        if len(req.prompt) == 0:
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError('deadline_s must be > 0 (seconds from now)')
+        # coerced HERE so the shed decision ranks the newcomer exactly
+        # as Request will store it (a fractional 0.5 must not outrank
+        # the priority-0 peer it would be stored equal to)
+        priority = int(priority)
+        # validation and the queue-bound verdict both read the token
+        # COUNT alone: a rejected submit is the designed high-frequency
+        # backpressure path, so it must not pay the Request's prompt
+        # copy just to throw it away. np.size is O(1) on an ndarray and
+        # counts the flattened length Request.__init__ will reshape to,
+        # so multi-dimensional prompts can't sneak past the fit guards
+        plen = int(np.size(prompt))
+        if plen == 0:
             raise ValueError('empty prompt')
-        total = len(req.prompt) + mnt
+        total = plen + mnt
         if total > self.max_context_len:
             raise ValueError(
                 f'prompt + max_new_tokens = {total} exceeds '
@@ -782,24 +965,183 @@ class ServingEngine:
                 f'request needs {_ceil_div(total, self.block_size)} '
                 f'pages but the pool only has {self.allocator.usable} '
                 f'usable — grow num_blocks')
+        victim = None
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            # never shed live traffic to protect dead work: entries
+            # whose deadline already passed while queued are retired
+            # here (they'd be swept at admission anyway) before the
+            # bound is judged
+            self._sweep_expired_queue()
+            if len(self.queue) >= self.max_queue:
+                victim = self._shed_for(priority)  # raises QueueFull
+                                                   # unless it can evict
+        # the victim is only PICKED above — it is evicted after Request
+        # construction succeeds, so a malformed prompt that np.asarray
+        # rejects cannot cancel an innocent queued request on its way
+        # to raising
+        req = Request(self._rid, prompt, mnt, priority)
+        if victim is not None:
+            self._shed(victim)
+        self._rid += 1
+        if deadline_s is not None:
+            req.deadline = time.perf_counter() + float(deadline_s)
+            self._deadlines_live += 1
         req.mark('arrival')
         _obs.inc('serve.requests')
+        self._live[req.rid] = req
         self.queue.push(req)
         return req.rid
 
+    def _sweep_expired_queue(self):
+        """Retire every queued or preempted request whose deadline has
+        already passed — called when the queue bound is hit, so a
+        full-of-dead-work queue never rejects live traffic (deadline
+        death is not shedding: even a preempted request's generated
+        work is worthless once nobody is waiting for it). Early-outs
+        without scanning when no live request has a deadline armed —
+        the common config on the reject hot path."""
+        if not self._deadlines_live:
+            return
+        now = time.perf_counter()
+        for r in [r for r in self.queue.live()
+                  if r.deadline is not None and now >= r.deadline]:
+            self.queue.remove(r)
+            self._retire(r, 'expired',
+                         reason='deadline exceeded while queued')
+
+    def _shed_for(self, priority):
+        """The queue is full: under 'evict', pick the lowest-priority
+        (then youngest-arrival) QUEUED request for displacement if the
+        newcomer at `priority` outranks it — preempted requests are
+        never shed (they hold generated work). Otherwise reject the
+        newcomer. Deterministic either way; returns the victim (the
+        caller evicts via `_shed` once the newcomer is actually
+        admissible) or raises QueueFull."""
+        victim = None
+        if self.shed_policy == 'evict':
+            queued = [r for r in self.queue.live() if r.state == 'queued']
+            if queued:
+                cand = min(queued, key=lambda r: (r.priority, -r.seq))
+                if cand.priority < priority:
+                    victim = cand
+        if victim is None:
+            self.counts['rejected'] += 1
+            _obs.inc('serve.rejected')
+            raise QueueFull(
+                f'queue full ({len(self.queue)}/{self.max_queue}), '
+                f'policy={self.shed_policy!r}: request rejected — back '
+                f'off and resubmit')
+        return victim
+
+    def _shed(self, victim):
+        """Evict a `_shed_for` victim from the queue."""
+        self.queue.remove(victim)
+        # counted under 'shed' ONLY (count=False): serve.cancelled
+        # means cancel(rid), and summing the terminal counters + shed
+        # must count every request exactly once
+        self._retire(victim, 'cancelled',
+                     reason=f'shed: displaced by higher-priority '
+                            f'arrival (queue full at {self.max_queue})',
+                     count=False)
+        self.counts['shed'] += 1
+        _obs.inc('serve.shed')
+
     def result(self, rid):
-        """(prompt + max_new_tokens) ids for a finished request (eos-
-        padded past an early stop, matching DecodeEngine.generate);
-        None while pending. The output is handed over ONCE — it is
-        removed from the engine on retrieval, so a long-running server
-        does not accumulate one array per request ever served."""
-        return self._results.pop(rid, None)
+        """Terminal outcome of a request, handed over ONCE (removed
+        from the engine on retrieval, so a long-running server does not
+        accumulate one record per request ever served):
+
+          - finished  -> the (prompt + max_new_tokens) ids (eos-padded
+                         past an early stop, matching
+                         DecodeEngine.generate);
+          - failed    -> raises RequestFailed (`.error` = the cause);
+          - expired   -> raises RequestExpired;
+          - cancelled -> raises RequestCancelled (`.reason` says
+                         whether cancel() or load shedding);
+          - still pending (queued/running/preempted) -> None;
+          - unknown rid (never submitted, or already retrieved)
+                      -> raises KeyError(rid).
+        """
+        req = self._terminal.pop(rid, None)
+        if req is None:
+            if rid in self._live:
+                return None
+            raise KeyError(rid)
+        self._collect_guard.discard(rid)
+        if req.state == 'finished':
+            return req.result
+        cls = {'failed': RequestFailed, 'expired': RequestExpired,
+               'cancelled': RequestCancelled}[req.state]
+        raise cls(rid, req.reason, error=req.error)
+
+    def status(self, rid):
+        """Current state string for a known request (non-destructive —
+        `result()` still hands the outcome over). KeyError when the rid
+        is unknown or its result was already retrieved."""
+        req = self._live.get(rid) or self._terminal.get(rid)
+        if req is None:
+            raise KeyError(rid)
+        return req.state
+
+    def cancel(self, rid):
+        """Drop a request: frees its pages (running), removes it from
+        the queue (queued/preempted — requeue-safe: a preempted
+        request's stale heap entry is discarded lazily). Returns True
+        when this call cancelled it, False when it was already
+        terminal; KeyError for unknown rids. Takes effect at the host
+        scheduler boundary — the engine is single-threaded."""
+        req = self._live.get(rid)
+        if req is None:
+            if rid in self._terminal:
+                return False
+            raise KeyError(rid)
+        if req.state in ('queued', 'preempted'):
+            self.queue.remove(req)
+        else:                     # running: release its slot and pages
+            slot = self._slot_req.index(req)
+            self._clear_slot(slot)
+        self._retire(req, 'cancelled', reason='cancelled by caller')
+        self._update_gauges()
+        return True
 
     def serve(self, prompts, max_new_tokens=None):
-        """Submit + run + collect, preserving submission order."""
-        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        """Submit + run + collect, preserving submission order.
+
+        When a `max_queue` bound is configured, submission interleaves
+        with scheduler steps (client backoff in miniature): a QueueFull
+        reject drains one iteration and retries, so the convenience API
+        never trips its own engine's admission control.
+        """
+        prompts = list(prompts)
+        rids = []
+        # guard this batch's terminal records against the max_terminal
+        # eviction: serve() is the one caller that WILL collect every
+        # record, so the bound that protects against abandonment must
+        # not evict outputs the collection loop below is about to
+        # return. result() releases each rid as it hands the outcome
+        # over; after a raise below the remainder stays guarded (still
+        # individually retrievable) until drained or until the next
+        # serve() batch replaces the guard.
+        self._collect_guard = set()
+        for p in prompts:
+            while True:
+                try:
+                    rid = self.submit(p, max_new_tokens)
+                    break
+                except QueueFull:
+                    self.step()
+            rids.append(rid)
+            self._collect_guard.add(rid)
         self.run()
-        return [self._results.pop(r) for r in rids]
+        # surface the first failure BEFORE popping any finished record:
+        # result() hands outcomes over destructively, so raising midway
+        # through collection would throw away completed outputs — this
+        # way they all stay individually retrievable via result()
+        bad = next((r for r in rids if self.status(r) != 'finished'),
+                   None)
+        if bad is not None:
+            self.result(bad)         # raises the typed terminal error
+        return [self.result(r) for r in rids]
 
     def run(self, max_steps=None):
         """Step until queue and batch drain (or max_steps)."""
@@ -810,6 +1152,162 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 break
         return steps
+
+    # -- crash-safe warm restart (snapshot / restore) ----------------------
+
+    def _snapshot_config(self):
+        """The config a snapshot must agree on to resume bit-equal:
+        same model (structure hash — weights are the artifact's
+        problem) and same sampling contract. Pool geometry is NOT here:
+        a snapshot may restore into a bigger or smaller pool, each
+        request re-validated for fit."""
+        from .engine import model_struct, model_tag
+
+        return {'model': model_tag(self.model),
+                'model_struct': model_struct(self.model),
+                'temperature': self.temperature, 'top_k': self.top_k,
+                'top_p': self.top_p, 'eos_token_id': self.eos_token_id,
+                'max_context_len': self.max_context_len}
+
+    def snapshot(self):
+        """JSON-serializable host state for crash recovery: every
+        non-terminal request (queued / running / preempted — prompt,
+        generated prefix, priority, remaining deadline, arrival seq)
+        plus unretrieved terminal records, the rid/seq counters, and
+        the sampling RNG key. ALL of it is host-authoritative — the
+        device pools hold only KV rows that re-prefill reconstructs —
+        so a supervisor can checkpoint at any scheduler boundary for
+        the cost of a dict copy, rebuild a fresh engine from a PR-7
+        AOT artifact, `restore()`, and finish every stream bit-equal
+        to an uninterrupted greedy run (gate_resilience proves it)."""
+        now = time.perf_counter()
+
+        def rec(req):
+            return {
+                'rid': req.rid, 'prompt': req.prompt.tolist(),
+                'generated': [int(t) for t in req.generated],
+                'max_new_tokens': req.max_new_tokens,
+                'priority': req.priority, 'seq': req.seq,
+                'state': req.state, 'reason': req.reason,
+                'error': repr(req.error) if req.error is not None else None,
+                'deadline_left_s': (req.deadline - now
+                                    if req.deadline is not None else None),
+                'result': (req.result.tolist()
+                           if req.result is not None else None),
+            }
+
+        live = ([rec(r) for r in self.queue]
+                + [rec(r) for r in self._slot_req if r is not None])
+        return {
+            'schema': 1,
+            'config': self._snapshot_config(),
+            'requests': live,
+            'terminal': [rec(r) for r in self._terminal.values()],
+            'next_rid': self._rid,
+            'preemptions': self.preemption_count,
+            'counts': dict(self.counts),
+            'tokens_out': self._tokens_out,
+            'serve_time': self._serve_time,
+            'rng': np.asarray(self._rng).tolist(),
+        }
+
+    def restore(self, snap):
+        """Load a `snapshot()` into a FRESH engine (nothing submitted,
+        nothing in flight). In-flight requests come back as
+        'preempted' — they lost their slot to the crash and resume by
+        re-prefilling prompt + generated prefix, the same machinery
+        that makes ordinary preemption bit-equal. Deadlines re-arm from
+        their remaining budget; rid/seq counters continue past the
+        snapshot so new submissions never collide. Raises ValueError on
+        a config mismatch (naming the differing fields) or a request
+        that cannot fit THIS pool, RuntimeError when the engine is not
+        fresh. Returns a report dict."""
+        if (self.in_flight() or len(self.queue) or self._live
+                or self._terminal or self._rid):
+            raise RuntimeError(
+                'restore() needs a fresh engine: this one has requests '
+                'queued, in flight, or unretrieved, or has already '
+                'served traffic (its lifetime counters would be '
+                'silently overwritten)')
+        if snap.get('schema') != 1:
+            raise ValueError(
+                f"unsupported snapshot schema {snap.get('schema')!r} "
+                f'(this engine reads schema 1)')
+        cfg = self._snapshot_config()
+        got = snap.get('config', {})
+        diff = sorted(k for k in cfg if got.get(k) != cfg[k])
+        if diff:
+            raise ValueError(
+                f'snapshot config mismatch on {diff}: snapshot '
+                f'{ {k: got.get(k) for k in diff} } vs engine '
+                f'{ {k: cfg[k] for k in diff} }')
+        now = time.perf_counter()
+        max_seq = -1
+
+        def rebuild(r):
+            req = Request(r['rid'], r['prompt'], r['max_new_tokens'],
+                          r['priority'])
+            req.generated = [int(t) for t in r['generated']]
+            req.seq = r['seq']
+            req.state = r['state']
+            req.reason = r['reason']
+            req.error = r['error']          # repr string post-restore
+            if r['result'] is not None:
+                req.result = np.asarray(r['result'], np.int32)
+            if r['deadline_left_s'] is not None:
+                req.deadline = now + max(float(r['deadline_left_s']), 0.0)
+            return req
+
+        # validate EVERY request's fit before touching engine state: a
+        # mid-loop raise would leave the standby half-restored (its
+        # fresh-engine check then refuses a retry, and stepping it
+        # would silently serve a subset of the snapshot's streams)
+        for r in snap['requests']:
+            total = len(r['prompt']) + r['max_new_tokens']
+            if (total > self.max_context_len
+                    or _ceil_div(total, self.block_size)
+                    > self.allocator.usable):
+                raise ValueError(
+                    f"snapshot request {r['rid']} needs {total} context "
+                    f'tokens — it cannot fit this engine '
+                    f'(max_context_len {self.max_context_len}, '
+                    f'{self.allocator.usable} usable pages)')
+        for r in snap['requests']:
+            req = rebuild(r)
+            if req.state == 'running':
+                # its slot died with the old replica; re-enters as
+                # preempted so it keeps arrival order and re-prefills
+                req.state = 'preempted'
+            max_seq = max(max_seq, req.seq if req.seq is not None else -1)
+            self._live[req.rid] = req
+            if req.deadline is not None:
+                self._deadlines_live += 1
+            self.queue.push(req)
+        for r in snap['terminal']:
+            req = rebuild(r)
+            max_seq = max(max_seq, req.seq if req.seq is not None else -1)
+            self._terminal[req.rid] = req
+        while len(self._terminal) > self.max_terminal:
+            self._terminal.pop(next(iter(self._terminal)))
+        self.queue.reset_seq(max_seq + 1)
+        self._rid = max(int(snap.get('next_rid', 0)), self._rid)
+        # monitoring continuity across the failover: the replica's
+        # lifetime counters continue from the snapshot
+        self.preemption_count = int(snap.get('preemptions', 0))
+        for k, v in snap.get('counts', {}).items():
+            if k in self.counts:
+                self.counts[k] = int(v)
+        self._tokens_out = int(snap.get('tokens_out', self._tokens_out))
+        # without the matching serve-time, tokens_per_s would divide the
+        # lifetime token total by the standby's near-zero wall time — a
+        # phantom throughput spike on every failover
+        self._serve_time = float(snap.get('serve_time', self._serve_time))
+        if snap.get('rng') is not None:
+            self._rng = jnp.asarray(np.asarray(snap['rng'], np.uint32))
+        self._update_gauges()
+        return {'requests': len(snap['requests']),
+                'terminal': len(snap['terminal']),
+                'next_rid': self._rid}
 
     # -- the scheduler iteration -------------------------------------------
 
@@ -831,15 +1329,39 @@ class ServingEngine:
         and gate_serve_retrace_zero both hold it to that."""
         t0 = time.perf_counter()
         _step_span = _obs_trace.span('serve.step', cat='scheduler').begin()
+        try:
+            return self._step_impl(t0)
+        finally:
+            # ended in finally: a propagating window fault (worker
+            # death) must not leak an open span into the host trace
+            _step_span.end()
+
+    def _step_impl(self, t0):
         groups = self._admit()
         if not self.in_flight():
             self._serve_time += time.perf_counter() - t0
-            _step_span.end()
+            self._update_gauges()   # admission may have expired/failed
             return []
-        self._ensure_window_pages()
-        # the top-up above may have preempted a just-admitted request:
-        # drop it from the prefill groups (its slot is parked on the
-        # scratch page; it re-prefills when re-admitted)
+        try:
+            self._ensure_window_pages()
+        except Exception:
+            # only an injected fault escapes the top-up (OutOfBlocks is
+            # absorbed above): the 'preempt' seam, or a non-OutOfBlocks
+            # alloc/free fault in the window phase. It models the
+            # worker dying mid-eviction and PROPAGATES — but the groups
+            # admitted THIS step have pages armed with no prefill run
+            # yet, so they demote first (same hazard the window-seam
+            # handler below covers), keeping the engine steppable in
+            # place with sound KV on every surviving slot
+            for _Sb, g in groups:
+                for slot, r in g:
+                    if self._slot_req[slot] is r:
+                        self._demote(slot, r)
+            raise
+        # the top-up above may have preempted (or failed) a
+        # just-admitted request: drop it from the prefill groups (its
+        # slot is parked on the scratch page; a preempted one
+        # re-prefills when re-admitted)
         kept = []
         for Sb, g in groups:
             g = [(s, r) for s, r in g if self._slot_req[s] is r]
@@ -853,19 +1375,54 @@ class ServingEngine:
             sub = self._rng               # unused inside a greedy trace
         # admissions beyond the first bucket group (rare: a step that
         # admits across buckets) prefill standalone; the first group
-        # rides inside the fused step
+        # rides inside the fused step. The 'dispatch' fault seam fires
+        # BEFORE each prefill dispatch (per-request failure isolation:
+        # a fault scripted for a request's prefill — the poisoned-
+        # request model — fails THAT admission group, pages freed, and
+        # the rest of the batch keeps decoding; the real dispatch is
+        # never interrupted mid-flight, so donated buffers stay sound).
         for Sb, group in groups[1:]:
+            if not self._prefill_seam_ok(Sb, group):
+                continue
             for _s, r in group:
                 r.mark('prefill_dispatch')
             self._prefill_group(Sb, group)
+        fused = groups[0] if groups else None
+        if fused is not None and not self._prefill_seam_ok(*fused):
+            fused = None
+        if not self.in_flight():
+            # every live slot failed at its prefill seam: nothing to
+            # decode this step, and step() must not abort
+            self._serve_time += time.perf_counter() - t0
+            self._update_gauges()
+            return []
         dev = self._device_state()
         budget = jnp.asarray(self._budget)      # shrinks every window
         common = dict(window=W, temperature=self.temperature,
                       top_k=self.top_k, top_p=self.top_p,
                       eos_token_id=self.eos_token_id)
+        # a fault scripted at kind='window' models the whole worker
+        # dying mid-serve and PROPAGATES out of step() by design, so a
+        # supervisor snapshots and restores — the crash path
+        # tests/test_resilience.py and gate_resilience exercise. Before
+        # it raises, the fused group admitted THIS step is demoted back
+        # to the queue: its pages are armed but its prefill rides
+        # inside the dispatch that now never runs, so leaving it
+        # 'running' would let a caller who keeps stepping in place
+        # decode uninitialized pages (the standalone prefills above
+        # already completed — every other row's KV is sound either way)
+        try:
+            if _faults.ACTIVE is not None:       # skip ctx build when off
+                _faults.fire('dispatch', kind='window',
+                             in_flight=self.in_flight())
+        except Exception:
+            if fused is not None:
+                for slot, r in fused[1]:
+                    self._demote(slot, r)
+            raise
         t_dispatch = time.perf_counter()
-        if groups:
-            Sb, group = groups[0]
+        if fused is not None:
+            Sb, group = fused
             for _s, r in group:
                 r.mark('prefill_dispatch')
             ids, real_len, btabs, slots = self._prefill_args(Sb, group)
@@ -950,13 +1507,22 @@ class ServingEngine:
             if done:
                 self._finish(slot, req)
                 finished.append(req)
+            elif req.deadline is not None and t_commit >= req.deadline:
+                # deadline check rides the existing per-window commit
+                # sync (t_commit is already in hand — no extra clock
+                # read, no device sync): an unfinished request past its
+                # deadline expires HERE, pages freed, slot recycled
+                self._clear_slot(slot)
+                self._retire(
+                    req, 'expired',
+                    reason=f'deadline exceeded after '
+                           f'{len(req.generated)} committed token(s)')
         self._serve_time += time.perf_counter() - t0
         if telemetry:
             mx['steps'].inc()
             mx['tokens'].inc(step_tokens)
             mx['step_ms'].observe((time.perf_counter() - t0) * 1e3)
             self._update_gauges()
-        _step_span.end()
         return finished
 
     # -- internals ---------------------------------------------------------
@@ -990,15 +1556,55 @@ class ServingEngine:
             return []
         free = self._free_slots()
         placed = []
+        a = self.allocator
         with _obs_trace.span('serve.admit', cat='scheduler') as _sp:
             while free and len(self.queue):
                 req = self.queue.peek()
+                if (req.deadline is not None
+                        and time.perf_counter() >= req.deadline):
+                    # expired while queued: never admitted, no prefill
+                    # wasted on a stream nobody is waiting for anymore
+                    self.queue.pop()
+                    self._retire(req, 'expired',
+                                 reason='deadline exceeded while queued')
+                    continue
                 need = _ceil_div(req.context_len, self.block_size)
-                if need > self.allocator.available():
+                if need > a.available():
+                    break
+                if ((a.in_use() + need) / a.usable > self.admit_watermark
+                        and self.in_flight() > 0):
+                    # pool-pressure watermark: admitting would push the
+                    # pool past the watermark and something is already
+                    # running — hold the head back so decode windows
+                    # top up from headroom instead of forcing a
+                    # preemption storm. With NOTHING in flight the head
+                    # always admits (forward progress beats pressure).
+                    self.counts['admission_paused'] += 1
+                    _obs.inc('serve.admission_paused')
                     break
                 self.queue.pop()
+                try:
+                    if _faults.ACTIVE is not None:
+                        _faults.fire('admit', rid=req.rid, need=need)
+                    a.phase = 'admit'
+                    pages = a.alloc(need)
+                except OutOfBlocks:
+                    # transient pool pressure (an injected dry spell,
+                    # or stats racing a concurrent free): requeue at
+                    # the head and stop admitting this step
+                    self.queue.push(req)
+                    break
+                except Exception as e:  # noqa: BLE001 - scripted faults
+                    # a fault at THIS request's admission (the
+                    # poisoned-request model): fail it alone, keep
+                    # admitting the rest of the queue
+                    self._retire(req, 'failed',
+                                 reason=f'fault at admission: {e!r}',
+                                 error=e)
+                    continue
+                finally:
+                    a.phase = None
                 slot = free.pop(0)
-                pages = self.allocator.alloc(need)
                 self._place(slot, req, pages)
                 placed.append((slot, req))
             _sp.args['admitted'] = len(placed)
@@ -1063,7 +1669,13 @@ class ServingEngine:
         """Every live slot must own pages covering the positions the
         coming window can write (ctx .. ctx + min(window, remaining)).
         A dry pool preempts the lowest-priority / youngest victim until
-        the top-up fits (the needy slot may evict itself)."""
+        the top-up fits (the needy slot may evict itself). A slot whose
+        top-up STILL cannot be satisfied once it is the last request
+        standing — maximal preemption reached — is unservable: that
+        request fails alone (pages freed, pool invariants intact) and
+        step() keeps decoding whatever remains; `OutOfBlocks` never
+        escapes the scheduler."""
+        a = self.allocator
         for slot in range(self.max_slots):
             req = self._slot_req[slot]
             if req is None:
@@ -1075,11 +1687,30 @@ class ServingEngine:
             while (self._slot_req[slot] is req
                    and target > len(self._slot_pages[slot])):
                 try:
-                    new = self.allocator.alloc(
-                        target - len(self._slot_pages[slot]))
-                except OutOfBlocks:
-                    self._preempt_one()
-                    continue
+                    a.phase = 'window'
+                    new = a.alloc(target - len(self._slot_pages[slot]))
+                except OutOfBlocks as e:
+                    others = any(
+                        r is not None and s != slot
+                        for s, r in enumerate(self._slot_req))
+                    if others and self._preempt_one():
+                        continue
+                    # maximal preemption: this request is the only one
+                    # left and a (nearly) drained pool still cannot
+                    # cover its window — submit()'s fit check makes
+                    # that unreachable for honest pools, so this is an
+                    # injected fault or a snapshot restored into a
+                    # smaller geometry; either way the REQUEST dies,
+                    # never the step
+                    self._clear_slot(slot)
+                    self._retire(
+                        req, 'failed',
+                        reason=f'unservable: window page top-up failed '
+                               f'after maximal preemption ({e})',
+                        error=e)
+                    break
+                finally:
+                    a.phase = None
                 pages = self._slot_pages[slot]
                 self._btab[slot, len(pages):len(pages) + len(new)] = new
                 pages.extend(new)
@@ -1090,36 +1721,103 @@ class ServingEngine:
         free its pages, park the slot on the scratch page, requeue the
         request WITH its generated prefix (it resumes by re-prefill —
         greedy decoding makes the resumed stream identical to an
-        uninterrupted one)."""
+        uninterrupted one). Returns False when there is nothing to
+        evict (the caller decides what dies; this never raises)."""
         victims = [(req.priority, -req.admit_seq, slot)
                    for slot, req in enumerate(self._slot_req)
                    if req is not None]
         if not victims:
-            raise OutOfBlocks(
-                'block pool exhausted with no in-flight request to '
-                'preempt — grow num_blocks')
+            return False
         _, _, slot = min(victims)
         req = self._slot_req[slot]
+        if _faults.ACTIVE is not None:
+            _faults.fire('preempt', rid=req.rid, slot=slot)
         with _obs_trace.span('serve.preempt', cat='scheduler',
                              rid=req.rid, slot=slot,
                              generated=len(req.generated)):
-            self._clear_slot(slot)
-            req.state = 'preempted'
-            self.preemption_count += 1
-            req.mark('preempted')
-            _obs.inc('serve.preemptions')
-            self.queue.push(req)
+            self._demote(slot, req)
+        return True
+
+    def _demote(self, slot, req):
+        """Evict `slot` back to the queue as 'preempted' with full
+        preemption bookkeeping (count, metric, lifecycle mark) — shared
+        by pool-pressure eviction and the crash paths that requeue a
+        just-admitted group whose prefill never ran, so a supervisor
+        watching preemption rate sees every forced requeue."""
+        self._clear_slot(slot)
+        req.state = 'preempted'
+        self.preemption_count += 1
+        req.mark('preempted')
+        _obs.inc('serve.preemptions')
+        self.queue.push(req)
+
+    def _retire(self, req, state, reason=None, error=None, result=None,
+                count=True):
+        """Move a request to its terminal state: stamp the lifecycle
+        trail, count it (host counters work with telemetry off —
+        stats() is truth), and park the record in `_terminal` for ONE
+        `result()` retrieval. Callers release slot/queue residency
+        first; this only flips the books. `count=False` lets a caller
+        that owns its own counter (shedding) skip the per-state one, so
+        every request lands in exactly one counter."""
+        req.state = state
+        req.reason = reason
+        req.error = error
+        if result is not None:
+            req.result = result
+        req.mark(state)
+        if count:
+            self.counts[state] += 1
+            _obs.inc(f'serve.{state}')
+        if self._live.pop(req.rid, None) is not None \
+                and req.deadline is not None:
+            self._deadlines_live -= 1
+        self._terminal[req.rid] = req
+        while len(self._terminal) > self.max_terminal:
+            victim = next((r for r in self._terminal
+                           if r not in self._collect_guard), None)
+            if victim is None:
+                # every record belongs to an active serve() collection
+                # — allow the overshoot (bounded by that one batch)
+                # rather than evict outputs about to be returned
+                break
+            self._terminal.pop(victim)
+
+    def _prefill_seam_ok(self, Sb, group):
+        """Fire the per-prefill 'dispatch' fault seam for one admission
+        group. A scripted fault fails the whole group (per-request
+        failure isolation — the real dispatch is never interrupted
+        mid-flight, so donated buffers stay sound) and returns False so
+        the caller skips that prefill."""
+        try:
+            if _faults.ACTIVE is not None:       # skip ctx build when off
+                _faults.fire('dispatch', kind='prefill', bucket=Sb,
+                             rids=[r.rid for _s, r in group])
+        except Exception as e:  # noqa: BLE001 - scripted faults only
+            self._fail_group(group, e)
+            return False
+        return True
+
+    def _fail_group(self, group, error):
+        """Per-request failure isolation for one admission group whose
+        prefill hit a fault: free each member's pages and fail it; the
+        rest of the batch keeps decoding."""
+        for slot, req in group:
+            if self._slot_req[slot] is req:
+                self._clear_slot(slot)
+                self._retire(
+                    req, 'failed',
+                    reason=f'fault injected during prefill: {error!r}',
+                    error=error)
 
     def _finish(self, slot, req):
-        req.state = 'finished'
-        req.mark('finished')
-        _obs.inc('serve.finished')
         pad = self.eos_token_id if self.eos_token_id is not None else 0
         gen = (req.generated
                + [pad] * (req.max_new_tokens - len(req.generated)))
-        self._results[req.rid] = np.concatenate(
+        out = np.concatenate(
             [req.prompt, np.asarray(gen, req.prompt.dtype)])
         self._clear_slot(slot)
+        self._retire(req, 'finished', result=out)
 
     def _clear_slot(self, slot):
         self.allocator.free(self._slot_pages[slot])
@@ -1132,4 +1830,5 @@ class ServingEngine:
 
 
 __all__ = ['ServingEngine', 'BlockAllocator', 'RequestQueue', 'Request',
-           'OutOfBlocks']
+           'OutOfBlocks', 'QueueFull', 'RequestError', 'RequestFailed',
+           'RequestExpired', 'RequestCancelled']

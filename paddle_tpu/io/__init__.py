@@ -22,6 +22,7 @@ from .dataloader import (  # noqa: F401
     RandomSampler,
     Sampler,
     SequenceSampler,
+    ShmRingTimeout,
     SubsetRandomSampler,
     WeightedRandomSampler,
     default_collate_fn,

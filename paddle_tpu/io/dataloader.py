@@ -170,28 +170,56 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn,
             data_queue.put((seq, None, repr(e)))
 
 
-def _push_with_backoff(push, timeout, sleep=None):
+class ShmRingTimeout(RuntimeError):
+    """Typed shm-ring stall. Raised by `_push_with_backoff` inside a
+    worker when its push budget runs out (carrying the waited/budget
+    seconds and ring stats), and RE-RAISED by the parent consumer loop
+    with worker identity when a worker dies or the ring goes silent —
+    so the failure surfaces as "worker 2 died pushing into ring X",
+    not a bare RuntimeError deep in a forked process. Every raise
+    records one `io.shm_timeouts` tick in the raising process's
+    registry."""
+
+    def __init__(self, msg, *, waited_s=None, budget_s=None,
+                 worker_id=None, ring=None):
+        super().__init__(msg)
+        self.waited_s = waited_s
+        self.budget_s = budget_s
+        self.worker_id = worker_id
+        self.ring = dict(ring or {})
+
+
+def _push_with_backoff(push, timeout, sleep=None, worker_id=None,
+                       ring=None):
     """Retry `push()` (returns False while the ring is full) with
     bounded exponential backoff until it lands or the push budget runs
-    out — a dead consumer then RAISES in the worker (surfacing as a
-    ring timeout in the parent) instead of spinning the core forever at
-    1 kHz. The budget is deliberately LOOSER than the consumer-side
-    `timeout`: a full ring is usually backpressure, not death — the
-    consumer legitimately stalls for minutes while the first train step
-    jit-compiles — so the worker waits several consumer-timeouts (floor
-    5 min) before concluding nobody is coming back."""
+    out — a dead consumer then RAISES `ShmRingTimeout` in the worker
+    (surfacing as a ring timeout in the parent) instead of spinning the
+    core forever at 1 kHz. The budget is deliberately LOOSER than the
+    consumer-side `timeout`: a full ring is usually backpressure, not
+    death — the consumer legitimately stalls for minutes while the
+    first train step jit-compiles — so the worker waits several
+    consumer-timeouts (floor 5 min) before concluding nobody is coming
+    back. `worker_id`/`ring` (stats dict) ride on the exception for
+    the parent's re-raise."""
     import time as time_mod
 
     from ..observability import metrics as _obs
+    from ..testing import faults as _faults
 
+    if _faults.ACTIVE is not None:
+        _faults.fire('shm_push', worker_id=worker_id, timeout=timeout)
     sleep = sleep if sleep is not None else time_mod.sleep
     budget = max(timeout * 5, 300)
     delay = 0.0005
     waited = 0.0
     while not push():
         if waited >= budget:
-            raise RuntimeError(
-                f'shm ring full for {budget}s: consumer stalled or gone')
+            _obs.inc('io.shm_timeouts')
+            raise ShmRingTimeout(
+                f'shm ring full for {budget}s: consumer stalled or gone',
+                waited_s=waited, budget_s=budget, worker_id=worker_id,
+                ring=ring)
         # backoff tick: counts in THIS process's registry (a forked shm
         # worker's counts stay in the worker — the parent-side signal
         # for ring pressure is io.prefetch_wait_ms instead)
@@ -227,7 +255,9 @@ def _worker_loop_shm(dataset, index_queue, ring_name, collate_fn,
             except Exception as e:  # pragma: no cover
                 msg = repr(e).encode()
                 payload = struct.pack('<QB', seq, 1) + msg
-            _push_with_backoff(lambda: ring.push(payload), timeout)
+            _push_with_backoff(
+                lambda: ring.push(payload), timeout, worker_id=worker_id,
+                ring={'name': ring_name, 'payload_bytes': len(payload)})
     finally:
         ring.close(unlink=False)
 
@@ -381,6 +411,7 @@ class DataLoader:
         import time as time_mod
 
         from .. import _native
+        from ..observability import metrics as _obs
 
         ctx = mp.get_context('fork')
         index_queue = ctx.Queue()
@@ -405,6 +436,8 @@ class DataLoader:
             reorder = {}
             next_yield = 0
             deadline_base = time_mod.time()
+            death_scan_at = 0.0
+            dead = {}                    # worker idx -> (pid, exitcode)
             while next_submit < len(batches) and inflight < max_inflight:
                 index_queue.put((next_submit, batches[next_submit]))
                 next_submit += 1
@@ -436,8 +469,46 @@ class DataLoader:
                     flat = _native.decode_batch(payload[13 + spec_len:])
                     reorder[seq] = _unflatten_batch(spec, flat)
                 if not got_any:
-                    if time_mod.time() - deadline_base > self.timeout:
-                        raise RuntimeError('DataLoader shm timeout')
+                    # a worker that exited non-zero mid-run died of an
+                    # exception (a push timeout, an injected fault).
+                    # When EVERY worker is gone no payload is ever
+                    # coming — raise now with identity instead of
+                    # burning the full consumer timeout on a silent
+                    # ring. A PARTIAL death may be survivable (an idle
+                    # victim held no popped batch, and the shared index
+                    # queue lets the survivors finish the epoch), so it
+                    # is only remembered here and named if the consumer
+                    # really does stall out. The exitcode poll is a
+                    # syscall per worker, so it runs at ~4 Hz rather
+                    # than on every 0.5 ms idle tick
+                    now = time_mod.time()
+                    timed_out = now - deadline_base > self.timeout
+                    if timed_out or now >= death_scan_at:
+                        death_scan_at = now + 0.25
+                        for i, w in enumerate(workers):
+                            if (i not in dead and not w.is_alive()
+                                    and w.exitcode not in (0, None)):
+                                dead[i] = (w.pid, w.exitcode)
+                    if timed_out or (dead and len(dead) == len(workers)):
+                        _obs.inc('io.shm_timeouts')
+                        if dead:
+                            i = min(dead)
+                            pid, code = dead[i]
+                            raise ShmRingTimeout(
+                                f'DataLoader shm worker {i} '
+                                f'(pid {pid}) died with exitcode '
+                                f'{code} — likely a ring push '
+                                f'timeout or a fault in the worker '
+                                f'(its stderr has the traceback)',
+                                worker_id=i,
+                                ring={'name': rings[i].name,
+                                      'inflight': inflight})
+                        raise ShmRingTimeout(
+                            f'DataLoader shm timeout: no batch for '
+                            f'{self.timeout}s with {inflight} in flight '
+                            f'across {len(workers)} live worker(s)',
+                            waited_s=now - deadline_base,
+                            ring={'inflight': inflight})
                     time_mod.sleep(0.0005)
         finally:
             for _ in workers:

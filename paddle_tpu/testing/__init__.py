@@ -1,0 +1,14 @@
+"""paddle_tpu.testing — deterministic test harnesses for the runtime.
+
+Currently home to `faults`, the scripted fault-injection layer the
+resilience tests and bench gates drive (see docs/serving.md#resilience).
+Import-time cost is nil (stdlib only); the seams it arms live in the
+serving engine, the block allocator, and the dataloader and are
+no-ops unless an injector is installed.
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from .faults import FaultError, FaultInjector  # noqa: F401
+
+__all__ = ['faults', 'FaultError', 'FaultInjector']

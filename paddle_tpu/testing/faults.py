@@ -1,0 +1,243 @@
+"""Deterministic fault injection for resilience tests and bench gates.
+
+Production serving has to survive conditions a clean test box never
+produces on its own: the block pool drying up mid-decode, a poisoned
+request crashing its prefill, a dataloader worker dying with the shm
+ring full. This module lets tests and bench gates SCRIPT those
+conditions at the host seams the runtime already owns, instead of
+monkeypatching internals:
+
+    from paddle_tpu.testing.faults import FaultInjector
+    from paddle_tpu.inference.serving import OutOfBlocks
+
+    inj = FaultInjector(seed=0)
+    inj.script('alloc', exc=OutOfBlocks('injected: pool dry'),
+               when=lambda ctx: ctx.get('phase') == 'window',
+               after=3, times=2)
+    with inj:
+        engine.run()          # the pool "dries" on the 4th and 5th
+                              # window-phase allocations
+
+Design rules:
+
+  - **Host seams only.** Trigger points fire in plain host code
+    (`BlockAllocator.alloc/free`, scheduler admit/preempt, the step's
+    dispatch boundary, the dataloader's shm push) — never inside a
+    traced function, so injection can't change a compiled program or a
+    trace count.
+  - **Zero cost when off.** `fire()` is one module-global `is None`
+    check when no injector is installed; production code paths keep
+    their perf contract (the observability overhead gate covers the
+    seams too, since they are always compiled in).
+  - **Deterministic.** Triggers are counter-based (`at`, `after`,
+    `times`) or predicate-based (`when`); probabilistic rules (`p`)
+    draw from ONE `random.Random(seed)` owned by the injector, so the
+    same script over the same workload fires identically every run —
+    a failing injection test reproduces.
+  - **One injector at a time.** `install()` refuses to stack; tests
+    that leak an active injector fail loudly instead of contaminating
+    the next test. Forked subprocess workers inherit the parent's
+    installed injector (the dataloader's `fork` context), which is how
+    "worker dies" scenarios are scripted from the parent.
+
+Seam sites wired in-tree (callers pass site-specific context):
+
+  | site       | fired by                                  | ctx keys |
+  |------------|-------------------------------------------|----------|
+  | `alloc`    | `BlockAllocator.alloc`                    | `n`, `free`, `phase` ('admit'/'window'/None) |
+  | `free`     | `BlockAllocator.free`                     | `pages` |
+  | `admit`    | `ServingEngine._admit`, per admission     | `rid`, `need` |
+  | `preempt`  | `ServingEngine._preempt_one`, pre-evict   | `rid`, `slot` |
+  | `dispatch` | `ServingEngine.step`, per dispatch        | `kind` ('prefill'/'window'), `rids`/`bucket` |
+  | `shm_push` | `io.dataloader._push_with_backoff`        | `worker_id`, `timeout` |
+
+Every ctx also carries `site` and `call` (1-based per-site call count
+since install). What each seam DOES with a scripted exception is the
+seam owner's contract: the serving engine isolates prefill/admit
+faults to the affected request, treats alloc faults as pool pressure,
+and lets a `dispatch kind='window'` fault propagate (that one models
+the whole worker dying — the crash `snapshot()`/`restore()` recovers
+from). See docs/serving.md#resilience.
+"""
+from __future__ import annotations
+
+import copy
+import random
+
+__all__ = ['FaultError', 'FaultRule', 'FaultInjector', 'fire', 'active']
+
+
+class FaultError(RuntimeError):
+    """Default injected error (used when a rule scripts no `exc`).
+    Carries the seam context so handlers and assertions can see what
+    was hit."""
+
+    def __init__(self, message, ctx=None):
+        super().__init__(message)
+        self.ctx = dict(ctx or {})
+
+
+class FaultRule:
+    """One scripted trigger on one seam site. Eligibility is counted
+    per rule over calls that pass `when`; `at` fires on exactly the
+    k-th eligible call (1-based), otherwise the first `after` eligible
+    calls are skipped and up to `times` fire (None = unlimited).
+    `p` < 1.0 additionally gates each would-fire on the injector's
+    seeded RNG. When several rules on one site would fire on the same
+    call, the first scripted wins the raise and the fire credit; the
+    losers keep their `times` budget (an `at` loser simply never
+    fires — its exact call has passed)."""
+
+    def __init__(self, site, exc=None, *, at=None, after=0, times=1,
+                 p=1.0, when=None):
+        if at is not None and (at < 1 or after):
+            raise ValueError('at is 1-based and exclusive with after')
+        if times is not None and times < 1:
+            raise ValueError('times must be >= 1 (None = unlimited)')
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f'p must be in (0, 1], got {p}')
+        self.site = site
+        self.exc = exc
+        self.at = at
+        self.after = int(after)
+        self.times = times
+        self.p = float(p)
+        self.when = when
+        self.calls = 0          # eligible (when-passing) calls seen
+        self.fired = 0
+
+    def _should_fire(self, ctx, rng):
+        """Would this rule trigger on this call? Counts the call but
+        NOT a fire — the injector credits `fired` only to the rule
+        whose exception actually raises, so a rule that loses a
+        same-call tie keeps its `times` budget and never reports an
+        injection that did not happen."""
+        if self.when is not None and not self.when(ctx):
+            return False
+        self.calls += 1
+        if self.at is not None:
+            if self.calls != self.at:
+                return False
+        else:
+            if self.calls <= self.after:
+                return False
+            if self.times is not None and self.fired >= self.times:
+                return False
+        if self.p < 1.0 and rng.random() >= self.p:
+            return False
+        return True
+
+    def _make_exc(self, ctx):
+        exc = self.exc
+        if exc is None:
+            return FaultError(f'injected fault at {self.site!r} '
+                              f'(call {self.calls})', ctx)
+        if isinstance(exc, BaseException):
+            # fresh identity per fire: a multi-shot rule must not hand
+            # two failed requests ONE shared object whose
+            # __traceback__/__context__ the later raise mutates
+            try:
+                return copy.copy(exc)
+            except Exception:
+                return exc       # exotic ctor — shared beats un-raisable
+        if isinstance(exc, type) and issubclass(exc, BaseException):
+            return exc(f'injected fault at {self.site!r}')
+        return exc(ctx)          # callable(ctx) -> exception
+
+
+# the one installed injector (None = every seam is a no-op attribute
+# check); forked workers inherit it through the module global. Public
+# so per-page hot seams can pre-check `faults.ACTIVE is not None` and
+# skip building fire()'s ctx kwargs entirely when injection is off
+ACTIVE = None
+
+
+class FaultInjector:
+    """A scripted set of `FaultRule`s plus the seeded RNG behind
+    probabilistic triggers. Usable as a context manager:
+
+        with FaultInjector(seed=0) as inj:
+            inj.script('dispatch', when=lambda c: c['kind'] == 'prefill')
+            ...
+
+    `log` records every fired injection as `(site, ctx)` and `calls`
+    counts ALL seam traffic per site (fired or not) — both are the
+    assertion surface for tests."""
+
+    def __init__(self, seed=0):
+        self._rng = random.Random(seed)
+        self.rules: list = []
+        self.log: list = []
+        self.calls: dict = {}
+
+    def script(self, site, exc=None, *, at=None, after=0, times=1,
+               p=1.0, when=None):
+        """Add one rule; returns it (rule.calls / rule.fired are live
+        counters). `exc` may be an exception instance, an exception
+        class, or a callable(ctx) -> exception; default `FaultError`."""
+        rule = FaultRule(site, exc, at=at, after=after, times=times,
+                         p=p, when=when)
+        self.rules.append(rule)
+        return rule
+
+    def install(self):
+        global ACTIVE
+        if ACTIVE is not None and ACTIVE is not self:
+            raise RuntimeError(
+                'another FaultInjector is already installed — uninstall '
+                'it first (one injector at a time keeps scripts '
+                'deterministic)')
+        ACTIVE = self
+        return self
+
+    def uninstall(self):
+        global ACTIVE
+        if ACTIVE is self:
+            ACTIVE = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc_info):
+        self.uninstall()
+        return False
+
+    def fired(self, site=None):
+        """Total fired injections (optionally for one site)."""
+        if site is None:
+            return len(self.log)
+        return sum(1 for s, _ in self.log if s == site)
+
+    def _fire(self, site, ctx):
+        self.calls[site] = self.calls.get(site, 0) + 1
+        ctx = dict(ctx, site=site, call=self.calls[site])
+        exc = None
+        for rule in self.rules:
+            if rule.site != site:
+                continue
+            # every matching rule sees every call, even once an earlier
+            # rule has triggered on this one — raising mid-loop would
+            # make later rules' at/after counters skip the call and
+            # fire one call late. First triggered rule wins the raise
+            # and is the only one credited with a fire.
+            if rule._should_fire(ctx, self._rng) and exc is None:
+                rule.fired += 1
+                self.log.append((site, ctx))
+                exc = rule._make_exc(ctx)
+        if exc is not None:
+            raise exc
+
+
+def fire(site, **ctx):
+    """The seam entry point production code calls. A no-op (one global
+    read) unless an injector is installed; otherwise evaluates this
+    site's rules and raises the scripted exception when one triggers."""
+    inj = ACTIVE
+    if inj is None:
+        return
+    inj._fire(site, ctx)
+
+
+def active():
+    """The installed injector, or None."""
+    return ACTIVE

@@ -1,0 +1,1046 @@
+"""Serving resilience (inference/serving.py + testing/faults.py).
+
+Covers the PR-8 contract:
+  - FaultInjector: seeded, scripted triggers at host seams — counter,
+    predicate, and probability rules fire deterministically;
+  - per-request failure isolation: injected pool-dry at every phase
+    (admit / decode top-up / maximal preemption) and prefill faults
+    fail ONE request — `step()` never aborts, pages never leak, the
+    rest of the batch keeps its bit-equal greedy parity;
+  - deadlines: mid-window expiry at the commit sync, queued expiry at
+    admission, generous deadlines are invisible;
+  - cancel() of queued / running / preempted requests;
+  - admission control: bounded queue (`QueueFull`), shed policies,
+    pool-pressure watermark pausing admission before preemption storms;
+  - result()/status(): terminal states with reason/error attached,
+    KeyError for unknown rids;
+  - snapshot()/restore(): crash-safe warm restart finishing every
+    stream bit-equal to an uninterrupted run (the gate_resilience
+    property at test scale);
+  - allocator invariants (double-free still raises) under injection,
+    and the typed ShmRingTimeout path in io/dataloader.
+"""
+import functools
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+# tier-1: resilience is part of the serving contract (same tiny-model
+# budget profile as test_serving.py)
+pytestmark = pytest.mark.tier1
+
+from paddle_tpu.inference.engine import DecodeEngine, total_traces  # noqa: E402
+from paddle_tpu.inference.serving import (  # noqa: E402
+    BlockAllocator,
+    OutOfBlocks,
+    QueueFull,
+    RequestCancelled,
+    RequestError,
+    RequestExpired,
+    RequestFailed,
+    ServingEngine,
+)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny  # noqa: E402
+from paddle_tpu.observability import REGISTRY  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+from paddle_tpu.testing.faults import FaultError, FaultInjector  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+
+@functools.lru_cache(maxsize=None)
+def _model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                       layers=2))
+
+
+def _prompt(seed, n, lo=3, hi=96):
+    return np.random.default_rng(seed).integers(lo, hi, (n,)).astype(np.int32)
+
+
+def _refs(prompts, mnts, eos=None):
+    """Batch-1 DecodeEngine outputs — the parity oracle."""
+    model = _model()
+    eng = DecodeEngine(model, max_new_tokens=max(mnts), eos_token_id=eos)
+    return [np.asarray(eng.generate(jnp.asarray(p[None], jnp.int32),
+                                    max_new_tokens=m))[0]
+            for p, m in zip(prompts, mnts)]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    leaked = faults.active()
+    if leaked is not None:
+        leaked.uninstall()
+        pytest.fail('test leaked an installed FaultInjector')
+
+
+class TestFaultInjector:
+    def test_inactive_fire_is_noop(self):
+        faults.fire('alloc', n=3)          # no injector: must not raise
+
+    def test_at_fires_exactly_once(self):
+        inj = FaultInjector()
+        rule = inj.script('x', at=2)
+        with inj:
+            faults.fire('x')
+            with pytest.raises(FaultError, match="injected fault at 'x'"):
+                faults.fire('x')
+            faults.fire('x')
+        assert rule.fired == 1 and rule.calls == 3
+        assert inj.fired('x') == 1 and inj.calls['x'] == 3
+
+    def test_two_rules_same_site_keep_independent_counters(self):
+        # a raise from one rule must not make the other rule's at/after
+        # counter skip the call and fire one call late
+        inj = FaultInjector()
+        inj.script('x', at=2)
+        inj.script('x', at=3)
+        fired = []
+        with inj:
+            for _ in range(4):
+                try:
+                    faults.fire('x')
+                    fired.append(False)
+                except FaultError:
+                    fired.append(True)
+        assert fired == [False, True, True, False]
+
+    def test_same_call_tie_first_rule_wins_loser_keeps_budget(self):
+        inj = FaultInjector()
+        winner = inj.script('x', at=2)
+        loser = inj.script('x', after=1, times=1)   # also due on call 2
+        fired = []
+        with inj:
+            for _ in range(3):
+                try:
+                    faults.fire('x')
+                    fired.append(False)
+                except FaultError:
+                    fired.append(True)
+        # call 2: winner raises; loser keeps its times budget and
+        # fires cleanly on call 3 — and never reports a phantom fire
+        assert fired == [False, True, True]
+        assert winner.fired == 1 and loser.fired == 1
+        assert len(inj.log) == 2
+
+    def test_after_and_times_window(self):
+        inj = FaultInjector()
+        inj.script('x', after=1, times=2)
+        fired = []
+        with inj:
+            for _ in range(5):
+                try:
+                    faults.fire('x')
+                    fired.append(False)
+                except FaultError:
+                    fired.append(True)
+        assert fired == [False, True, True, False, False]
+
+    def test_times_none_is_unlimited(self):
+        inj = FaultInjector()
+        inj.script('x', times=None)
+        with inj:
+            for _ in range(4):
+                with pytest.raises(FaultError):
+                    faults.fire('x')
+
+    def test_when_predicate_and_ctx(self):
+        inj = FaultInjector()
+        inj.script('x', when=lambda c: c.get('phase') == 'window')
+        with inj:
+            faults.fire('x', phase='admit')      # ineligible: no raise
+            with pytest.raises(FaultError):
+                faults.fire('x', phase='window')
+        site, ctx = inj.log[0]
+        assert site == 'x' and ctx['phase'] == 'window'
+        assert ctx['site'] == 'x' and ctx['call'] == 2
+
+    def test_probability_is_seed_deterministic(self):
+        def pattern(seed):
+            inj = FaultInjector(seed=seed)
+            inj.script('x', p=0.5, times=None)
+            out = []
+            with inj:
+                for _ in range(32):
+                    try:
+                        faults.fire('x')
+                        out.append(0)
+                    except FaultError:
+                        out.append(1)
+            return out
+
+        a, b = pattern(7), pattern(7)
+        assert a == b                       # same seed, same script
+        assert 0 < sum(a) < 32              # actually probabilistic
+
+    def test_custom_exc_instance_and_class(self):
+        inj = FaultInjector()
+        inj.script('a', exc=OutOfBlocks('injected dry'))
+        inj.script('b', exc=KeyError)
+        with inj:
+            with pytest.raises(OutOfBlocks, match='injected dry'):
+                faults.fire('a')
+            with pytest.raises(KeyError):
+                faults.fire('b')
+
+    def test_multi_shot_instance_exc_raises_fresh_copies(self):
+        # two fires of one scripted instance must not share an
+        # exception object: the later raise would mutate
+        # __traceback__/__context__ under the first request's
+        # attached error
+        inj = FaultInjector()
+        inj.script('a', exc=OutOfBlocks('injected dry'), times=2)
+        caught = []
+        with inj:
+            for _ in range(2):
+                try:
+                    faults.fire('a')
+                except OutOfBlocks as e:
+                    caught.append(e)
+        assert len(caught) == 2 and caught[0] is not caught[1]
+        assert str(caught[0]) == str(caught[1]) == 'injected dry'
+
+    def test_single_installation(self):
+        a, b = FaultInjector(), FaultInjector()
+        with a:
+            with pytest.raises(RuntimeError, match='already installed'):
+                b.install()
+            a.install()                     # re-install of self is fine
+        assert faults.active() is None
+        b.uninstall()                       # uninstall when inactive: noop
+
+
+class TestFailureIsolation:
+    def test_pool_dry_at_admit_requeues_and_recovers(self):
+        prompts = [_prompt(s, 6) for s in (40, 41)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        inj = FaultInjector()
+        inj.script('alloc', exc=OutOfBlocks('injected: dry at admit'),
+                   when=lambda c: c.get('phase') == 'admit', times=1)
+        with inj:
+            rids = [srv.submit(p, 8) for p in prompts]
+            srv.run()
+        assert inj.fired('alloc') == 1
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(rid), ref)
+        assert srv.allocator.in_use() == 0
+        assert srv.counts['failed'] == 0    # transient, not fatal
+
+    def test_pool_dry_mid_decode_preempts_and_recovers(self):
+        prompts = [_prompt(s, 6) for s in (42, 43)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), max_slots=2, block_size=4,
+                            max_context_len=16, max_new_tokens=8,
+                            decode_window=4)
+        inj = FaultInjector()
+        inj.script('alloc', exc=OutOfBlocks('injected: dry mid-decode'),
+                   when=lambda c: c.get('phase') == 'window', times=1)
+        with inj:
+            rids = [srv.submit(p, 8) for p in prompts]
+            srv.run()
+        assert inj.fired('alloc') == 1
+        assert srv.preemption_count >= 1    # the dry spell forced eviction
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(rid), ref)
+        assert srv.allocator.in_use() == 0
+
+    def test_unservable_after_maximal_preemption_fails_request_only(self):
+        """The satellite fix: a persistent window-phase dry pool must
+        fail the LAST request standing (state='failed', pool intact) —
+        `OutOfBlocks` never escapes step()."""
+        prompts = [_prompt(s, 6) for s in (44, 45)]
+        srv = ServingEngine(_model(), max_slots=2, block_size=4,
+                            max_context_len=16, max_new_tokens=8,
+                            decode_window=4)
+        inj = FaultInjector()
+        inj.script('alloc', exc=OutOfBlocks('injected: pool gone'),
+                   when=lambda c: c.get('phase') == 'window', times=None)
+        with inj:
+            rids = [srv.submit(p, 8) for p in prompts]
+            srv.run()                       # must not raise
+        for rid in rids:
+            assert srv.status(rid) == 'failed'
+            with pytest.raises(RequestFailed, match='maximal preemption'):
+                srv.result(rid)
+        assert srv.counts['failed'] == 2
+        assert srv.allocator.in_use() == 0  # no page leaked
+        assert srv.in_flight() == 0 and len(srv.queue) == 0
+
+    def test_prefill_fault_isolates_one_request(self):
+        prompts = [_prompt(s, 6) for s in (46, 47)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        r0 = srv.submit(prompts[0], 8)
+        srv.step()                          # r0 decoding steadily
+        inj = FaultInjector()
+        inj.script('dispatch', when=lambda c: c.get('kind') == 'prefill',
+                   times=1)
+        with inj:
+            r1 = srv.submit(prompts[1], 8)
+            srv.run()                       # r1's prefill faults; r0 lives
+        np.testing.assert_array_equal(srv.result(r0), refs[0])
+        err = pytest.raises(RequestFailed, srv.result, r1).value
+        assert isinstance(err.error, FaultError)
+        assert srv.allocator.in_use() == 0
+        assert srv.counts['failed'] == 1 and srv.counts['finished'] == 1
+
+    def test_serve_raises_without_discarding_finished_outputs(self):
+        # result() hands outcomes over destructively, so serve() must
+        # surface a failure BEFORE popping any finished record — the
+        # completed streams stay retrievable afterwards
+        prompts = [_prompt(s, 6) for s in (141, 142)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        inj = FaultInjector()
+        inj.script('dispatch',
+                   when=lambda c: (c.get('kind') == 'prefill'
+                                   and 1 in c.get('rids', [])))
+        with inj:
+            with pytest.raises(RequestFailed):
+                srv.serve(prompts, 8)       # rid 0 finishes, rid 1 faults
+        np.testing.assert_array_equal(srv.result(0), refs[0])
+
+    def test_window_fault_crashes_step_but_state_survives(self):
+        """kind='window' models the worker dying: step() raises, but
+        the host scheduler state snapshots and a fresh engine finishes
+        every stream bit-equal (the crash-recovery acceptance shape)."""
+        prompts = [_prompt(s, 6) for s in (48, 49, 50)]
+        mnts = [8, 6, 8]
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.step()                          # make some progress first
+        inj = FaultInjector()
+        inj.script('dispatch', when=lambda c: c.get('kind') == 'window',
+                   times=1)
+        with inj:
+            with pytest.raises(FaultError):
+                srv.run()                   # the "crash"
+        snap = srv.snapshot()
+        fresh = ServingEngine(_model(), max_slots=2, block_size=8,
+                              max_context_len=32, max_new_tokens=8,
+                              decode_window=4)
+        fresh.restore(snap)
+        fresh.run()
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(fresh.result(rid), ref)
+        assert fresh.allocator.in_use() == 0
+
+    def test_window_fault_engine_remains_steppable_in_place(self):
+        """The window fault fires before the dispatch, so stepping the
+        SAME engine afterward must also be safe: the fused group
+        admitted that step is demoted back to the queue (its prefill
+        never ran — decoding it in place would read uninitialized
+        pages) and re-admits with sound KV, bit-equal without a
+        restore."""
+        prompts = [_prompt(s, 6) for s in (55, 56, 57)]
+        mnts = [6, 6, 6]
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=6,
+                            decode_window=2)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        inj = FaultInjector()
+        inj.script('dispatch', when=lambda c: c.get('kind') == 'window',
+                   times=1)
+        with inj:
+            with pytest.raises(FaultError):
+                srv.run()                   # the "crash"...
+        srv.run()                           # ...survived in place
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(rid), ref)
+        assert srv.allocator.in_use() == 0
+
+    def test_preempt_fault_engine_remains_steppable_in_place(self):
+        """A fault at the 'preempt' seam (the worker dies mid-eviction)
+        propagates out of step() like a window fault — and like one,
+        the group admitted THAT step demotes back to the queue: its
+        pages are armed but its prefill never ran, so leaving it
+        'running' would silently decode uninitialized KV when the same
+        engine keeps stepping in place."""
+        prompts = [_prompt(s, 6) for s in (58, 59)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), max_slots=2, block_size=4,
+                            max_context_len=16, max_new_tokens=8,
+                            decode_window=4)
+        ra = srv.submit(prompts[0], 8)
+        srv.step()                          # A decoding steadily
+        inj = FaultInjector()
+        # dry the pool at the next window top-up so _preempt_one runs...
+        inj.script('alloc', exc=OutOfBlocks('injected: dry mid-decode'),
+                   when=lambda c: c.get('phase') == 'window', times=1)
+        # ...and crash inside the eviction itself
+        inj.script('preempt', times=1)
+        with inj:
+            rb = srv.submit(prompts[1], 8)
+            with pytest.raises(FaultError):
+                srv.step()                  # B admitted, never prefilled
+        # B demoted with full preemption bookkeeping, not left armed
+        assert srv.status(rb) == 'preempted'
+        assert srv.preemption_count >= 1
+        srv.run()                           # ...survived in place
+        for rid, ref in zip((ra, rb), refs):
+            np.testing.assert_array_equal(srv.result(rid), ref)
+        assert srv.allocator.in_use() == 0
+
+    def test_no_retraces_from_resilience_paths(self):
+        """Cancel/expiry/failure isolation are pure host bookkeeping:
+        after warmup, a run exercising them compiles NOTHING."""
+        prompts = [_prompt(s, 6) for s in range(60, 66)]
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        srv.serve(prompts[:4], 8)           # warmup: buckets + window
+        t0 = total_traces()
+        a = srv.submit(prompts[0], 8)
+        b = srv.submit(prompts[1], 8, deadline_s=1e-4)   # will expire
+        c = srv.submit(prompts[2], 8)
+        srv.cancel(c)
+        srv.run()
+        assert total_traces() - t0 == 0, srv.stats()
+        assert srv.result(a) is not None
+        with pytest.raises(RequestExpired):
+            srv.result(b)
+        with pytest.raises(RequestCancelled):
+            srv.result(c)
+
+
+class TestDeadlines:
+    def test_deadline_expires_at_window_commit(self):
+        REGISTRY.reset()
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=12,
+                            decode_window=2)
+        rid = srv.submit(_prompt(51, 6), 12, deadline_s=600.0)
+        srv.step()                          # admitted, first window done
+        assert 0 < len(srv._live[rid].generated) < 12
+        # rewind the host-authoritative deadline so the NEXT window
+        # commit is past it — deterministic, no wall-clock race with
+        # the admission sweep on a loaded box
+        srv._live[rid].deadline = time.perf_counter() - 1e-3
+        srv.run()                           # expires mid-stream, no abort
+        assert srv.status(rid) == 'expired'
+        req = srv._terminal[rid]
+        assert 0 < len(req.generated) < 12  # partial progress, then cut
+        with pytest.raises(RequestExpired, match='deadline exceeded'):
+            srv.result(rid)
+        assert srv.counts['expired'] == 1
+        assert srv.allocator.in_use() == 0
+        snap = REGISTRY.snapshot()
+        assert snap['serve.expired']['value'] == 1
+
+    def test_generous_deadline_finishes_normally(self):
+        prompts = [_prompt(52, 6)]
+        refs = _refs(prompts, [8])
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        rid = srv.submit(prompts[0], 8, deadline_s=300.0)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(rid), refs[0])
+
+    def test_queued_request_expires_at_admission(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4)
+        r1 = srv.submit(_prompt(53, 6), 8)
+        r2 = srv.submit(_prompt(54, 6), 8, deadline_s=1e-6)
+        srv.run()
+        assert srv.result(r1) is not None
+        with pytest.raises(RequestExpired, match='while queued'):
+            srv.result(r2)
+        # never admitted: no pages were ever spent on it
+        assert srv.counts['expired'] == 1 and srv.counts['finished'] == 1
+
+    def test_full_queue_sweeps_expired_before_rejecting(self):
+        """A queue full of past-deadline work must not shed live
+        traffic: submit() retires the dead entries and admits the
+        newcomer instead of raising QueueFull."""
+        import time
+
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4, max_queue=2)
+        dead = [srv.submit(_prompt(s, 6), 8, deadline_s=1e-6)
+                for s in (56, 57)]
+        time.sleep(0.001)
+        live = srv.submit(_prompt(58, 6), 8)    # no QueueFull
+        for rid in dead:
+            with pytest.raises(RequestExpired, match='while queued'):
+                srv.result(rid)
+        srv.run()
+        assert srv.result(live) is not None
+        assert srv.counts['rejected'] == 0
+
+    def test_nonpositive_deadline_rejected(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=8)
+        with pytest.raises(ValueError, match='deadline_s'):
+            srv.submit(_prompt(55, 6), 8, deadline_s=0)
+
+
+class TestCancel:
+    def _engine(self, slots=2):
+        return ServingEngine(_model(), max_slots=slots, block_size=8,
+                             max_context_len=32, max_new_tokens=8,
+                             decode_window=4)
+
+    def test_cancel_queued(self):
+        prompts = [_prompt(s, 6) for s in (70, 71)]
+        refs = _refs(prompts, [8, 8])
+        srv = self._engine(slots=1)
+        r1 = srv.submit(prompts[0], 8)
+        r2 = srv.submit(prompts[1], 8)
+        assert srv.cancel(r2) is True
+        assert srv.status(r2) == 'cancelled'
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r1), refs[0])
+        with pytest.raises(RequestCancelled, match='by caller'):
+            srv.result(r2)
+        assert len(srv.queue) == 0
+
+    def test_cancel_running_frees_pages_and_batch_decodes_on(self):
+        prompts = [_prompt(s, 6) for s in (72, 73)]
+        refs = _refs(prompts, [8, 8])
+        srv = self._engine()
+        r1 = srv.submit(prompts[0], 8)
+        r2 = srv.submit(prompts[1], 8)
+        srv.step()
+        in_use_before = srv.allocator.in_use()
+        assert srv.cancel(r1) is True
+        assert srv.in_flight() == 1
+        assert srv.allocator.in_use() < in_use_before
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r2), refs[1])
+        with pytest.raises(RequestCancelled):
+            srv.result(r1)
+        assert srv.allocator.in_use() == 0
+
+    def test_cancel_preempted_is_requeue_safe(self):
+        prompts = [_prompt(s, 6) for s in range(74, 78)]
+        mnts = [10, 10, 10, 10]
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=4,
+                            num_blocks=6, max_context_len=16,
+                            max_new_tokens=10, decode_window=4)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        victim = None
+        for _ in range(64):
+            srv.step()
+            victim = next((rid for rid in rids
+                           if rid in srv._live
+                           and srv._live[rid].state == 'preempted'), None)
+            if victim is not None:
+                break
+        assert victim is not None, 'expected a preemption in this geometry'
+        assert srv.cancel(victim) is True
+        srv.run()
+        for rid, ref in zip(rids, refs):
+            if rid == victim:
+                with pytest.raises(RequestCancelled):
+                    srv.result(rid)
+            else:
+                np.testing.assert_array_equal(srv.result(rid), ref)
+        assert srv.allocator.in_use() == 0 and len(srv.queue) == 0
+
+    def test_cancel_unknown_and_terminal(self):
+        srv = self._engine(slots=1)
+        with pytest.raises(KeyError):
+            srv.cancel(123)
+        rid = srv.submit(_prompt(79, 6), 4)
+        srv.run()
+        assert srv.cancel(rid) is False     # already finished
+        assert srv.result(rid) is not None
+        cid = srv.submit(_prompt(80, 6), 4)
+        assert srv.cancel(cid) is True
+        assert srv.cancel(cid) is False     # already terminal
+
+
+class TestAdmissionControl:
+    def test_queue_full_rejects_deterministically(self):
+        REGISTRY.reset()
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_queue=2)
+        srv.submit(_prompt(81, 6), 4)
+        srv.submit(_prompt(82, 6), 4)
+        with pytest.raises(QueueFull, match='queue full'):
+            srv.submit(_prompt(83, 6), 4)
+        assert srv.stats()['resilience']['rejected'] == 1
+        assert REGISTRY.snapshot()['serve.rejected']['value'] == 1
+        srv.run()                           # the two accepted ones drain
+
+    def test_serve_interleaves_submission_with_bounded_queue(self):
+        prompts = [_prompt(s, 6) for s in range(120, 126)]
+        refs = _refs(prompts, [4] * 6)
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_queue=2)
+        outs = srv.serve(prompts, 4)    # 6 prompts through a 2-deep queue
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        # the bound was really exercised: submissions backed off and
+        # retried instead of aborting the batch
+        assert srv.counts['rejected'] >= 1
+        assert srv.counts['finished'] == len(prompts)
+
+    def test_shed_evict_displaces_lowest_priority(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_queue=2, shed_policy='evict')
+        a = srv.submit(_prompt(84, 6), 4, priority=0)
+        b = srv.submit(_prompt(85, 6), 4, priority=0)
+        c = srv.submit(_prompt(86, 6), 4, priority=5)   # displaces b
+        assert srv.status(b) == 'cancelled'
+        with pytest.raises(RequestCancelled, match='shed'):
+            srv.result(b)
+        assert len(srv.queue) == 2
+        with pytest.raises(QueueFull):      # equal priority: no barging
+            srv.submit(_prompt(87, 6), 4, priority=0)
+        with pytest.raises(QueueFull):      # fractional priority ranks as
+            srv.submit(_prompt(90, 6), 4, priority=0.9)   # stored: int(0)
+        assert srv.counts['shed'] == 1 and srv.counts['rejected'] == 2
+        # a shed victim counts under 'shed' ONLY — serve.cancelled
+        # means cancel(rid), and terminal counters + shed sum to one
+        # entry per request
+        assert srv.counts['cancelled'] == 0
+        srv.run()
+        assert srv.result(a) is not None and srv.result(c) is not None
+
+    def test_invalid_prompt_under_evict_sheds_nobody(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_queue=1, shed_policy='evict')
+        a = srv.submit(_prompt(91, 6), 4, priority=0)
+        # passes the O(1) size pre-checks but fails Request's
+        # np.asarray coercion — the picked victim must survive
+        with pytest.raises((ValueError, TypeError)):
+            srv.submit(np.array(['x'] * 6, dtype=object), 4, priority=5)
+        assert srv.status(a) == 'queued'
+        assert srv.counts['shed'] == 0 and len(srv.queue) == 1
+        srv.run()
+        assert srv.result(a) is not None
+
+    def test_watermark_pauses_admission_instead_of_preempting(self):
+        prompts = [_prompt(s, 6) for s in (88, 89)]
+        refs = _refs(prompts, [6, 6])
+        kw = dict(max_slots=2, block_size=4, num_blocks=7,
+                  max_context_len=16, max_new_tokens=6, decode_window=4)
+        # watermark 0.6: each request admits at 2/6 usable pages and
+        # grows to 3/6, so a second concurrent admission would hit
+        # (2+2)/6 = 0.67 — it must WAIT (paused admission) instead of
+        # being admitted toward a full pool
+        srv = ServingEngine(_model(), admit_watermark=0.6, **kw)
+        rids = [srv.submit(p, 6) for p in prompts]
+        max_in_flight = 0
+        while len(srv.queue) or srv.in_flight():
+            srv.step()
+            max_in_flight = max(max_in_flight, srv.in_flight())
+        assert max_in_flight == 1
+        assert srv.counts['admission_paused'] >= 1
+        assert srv.preemption_count == 0
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(rid), ref)
+        # control: watermark 1.0 runs both concurrently, same outputs
+        srv2 = ServingEngine(_model(), **kw)
+        rids2 = [srv2.submit(p, 6) for p in prompts]
+        srv2.step()
+        assert srv2.in_flight() == 2
+        srv2.run()
+        for rid, ref in zip(rids2, refs):
+            np.testing.assert_array_equal(srv2.result(rid), ref)
+
+    def test_submit_validates_flattened_prompt_length(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4)
+        # fit guards see the FLATTENED token count (Request reshapes):
+        # a (1, 40) prompt is 40 tokens, not 1 — reject at submit, not
+        # as a mid-serve crash
+        with pytest.raises(ValueError, match='exceeds'):
+            srv.submit(np.ones((1, 40), np.int32), 4)
+        rid = srv.submit(np.int32(5), 4)    # 0-d: one token, still fine
+        srv.run()
+        assert srv.result(rid) is not None
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match='max_queue'):
+            ServingEngine(_model(), max_queue=0)
+        with pytest.raises(ValueError, match='admit_watermark'):
+            ServingEngine(_model(), admit_watermark=0.0)
+        with pytest.raises(ValueError, match='shed_policy'):
+            ServingEngine(_model(), shed_policy='drop-oldest')
+
+
+class TestResultAPI:
+    def test_unknown_rid_raises_keyerror(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4)
+        with pytest.raises(KeyError):
+            srv.result(999)
+        with pytest.raises(KeyError):
+            srv.status(999)
+
+    def test_pending_and_one_shot_retrieval(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4)
+        rid = srv.submit(_prompt(90, 6), 4)
+        assert srv.result(rid) is None and srv.status(rid) == 'queued'
+        srv.run()
+        assert srv.status(rid) == 'finished'
+        assert srv.result(rid) is not None
+        with pytest.raises(KeyError):       # handed over once
+            srv.result(rid)
+
+    def test_terminal_records_bounded_by_max_terminal(self):
+        # fire-and-forget cancellation must not grow host memory
+        # forever: oldest unretrieved records are evicted at the cap
+        # and read as already-retrieved
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_terminal=3)
+        rids = []
+        for s in range(100, 108):
+            rid = srv.submit(_prompt(s, 6), 4)
+            srv.cancel(rid)
+            rids.append(rid)
+        assert len(srv._terminal) == 3
+        with pytest.raises(KeyError):       # evicted, oldest first
+            srv.result(rids[0])
+        with pytest.raises(RequestCancelled):
+            srv.result(rids[-1])
+
+    def test_serve_batch_survives_max_terminal_eviction(self):
+        # records serve() is about to collect are exempt from the
+        # max_terminal eviction — other traffic finishing mid-batch
+        # (here: fire-and-forget cancels racing the batch) must not
+        # evict them, and a failure raise must leave the remainder
+        # individually retrievable
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            max_terminal=2)
+        stale = [srv.submit(_prompt(s, 6), 4) for s in range(100, 104)]
+        for r in stale:
+            srv.cancel(r)               # unguarded terminal records
+        outs = srv.serve([_prompt(s, 6) for s in range(110, 116)], 4)
+        assert len(outs) == 6 and all(o is not None for o in outs)
+        assert len(srv._terminal) <= 2  # bound holds for the stale ones
+
+        # failure raise path: the uncollected finished records stay
+        # guarded past the raise, retrievable one by one
+        inj = FaultInjector()
+        inj.script('admit', at=3)       # fail the 3rd admission
+        with inj:
+            with pytest.raises(RequestFailed):
+                srv.serve([_prompt(s, 6) for s in range(120, 126)], 4)
+        survivors = [r for r in list(srv._terminal)
+                     if srv.status(r) == 'finished']
+        assert len(survivors) == 5      # 6 submitted, 1 failed
+        for r in survivors:
+            assert srv.result(r) is not None
+        assert not srv._collect_guard   # drained by retrieval
+
+    def test_restore_fit_refusal_leaves_standby_fresh(self):
+        # a snapshot that cannot fit the standby must refuse BEFORE
+        # mutating it, so the same standby can restore a fitting
+        # snapshot afterwards
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8)
+        for s in range(104, 107):
+            srv.submit(_prompt(s, 40), 8)   # needs 6 pages each
+        snap = srv.snapshot()
+        small = ServingEngine(_model(), max_slots=2, block_size=8,
+                              max_context_len=64, max_new_tokens=8,
+                              num_blocks=4)  # 3 usable pages
+        with pytest.raises(ValueError, match='cannot fit'):
+            small.restore(snap)
+        assert not small._live and not len(small.queue)
+        ok = ServingEngine(_model(), max_slots=2, block_size=8,
+                           max_context_len=64, max_new_tokens=8)
+        rid = ok.submit(_prompt(104, 6), 8)  # 2 pages: fits the standby
+        ok.run()
+        small.restore(ok.snapshot())        # still fresh: accepts
+        assert small.result(rid) is not None
+
+    def test_failed_result_carries_error(self):
+        srv = ServingEngine(_model(), max_slots=1, block_size=8,
+                            max_context_len=32, max_new_tokens=4)
+        inj = FaultInjector()
+        inj.script('admit', times=1)
+        with inj:
+            rid = srv.submit(_prompt(91, 6), 4)
+            srv.run()
+        err = pytest.raises(RequestFailed, srv.result, rid).value
+        assert err.rid == rid and isinstance(err.error, FaultError)
+        assert isinstance(err, RequestError) and isinstance(err, RuntimeError)
+
+
+class TestSnapshotRestore:
+    def _kw(self):
+        return dict(max_slots=2, block_size=8, max_context_len=32,
+                    max_new_tokens=8, decode_window=2)
+
+    def test_mid_stream_restore_is_bit_equal(self):
+        prompts = [_prompt(s, 6) for s in range(92, 98)]
+        mnts = [2, 3, 8, 8, 6, 5]
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), **self._kw())
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.run(max_steps=3)                # finished + running + queued
+        snap = json.loads(json.dumps(srv.snapshot()))   # wire round-trip
+        states = {r['state'] for r in snap['requests']}
+        assert 'running' in states          # a real mid-stream cut
+        assert any(r['state'] == 'finished' for r in snap['terminal'])
+        fresh = ServingEngine(_model(), **self._kw())
+        rep = fresh.restore(snap)
+        assert rep['requests'] == len(snap['requests'])
+        fresh.run()
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(fresh.result(rid), ref)
+        assert fresh.allocator.in_use() == 0
+        # rid continuity: new submissions never collide with restored ids
+        nrid = fresh.submit(prompts[0], 4)
+        assert nrid >= rep['next_rid'] and nrid not in rids
+        fresh.run()
+        assert fresh.result(nrid) is not None
+
+    def test_restore_into_bigger_pool_is_fine(self):
+        prompts = [_prompt(s, 6) for s in (98, 99)]
+        refs = _refs(prompts, [8, 8])
+        srv = ServingEngine(_model(), **self._kw())
+        rids = [srv.submit(p, 8) for p in prompts]
+        srv.run(max_steps=1)
+        big = ServingEngine(_model(), max_slots=4, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=2, num_blocks=64)
+        big.restore(srv.snapshot())
+        big.run()
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(big.result(rid), ref)
+
+    def test_restore_rejects_config_mismatch(self):
+        srv = ServingEngine(_model(), **self._kw())
+        srv.submit(_prompt(100, 6), 4)
+        snap = srv.snapshot()
+        other = ServingEngine(_model(), temperature=0.7, **self._kw())
+        with pytest.raises(ValueError, match='mismatch.*temperature'):
+            other.restore(snap)
+
+    def test_restore_rejects_unfit_request(self):
+        srv = ServingEngine(_model(), **self._kw())
+        srv.submit(_prompt(101, 20), 8)     # 28-token stream
+        snap = srv.snapshot()
+        tiny = ServingEngine(_model(), max_slots=1, block_size=8,
+                             num_blocks=3, max_context_len=32,
+                             max_new_tokens=8, decode_window=2)
+        with pytest.raises(ValueError, match='cannot fit'):
+            tiny.restore(snap)
+
+    def test_restore_requires_fresh_engine(self):
+        srv = ServingEngine(_model(), **self._kw())
+        srv.submit(_prompt(102, 6), 4)
+        snap = srv.snapshot()
+        busy = ServingEngine(_model(), **self._kw())
+        busy.submit(_prompt(103, 6), 4)
+        with pytest.raises(RuntimeError, match='fresh engine'):
+            busy.restore(snap)
+        with pytest.raises(ValueError, match='schema'):
+            ServingEngine(_model(), **self._kw()).restore({'schema': 99})
+
+    def test_preemption_count_survives_restore(self):
+        prompts = [_prompt(s, 6) for s in range(105, 109)]
+        srv = ServingEngine(_model(), max_slots=2, block_size=4,
+                            num_blocks=6, max_context_len=16,
+                            max_new_tokens=10, decode_window=4)
+        for p in prompts:
+            srv.submit(p, 10)
+        while srv.preemption_count == 0:
+            srv.step()
+        snap = srv.snapshot()
+        fresh = ServingEngine(_model(), max_slots=2, block_size=4,
+                              num_blocks=6, max_context_len=16,
+                              max_new_tokens=10, decode_window=4)
+        fresh.restore(snap)
+        assert fresh.preemption_count == srv.preemption_count
+        fresh.run()
+        assert fresh.stats()['preemptions'] >= snap['preemptions']
+
+    def test_lifetime_counters_survive_restore(self):
+        prompts = [_prompt(s, 6) for s in range(130, 134)]
+        srv = ServingEngine(_model(), **self._kw())
+        rids = [srv.submit(p, 4) for p in prompts]
+        srv.cancel(rids[3])
+        srv.run(max_steps=3)
+        pre = dict(srv.counts)
+        toks = srv.stats()['tokens_generated']
+        assert pre['cancelled'] == 1 and toks > 0
+        snap = json.loads(json.dumps(srv.snapshot()))
+        fresh = ServingEngine(_model(), **self._kw())
+        fresh.restore(snap)
+        # monitoring sees no discontinuity across the failover
+        assert fresh.counts == pre
+        assert fresh.stats()['tokens_generated'] == toks
+        fresh.run()
+        assert fresh.counts['cancelled'] == 1
+        assert fresh.counts['finished'] == 3
+
+    def test_deadline_rearms_from_remaining_budget(self):
+        import time
+
+        srv = ServingEngine(_model(), **self._kw())
+        rid = srv.submit(_prompt(104, 6), 8, deadline_s=300.0)
+        snap = srv.snapshot()
+        (rec,) = snap['requests']
+        assert 0 < rec['deadline_left_s'] <= 300.0
+        fresh = ServingEngine(_model(), **self._kw())
+        fresh.restore(snap)
+        left = fresh._live[rid].deadline - time.perf_counter()
+        assert 0 < left <= 300.0
+
+
+class TestAllocatorUnderInjection:
+    def test_double_free_still_raises_under_injection(self):
+        inj = FaultInjector()
+        inj.script('alloc', exc=OutOfBlocks('injected'), at=2)
+        a = BlockAllocator(9, 16)
+        with inj:
+            pages = a.alloc(3)
+            with pytest.raises(OutOfBlocks, match='injected'):
+                a.alloc(1)                  # the injected dry spell
+            # invariants hold right through the fault:
+            assert a.in_use() == 3 and a.available() == 5
+            a.free(pages)
+            with pytest.raises(ValueError, match='not currently allocated'):
+                a.free(pages[:1])           # double-free still fatal
+            with pytest.raises(ValueError, match='not currently allocated'):
+                a.free([0])                 # scratch page still foreign
+        assert a.in_use() == 0 and a.available() == a.usable
+
+
+class TestShmRingTimeout:
+    def test_push_timeout_is_typed_with_stats(self):
+        from paddle_tpu.io.dataloader import (ShmRingTimeout,
+                                              _push_with_backoff)
+
+        REGISTRY.reset()
+        with pytest.raises(ShmRingTimeout, match='consumer stalled') as ei:
+            _push_with_backoff(lambda: False, timeout=0.2,
+                               sleep=lambda s: None, worker_id=3,
+                               ring={'name': 'ring-x'})
+        err = ei.value
+        assert isinstance(err, RuntimeError)        # old handlers still work
+        assert err.worker_id == 3 and err.ring['name'] == 'ring-x'
+        assert err.budget_s >= 300 and err.waited_s >= err.budget_s
+        assert REGISTRY.snapshot()['io.shm_timeouts']['value'] == 1
+
+    def test_exported_from_io_package(self):
+        from paddle_tpu.io import ShmRingTimeout, dataloader
+
+        assert ShmRingTimeout is dataloader.ShmRingTimeout
+        assert issubclass(ShmRingTimeout, RuntimeError)
+
+    def test_partial_worker_death_without_lost_batch_is_survivable(
+            self, tmp_path):
+        # a worker killed while idle (nothing popped from the shared
+        # index queue) must not abort the epoch: the survivors can
+        # still deliver every remaining batch
+        import os
+        import signal
+        import threading
+
+        from paddle_tpu import _native
+        from paddle_tpu.io.dataloader import DataLoader
+
+        if not _native.AVAILABLE:
+            pytest.skip('native shm ring unavailable')
+
+        sync = str(tmp_path)
+
+        class Ds:
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                with open(os.path.join(sync, f'idx{i}.{os.getpid()}'),
+                          'w'):
+                    pass
+                if i == 0:          # wedge worker A until released
+                    while not os.path.exists(os.path.join(sync, 'go')):
+                        time.sleep(0.01)
+                return np.full((4,), i, np.float32)
+
+        def pids_for(idx):
+            return {int(f.split('.')[1]) for f in os.listdir(sync)
+                    if f.startswith(f'idx{idx}.')}
+
+        dl = DataLoader(Ds(), batch_size=2, num_workers=2,
+                        use_shared_memory=True, timeout=30)
+        got, err = [], []
+
+        def consume():
+            try:
+                got.extend(b for b in dl)
+            except Exception as e:     # noqa: BLE001 — re-raised below
+                err.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        try:
+            # worker A wedges in idx0; worker B collates batches [2,3]
+            # and [4,5] then blocks on the DRAINED index queue holding
+            # nothing
+            deadline = time.time() + 20
+            while not (pids_for(2) and pids_for(4)):
+                assert time.time() < deadline, 'workers never ran'
+                time.sleep(0.02)
+            (pid_a,) = pids_for(0)
+            victims = (pids_for(2) | pids_for(4)) - {pid_a}
+            if not victims:
+                pytest.skip('one worker collated every batch — '
+                            'inconclusive scheduling')
+            os.kill(victims.pop(), signal.SIGKILL)
+            time.sleep(1.0)            # idle ticks observe the death
+        finally:
+            with open(os.path.join(sync, 'go'), 'w'):
+                pass
+        t.join(timeout=25)
+        assert not err, err
+        assert len(got) == 3
+
+    def test_worker_death_reraised_with_identity(self):
+        from paddle_tpu import _native
+        from paddle_tpu.io.dataloader import DataLoader, ShmRingTimeout
+
+        if not _native.AVAILABLE:
+            pytest.skip('native shm ring unavailable')
+
+        class Ds:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32)
+
+        inj = FaultInjector()
+        inj.script('shm_push', times=1)     # the worker dies on push 1
+        with inj:
+            dl = DataLoader(Ds(), batch_size=2, num_workers=1,
+                            use_shared_memory=True, timeout=10)
+            with pytest.raises(ShmRingTimeout, match='worker 0') as ei:
+                list(dl)
+        assert ei.value.worker_id == 0
