@@ -177,6 +177,21 @@ def _mosaiclint_gate(timeout_s=240):
     return clean, detail, payload.get('vmem')
 
 
+def _shardlint_gate(timeout_s=240):
+    """Static sharding-contract gate: shardlint must report zero NEW
+    error-severity violations over the distributed suite registry vs
+    the committed baseline — an undeclared collective, a silently
+    replicated weight, or a donation/sharding mismatch fails the bench
+    run on the virtual 8-device CPU mesh while the tunnel is down.
+    Returns (clean, detail, comm): comm is the per-suite collective
+    census stamped into the bench detail blob, or None."""
+    clean, detail, payload = _analysis_gate(['--shard'],
+                                            timeout_s=timeout_s)
+    if clean:
+        detail += f' ({payload.get("suppressed", 0)} suppressed)'
+    return clean, detail, payload.get('comm')
+
+
 _TRAIN_GATE_SRC = r'''
 import json
 import jax
@@ -855,6 +870,8 @@ def main():
     print(f'# tracelint gate: {tracelint_detail}', flush=True)
     mosaiclint_clean, mosaiclint_detail, mosaiclint_vmem = _mosaiclint_gate()
     print(f'# mosaiclint gate: {mosaiclint_detail}', flush=True)
+    shardlint_clean, shardlint_detail, shardlint_comm = _shardlint_gate()
+    print(f'# shardlint gate: {shardlint_detail}', flush=True)
     train_gate_clean, train_gate_detail = _train_engine_gate()
     print(f'# train engine gate: {train_gate_detail}', flush=True)
     serving_gate_clean, serving_gate_detail, serving_gate_payload = (
@@ -871,6 +888,7 @@ def main():
     print(f'# resilience gate: {res_gate_detail}', flush=True)
     static_gate_failed = (tracelint_clean is False
                           or mosaiclint_clean is False
+                          or shardlint_clean is False
                           or train_gate_clean is False
                           or serving_gate_clean is False
                           or obs_gate_clean is False
@@ -885,6 +903,9 @@ def main():
             det['gate_mosaiclint_clean'] = mosaiclint_clean
             det['mosaiclint'] = mosaiclint_detail
             det['mosaiclint_vmem'] = mosaiclint_vmem
+            det['gate_shardlint_clean'] = shardlint_clean
+            det['shardlint'] = shardlint_detail
+            det['shardlint_comm'] = shardlint_comm
             det['gate_train_retrace_zero'] = train_gate_clean
             det['train_gate'] = train_gate_detail
             # the CPU-pinned serving gate is the round's continuous-
@@ -1550,6 +1571,16 @@ def main():
             # per-kernel VMEM working-set estimates (bytes): footprint
             # regressions show in the bench history before they OOM
             'mosaiclint_vmem': mosaiclint_vmem,
+            # static sharding-contract gate (shardlint): False also
+            # fails the run — an undeclared collective or a silently
+            # replicated weight is a multichip perf regression the
+            # virtual 8-device CPU mesh can prove
+            'gate_shardlint_clean': shardlint_clean,
+            'shardlint': shardlint_detail,
+            # per-suite collective census (kind x call sites x bytes):
+            # communication regressions show in the bench history
+            # before they burn a real pod
+            'shardlint_comm': shardlint_comm,
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,

@@ -19,11 +19,15 @@ docs/tracelint.md for the rule catalogue and workflow.
 
 The SECOND analyzer family lives in `analysis.mosaic` (mosaiclint,
 docs/mosaiclint.md): ML001–ML006 prove Mosaic/TPU lowering legality at
-the jaxpr/BlockSpec level over the registered pallas kernels.  It is
-NOT imported here — mosaiclint needs jax (it traces kernels), and
-plain tracelint must stay importable without it.  Reach it via
-`paddle_tpu.analysis.mosaic`, `python -m paddle_tpu.analysis
---mosaic`, or the `mosaiclint` console script.
+the jaxpr/BlockSpec level over the registered pallas kernels.  The
+THIRD lives in `analysis.shard` (shardlint, docs/shardlint.md):
+SL001–SL006 prove the distributed layer's sharding and communication
+budgets by compiling registered suites under a virtual 8-device mesh.
+Neither is imported here — both need jax, and plain tracelint must
+stay importable without it.  Reach them via
+`paddle_tpu.analysis.mosaic` / `paddle_tpu.analysis.shard`,
+`python -m paddle_tpu.analysis --mosaic|--shard`, or the `mosaiclint`
+/ `shardlint` console scripts.
 """
 from .engine import (
     Violation,
@@ -38,8 +42,8 @@ from .engine import (
     format_text,
     format_json,
 )
-from .config import (MosaiclintConfig, TracelintConfig, load_config,
-                     load_mosaic_config)
+from .config import (MosaiclintConfig, ShardlintConfig, TracelintConfig,
+                     load_config, load_mosaic_config, load_shard_config)
 from .rules import all_rules, get_rule
 
 __all__ = [
@@ -47,7 +51,7 @@ __all__ = [
     'lint_source', 'lint_file', 'lint_paths',
     'load_baseline', 'write_baseline', 'filter_new',
     'format_text', 'format_json',
-    'TracelintConfig', 'MosaiclintConfig', 'load_config',
-    'load_mosaic_config',
+    'TracelintConfig', 'MosaiclintConfig', 'ShardlintConfig',
+    'load_config', 'load_mosaic_config', 'load_shard_config',
     'all_rules', 'get_rule',
 ]

@@ -1,20 +1,27 @@
-"""tracelint / mosaiclint CLI.
+"""tracelint / mosaiclint / shardlint CLI.
 
     python -m paddle_tpu.analysis [paths...]        # tracelint (AST)
     python -m paddle_tpu.analysis --mosaic [paths]  # mosaiclint (jaxpr)
+    python -m paddle_tpu.analysis --shard [paths]   # shardlint (GSPMD)
     tracelint paddle_tpu/                           # console script
     mosaiclint                                      # console script
+    shardlint                                       # console script
     tracelint --write-baseline                      # accept current debt
-    mosaiclint --list-rules
+    shardlint --list-rules
+
+`--mosaic` and `--shard` are mutually exclusive — one invocation runs
+exactly one analyzer family (tools/lint_all.py runs all three).
 
 Exit codes: 0 clean (modulo baseline/suppressions), 1 new
 ERROR-severity violations (warnings print but never gate — they exist
 to be confirmed on chip, not to block it), 2 usage/IO error.  Config
-comes from `[tool.tracelint]` /
-`[tool.mosaiclint]` in pyproject.toml at `--root` (default: cwd); CLI
-flags win over config.  mosaiclint traces the kernel registry with
-jax, so pin `JAX_PLATFORMS=cpu` where touching an accelerator backend
-is unwanted (bench.py's gates do).
+comes from `[tool.tracelint]` / `[tool.mosaiclint]` / `[tool.shardlint]`
+in pyproject.toml at `--root` (default: cwd); CLI flags win over
+config.  mosaiclint traces the kernel registry and shardlint compiles
+the sharding registry with jax, so pin `JAX_PLATFORMS=cpu` where
+touching an accelerator backend is unwanted (bench.py's gates do);
+shardlint additionally forces the 8-virtual-device flag itself when
+the backend has not initialised yet.
 """
 from __future__ import annotations
 
@@ -22,7 +29,7 @@ import argparse
 import os
 import sys
 
-from .config import load_config, load_mosaic_config
+from .config import load_config, load_mosaic_config, load_shard_config
 from .engine import (filter_new, format_json, format_text, lint_paths,
                      load_baseline, write_baseline)
 from .rules import all_rules
@@ -34,14 +41,20 @@ def _build_parser():
         description='Static TPU analyzers: tracelint enforces the '
                     'jit/donation/host-sync serving contract over the '
                     'AST; --mosaic (mosaiclint) enforces Mosaic/TPU '
-                    'lowering legality over traced pallas kernels.')
+                    'lowering legality over traced pallas kernels; '
+                    '--shard (shardlint) enforces sharding and '
+                    'communication budgets over the distributed-layer '
+                    'registry on a virtual 8-device mesh.')
     p.add_argument('paths', nargs='*',
                    help='files/directories to lint (default: from '
-                        'config; with --mosaic, filters registry '
-                        'entries by kernel source file)')
+                        'config; with --mosaic/--shard, filters '
+                        'registry entries by anchor source file)')
     p.add_argument('--mosaic', action='store_true',
                    help='run mosaiclint (ML rules over the pallas '
                         'kernel registry) instead of tracelint')
+    p.add_argument('--shard', action='store_true',
+                   help='run shardlint (SL rules over the distributed '
+                        'sharding registry) instead of tracelint')
     p.add_argument('--root', default=None,
                    help='project root holding pyproject.toml and the '
                         'baseline (default: cwd)')
@@ -59,12 +72,17 @@ def _build_parser():
     return p
 
 
+def _family(args):
+    return ('mosaiclint' if args.mosaic
+            else 'shardlint' if args.shard else 'tracelint')
+
+
 def _finish(args, violations, baseline_path, baselined_filter=True,
             suppressed=0, extra=None):
-    """Shared baseline-filter + output + exit-code tail of both modes."""
+    """Shared baseline-filter + output + exit-code tail of all modes."""
     if args.write_baseline:
         counts = write_baseline(violations, baseline_path)
-        print(f'{"mosaiclint" if args.mosaic else "tracelint"}: wrote '
+        print(f'{_family(args)}: wrote '
               f'baseline with {len(violations)} violation(s) across '
               f'{len(counts)} (file, rule) key(s) to {baseline_path}')
         return 0
@@ -82,9 +100,10 @@ def _finish(args, violations, baseline_path, baselined_filter=True,
     else:
         print(format_text(violations, baselined=baselined,
                           suppressed=suppressed))
-    # warnings (ML003 lane-reshape, ML006 near-budget) are advisory by
-    # design: they surface in the output and the baseline but must not
-    # fail CI — only error-severity violations gate
+    # warnings (ML003 lane-reshape, ML006 near-budget, SL001
+    # indivisible-dim, SL002 budget-slack) are advisory by design: they
+    # surface in the output and the baseline but must not fail CI —
+    # only error-severity violations gate
     return 1 if any(v.severity == 'error' for v in violations) else 0
 
 
@@ -114,57 +133,102 @@ def _main_tracelint(args, root):
     return _finish(args, violations, baseline_path)
 
 
+def _registry_main(args, root, name, cfg, all_rules_fn, entries_for_fn,
+                   lint_fn, extra_key):
+    """Shared mosaiclint/shardlint driver: both lint a REGISTRY of
+    traced suites instead of a file tree, differing only in the
+    registry, the rule set, and the per-suite detail blob (`vmem` vs
+    `comm`) their JSON output carries."""
+    select = ([s.strip() for s in args.select.split(',') if s.strip()]
+              if args.select else cfg.select)
+    try:
+        rules = all_rules_fn(select or None)
+    except KeyError as e:
+        print(f'{name}: {e.args[0]}', file=sys.stderr)
+        return 2
+
+    paths = args.paths or cfg.paths
+    try:
+        entries = entries_for_fn(paths or None, root=root)
+    except Exception as e:  # noqa: BLE001 - registry import failure
+        print(f'{name}: registry failed to load: '
+              f'{type(e).__name__}: {e}', file=sys.stderr)
+        return 2
+    if paths and not entries:
+        print(f'{name}: no registered suites under {paths}',
+              file=sys.stderr)
+        return 2
+
+    try:
+        # one trace per suite covers both the rules and the detail blob
+        violations, suppressed, detail = lint_fn(entries, rules=rules,
+                                                 root=root)
+    except ValueError as e:
+        # a registry misconfiguration (reasonless suppression) is a
+        # usage error, not a suite violation — rc 2, never rc 1
+        print(f'{name}: {e}', file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or cfg.baseline
+    if not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(root, baseline_path)
+    extra = {extra_key: detail} if args.format == 'json' else None
+    return _finish(args, violations, baseline_path,
+                   suppressed=len(suppressed), extra=extra)
+
+
 def _main_mosaic(args, root):
     # imported here: mosaiclint needs jax, plain tracelint must not
     from .mosaic import lint_and_report
     from .mosaic.registry import entries_for
     from .mosaic.rules import all_rules as all_ml_rules
 
-    cfg = load_mosaic_config(root)
-    select = ([s.strip() for s in args.select.split(',') if s.strip()]
-              if args.select else cfg.select)
-    try:
-        rules = all_ml_rules(select or None)
-    except KeyError as e:
-        print(f'mosaiclint: {e.args[0]}', file=sys.stderr)
+    return _registry_main(args, root, 'mosaiclint',
+                          load_mosaic_config(root), all_ml_rules,
+                          entries_for, lint_and_report, 'vmem')
+
+
+def _main_shard(args, root):
+    # imported here: shardlint needs jax (it compiles the registry on
+    # the virtual mesh), plain tracelint must not
+    from .shard import ensure_virtual_devices
+    from .shard import lint_and_report
+    from .shard.registry import entries_for
+    from .shard.rules import all_rules as all_sl_rules
+
+    # set the virtual-device flag BEFORE anything touches the backend;
+    # refuse with a recipe (rc 2) when a too-small backend already won
+    if not ensure_virtual_devices():
+        import jax
+
+        print(f'shardlint: needs 8 devices, found {jax.device_count()} '
+              f'(backend initialised first?) — run with XLA_FLAGS='
+              f'--xla_force_host_platform_device_count=8 '
+              f'JAX_PLATFORMS=cpu', file=sys.stderr)
         return 2
 
-    paths = args.paths or cfg.paths
-    try:
-        entries = entries_for(paths or None, root=root)
-    except Exception as e:  # noqa: BLE001 - registry import failure
-        print(f'mosaiclint: registry failed to load: '
-              f'{type(e).__name__}: {e}', file=sys.stderr)
-        return 2
-    if paths and not entries:
-        print(f'mosaiclint: no registered kernels under {paths}',
-              file=sys.stderr)
-        return 2
-
-    try:
-        # one trace per suite covers both the rules and the vmem map
-        violations, suppressed, vmem = lint_and_report(
-            entries, rules=rules, root=root)
-    except ValueError as e:
-        # a registry misconfiguration (reasonless suppression) is a
-        # usage error, not a kernel violation — rc 2, never rc 1
-        print(f'mosaiclint: {e}', file=sys.stderr)
-        return 2
-    baseline_path = args.baseline or cfg.baseline
-    if not os.path.isabs(baseline_path):
-        baseline_path = os.path.join(root, baseline_path)
-    extra = {'vmem': vmem} if args.format == 'json' else None
-    return _finish(args, violations, baseline_path,
-                   suppressed=len(suppressed), extra=extra)
+    return _registry_main(args, root, 'shardlint',
+                          load_shard_config(root), all_sl_rules,
+                          entries_for, lint_and_report, 'comm')
 
 
 def main(argv=None):
     args = _build_parser().parse_args(argv)
+    if args.mosaic and args.shard:
+        # one invocation = one analyzer family; last-flag-wins would
+        # silently skip a whole family in CI
+        print('tracelint: --mosaic and --shard are mutually exclusive '
+              '— pick one analyzer per invocation (tools/lint_all.py '
+              'runs all three)', file=sys.stderr)
+        return 2
     if args.list_rules:
         if args.mosaic:
             from .mosaic.rules import all_rules as all_ml_rules
 
             rules = all_ml_rules()
+        elif args.shard:
+            from .shard.rules import all_rules as all_sl_rules
+
+            rules = all_sl_rules()
         else:
             rules = all_rules()
         for rule in rules:
@@ -175,6 +239,8 @@ def main(argv=None):
     root = os.path.abspath(args.root or os.getcwd())
     if args.mosaic:
         return _main_mosaic(args, root)
+    if args.shard:
+        return _main_shard(args, root)
     return _main_tracelint(args, root)
 
 
@@ -182,6 +248,12 @@ def mosaic_main(argv=None):
     """Entry point for the `mosaiclint` console script."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(['--mosaic'] + argv)
+
+
+def shard_main(argv=None):
+    """Entry point for the `shardlint` console script."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(['--shard'] + argv)
 
 
 if __name__ == '__main__':

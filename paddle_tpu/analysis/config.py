@@ -30,6 +30,15 @@ class MosaiclintConfig:
     select: list = dataclasses.field(default_factory=list)  # empty = all
 
 
+@dataclasses.dataclass
+class ShardlintConfig:
+    # same registry-filter semantics as mosaiclint: paths select suite
+    # entries by anchor file under paddle_tpu/distributed/
+    paths: list = dataclasses.field(default_factory=list)
+    baseline: str = 'tools/shardlint_baseline.json'
+    select: list = dataclasses.field(default_factory=list)  # empty = all
+
+
 _ANY_SECTION_RE = re.compile(r'^\s*\[')
 _STRING_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*"([^"]*)"\s*$')
 _LIST_OPEN_RE = re.compile(r'^\s*([A-Za-z_][\w-]*)\s*=\s*\[')
@@ -108,6 +117,19 @@ def load_mosaic_config(root=None):
     """Mosaiclint config from the [tool.mosaiclint] table."""
     cfg = MosaiclintConfig()
     table = _load_table(root, 'mosaiclint')
+    if 'paths' in table:
+        cfg.paths = list(table['paths'])
+    if 'baseline' in table:
+        cfg.baseline = table['baseline']
+    if 'select' in table:
+        cfg.select = list(table['select'])
+    return cfg
+
+
+def load_shard_config(root=None):
+    """Shardlint config from the [tool.shardlint] table."""
+    cfg = ShardlintConfig()
+    table = _load_table(root, 'shardlint')
     if 'paths' in table:
         cfg.paths = list(table['paths'])
     if 'baseline' in table:

@@ -1,0 +1,41 @@
+"""shardlint rule registry (same pattern as mosaiclint's).
+
+Rules self-register via `@register`; importing this package pulls in
+every `sl*.py` module.  `all_rules()` returns fresh instances sorted
+by id, `get_rule('SL001')` one of them.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: adds a ShardRule subclass to the registry."""
+    if cls.id in _REGISTRY:
+        raise ValueError(f'duplicate rule id {cls.id}')
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules(select=None):
+    """Instances of every registered rule (or the `select` subset),
+    sorted by id."""
+    ids = sorted(_REGISTRY)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise KeyError(f'unknown rule id(s): {sorted(unknown)}')
+        ids = sorted(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]()
+
+
+from . import sl001_unknown_axis        # noqa: E402,F401
+from . import sl002_comm_budget         # noqa: E402,F401
+from . import sl003_replication_blowup  # noqa: E402,F401
+from . import sl004_host_transfer       # noqa: E402,F401
+from . import sl005_donation_mismatch   # noqa: E402,F401
+from . import sl006_shardmap_collectives  # noqa: E402,F401

@@ -1,0 +1,66 @@
+"""SL001 — PartitionSpec / shard_map axis names must exist in the mesh.
+
+The distributed layer is deliberately forgiving: `_valid_spec` (which
+`sharding_constraint`, `shard_model` and `shard_tensor` all route
+through) DROPS any spec axis the mesh does not know, and
+`data_sharding` / `zero_spec` filter their axis tuples the same way.
+Great for running tp code on a dp-only test mesh — catastrophic for a
+typo: `P('tpu')` on a 7B weight silently replicates it on every chip
+and nothing fails until HBM does.  The engine's `spec_audit` seam
+records every dropped axis during the trace; this rule turns
+unknown-axis drops into errors and divisibility drops into warnings
+(clamping a non-dividing dim is often intended on small suites, but at
+bench shapes it usually means the spec never applies).
+
+Declared `Suite.specs` and every traced shard_map's in/out axes are
+checked against the mesh directly.
+"""
+from __future__ import annotations
+
+from ..engine import ShardRule, _axes_of
+from . import register
+
+
+@register
+class UnknownAxis(ShardRule):
+    id = 'SL001'
+    name = 'unknown-mesh-axis'
+    severity = 'error'
+    description = ('PartitionSpec/shard_map axis names must exist in '
+                   'the mesh — unknown names are silently dropped '
+                   '(replicated) by the clamping helpers; '
+                   'non-dividing dims warn.')
+
+    def check(self, ctx):
+        for rec in ctx.spec_records:
+            if rec['reason'] == 'unknown-axis':
+                yield self.violation(
+                    ctx,
+                    f"{rec['where']} dropped axis '{rec['axis']}' of "
+                    f"{rec['spec']}: no such axis in the mesh "
+                    f'{tuple(ctx.mesh.axis_names)} — the array is '
+                    f'silently replicated (axis-name typo?)')
+            else:
+                yield self.violation(
+                    ctx,
+                    f"{rec['where']} dropped axis '{rec['axis']}' of "
+                    f"{rec['spec']}: dim {rec['dim']} is not divisible "
+                    f'by the axis size — the spec never applies at '
+                    f'this shape', severity='warning')
+        mesh_axes = set(ctx.mesh.axis_names) if ctx.mesh is not None else set()
+        for label, spec in ctx.suite.specs.items():
+            for entry in tuple(spec):
+                for axis in _axes_of(entry):
+                    if axis not in mesh_axes:
+                        yield self.violation(
+                            ctx,
+                            f"declared spec '{label}' = {spec} names "
+                            f"axis '{axis}' missing from the mesh "
+                            f'{tuple(sorted(mesh_axes))}')
+        for sm in ctx.shard_maps:
+            known = set(sm.mesh_axes)
+            for axis in sorted(sm.data_axes - known):
+                yield self.violation(
+                    ctx,
+                    f"shard_map in_specs name axis '{axis}' missing "
+                    f'from its mesh {sm.mesh_axes}')

@@ -1,0 +1,52 @@
+"""SL003 — large fully-replicated arrays on a multi-device mesh.
+
+A 7B parameter tensor whose PartitionSpec quietly degraded to P()
+costs its full size in HBM on EVERY chip — the memory analogue of
+SL002's undeclared all-gather, and just as invisible until a real pod
+OOMs.  This rule walks the compiled suite's input and output shardings
+(the arrays whose placement the suite actually contracts; compiler-
+internal temporaries are GSPMD's business) and errors on any array at
+or above the threshold (`Entry.replication_threshold`, default 4 MiB)
+that is fully replicated while the mesh has more than one device.
+
+Intentionally replicated big arrays (ZeRO-1 keeps params replicated by
+design) carry a registry suppression with the reason on record.
+"""
+from __future__ import annotations
+
+from ..engine import ShardRule
+from . import register
+
+
+def _mb(n):
+    return n / (1024 * 1024)
+
+
+@register
+class ReplicationBlowup(ShardRule):
+    id = 'SL003'
+    name = 'replication-blowup'
+    severity = 'error'
+    description = ('inputs/outputs at or above the byte threshold must '
+                   'not be fully replicated on a multi-device mesh — '
+                   'a dropped spec costs full size on every device.')
+
+    def check(self, ctx):
+        if ctx.n_devices <= 1:
+            return
+        threshold = ctx.entry.replication_threshold
+        for label, aval, sharding in ctx.inputs + ctx.outputs:
+            if sharding is None:
+                continue
+            nbytes = getattr(aval, 'size', 0) * getattr(
+                aval.dtype, 'itemsize', 4)
+            if (nbytes >= threshold
+                    and getattr(sharding, 'is_fully_replicated', False)):
+                yield self.violation(
+                    ctx,
+                    f'{label} {tuple(aval.shape)}:{aval.dtype} '
+                    f'({_mb(nbytes):.1f} MB) is fully replicated '
+                    f'across {ctx.n_devices} devices '
+                    f'({_mb(nbytes * ctx.n_devices):.1f} MB total) — '
+                    f'shard it or suppress with the reason it must '
+                    f'ride on every device')

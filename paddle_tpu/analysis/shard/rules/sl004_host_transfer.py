@@ -1,0 +1,45 @@
+"""SL004 — host transfer of a sharded global.
+
+`jax.device_get` (or anything that funnels into it) on an array whose
+sharding spans multiple devices is an implicit FULL GATHER: every
+shard crosses the interconnect to one host before the bytes ever reach
+numpy.  Single-host CPU testing hides it completely; on a multi-host
+pod the same line is either a cross-ICI gather on the serving path or
+an outright error on non-addressable arrays.  tracelint's TL002
+catches per-iteration host syncs at the AST level; this rule catches
+the SHARDED-ness, which only exists at runtime — the engine's
+`host_transfer_audit` seam records offending transfers while a suite's
+eager `host_probe` runs.
+
+The clean pattern: reduce on device to a replicated scalar/metric
+first (one psum beats shipping the tensor), or device_get per-shard
+via `addressable_shards` when the host genuinely needs local data.
+"""
+from __future__ import annotations
+
+from ..engine import ShardRule
+from . import register
+
+
+def _mb(n):
+    return n / (1024 * 1024)
+
+
+@register
+class HostTransfer(ShardRule):
+    id = 'SL004'
+    name = 'sharded-host-transfer'
+    severity = 'error'
+    description = ('device_get of a non-fully-replicated multi-device '
+                   'array is an implicit full gather to the host — '
+                   'reduce on device first.')
+
+    def check(self, ctx):
+        for rec in ctx.host_transfers:
+            yield self.violation(
+                ctx,
+                f'host_probe pulled a sharded global to the host: '
+                f'{rec["shape"]}:{rec["dtype"]} '
+                f'({_mb(rec["bytes"]):.2f} MB gathered from '
+                f'{rec["devices"]} devices) — reduce or slice on '
+                f'device before the transfer')

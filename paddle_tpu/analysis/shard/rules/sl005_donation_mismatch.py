@@ -1,0 +1,63 @@
+"""SL005 — donated input whose sharding differs from its aliased
+output.
+
+Buffer donation only pays when XLA can alias the donated input's
+buffer to the output IN PLACE — which requires the same shape, dtype
+AND sharding layout.  A donated tp-sharded KV cache whose output spec
+degraded to replicated forces a full copy (plus the resharding
+collective) every step: the donation "succeeds" API-wise, jax prints
+at most a one-line warning deep in a log, and serving quietly loses
+the zero-copy update the engines' donation contract (tracelint TL003)
+was built around.
+
+Suites declare the intended aliasing as `Suite.donate`
+({flat input leaf index: flat output leaf index}); the rule compares
+the COMPILED shardings of each pair via `is_equivalent_to` and errors
+on shape/dtype/sharding mismatches.
+"""
+from __future__ import annotations
+
+from ..engine import ShardRule
+from . import register
+
+
+@register
+class DonationMismatch(ShardRule):
+    id = 'SL005'
+    name = 'donation-sharding-mismatch'
+    severity = 'error'
+    description = ('a donated input must alias an output with the '
+                   'same shape, dtype and sharding — otherwise XLA '
+                   'copies (and reshards) instead of reusing the '
+                   'buffer, defeating the donation.')
+
+    def check(self, ctx):
+        for in_idx, out_idx in sorted(ctx.suite.donate.items()):
+            if in_idx >= len(ctx.inputs) or out_idx >= len(ctx.outputs):
+                yield self.violation(
+                    ctx,
+                    f'donation {in_idx} -> {out_idx} is out of range '
+                    f'({len(ctx.inputs)} inputs, {len(ctx.outputs)} '
+                    f'outputs)')
+                continue
+            in_label, in_aval, in_sh = ctx.inputs[in_idx]
+            out_label, out_aval, out_sh = ctx.outputs[out_idx]
+            if (tuple(in_aval.shape) != tuple(out_aval.shape)
+                    or in_aval.dtype != out_aval.dtype):
+                yield self.violation(
+                    ctx,
+                    f'donated {in_label} '
+                    f'{tuple(in_aval.shape)}:{in_aval.dtype} cannot '
+                    f'alias {out_label} '
+                    f'{tuple(out_aval.shape)}:{out_aval.dtype} — '
+                    f'shape/dtype differ, the buffer is never reused')
+                continue
+            if in_sh is None or out_sh is None:
+                continue
+            if not in_sh.is_equivalent_to(out_sh, len(in_aval.shape)):
+                yield self.violation(
+                    ctx,
+                    f'donated {in_label} is {in_sh.spec} but its '
+                    f'aliased {out_label} is {out_sh.spec} — the '
+                    f'sharding mismatch forces a copy+reshard every '
+                    f'call, defeating the donation')

@@ -41,7 +41,9 @@ def _in_mapped_context(axis):
 
 
 def axis_size(axis) -> int:
-    return lax.axis_size(axis)
+    from ._spmd import axis_size as _axis_size
+
+    return _axis_size(axis)
 
 
 def axis_index(axis):
@@ -84,7 +86,7 @@ def broadcast(x, src: int = 0, group='dp'):
     """Every participant gets src's shard."""
     if not _in_mapped_context(group):
         return x
-    n = lax.axis_size(group)
+    n = axis_size(group)
     full = lax.all_gather(x, group, axis=0, tiled=False)
     return full[src]
 
@@ -108,7 +110,7 @@ def send_recv(x, group='pp', shift: int = 1):
     p2p NCCL send/recv; on TPU a ppermute rides the ICI torus)."""
     if not _in_mapped_context(group):
         return x
-    n = lax.axis_size(group)
+    n = axis_size(group)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, group, perm)
 
@@ -125,7 +127,7 @@ def scatter(x, src: int = 0, group='dp'):
     """x holds the full array on all participants; return this rank's slice."""
     if not _in_mapped_context(group):
         return x
-    n = lax.axis_size(group)
+    n = axis_size(group)
     idx = lax.axis_index(group)
     chunk = x.shape[0] // n
     return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)

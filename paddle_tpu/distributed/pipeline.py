@@ -29,12 +29,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-
-def _pvary(x, axis):
-    """jax.lax.pvary is deprecated; pcast(..., to='varying') replaces it."""
-    if hasattr(lax, 'pcast'):
-        return lax.pcast(x, axis, to='varying')
-    return lax.pvary(x, axis)
+from ._spmd import pvary as _pvary
+from ._spmd import shard_map
 
 
 def stack_stage_params(stage_models: typing.Sequence, axis=0):
@@ -124,7 +120,7 @@ def pipeline_apply(stacked_params, microbatches, stage_fn, mesh: Mesh,
         local = jax.tree.map(lambda p: p[0], params)
         return body(local, mbs)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local_body, mesh=mesh,
         in_specs=(param_specs, P()), out_specs=P(),
         # only 'pp' is hand-scheduled; other mesh axes (dp/tp/fsdp) stay
@@ -406,7 +402,7 @@ def pipeline_1f1b(stacked_params, extra_params, microbatches, targets,
         return loss, pgrad, egrad, dmbs, dtgts
 
     param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P(), P(), P()),
         out_specs=(P(), param_specs, P(), P(), P()),
@@ -804,7 +800,7 @@ def pipeline_interleaved_1f1b(stacked_params, extra_params, microbatches,
         return loss, pgrad, egrad, dmbs, dtgts
 
     param_specs = jax.tree.map(lambda _: P(axis), rank_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P(), P(), P()),
         out_specs=(P(), param_specs, P(), P(), P()),

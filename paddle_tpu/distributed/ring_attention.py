@@ -30,6 +30,8 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from ._spmd import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -107,7 +109,7 @@ def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
     shard_map with q,k,v local blocks (B, S_local, H, D)."""
     from ..ops import use_pallas
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     rank = lax.axis_index(axis)
     B, Sq, H, D = q.shape
     scale = scale or 1.0 / math.sqrt(D)
@@ -158,7 +160,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis='sp', causal=False,
     """Convenience wrapper: q/k/v are global arrays; shards seq over
     `axis`, runs the ring, returns the global output."""
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

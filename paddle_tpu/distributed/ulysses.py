@@ -24,13 +24,15 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from ._spmd import axis_size, shard_map
+
 
 def ulysses_attention(q, k, v, axis='sp', causal=False, scale=None):
     """Run inside shard_map: local q/k/v are (B, S/n, H, D), sequence
     sharded over `axis`; H (and kv heads) must be divisible by n.
     Returns (B, S/n, H, D) sequence-sharded output.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     if q.shape[2] % n or k.shape[2] % n:
         raise ValueError(
             f'ulysses needs heads divisible by the axis size: '
@@ -58,7 +60,7 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis='sp', causal=False,
     """Convenience wrapper: q/k/v are global arrays; shards seq over
     `axis`, runs the all-to-all attention, returns the global output."""
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ulysses_attention, axis=axis, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

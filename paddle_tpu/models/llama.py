@@ -297,8 +297,9 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                         and H % m.shape['tp'] == 0):
                     mesh = m
                 if mesh is not None:
-                    import jax as _jax
                     from jax.sharding import PartitionSpec as P
+
+                    from ..distributed._spmd import shard_map
 
                     from ..distributed.parallel import _valid_spec
 
@@ -323,7 +324,7 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                                 q_, k_, v_, vl_, start=st_, window=window,
                                 k_scale=ks_, v_scale=vs_)
 
-                        out = _jax.shard_map(
+                        out = shard_map(
                             _da8, mesh=mesh,
                             in_specs=(hspec, hspec, hspec, P(bat), P(bat),
                                       sspec, sspec),
@@ -334,7 +335,7 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
                             return dispatch_decode_attention(
                                 q_, k_, v_, vl_, start=st_, window=window)
 
-                        out = _jax.shard_map(
+                        out = shard_map(
                             _da, mesh=mesh,
                             in_specs=(hspec, hspec, hspec, P(bat), P(bat)),
                             out_specs=hspec, check_vma=False,

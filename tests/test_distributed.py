@@ -5,9 +5,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed._spmd import shard_map
 
 import paddle_tpu as pt
 from paddle_tpu import distributed as dist

@@ -131,10 +131,12 @@ class TestFixes:
         assert float(nll[1]) == 0.0 and float(nll[3]) == 0.0
 
     def test_all_reduce_prod_with_negatives_and_zero(self):
+        from paddle_tpu.distributed._spmd import shard_map
+
         mesh = _mesh(x=8)
-        f = jax.shard_map(lambda v: dist.all_reduce(v, op='prod', group='x'),
-                          mesh=mesh, in_specs=P('x'), out_specs=P('x'),
-                          check_vma=False)
+        f = shard_map(lambda v: dist.all_reduce(v, op='prod', group='x'),
+                      mesh=mesh, in_specs=P('x'), out_specs=P('x'),
+                      check_vma=False)
         x = jnp.asarray([1., -1., 2., 3., 1., 1., 1., 1.])
         np.testing.assert_allclose(np.asarray(f(x)), np.full(8, -6.0))
         x0 = x.at[0].set(0.0)
