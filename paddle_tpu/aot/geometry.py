@@ -31,13 +31,18 @@ class Geometry:
     """One compilable dispatch shape: `kind` + a params dict of
     primitives. Kinds and their params:
 
-      decode        batch, prompt_len, max_new_tokens
-      decode_spec   batch, prompt_len, max_new_tokens, num_draft_tokens
-      serve_step    window, bucket
-      serve_window  window
-      serve_prefill bucket
-      train_step    input_shapes, input_dtypes, label_shapes,
-                    label_dtypes (shape entries are tuples/lists of int)
+      decode           batch, prompt_len, max_new_tokens
+      decode_spec      batch, prompt_len, max_new_tokens, num_draft_tokens
+      serve_step       window, bucket
+      serve_window     window
+      serve_prefill    bucket
+      serve_chunk_step window, chunk, bucket (chunked/continuation
+                       prefill fused with the decode window: `chunk`
+                       buckets the per-step token width, `bucket` the
+                       contiguous temp-cache length — the largest end
+                       position in the batch)
+      train_step       input_shapes, input_dtypes, label_shapes,
+                       label_dtypes (shape entries are tuples/lists of int)
     """
 
     __slots__ = ('kind', 'params')
@@ -152,6 +157,9 @@ def _registry_key(engine, g):
         return engine.registry_key('serve_window', p['window'])
     if g.kind == 'serve_prefill':
         return engine.registry_key('serve_prefill', p['bucket'])
+    if g.kind == 'serve_chunk_step':
+        return engine.registry_key('serve_chunk_step', p['window'],
+                                   p['chunk'], p['bucket'])
     if g.kind == 'train_step':
         return engine.registry_key(p['input_shapes'][0],
                                    p['input_dtypes'][0])
@@ -217,20 +225,37 @@ def for_decode_engine(engine, prompt_lens, batch_sizes=(1,),
 def for_serving_engine(engine, prompt_lens=None,
                        include_standalone_prefill=True):
     """Geometries a ServingEngine dispatches: one fused admit+decode
-    step per admission bucket, the pure decode window, and (when
+    step per admission bucket, the pure decode window, (when
     `include_standalone_prefill`) the standalone prefill each bucket
-    can additionally hit on a multi-bucket admission step.
+    can additionally hit on a multi-bucket admission step, and — for
+    engines with `prefill_chunk` and/or `prefix_cache` configured —
+    the fused chunk-continuation step per (chunk bucket, context
+    bucket) pair.
 
     `prompt_lens` bounds the admission context lengths (prompt +
     resumed prefix) the deployment will see; default is full coverage
     of 1..max_context_len — the safe choice for an artifact, since a
-    preempted request re-prefills at prompt+prefix length."""
+    preempted request re-prefills at prompt+prefix length.
+
+    With chunking enabled, contexts longer than `prefill_chunk` ride
+    the chunk path, so the MONOLITHIC serve_step/serve_prefill buckets
+    clamp to lengths <= prefill_chunk; the chunk pairs cover every
+    (per-step token width, end position) bucket combination a chunked
+    or prefix-hit-continuation admission can dispatch (chunk widths
+    cap at bucket(prefill_chunk); with prefix caching alone the width
+    is the unshared suffix, at most max_context_len - block_size
+    since a hit is at least one full page)."""
     W = engine.decode_window
     if prompt_lens is None:
         prompt_lens = range(1, engine.max_context_len + 1)
+    prompt_lens = [int(L) for L in prompt_lens]
+    chunk = getattr(engine, 'prefill_chunk', None)
+    prefix = bool(getattr(engine, 'prefix_cache', False))
+    mono_lens = (prompt_lens if chunk is None
+                 else [L for L in prompt_lens if L <= chunk])
     buckets = []
-    for L in prompt_lens:
-        b = bucket_length(int(L), engine.buckets)
+    for L in mono_lens:
+        b = bucket_length(L, engine.buckets)
         if b not in buckets:
             buckets.append(b)
     entries = [Geometry('serve_step', window=W, bucket=b)
@@ -239,6 +264,34 @@ def for_serving_engine(engine, prompt_lens=None,
     if include_standalone_prefill:
         entries.extend(Geometry('serve_prefill', bucket=b)
                        for b in buckets)
+    if (chunk is not None or prefix) and prompt_lens:
+        max_end = max(prompt_lens)
+        # the bucket ladder every chunk END can land on (intermediate
+        # chunk ends cover 1..max_end even when prompt_lens is sparse)
+        ladder, L = [], 1
+        while L <= max_end:
+            b = bucket_length(L, engine.buckets)
+            ladder.append(b)
+            L = b + 1
+        if chunk is not None:
+            max_take = min(chunk, max_end)
+        else:
+            max_take = max(1, max_end - engine.block_size)
+        cb_max = bucket_length(max_take, engine.buckets)
+        # equal-bucket pairs are only reachable through a start-0
+        # chunked admission's FIRST chunk, whose take is exactly
+        # prefill_chunk (so cb == sb == bucket(prefill_chunk), and
+        # only when some declared context exceeds the chunk at all):
+        # later chunks and tails sit at end > chunk (sb > cb), and a
+        # prefix-hit continuation passes the profitability guard only
+        # when bucket(take) < bucket(end) — any other equal pair would
+        # be a dead executable in the artifact
+        entries.extend(
+            Geometry('serve_chunk_step', window=W, chunk=cb, bucket=sb)
+            for cb in ladder if cb <= cb_max
+            for sb in ladder
+            if cb < sb or (chunk is not None and max_end > chunk
+                           and cb == sb == cb_max))
     return GeometrySet(entries)
 
 

@@ -40,6 +40,20 @@ the ITERATION level instead:
      queue — and later resumes by re-prefilling prompt+prefix (greedy
      decoding makes the resumed stream exactly the uninterrupted one).
 
+  4. Prefix caching + chunked prefill (both opt-in, both bit-equal;
+     docs/serving.md#prefix-caching-and-chunked-prefill):
+     `prefix_cache=True` shares full pages of identical prompt
+     prefixes across requests through the allocator's refcounted
+     content-hash index (copy-on-write on the one page a
+     full-coverage hit must rewrite; refcount-0 pages park on a
+     hittable LRU) and prefills only the unshared suffix;
+     `prefill_chunk=N` admits long prompts as <=N-token chunks fused
+     with the decode window (`_serve_chunk_step`), so one 8k-token
+     arrival never stalls in-flight streams for a whole-prompt
+     prefill. A chunked request occupies its slot but emits nothing
+     until its last chunk commits — then its first window runs in the
+     SAME dispatch, preserving monolithic semantics exactly.
+
 Sampling config is pinned at engine construction (it is part of the
 compilation key), greedy (temperature=0) is the parity-tested path:
 per-request outputs are exactly `DecodeEngine.generate`'s batch-1
@@ -62,6 +76,7 @@ uninterrupted run. Failure paths are exercised on purpose through the
 from __future__ import annotations
 
 import functools
+import hashlib
 import heapq
 import inspect
 import itertools
@@ -129,7 +144,9 @@ class RequestCancelled(RequestError):
 
 
 class BlockAllocator:
-    """Free-list allocator over a fixed pool of KV-cache pages.
+    """Free-list allocator over a fixed pool of KV-cache pages, with
+    per-page REFCOUNTS and a content-hash PREFIX INDEX (vLLM-style
+    prefix caching — ROADMAP item 2).
 
     Pure id bookkeeping: the device page pools live in the engine and
     are NEVER reallocated — alloc/free hand out integer page ids, so
@@ -137,7 +154,20 @@ class BlockAllocator:
     0 is reserved as the scratch page (inactive/frozen slots write
     there), so usable capacity is num_blocks - 1 and every handed-out
     id is >= 1. Freed ids are reused LIFO (most-recently-freed first —
-    deterministic, and the hottest pages stay hot)."""
+    deterministic, and the hottest pages stay hot).
+
+    Prefix caching: a page holding a FULL block of prompt-token KV can
+    be bound to its chain hash (`register_prefix`); a later request
+    whose prompt starts with the same token pages walks the chain
+    (`match_prefix`) and takes references on the pages (`share` —
+    refcount++ instead of alloc: the KV bytes are reused and the
+    prefill compute for those tokens is skipped). A freed page whose
+    refcount hits zero parks on an LRU of CACHED pages (still indexed,
+    still hittable) instead of the free list; `alloc` harvests the LRU
+    oldest-first only once the free list runs dry, so caching never
+    shrinks the allocatable pool. `cow` swaps a writer's reference on
+    a shared page for a private fresh page (copy-on-write — the device
+    row copy is the engine's job; the allocator only moves ids)."""
 
     def __init__(self, num_blocks, block_size):
         num_blocks = int(num_blocks)
@@ -150,19 +180,29 @@ class BlockAllocator:
         # LIFO stack, low ids on top: the first alloc after init hands
         # out 1, 2, ... in order (deterministic, test-friendly)
         self._free = list(range(num_blocks - 1, 0, -1))
-        self._held: set = set()
+        self._ref: dict = {}             # page -> refcount (held pages)
+        # prefix index: chain hash <-> page, plus the refcount-0 cached
+        # pages in least-recently-freed-first order (python dicts are
+        # insertion-ordered, so "pop oldest" is one iteration step and
+        # "re-free" reinserts at the tail)
+        self._index: dict = {}           # chain hash -> page
+        self._hash_of: dict = {}         # page -> chain hash (indexed)
+        self._cached: dict = {}          # page -> None (LRU, oldest first)
         self.alloc_count = 0
         self.free_count = 0
         self.high_water = 0
+        self.cow_count = 0               # copy-on-write page swaps
+        self.prefix_shares = 0           # pages handed out via share()
+        self.prefix_evictions = 0        # cached pages harvested by alloc
         # device bytes one page costs across ALL layers (k + v), set by
         # the owning engine from the real pool arrays (the allocator
         # itself only moves ids); stats() reports real-unit pool sizes
         # once it is known
         self.bytes_per_page = None
         # which scheduler phase is allocating ('admit' / 'window' /
-        # None for direct users) — set by the owning engine around its
-        # call sites purely so fault scripts can target one phase
-        # ("pool dries mid-decode but admission still works")
+        # 'cow' / None for direct users) — set by the owning engine
+        # around its call sites purely so fault scripts can target one
+        # phase ("pool dries mid-decode but admission still works")
         self.phase = None
 
     @property
@@ -170,49 +210,169 @@ class BlockAllocator:
         return self.num_blocks - 1
 
     def available(self):
-        return len(self._free)
+        """Pages an alloc() can hand out: the free list plus the
+        refcount-0 cached prefix pages (reclaimable on demand — the
+        prefix cache never shrinks the allocatable pool)."""
+        return len(self._free) + len(self._cached)
 
     def in_use(self):
-        return len(self._held)
+        return len(self._ref)
+
+    def cached(self):
+        """Refcount-0 prefix pages parked on the LRU."""
+        return len(self._cached)
+
+    def shared(self):
+        """Held pages with MORE than one reference."""
+        return sum(1 for c in self._ref.values() if c > 1)
+
+    def refcount(self, page):
+        """Live references on `page` (0 = cached or free)."""
+        return self._ref.get(page, 0)
 
     def utilization(self):
-        """Held fraction of the usable pool (scratch page excluded)."""
-        return len(self._held) / max(self.usable, 1)
+        """Held fraction of the usable pool (scratch page excluded;
+        cached refcount-0 pages are reclaimable and do not count)."""
+        return len(self._ref) / max(self.usable, 1)
 
     def alloc(self, n):
         """n page ids, or OutOfBlocks (the pool is untouched on
-        failure — no partial allocation to unwind)."""
+        failure — no partial allocation to unwind). When the free list
+        alone cannot cover, refcount-0 cached prefix pages are evicted
+        oldest-first (their index bindings drop) to make up the rest."""
         n = int(n)
         if n < 0:
             raise ValueError(f'cannot allocate {n} pages')
         if _faults.ACTIVE is not None:   # pre-check: alloc is per-page-op
             _faults.fire('alloc', n=n, free=len(self._free),
                          phase=self.phase)
-        if n > len(self._free):
+        if n > len(self._free) + len(self._cached):
             raise OutOfBlocks(
-                f'need {n} page(s), {len(self._free)} free '
-                f'({len(self._held)}/{self.usable} in use)')
+                f'need {n} page(s), {len(self._free) + len(self._cached)} '
+                f'free ({len(self._ref)}/{self.usable} in use)')
+        harvest = max(0, n - len(self._free))
+        if harvest:
+            victims = list(itertools.islice(self._cached, harvest))
+            if _faults.ACTIVE is not None:
+                # seams fire BEFORE any mutation, so a scripted
+                # prefix-evict fault leaves the pool untouched
+                for p in victims:
+                    _faults.fire('prefix_evict', page=p, phase=self.phase)
+            for p in victims:
+                self._unindex(p)
+                del self._cached[p]
+                self._free.append(p)
+            self.prefix_evictions += harvest
         pages = [self._free.pop() for _ in range(n)]
-        self._held.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         self.alloc_count += n
-        self.high_water = max(self.high_water, len(self._held))
+        self.high_water = max(self.high_water, len(self._ref))
         return pages
 
     def free(self, pages):
-        """Return pages to the free list. Double-frees and foreign ids
-        raise — both are allocator-corruption bugs worth failing on."""
+        """Drop one reference per listed page. The last reference
+        either returns the page to the free list or — when the page is
+        prefix-indexed — parks it on the cached LRU (still hittable).
+        Over-freeing and foreign ids raise — both are allocator-
+        corruption bugs worth failing on."""
         pages = list(pages)
         if _faults.ACTIVE is not None:   # pre-check: free is per-page-op
             _faults.fire('free', pages=pages)
+        drops: dict = {}
         for p in pages:
-            if p not in self._held:
+            drops[p] = drops.get(p, 0) + 1
+        for p, k in drops.items():
+            if self._ref.get(p, 0) < k:
                 raise ValueError(
                     f'page {p} is not currently allocated '
                     f'(double-free or foreign id)')
         for p in pages:
-            self._held.discard(p)
-            self._free.append(p)
+            self._ref[p] -= 1
+            if self._ref[p]:
+                continue
+            del self._ref[p]
+            if p in self._hash_of:
+                self._cached[p] = None       # LRU tail (newest)
+            else:
+                self._free.append(p)
         self.free_count += len(pages)
+
+    # -- prefix index ------------------------------------------------------
+
+    def match_prefix(self, hashes):
+        """Pages for the longest indexed leading run of `hashes`.
+        Every returned page is held or cached RIGHT NOW — `share()`
+        them before relying on the ids (an interleaved alloc could
+        harvest a cached one)."""
+        pages = []
+        for h in hashes:
+            p = self._index.get(h)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def share(self, pages):
+        """Take one more reference on each page (a prefix-cache hit):
+        held pages refcount++, cached pages revive off the LRU.
+        Sharing a free page is corruption and raises (nothing is
+        mutated on failure)."""
+        pages = list(pages)
+        for p in pages:
+            if p not in self._ref and p not in self._cached:
+                raise ValueError(
+                    f'page {p} is neither held nor cached — cannot share')
+        for p in pages:
+            if p in self._cached:
+                del self._cached[p]
+                self._ref[p] = 1
+            else:
+                self._ref[p] += 1
+        self.prefix_shares += len(pages)
+        self.high_water = max(self.high_water, len(self._ref))
+        return pages
+
+    def register_prefix(self, page, h):
+        """Bind chain hash `h` to a held page whose FULL block of
+        prompt-token KV has been written. First writer wins: when the
+        hash is already bound (a concurrent request computed the same
+        block) the existing binding stays and this page simply remains
+        unindexed. Returns True when the binding was recorded."""
+        if page not in self._ref:
+            raise ValueError(f'page {page} is not allocated')
+        if h in self._index:
+            return False
+        self._index[h] = page
+        self._hash_of[page] = h
+        return True
+
+    def cow(self, page):
+        """Copy-on-write: hand the caller a private fresh page id for
+        shared/indexed `page`. The caller must hold a reference on
+        `page` and KEEPS it — that reference is the copy-pin: until
+        the device rows are actually copied old -> new (the engine
+        defers the copy into its next fused dispatch), freeing it
+        would park an indexed source on the harvestable LRU, where a
+        same-step allocation could hand it to another request whose
+        prefill overwrites it BEFORE the copy reads it. Free the pin
+        only once the copy has landed. Fires the alloc seam with
+        phase='cow' so fault scripts can target exactly this path; on
+        failure nothing changes."""
+        if page not in self._ref:
+            raise ValueError(f'page {page} is not allocated — cannot CoW')
+        prev, self.phase = self.phase, 'cow'
+        try:
+            new = self.alloc(1)[0]
+        finally:
+            self.phase = prev
+        self.cow_count += 1
+        return new
+
+    def _unindex(self, page):
+        h = self._hash_of.pop(page, None)
+        if h is not None and self._index.get(h) == page:
+            del self._index[h]
 
     def stats(self):
         s = {
@@ -225,6 +385,14 @@ class BlockAllocator:
             'allocs': self.alloc_count,
             'frees': self.free_count,
         }
+        prefix = {
+            'shared_pages': self.shared(),
+            'cached_pages': len(self._cached),
+            'indexed_pages': len(self._hash_of),
+            'cow_pages': self.cow_count,
+            'shares': self.prefix_shares,
+            'evictions': self.prefix_evictions,
+        }
         if self.bytes_per_page:
             # real units: page counts x per-page KV bytes across all
             # layers and both of k/v, at the pool dtype — what an HBM
@@ -234,7 +402,34 @@ class BlockAllocator:
             s['bytes_total'] = self.num_blocks * bpp
             s['bytes_in_use'] = self.in_use() * bpp
             s['bytes_high_water'] = self.high_water * bpp
+            prefix['bytes_shared'] = prefix['shared_pages'] * bpp
+            prefix['bytes_cached'] = prefix['cached_pages'] * bpp
+            prefix['bytes_cow'] = prefix['cow_pages'] * bpp
+        s['prefix'] = prefix
         return s
+
+
+def prompt_page_hashes(prompt, block_size):
+    """Chain hashes for the FULL pages of `prompt` (one 16-byte
+    blake2b digest per `block_size` tokens; each digest chains the
+    previous one, so hash k covers the whole prefix through page k —
+    a hit on page k implies the entire leading context matches). ONE
+    batched token->bytes conversion covers the whole prompt — the
+    admission hot path never converts per page in a loop (the
+    tracelint host-sync discipline)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    n = len(prompt) // block_size
+    if not n:
+        return []
+    raw = np.ascontiguousarray(prompt[:n * block_size]).tobytes()
+    step = 4 * block_size                 # int32 tokens
+    out = []
+    h = b'paddle_tpu.prefix.v1'
+    for i in range(n):
+        h = hashlib.blake2b(h + raw[i * step:(i + 1) * step],
+                            digest_size=16).digest()
+        out.append(h)
+    return out
 
 
 class Request:
@@ -257,7 +452,7 @@ class Request:
 
     __slots__ = ('rid', 'prompt', 'max_new_tokens', 'priority', 'generated',
                  'seq', 'state', 'admit_seq', 'times', 'enqueued_at',
-                 'deadline', 'reason', 'error', 'result')
+                 'deadline', 'reason', 'error', 'result', 'page_hashes')
 
     def __init__(self, rid, prompt, max_new_tokens, priority):
         self.rid = rid
@@ -265,6 +460,7 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
         self.generated: list = []
+        self.page_hashes = None  # full-prompt-page chain hashes, lazy
         self.seq = None          # arrival order, stamped by RequestQueue
         self.admit_seq = None    # last admission order (preemption ties)
         self.state = 'queued'
@@ -511,6 +707,111 @@ def _serve_step(model, pages, last_logits, ids, real_len, btabs, slots,
                         eos_token_id=eos_token_id)
 
 
+def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
+                slots, cow_src, cow_dst, *, ctx_bucket):
+    """Chunked / continuation prefill INTO pages (traced body, fused
+    ahead of the decode window by `_serve_chunk_step`): each row b
+    already owns positions [0, start[b]) of its context in its pages —
+    a prior chunk's output, or shared prefix-cache pages — and appends
+    chunk_len[b] new tokens at positions [start[b], start[b] +
+    chunk_len[b]).
+
+    The model needs no paged-prefill support: each row's committed
+    prefix K/V is GATHERED out of its pages into a throwaway
+    contiguous cache of static length `ctx_bucket` (the bucket of the
+    largest end position in the batch), the chunk runs through the
+    standard per-row-offset forward (`kv_write_pos` — the speculative-
+    verify machinery: causal within the chunk, full attention over the
+    gathered prefix), and the new K/V rows scatter back into pages
+    exactly like `_prefill_body`. Rows whose chunk COMPLETES their
+    context carry their slot id in `slots` and commit next-token
+    logits; still-prefilling and dummy rows carry max_slots and are
+    dropped by the OOB scatter — so a chunked request occupies its
+    slot but emits nothing until its last chunk commits.
+
+    `cow_src`/`cow_dst` apply the copy-on-write page copies the
+    scheduler armed this step (dst := src, FIRST, so the gather and
+    the scatter below both see the private copy through the already-
+    rewritten block tables); rows with no pending copy carry (0, 0) —
+    a harmless scratch-page self-copy."""
+    K, Cb = ids.shape
+    bs = pages[0].kp.shape[2]
+    maxb = btabs.shape[1]
+    Sb = int(ctx_bucket)
+    cl = jnp.reshape(jnp.asarray(chunk_len, jnp.int32), (K,))
+    st = jnp.reshape(jnp.asarray(start, jnp.int32), (K,))
+    pages = [type(pc)(pc.kp.at[cow_dst].set(pc.kp[cow_src]),
+                      pc.vp.at[cow_dst].set(pc.vp[cow_src]))
+             for pc in pages]
+    # gather each row's prefix rows [0, start) into a contiguous
+    # (K, Sb, Hkv, D) temp cache; positions >= start read the scratch
+    # page (never attended: the per-row causal mask stops at qpos)
+    s = jnp.arange(Sb)
+    blk = jnp.minimum(s // bs, maxb - 1)
+    gpage = jnp.take_along_axis(
+        btabs, jnp.broadcast_to(blk[None, :], (K, Sb)), axis=1)
+    gpage = jnp.where(s[None, :] < st[:, None], gpage, 0)
+    soff = jnp.broadcast_to((s % bs)[None, :], (K, Sb))
+    tmp = [(pc.kp[gpage, :, soff, :], pc.vp[gpage, :, soff, :])
+           for pc in pages]
+    logits, tmp = model(ids, caches=tmp, kv_write_pos=st)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(cl - 1, 0)[:, None, None], axis=1)[:, 0]
+    # scatter the chunk's K/V rows back into pages: position start + i
+    # of row b lands in page btabs[b, (start+i) // bs] slot (start+i) %
+    # bs; pad and dummy rows (i >= chunk_len) land on the scratch page
+    i = jnp.arange(Cb)
+    wpos = st[:, None] + i[None, :]                        # (K, Cb)
+    wblk = jnp.minimum(wpos // bs, maxb - 1)
+    wpage = jnp.where(i[None, :] < cl[:, None],
+                      jnp.take_along_axis(btabs, wblk, axis=1), 0)
+    pflat = wpage.reshape(-1)
+    sflat = (wpos % bs).reshape(-1)
+    out_pages = []
+    for (k, v), pc in zip(tmp, pages):
+        rows = (K * Cb,) + k.shape[2:]
+        kc = jnp.take_along_axis(
+            k, jnp.minimum(wpos, Sb - 1)[:, :, None, None], axis=1)
+        vc = jnp.take_along_axis(
+            v, jnp.minimum(wpos, Sb - 1)[:, :, None, None], axis=1)
+        kp = pc.kp.at[pflat, :, sflat, :].set(
+            kc.reshape(rows).astype(pc.kp.dtype))
+        vp = pc.vp.at[pflat, :, sflat, :].set(
+            vc.reshape(rows).astype(pc.vp.dtype))
+        out_pages.append(type(pc)(kp, vp))
+    last_logits = last_logits.at[slots].set(
+        last.astype(last_logits.dtype), mode='drop')
+    return last_logits, out_pages
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('pages', 'last_logits'),
+    static_argnames=('ctx_bucket', 'window', 'temperature', 'top_k',
+                     'top_p', 'eos_token_id'))
+def _serve_chunk_step(model, pages, last_logits, ids, chunk_len, start,
+                      btabs, slots, cow_src, cow_dst, btab, ctx, live,
+                      budget, rng_key, *, ctx_bucket, window, temperature,
+                      top_k, top_p, eos_token_id):
+    """The chunked-prefill scheduler iteration as one fused jitted
+    dispatch: every in-progress chunked/continuation row appends its
+    chunk into its pages (_chunk_body — CoW copies first, prefix
+    gathered from pages, completing rows commit their logits), then
+    every slot decodes a window (_window_body; still-prefilling rows
+    ride frozen on the scratch page). One compilation per (window,
+    chunk bucket, context bucket) triple covers every row count, chunk
+    length, and prefill progress — a long-prompt flood never changes a
+    traced shape."""
+    _count_trace('serve_chunk_step')
+    last_logits, pages = _chunk_body(model, pages, last_logits, ids,
+                                     chunk_len, start, btabs, slots,
+                                     cow_src, cow_dst,
+                                     ctx_bucket=ctx_bucket)
+    return _window_body(model, pages, last_logits, btab, ctx, live,
+                        budget, rng_key, window=window,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id)
+
+
 def _ceil_div(a, b):
     return -(-a // b)
 
@@ -540,7 +841,8 @@ class ServingEngine:
                  max_context_len=None, max_new_tokens=32, decode_window=8,
                  temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
                  buckets=None, max_queue=None, admit_watermark=1.0,
-                 shed_policy='reject', max_terminal=1024):
+                 shed_policy='reject', max_terminal=1024,
+                 prefix_cache=False, prefill_chunk=None):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -600,6 +902,20 @@ class ServingEngine:
                 f"shed_policy must be 'reject' or 'evict', "
                 f'got {shed_policy!r}')
         self.shed_policy = shed_policy
+        # prefix caching + chunked prefill (docs/serving.md#prefix):
+        # prefix_cache shares full pages of identical prompt prefixes
+        # across requests through the allocator's hash index (system
+        # prompts amortize to ~zero prefill); prefill_chunk splits
+        # long-prompt admission into <=prefill_chunk-token chunks
+        # interleaved with decode windows so one long arrival never
+        # stalls in-flight streams for a whole-prompt prefill. Both
+        # default OFF: the monolithic admission path is bit-identical
+        # to prior behavior.
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError('prefill_chunk must be >= 1 (or None)')
 
         # device state, allocated ONCE (shapes never change)
         self._pages = model.init_paged_cache(num_blocks, self.block_size)
@@ -622,6 +938,15 @@ class ServingEngine:
                               np.int32)
         self._ctx = np.zeros((self.max_slots,), np.int32)
         self._budget = np.zeros((self.max_slots,), np.int32)
+        # per-slot prefill progress: None = fully prefilled (decoding);
+        # an int = context tokens already in pages — the slot is mid
+        # chunked/continuation prefill, rides decode windows frozen on
+        # the scratch page, and emits nothing until its last chunk
+        # commits. `_cow_pending` holds the (src, dst) page copy the
+        # slot's first chunk dispatch must perform (prefix-cache CoW).
+        self._pfill: list = [None] * self.max_slots
+        self._cow_pending: list = [None] * self.max_slots
+        self._cow_release: list = []     # pins freed post chunk dispatch
         # device mirror of (btab, ctx, live): rebuilt only when a slot
         # changes (admission/retire/preempt/page top-up); between those
         # the window's returned ctx is carried device-resident, so a
@@ -655,6 +980,12 @@ class ServingEngine:
         self.preemption_count = 0
         self._tokens_out = 0
         self._serve_time = 0.0
+        # host-truth prefix/chunk counters (stats() reports them even
+        # with telemetry off; snapshot()/restore() carries them like
+        # `counts` so monitoring sees no discontinuity)
+        self.prefix_counts = {'hits': 0, 'misses': 0, 'hits_skipped': 0,
+                              'hit_tokens': 0, 'chunked_admissions': 0,
+                              'chunk_steps': 0}
         # telemetry hot-path caches: metric handles (refreshed when the
         # registry generation changes, i.e. after a reset) and the last
         # occupancy tuple (gauges re-set only when it moves) — keeps
@@ -713,6 +1044,11 @@ class ServingEngine:
                 'bytes_in_use': R.gauge('pool.bytes_in_use'),
                 'bytes_total': R.gauge('pool.bytes_total'),
                 'pressure': R.gauge('serve.pool_pressure'),
+                'pfx_shared': R.gauge('pool.prefix_shared_pages'),
+                'pfx_cached': R.gauge('pool.prefix_cached_pages'),
+                'pfx_cow': R.gauge('pool.prefix_cow_pages'),
+                'pfx_shared_b': R.gauge('pool.prefix_shared_bytes'),
+                'pfx_cached_b': R.gauge('pool.prefix_cached_bytes'),
             }
             self._mgen = R.generation
             self._last_occ = None          # force a gauge refresh
@@ -726,7 +1062,8 @@ class ServingEngine:
             return
         m = self._metrics()
         a = self.allocator
-        occ = (self.in_flight(), len(self.queue), a.in_use())
+        occ = (self.in_flight(), len(self.queue), a.in_use(),
+               a.cached(), a.shared(), a.cow_count)
         if occ == self._last_occ:
             return
         self._last_occ = occ
@@ -737,9 +1074,14 @@ class ServingEngine:
         # watermark-relative pool pressure: 1.0 == AT the admission
         # watermark (>= 1.0 means admission is pausing)
         m['pressure'].set(a.utilization() / self.admit_watermark)
+        m['pfx_cached'].set(occ[3])
+        m['pfx_shared'].set(occ[4])
+        m['pfx_cow'].set(occ[5])
         if a.bytes_per_page:
             m['bytes_in_use'].set(occ[2] * a.bytes_per_page)
             m['bytes_total'].set(a.num_blocks * a.bytes_per_page)
+            m['pfx_cached_b'].set(occ[3] * a.bytes_per_page)
+            m['pfx_shared_b'].set(occ[4] * a.bytes_per_page)
 
     def in_flight(self):
         return sum(r is not None for r in self._slot_req)
@@ -762,6 +1104,10 @@ class ServingEngine:
                            'admit_watermark': self.admit_watermark,
                            'shed_policy': self.shed_policy,
                            **self.counts},
+            'prefix': {'enabled': self.prefix_cache,
+                       'prefill_chunk': self.prefill_chunk,
+                       **self.prefix_counts,
+                       **self.allocator.stats()['prefix']},
             'blocks': self.allocator.stats(),
             'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
                          'block_size': self.block_size,
@@ -794,13 +1140,16 @@ class ServingEngine:
             'top_p': self.top_p,
             'eos_token_id': self.eos_token_id,
             'buckets': list(self.buckets),
+            'prefix_cache': self.prefix_cache,
+            'prefill_chunk': self.prefill_chunk,
         }
 
     def _aot_jitted_fns(self):
         """The module-level jitted steps this engine's geometries
         dispatch — what `aot.build` cache-evicts (per FUNCTION, not
         process-wide) to force real persisting compiles."""
-        return (_paged_prefill, _serve_window, _serve_step)
+        return (_paged_prefill, _serve_window, _serve_step,
+                _serve_chunk_step)
 
     def _warm_geometry(self, g, draft=None):
         """Drive ONE enumerated geometry through the SAME module-level
@@ -856,6 +1205,18 @@ class ServingEngine:
             self._last_logits, self._pages = _paged_prefill(
                 self.model, self._pages, self._last_logits, ids, real_len,
                 btabs, slots)
+        elif g.kind == 'serve_chunk_step':
+            Cb, Sb = int(p['chunk']), int(p['bucket'])
+            K = self.max_slots
+            ids = jnp.zeros((K, Cb), jnp.int32)
+            z = jnp.zeros((K,), jnp.int32)
+            btabs = jnp.zeros((K, self.max_blocks_per_seq), jnp.int32)
+            slots = jnp.full((K,), K, jnp.int32)      # all dummies: drop
+            self._note('serve_chunk_step', W, Cb, Sb)
+            _, self._last_logits, self._pages, _ = _serve_chunk_step(
+                self.model, self._pages, self._last_logits, ids, z, z,
+                btabs, slots, z, z, dev['btab'], dev['ctx'], dev['live'],
+                budget, sub, ctx_bucket=Sb, **common)
         else:
             raise ValueError(f'unknown serving geometry kind {g.kind!r}')
 
@@ -903,6 +1264,12 @@ class ServingEngine:
             btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
                                          jnp.int32)
             slots = jax.ShapeDtypeStruct((K,), jnp.int32)
+        elif g.kind == 'serve_chunk_step':
+            ids = jax.ShapeDtypeStruct((K, int(p['chunk'])), jnp.int32)
+            rl = jax.ShapeDtypeStruct((K,), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            slots = jax.ShapeDtypeStruct((K,), jnp.int32)
 
         def wrap(base, **statics):
             # tracelint: disable=TL001 - one-shot export wrapper (model
@@ -920,6 +1287,11 @@ class ServingEngine:
         elif g.kind == 'serve_prefill':
             yield ('', wrap(_paged_prefill),
                    (pages, logits, ids, rl, btabs, slots))
+        elif g.kind == 'serve_chunk_step':
+            yield ('', wrap(_serve_chunk_step,
+                            ctx_bucket=int(p['bucket']), **common),
+                   (pages, logits, ids, rl, rl, btabs, slots, rl, rl,
+                    btab, ctx, live, budget, self._rng))
         else:
             raise NotImplementedError(
                 f'no StableHLO export for geometry kind {g.kind!r}')
@@ -1206,6 +1578,7 @@ class ServingEngine:
             'next_rid': self._rid,
             'preemptions': self.preemption_count,
             'counts': dict(self.counts),
+            'prefix_counts': dict(self.prefix_counts),
             'tokens_out': self._tokens_out,
             'serve_time': self._serve_time,
             'rng': np.asarray(self._rng).tolist(),
@@ -1297,6 +1670,9 @@ class ServingEngine:
         for k, v in snap.get('counts', {}).items():
             if k in self.counts:
                 self.counts[k] = int(v)
+        for k, v in snap.get('prefix_counts', {}).items():
+            if k in self.prefix_counts:
+                self.prefix_counts[k] = int(v)
         self._tokens_out = int(snap.get('tokens_out', self._tokens_out))
         # without the matching serve-time, tokens_per_s would divide the
         # lifetime token total by the standby's near-zero wall time — a
@@ -1342,6 +1718,26 @@ class ServingEngine:
             self._serve_time += time.perf_counter() - t0
             self._update_gauges()   # admission may have expired/failed
             return []
+        # assemble this step's CHUNK group: every slot mid chunked /
+        # continuation prefill advances one chunk. Completions are
+        # marked now — a slot whose last chunk commits this step
+        # decodes its first window inside this very dispatch (the
+        # monolithic _serve_step semantics), so the page top-up below
+        # must already cover its window.
+        chunk_rows = []
+        for slot, req in enumerate(self._slot_req):
+            p = self._pfill[slot]
+            if req is None or p is None:
+                continue
+            take = req.context_len - p
+            if self.prefill_chunk is not None:
+                take = min(take, self.prefill_chunk)
+            chunk_rows.append((slot, req, p, take))
+        for slot, req, p, take in chunk_rows:
+            self._pfill[slot] = (None if p + take >= req.context_len
+                                 else p + take)
+        if chunk_rows:
+            self._dev = None
         try:
             self._ensure_window_pages()
         except Exception:
@@ -1352,11 +1748,16 @@ class ServingEngine:
             # admitted THIS step have pages armed with no prefill run
             # yet, so they demote first (same hazard the window-seam
             # handler below covers), keeping the engine steppable in
-            # place with sound KV on every surviving slot
+            # place with sound KV on every surviving slot. Chunk rows
+            # claimed progress whose dispatch now never runs — they
+            # demote too and re-prefill from scratch on resume.
             for _Sb, g in groups:
                 for slot, r in g:
                     if self._slot_req[slot] is r:
                         self._demote(slot, r)
+            for slot, r, _p, _t in chunk_rows:
+                if self._slot_req[slot] is r:
+                    self._demote(slot, r)
             raise
         # the top-up above may have preempted (or failed) a
         # just-admitted request: drop it from the prefill groups (its
@@ -1368,26 +1769,38 @@ class ServingEngine:
             if g:
                 kept.append((Sb, g))
         groups = kept
+        chunk_rows = [(s, r, p, t) for s, r, p, t in chunk_rows
+                      if self._slot_req[s] is r]
+        # the chunk group's fault seam (per-request isolation, same
+        # contract as a prefill group: a scripted chunk fault fails the
+        # affected rows, pages freed, the rest of the batch decodes on)
+        if chunk_rows and not self._chunk_seam_ok(chunk_rows):
+            chunk_rows = []
         W = self.decode_window
         if self.temperature != 0.0:
             self._rng, sub = jax.random.split(self._rng)
         else:
             sub = self._rng               # unused inside a greedy trace
-        # admissions beyond the first bucket group (rare: a step that
-        # admits across buckets) prefill standalone; the first group
-        # rides inside the fused step. The 'dispatch' fault seam fires
-        # BEFORE each prefill dispatch (per-request failure isolation:
-        # a fault scripted for a request's prefill — the poisoned-
-        # request model — fails THAT admission group, pages freed, and
-        # the rest of the batch keeps decoding; the real dispatch is
-        # never interrupted mid-flight, so donated buffers stay sound).
-        for Sb, group in groups[1:]:
+        # admissions beyond the fused dispatch prefill standalone (a
+        # step that admits across buckets, or any monolithic admission
+        # landing on a step where a chunk group holds the fused slot).
+        # The 'dispatch' fault seam fires BEFORE each prefill dispatch
+        # (per-request failure isolation: a fault scripted for a
+        # request's prefill — the poisoned-request model — fails THAT
+        # admission group, pages freed, and the rest of the batch keeps
+        # decoding; the real dispatch is never interrupted mid-flight,
+        # so donated buffers stay sound).
+        standalone = groups if chunk_rows else groups[1:]
+        for Sb, group in standalone:
             if not self._prefill_seam_ok(Sb, group):
                 continue
             for _s, r in group:
                 r.mark('prefill_dispatch')
             self._prefill_group(Sb, group)
-        fused = groups[0] if groups else None
+            if self.prefix_cache:
+                for slot, r in group:
+                    self._register_prefix_pages(slot, r, 0, r.context_len)
+        fused = groups[0] if groups and not chunk_rows else None
         if fused is not None and not self._prefill_seam_ok(*fused):
             fused = None
         if not self.in_flight():
@@ -1419,9 +1832,37 @@ class ServingEngine:
             if fused is not None:
                 for slot, r in fused[1]:
                     self._demote(slot, r)
+            for slot, r, _p, _t in chunk_rows:
+                if self._slot_req[slot] is r:
+                    self._demote(slot, r)
             raise
         t_dispatch = time.perf_counter()
-        if fused is not None:
+        if chunk_rows:
+            (ids, clen, cst, btabs, slots, cow_src, cow_dst, Cb,
+             Sb) = self._chunk_args(chunk_rows)
+            for _s, r, _p, _t in chunk_rows:
+                r.mark('prefill_dispatch')
+            hit = self._note('serve_chunk_step', W, Cb, Sb)
+            dispatch_key = ('serve_chunk_step', W, Cb, Sb)
+            toks, self._last_logits, self._pages, ctx_out = \
+                _serve_chunk_step(
+                    self.model, self._pages, self._last_logits, ids,
+                    clen, cst, btabs, slots, cow_src, cow_dst,
+                    dev['btab'], dev['ctx'], dev['live'], budget, sub,
+                    ctx_bucket=Sb, **common)
+            self.prefix_counts['chunk_steps'] += 1
+            _obs.inc('serve.chunk_steps')
+            if self._cow_release:
+                # the dispatch carrying the CoW copies is issued: the
+                # pinned source pages may now be freed (any future
+                # writer of those pages is ordered after the copy by
+                # the device dataflow through self._pages)
+                self.allocator.free(self._cow_release)
+                self._cow_release = []
+            if self.prefix_cache:
+                for slot, r, p, t in chunk_rows:
+                    self._register_prefix_pages(slot, r, p, p + t)
+        elif fused is not None:
             Sb, group = fused
             for _s, r in group:
                 r.mark('prefill_dispatch')
@@ -1432,6 +1873,9 @@ class ServingEngine:
                 self.model, self._pages, self._last_logits, ids, real_len,
                 btabs, slots, dev['btab'], dev['ctx'], dev['live'],
                 budget, sub, **common)
+            if self.prefix_cache:
+                for slot, r in group:
+                    self._register_prefix_pages(slot, r, 0, r.context_len)
         else:
             hit = self._note('serve_window', W)
             dispatch_key = ('serve_window', W)
@@ -1470,7 +1914,10 @@ class ServingEngine:
         step_tokens = 0
         finished = []
         for slot, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or self._pfill[slot] is not None:
+                # mid-prefill slots rode the window frozen: they
+                # emitted pad tokens and commit nothing until their
+                # last chunk lands
                 continue
             take = min(W, req.remaining)
             committed = []
@@ -1532,13 +1979,27 @@ class ServingEngine:
 
     def _device_state(self):
         """Device copies of the per-slot scheduler state, cached until
-        a slot mutation invalidates them (self._dev = None)."""
+        a slot mutation invalidates them (self._dev = None). Slots mid
+        chunked prefill ride the decode window FROZEN on the scratch
+        page: their real block tables stay host-side (the chunk
+        dispatch gets them as explicit args), so the window's clamped
+        frozen-row write can never touch a page a chunk is still
+        filling."""
         if self._dev is None:
+            btab, ctx = self._btab, self._ctx
+            live = [r is not None and self._pfill[i] is None
+                    for i, r in enumerate(self._slot_req)]
+            if any(p is not None for p in self._pfill):
+                btab = btab.copy()
+                ctx = ctx.copy()
+                for i, p in enumerate(self._pfill):
+                    if p is not None:
+                        btab[i] = 0
+                        ctx[i] = 0
             self._dev = {
-                'btab': jnp.asarray(self._btab),
-                'ctx': jnp.asarray(self._ctx),
-                'live': jnp.asarray(
-                    np.asarray([r is not None for r in self._slot_req])),
+                'btab': jnp.asarray(btab),
+                'ctx': jnp.asarray(ctx),
+                'live': jnp.asarray(np.asarray(live)),
             }
         return self._dev
 
@@ -1556,6 +2017,7 @@ class ServingEngine:
             return []
         free = self._free_slots()
         placed = []
+        admitted = 0
         a = self.allocator
         with _obs_trace.span('serve.admit', cat='scheduler') as _sp:
             while free and len(self.queue):
@@ -1568,10 +2030,50 @@ class ServingEngine:
                     self._retire(req, 'expired',
                                  reason='deadline exceeded while queued')
                     continue
-                need = _ceil_div(req.context_len, self.block_size)
-                if need > a.available():
+                total_pages = _ceil_div(req.context_len, self.block_size)
+                hit = []
+                hit_skipped = False
+                if self.prefix_cache:
+                    if req.page_hashes is None:
+                        req.page_hashes = prompt_page_hashes(
+                            req.prompt, self.block_size)
+                    hit = a.match_prefix(req.page_hashes)
+                if hit:
+                    # profitability guard: a hit is taken only when it
+                    # SHRINKS the prefill to a smaller bucket. A short
+                    # hit on a short prompt lands in the same bucket —
+                    # it saves (almost) no compute but pays the
+                    # continuation gather and an extra chunk-step
+                    # bookkeeping pass, a measured net loss on plain
+                    # traffic. Skipped hits leave the pages cached for
+                    # a longer-prefix arrival.
+                    suffix = req.context_len - min(
+                        len(hit) * self.block_size, req.context_len - 1)
+                    if (bucket_length(suffix, self.buckets)
+                            >= bucket_length(req.context_len,
+                                             self.buckets)):
+                        self.prefix_counts['hits_skipped'] += 1
+                        hit = []
+                        hit_skipped = True
+                # continuation start: everything before it is valid KV
+                # in shared pages. At least the LAST context token must
+                # be recomputed (its logits seed the decode), so a
+                # full-coverage hit backs off one token — into a shared
+                # page, which the writer must copy-on-write first.
+                start = min(len(hit) * self.block_size,
+                            req.context_len - 1)
+                cow = len(hit) * self.block_size > start
+                need = total_pages - len(hit) + (1 if cow else 0)
+                # cached pages the hit will revive stop being
+                # allocatable the moment they are shared — the fresh
+                # pages must fit in what remains, or the head waits
+                # (checking available() alone would churn the LRU
+                # through a share/unwind/re-park cycle every step)
+                revive = sum(1 for p in hit if a.refcount(p) == 0)
+                if need > a.available() - revive:
                     break
-                if ((a.in_use() + need) / a.usable > self.admit_watermark
+                held_after = a.in_use() + need + revive
+                if (held_after / a.usable > self.admit_watermark
                         and self.in_flight() > 0):
                     # pool-pressure watermark: admitting would push the
                     # pool past the watermark and something is already
@@ -1579,35 +2081,96 @@ class ServingEngine:
                     # top up from headroom instead of forcing a
                     # preemption storm. With NOTHING in flight the head
                     # always admits (forward progress beats pressure).
+                    # Shared pages a hit would revive off the cached
+                    # LRU count as pressure too.
                     self.counts['admission_paused'] += 1
                     _obs.inc('serve.admission_paused')
                     break
                 self.queue.pop()
+                got = []             # references to return on unwind
+                cow_pair = None      # (src, dst): src ref is the PIN
                 try:
                     if _faults.ACTIVE is not None:
                         _faults.fire('admit', rid=req.rid, need=need)
                     a.phase = 'admit'
-                    pages = a.alloc(need)
+                    if hit:
+                        a.share(hit)
+                        got.extend(hit)
+                    if cow:
+                        # the slot's page table carries the private
+                        # copy; the reference on the SOURCE page stays
+                        # held (allocator.cow's copy-pin contract) so
+                        # no same-step allocation can harvest and
+                        # overwrite it before the deferred device copy
+                        # in the chunk dispatch reads it — released in
+                        # _step_impl once that dispatch is issued (or
+                        # by _clear_slot if the slot dies first)
+                        cp = a.cow(hit[-1])
+                        got.append(cp)
+                        cow_pair = (hit[-1], cp)
+                    got.extend(a.alloc(total_pages - len(hit)))
                 except OutOfBlocks:
                     # transient pool pressure (an injected dry spell,
-                    # or stats racing a concurrent free): requeue at
-                    # the head and stop admitting this step
+                    # or stats racing a concurrent free): release any
+                    # shares already taken, requeue at the head, and
+                    # stop admitting this step
+                    if got:
+                        a.free(got)
                     self.queue.push(req)
                     break
                 except Exception as e:  # noqa: BLE001 - scripted faults
                     # a fault at THIS request's admission (the
-                    # poisoned-request model): fail it alone, keep
+                    # poisoned-request model): fail it alone — shares
+                    # returned, zero leaked references — and keep
                     # admitting the rest of the queue
+                    if got:
+                        a.free(got)
                     self._retire(req, 'failed',
                                  reason=f'fault at admission: {e!r}',
                                  error=e)
                     continue
                 finally:
                     a.phase = None
+                if cow_pair is not None:
+                    # page list for the slot: prefix with the private
+                    # copy at the boundary position (the pinned source
+                    # is NOT part of the slot's table)
+                    pages_for_slot = (hit[:-1] + [cow_pair[1]]
+                                      + got[len(hit) + 1:])
+                else:
+                    pages_for_slot = got
                 slot = free.pop(0)
-                self._place(slot, req, pages)
-                placed.append((slot, req))
-            _sp.args['admitted'] = len(placed)
+                self._place(slot, req, pages_for_slot)
+                admitted += 1
+                if self.prefix_cache:
+                    if hit:
+                        self.prefix_counts['hits'] += 1
+                        self.prefix_counts['hit_tokens'] += start
+                        _obs.inc('serve.prefix_hits')
+                        _obs.inc('serve.prefix_hit_tokens', start)
+                    elif not hit_skipped:
+                        # a matched-but-unprofitable hit counts in
+                        # NEITHER hits nor misses (hits_skipped above):
+                        # hit rate = hits/(hits+misses) must read cache
+                        # effectiveness, not the guard's declines
+                        self.prefix_counts['misses'] += 1
+                        _obs.inc('serve.prefix_misses')
+                chunked = (self.prefill_chunk is not None
+                           and req.context_len - start > self.prefill_chunk)
+                if start > 0 or chunked:
+                    # continuation / chunked admission: this slot rides
+                    # the fused chunk dispatch (starting this very
+                    # step) instead of the monolithic bucket prefill —
+                    # it occupies its slot but emits no tokens until
+                    # its last chunk commits
+                    self._pfill[slot] = start
+                    self._cow_pending[slot] = cow_pair
+                    if chunked:
+                        self.prefix_counts['chunked_admissions'] += 1
+                        _obs.inc('serve.chunked_admissions')
+                else:
+                    placed.append((slot, req))
+            _sp.args['admitted'] = admitted
         by_bucket: dict = {}
         for slot, req in placed:
             Sb = bucket_length(req.context_len, self.buckets)
@@ -1658,12 +2221,88 @@ class ServingEngine:
 
     def _prefill_group(self, Sb, group):
         """Standalone prefill dispatch for an admission group that did
-        not fit the fused step (multi-bucket admission steps)."""
+        not fit the fused step (multi-bucket admission steps, or any
+        monolithic admission landing on a step whose fused dispatch is
+        the chunk group's)."""
         ids, real_len, btabs, slots = self._prefill_args(Sb, group)
         self._note('serve_prefill', Sb)
         self._last_logits, self._pages = _paged_prefill(
             self.model, self._pages, self._last_logits, ids, real_len,
             btabs, slots)
+
+    def _chunk_args(self, rows):
+        """Device args for one fixed-width chunk-continuation batch
+        (the K-row discipline of `_prefill_args`: row i of the batch
+        is rows[i] = (slot, req, progress, take); everything past the
+        group is a dummy that lands on the scratch page and drops its
+        logits). Returns the arrays plus the static (chunk bucket,
+        context bucket) pair that keys the dispatch — row counts,
+        chunk lengths, and per-row progress all ride as device data,
+        so a whole long-prompt flood shares one compilation per
+        bucket pair."""
+        K = self.max_slots
+        Cb = bucket_length(max(t for _s, _r, _p, t in rows), self.buckets)
+        Sb = bucket_length(max(p + t for _s, _r, p, t in rows),
+                           self.buckets)
+        ids = np.zeros((K, Cb), np.int32)
+        clen = np.zeros((K,), np.int32)
+        start = np.zeros((K,), np.int32)
+        btabs = np.zeros((K, self.max_blocks_per_seq), np.int32)
+        slots = np.full((K,), self.max_slots, np.int32)   # dummy: drop
+        cow_src = np.zeros((K,), np.int32)
+        cow_dst = np.zeros((K,), np.int32)
+        for i, (slot, req, p, take) in enumerate(rows):
+            toks = np.concatenate([req.prompt,
+                                   np.asarray(req.generated, np.int32)])
+            ids[i, :take] = toks[p:p + take]
+            clen[i] = take
+            start[i] = p
+            btabs[i] = self._btab[slot]
+            if self._pfill[slot] is None:     # last chunk: commit logits
+                slots[i] = slot
+            pair = self._cow_pending[slot]
+            if pair is not None:              # CoW rides the first chunk
+                cow_src[i], cow_dst[i] = pair
+                self._cow_pending[slot] = None
+                # the copy-pin reference on the source drops once the
+                # dispatch consuming this copy is issued (the caller
+                # frees these right after the _serve_chunk_step call —
+                # from then on the device dataflow orders any reuse of
+                # the page after the copy that read it)
+                self._cow_release.append(pair[0])
+        return (jnp.asarray(ids), jnp.asarray(clen), jnp.asarray(start),
+                jnp.asarray(btabs), jnp.asarray(slots),
+                jnp.asarray(cow_src), jnp.asarray(cow_dst), Cb, Sb)
+
+    def _chunk_seam_ok(self, rows):
+        """Fire the per-dispatch fault seam for the chunk group
+        (kind='chunk'). A scripted fault fails every member —
+        per-request failure isolation, pages freed, shares returned —
+        and returns False so the caller skips the chunk dispatch while
+        the rest of the batch keeps decoding."""
+        try:
+            if _faults.ACTIVE is not None:       # skip ctx build when off
+                _faults.fire('dispatch', kind='chunk',
+                             rids=[r.rid for _s, r, _p, _t in rows])
+        except Exception as e:  # noqa: BLE001 - scripted faults only
+            self._fail_group([(s, r) for s, r, _p, _t in rows], e)
+            return False
+        return True
+
+    def _register_prefix_pages(self, slot, req, lo, hi):
+        """Bind the chain hash of every FULL prompt page whose KV the
+        dispatch covering context positions [lo, hi) just completed.
+        Only prompt-token pages index (generated tokens are
+        per-request data); a hash already bound — shared pages, or a
+        concurrent duplicate that computed the same block — stays with
+        its first writer."""
+        if req.page_hashes is None:
+            return
+        a = self.allocator
+        pages = self._slot_pages[slot]
+        bs = self.block_size
+        for j in range(lo // bs, min(hi // bs, len(req.page_hashes))):
+            a.register_prefix(pages[j], req.page_hashes[j])
 
     def _ensure_window_pages(self):
         """Every live slot must own pages covering the positions the
@@ -1678,7 +2317,10 @@ class ServingEngine:
         a = self.allocator
         for slot in range(self.max_slots):
             req = self._slot_req[slot]
-            if req is None:
+            if req is None or self._pfill[slot] is not None:
+                # mid-prefill slots already own every page their
+                # admission allocated and ride the window frozen — no
+                # top-up until their last chunk commits
                 continue
             target = _ceil_div(
                 int(self._ctx[slot]) + min(self.decode_window,
@@ -1821,14 +2463,20 @@ class ServingEngine:
 
     def _clear_slot(self, slot):
         self.allocator.free(self._slot_pages[slot])
+        if self._cow_pending[slot] is not None:
+            # the slot died before its first chunk dispatched: release
+            # the copy-pin reference on the CoW source page too
+            self.allocator.free([self._cow_pending[slot][0]])
         self._slot_req[slot] = None
         self._slot_pages[slot] = []
         self._btab[slot] = 0
         self._ctx[slot] = 0
         self._budget[slot] = 0
+        self._pfill[slot] = None
+        self._cow_pending[slot] = None
         self._dev = None
 
 
 __all__ = ['ServingEngine', 'BlockAllocator', 'RequestQueue', 'Request',
            'OutOfBlocks', 'QueueFull', 'RequestError', 'RequestFailed',
-           'RequestExpired', 'RequestCancelled']
+           'RequestExpired', 'RequestCancelled', 'prompt_page_hashes']

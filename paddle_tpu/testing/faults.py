@@ -42,22 +42,25 @@ Design rules:
 
 Seam sites wired in-tree (callers pass site-specific context):
 
-  | site       | fired by                                  | ctx keys |
-  |------------|-------------------------------------------|----------|
-  | `alloc`    | `BlockAllocator.alloc`                    | `n`, `free`, `phase` ('admit'/'window'/None) |
-  | `free`     | `BlockAllocator.free`                     | `pages` |
-  | `admit`    | `ServingEngine._admit`, per admission     | `rid`, `need` |
-  | `preempt`  | `ServingEngine._preempt_one`, pre-evict   | `rid`, `slot` |
-  | `dispatch` | `ServingEngine.step`, per dispatch        | `kind` ('prefill'/'window'), `rids`/`bucket` |
-  | `shm_push` | `io.dataloader._push_with_backoff`        | `worker_id`, `timeout` |
+  | site           | fired by                                  | ctx keys |
+  |----------------|-------------------------------------------|----------|
+  | `alloc`        | `BlockAllocator.alloc`                    | `n`, `free`, `phase` ('admit'/'window'/'cow'/None — 'cow' is the copy-on-write page swap behind a full-coverage prefix hit) |
+  | `free`         | `BlockAllocator.free`                     | `pages` |
+  | `prefix_evict` | `BlockAllocator.alloc`, per refcount-0 cached prefix page harvested off the LRU (fired BEFORE any mutation — a scripted fault leaves the pool untouched) | `page`, `phase` |
+  | `admit`        | `ServingEngine._admit`, per admission     | `rid`, `need` |
+  | `preempt`      | `ServingEngine._preempt_one`, pre-evict   | `rid`, `slot` |
+  | `dispatch`     | `ServingEngine.step`, per dispatch        | `kind` ('prefill'/'chunk'/'window'), `rids`/`bucket` |
+  | `shm_push`     | `io.dataloader._push_with_backoff`        | `worker_id`, `timeout` |
 
 Every ctx also carries `site` and `call` (1-based per-site call count
 since install). What each seam DOES with a scripted exception is the
-seam owner's contract: the serving engine isolates prefill/admit
-faults to the affected request, treats alloc faults as pool pressure,
-and lets a `dispatch kind='window'` fault propagate (that one models
-the whole worker dying — the crash `snapshot()`/`restore()` recovers
-from). See docs/serving.md#resilience.
+seam owner's contract: the serving engine isolates prefill/chunk/admit
+faults to the affected request or group (an admission fault under a
+prefix-cache hit returns its page shares — refcounts stay balanced),
+treats alloc faults as pool pressure, and lets a `dispatch
+kind='window'` fault propagate (that one models the whole worker
+dying — the crash `snapshot()`/`restore()` recovers from). See
+docs/serving.md#resilience.
 """
 from __future__ import annotations
 

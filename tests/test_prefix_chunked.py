@@ -1,0 +1,628 @@
+"""Prefix caching + chunked prefill (inference/serving.py, PR 11).
+
+Covers the tentpole properties:
+  - BlockAllocator refcounts: share/free round trips, cached-LRU
+    revive and eviction order, copy-on-write id swaps, over-free
+    detection, the free+cached+held == usable invariant under fuzz;
+  - content-hash chain: full pages only, prefix-sensitivity, ONE
+    batched bytes conversion;
+  - bit-equal greedy parity: prefix-hit, chunked, and mixed requests
+    produce EXACTLY the batch-1 DecodeEngine streams — including a
+    request whose prefix pages are evicted and re-cached mid-run, and
+    the full-coverage CoW case;
+  - zero retraces as the chunk/hit mix changes, and enumeration ==
+    live registry keys for a chunk+prefix engine (the AOT contract);
+  - refcount integrity through preemption, LRU eviction, snapshot/
+    restore, and injected faults at the admission/cow/prefix-evict/
+    chunk-dispatch seams — zero leaked or double-freed pages.
+"""
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+pytestmark = pytest.mark.tier1
+
+from paddle_tpu.inference.engine import (  # noqa: E402
+    COMPILE_CACHE,
+    DecodeEngine,
+    total_traces,
+)
+from paddle_tpu.inference.serving import (  # noqa: E402
+    BlockAllocator,
+    OutOfBlocks,
+    RequestFailed,
+    ServingEngine,
+    prompt_page_hashes,
+)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.testing.faults import FaultError, FaultInjector
+
+
+@functools.lru_cache(maxsize=None)
+def _model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                       layers=2))
+
+
+def _prompt(seed, n, lo=3, hi=96):
+    return np.random.default_rng(seed).integers(lo, hi, (n,)).astype(np.int32)
+
+
+def _refs(prompts, mnts, eos=None):
+    """Batch-1 DecodeEngine outputs — the parity oracle."""
+    model = _model()
+    eng = DecodeEngine(model, max_new_tokens=max(mnts), eos_token_id=eos)
+    return [np.asarray(eng.generate(jnp.asarray(p[None], jnp.int32),
+                                    max_new_tokens=m))[0]
+            for p, m in zip(prompts, mnts)]
+
+
+class TestAllocatorRefcounts:
+    def test_share_free_round_trip(self):
+        a = BlockAllocator(9, 8)
+        pages = a.alloc(3)
+        a.share(pages)                       # second owner
+        assert a.shared() == 3 and a.in_use() == 3
+        a.free(pages)                        # first owner leaves
+        assert a.in_use() == 3 and a.shared() == 0
+        a.free(pages)                        # last owner leaves
+        assert a.in_use() == 0 and a.available() == 8
+
+    def test_overfree_shared_page_raises(self):
+        a = BlockAllocator(5, 8)
+        p = a.alloc(1)
+        a.share(p)
+        a.free(p)
+        a.free(p)
+        with pytest.raises(ValueError, match='not currently allocated'):
+            a.free(p)
+        # over-free inside ONE call (refcount 1, listed twice)
+        q = a.alloc(1)
+        with pytest.raises(ValueError, match='not currently allocated'):
+            a.free(q + q)
+        assert a.refcount(q[0]) == 1         # failed free mutated nothing
+
+    def test_indexed_page_parks_on_lru_and_revives(self):
+        a = BlockAllocator(9, 8)
+        pages = a.alloc(2)
+        a.register_prefix(pages[0], b'h0')
+        a.free(pages)
+        # indexed page 1 cached, unindexed page 2 back on the free list
+        assert a.cached() == 1 and a.available() == 8
+        assert a.match_prefix([b'h0']) == [pages[0]]
+        a.share([pages[0]])                  # revive off the LRU
+        assert a.cached() == 0 and a.refcount(pages[0]) == 1
+        a.free([pages[0]])
+        assert a.cached() == 1
+
+    def test_lru_eviction_oldest_first_fires_seam(self):
+        a = BlockAllocator(4, 8)             # 3 usable
+        pages = a.alloc(3)
+        for i, p in enumerate(pages):
+            a.register_prefix(p, b'h%d' % i)
+        a.free([pages[1]])                   # cached first (oldest)
+        a.free([pages[0]])
+        a.free([pages[2]])
+        with FaultInjector(seed=0) as inj:
+            inj.script('prefix_evict', times=None, when=lambda c: False)
+            got = a.alloc(2)                 # free list empty: harvest 2
+        # oldest-cached first: pages[1] then pages[0] evicted
+        assert a.prefix_evictions == 2
+        assert a.match_prefix([b'h1']) == []
+        assert a.match_prefix([b'h0']) == []
+        assert a.match_prefix([b'h2']) == [pages[2]]
+        assert len(got) == 2 and a.cached() == 1
+
+    def test_prefix_evict_fault_leaves_pool_untouched(self):
+        a = BlockAllocator(3, 8)             # 2 usable
+        pages = a.alloc(2)
+        a.register_prefix(pages[0], b'h0')
+        a.free(pages)
+        assert a.cached() == 1
+        with FaultInjector(seed=0) as inj:
+            inj.script('prefix_evict', exc=FaultError('injected'))
+            with pytest.raises(FaultError):
+                a.alloc(2)                   # needs the harvest
+            assert inj.fired('prefix_evict') == 1
+        # nothing mutated: the cached page survived, retry succeeds
+        assert a.cached() == 1 and a.available() == 2
+        assert a.alloc(2) and a.cached() == 0
+
+    def test_cow_retains_source_pin(self):
+        a = BlockAllocator(9, 8)
+        p = a.alloc(1)[0]
+        a.register_prefix(p, b'h0')
+        a.share([p])                         # a second owner (the writer)
+        new = a.cow(p)
+        assert new != p and a.refcount(new) == 1
+        # the writer's reference on the source is RETAINED as the
+        # copy-pin: cow itself frees nothing (the deferred device copy
+        # still has to read the page)
+        assert a.refcount(p) == 2
+        assert a.cow_count == 1
+        a.free([p])                          # copy landed: release pin
+        assert a.refcount(p) == 1
+        a.free([p, new])
+        assert a.in_use() == 0
+
+    def test_available_counts_cached_and_fuzz_invariant(self):
+        rng = np.random.default_rng(0)
+        a = BlockAllocator(17, 8)
+        held = []                            # (page, owners)
+        nhash = 0
+        for step in range(400):
+            r = rng.random()
+            if held and r < 0.3:             # free one reference
+                i = int(rng.integers(len(held)))
+                p, n = held[i]
+                a.free([p])
+                if n == 1:
+                    held.pop(i)
+                else:
+                    held[i] = (p, n - 1)
+            elif held and r < 0.45:          # share one held page
+                i = int(rng.integers(len(held)))
+                p, n = held[i]
+                a.share([p])
+                held[i] = (p, n + 1)
+            elif r < 0.6 and a.cached():     # revive a cached page
+                p = next(iter(a._cached))
+                a.share([p])
+                held.append((p, 1))
+            else:                            # alloc (maybe index it)
+                try:
+                    p = a.alloc(1)[0]
+                except OutOfBlocks:
+                    assert a.available() == 0
+                    continue
+                if rng.random() < 0.5:
+                    a.register_prefix(p, b'f%d' % nhash)
+                    nhash += 1
+                held.append((p, 1))
+            assert a.in_use() == len(held)
+            assert len({p for p, _ in held}) == len(held)
+            assert a.in_use() + a.available() == a.usable
+        for p, n in held:
+            a.free([p] * n)
+        assert a.in_use() == 0 and a.available() == a.usable
+
+    def test_register_first_writer_wins(self):
+        a = BlockAllocator(9, 8)
+        p, q = a.alloc(2)
+        assert a.register_prefix(p, b'h') is True
+        assert a.register_prefix(q, b'h') is False
+        assert a.match_prefix([b'h']) == [p]
+
+
+class TestPageHashes:
+    def test_full_pages_only_and_chain(self):
+        toks = _prompt(0, 20)
+        h8 = prompt_page_hashes(toks, 8)
+        assert len(h8) == 2                  # 20 // 8 full pages
+        assert prompt_page_hashes(toks[:7], 8) == []
+        # chain: same first page -> same first hash; any earlier token
+        # change flips every later hash
+        other = toks.copy()
+        other[3] += 1
+        g8 = prompt_page_hashes(other, 8)
+        assert g8[0] != h8[0] and g8[1] != h8[1]
+        same_head = np.concatenate([toks[:8], _prompt(9, 8)])
+        assert prompt_page_hashes(same_head, 8)[0] == h8[0]
+        assert prompt_page_hashes(same_head, 8)[1] != h8[1]
+
+
+class TestParity:
+    def test_shared_prefix_matches_batch1(self):
+        """System-prompt traffic: every suffix continuation over shared
+        pages emits exactly the batch-1 DecodeEngine stream."""
+        sys_p = _prompt(1, 20)
+        prompts = [np.concatenate([sys_p, _prompt(s, 5)])
+                   for s in range(4)] + [sys_p.copy()]
+        mnts = [6] * 5
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.run()
+        for r, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(r), ref)
+        st = srv.stats()['prefix']
+        assert st['hits'] > 0 and st['hit_tokens'] > 0
+        assert srv.allocator.in_use() == 0   # zero leaked pages
+
+    def test_full_coverage_hit_cows_boundary_page(self):
+        """A prompt whose every token sits in cached pages recomputes
+        only its last token — into a CoW copy of the boundary page —
+        and still matches batch-1 exactly."""
+        sys_p = _prompt(2, 24)               # exactly 3 full pages
+        long_p = np.concatenate([sys_p, _prompt(3, 5)])
+        refs = _refs([long_p, sys_p], [6, 6])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True)
+        r1 = srv.submit(long_p, 6)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r1), refs[0])
+        r2 = srv.submit(sys_p, 6)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r2), refs[1])
+        assert srv.stats()['prefix']['cow_pages'] == 1
+        assert srv.allocator.in_use() == 0
+
+    def test_chunked_long_prompts_match_batch1(self):
+        """Chunked admission (chunk far smaller than the prompt) is
+        bit-equal to the monolithic path and to batch-1 decode."""
+        prompts = [_prompt(s, 25) for s in range(4)]
+        mnts = [8, 5, 8, 6]
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefill_chunk=8)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.run()
+        for r, ref in zip(rids, refs):
+            np.testing.assert_array_equal(srv.result(r), ref)
+        st = srv.stats()['prefix']
+        assert st['chunked_admissions'] == 4 and st['chunk_steps'] > 4
+        assert srv.allocator.in_use() == 0
+
+    def test_eos_stop_through_chunked_and_hit_paths(self):
+        prompts = [_prompt(s, 21) for s in (11, 12)]
+        prompts.append(prompts[0].copy())    # a guaranteed full hit
+        plain = _refs(prompts, [8, 8, 8])
+        eos = int(plain[0][21 + 2])
+        refs = _refs(prompts, [8, 8, 8], eos=eos)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=3, eos_token_id=eos,
+                            prefix_cache=True, prefill_chunk=8)
+        outs = srv.serve(prompts)
+        for o, ref in zip(outs, refs):
+            np.testing.assert_array_equal(o, ref)
+        assert srv.allocator.in_use() == 0
+
+    def test_evicted_and_recached_prefix_mid_run(self):
+        """The satellite shape: the shared prefix HITS, concurrent
+        filler traffic harvests its cached pages off the LRU
+        (eviction), the next arrival MISSES and re-caches, and the one
+        after hits again — every stream bit-equal throughout, zero
+        leaks."""
+        sys_p = _prompt(4, 16)
+        shared = np.concatenate([sys_p, _prompt(5, 4)])
+        fillers = [_prompt(6, 20), _prompt(7, 20)]
+        refs = _refs([shared] + fillers, [8, 8, 8])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            num_blocks=9, max_context_len=32,
+                            max_new_tokens=8, decode_window=4,
+                            prefix_cache=True)
+
+        def one(p, ref):
+            r = srv.submit(p, 8)
+            srv.run()
+            np.testing.assert_array_equal(srv.result(r), ref)
+
+        one(shared, refs[0])                 # miss: registers + caches
+        one(shared, refs[0])                 # hit
+        st = srv.stats()['prefix']
+        assert st['hits'] == 1 and st['evictions'] == 0
+        # two concurrent fillers need the whole pool: the cached
+        # prefix pages get harvested oldest-first
+        rs = [srv.submit(p, 8) for p in fillers]
+        srv.run()
+        for r, ref in zip(rs, refs[1:]):
+            np.testing.assert_array_equal(srv.result(r), ref)
+        assert srv.stats()['prefix']['evictions'] > 0
+        one(shared, refs[0])                 # miss again: re-caches
+        one(shared, refs[0])                 # ... and hits again
+        st = srv.stats()['prefix']
+        assert st['hits'] == 2 and st['misses'] >= 2
+        assert srv.allocator.in_use() == 0
+
+
+class TestZeroRetraces:
+    def test_mix_changes_compile_nothing(self):
+        """After one warmup wave, any chunk/hit/miss mix over the same
+        buckets compiles NOTHING."""
+        sys_p = _prompt(7, 16)
+        prompts = ([np.concatenate([sys_p, _prompt(s, 4)])
+                    for s in range(3)]
+                   + [_prompt(20, 25), _prompt(21, 5), sys_p.copy()])
+        mnts = [6, 4, 6, 8, 4, 6]
+        srv = ServingEngine(_model(), max_slots=3, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True,
+                            prefill_chunk=8)
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.run()
+        t0 = total_traces()
+        # second wave: different order, different mix of hits/chunks
+        rids2 = [srv.submit(p, m) for p, m in
+                 zip(prompts[::-1], mnts[::-1])]
+        srv.run()
+        assert total_traces() - t0 == 0, srv.stats()
+        for a, b in zip(rids, rids2[::-1]):
+            np.testing.assert_array_equal(srv.result(a), srv.result(b))
+
+    def test_enumeration_matches_live_chunk_engine(self):
+        """The AOT contract for a prefix+chunk engine: a workload
+        covering every reachable geometry notes EXACTLY the enumerated
+        keys — no missing (a first request would compile) and no extra
+        (the artifact would overclaim). A fresh model keeps this
+        engine's registry keys disjoint from the other tests'."""
+        from paddle_tpu import aot
+
+        pt.seed(3)
+        model = LlamaForCausalLM(llama_tiny(vocab_size=96,
+                                            hidden_size=32, layers=1))
+        srv = ServingEngine(model, max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True,
+                            prefill_chunk=8)
+        gs = aot.for_serving_engine(srv)
+        want = set(gs.registry_keys(srv))
+        # chunk pairs cap at bucket(prefill_chunk): (16,16) + (16,32),
+        # monolithic clamps to lengths <= chunk -> bucket 16 only
+        assert len(want) == 5
+        # equal-bucket pairs enumerate ONLY at bucket(prefill_chunk)
+        # (a start-0 first chunk's take is exactly the chunk): a
+        # chunk=32 engine must not carry a dead (16, 16) executable,
+        # and a prefix-only engine none at all (the profitability
+        # guard makes every hit shrink the bucket)
+        srv32 = ServingEngine(model, max_slots=2, block_size=8,
+                              max_context_len=128, max_new_tokens=8,
+                              decode_window=4, prefill_chunk=32)
+        pairs32 = {(g.params['chunk'], g.params['bucket'])
+                   for g in aot.for_serving_engine(srv32)
+                   if g.kind == 'serve_chunk_step'}
+        assert (32, 32) in pairs32 and (16, 16) not in pairs32
+        srv_pfx = ServingEngine(model, max_slots=2, block_size=8,
+                                max_context_len=64, max_new_tokens=8,
+                                decode_window=4, prefix_cache=True)
+        assert all(g.params['chunk'] < g.params['bucket']
+                   for g in aot.for_serving_engine(srv_pfx)
+                   if g.kind == 'serve_chunk_step')
+        before = set(COMPILE_CACHE.keys())
+        # workload engineered to hit EVERY dispatch kind the config
+        # implies: a 20-token chunked admission walks chunk ends across
+        # both context buckets, a same-step short admission takes the
+        # standalone prefill (the chunk group holds the fused slot),
+        # a later lone short admission takes the fused serve_step, and
+        # the drains cover the pure decode window
+        srv.submit(_prompt(80, 20), 8)       # chunks: (16,16)+(16,32)
+        srv.submit(_prompt(81, 5), 8)        # same step: serve_prefill(16)
+        srv.run()
+        srv.submit(_prompt(82, 6), 8)        # alone: serve_step(4, 16)
+        srv.run()
+        got = set(COMPILE_CACHE.keys()) - before
+        assert got == want, (
+            f'missing={sorted(want - got)} extra={sorted(got - want)}')
+        # and the full enumeration is warmable on a fresh engine with
+        # zero traces left for the same workload
+        pt.seed(3)
+        model2 = LlamaForCausalLM(llama_tiny(vocab_size=96,
+                                             hidden_size=32, layers=1))
+        srv2 = ServingEngine(model2, max_slots=2, block_size=8,
+                             max_context_len=32, max_new_tokens=8,
+                             decode_window=4, prefix_cache=True,
+                             prefill_chunk=8)
+        srv2.warmup(geometries=aot.for_serving_engine(srv2))
+        t0 = total_traces()
+        srv2.submit(_prompt(80, 20), 8)
+        srv2.submit(_prompt(81, 5), 8)
+        srv2.run()
+        assert total_traces() - t0 == 0
+
+
+class TestRefcountIntegrity:
+    def test_preemption_with_shared_pages(self):
+        """Preempting a sharer decrements, never frees-for-real, the
+        shared pages; resumed streams stay exact and the pool drains
+        to zero."""
+        sys_p = _prompt(9, 16)
+        prompts = [np.concatenate([sys_p, _prompt(s, 4)])
+                   for s in (30, 31, 32, 33)]
+        mnts = [10] * 4
+        refs = _refs(prompts, mnts)
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            num_blocks=6, max_context_len=32,
+                            max_new_tokens=10, decode_window=4,
+                            prefix_cache=True)
+        outs = srv.serve(prompts)
+        for o, ref in zip(outs, refs):
+            np.testing.assert_array_equal(o, ref)
+        assert srv.preemption_count > 0
+        assert srv.allocator.in_use() == 0
+
+    def test_snapshot_restore_preserves_books(self):
+        """Crash mid-run with shared/cached pages in play: the standby
+        finishes every stream bit-equal, prefix counters carry over,
+        and BOTH engines' pools account to zero."""
+        sys_p = _prompt(10, 16)
+        prompts = [np.concatenate([sys_p, _prompt(s, 4)])
+                   for s in (40, 41, 42)] + [sys_p.copy()]
+        mnts = [8] * 4
+        refs = _refs(prompts, mnts)
+        mk = lambda: ServingEngine(  # noqa: E731
+            _model(), max_slots=2, block_size=8, max_context_len=32,
+            max_new_tokens=8, decode_window=4, prefix_cache=True,
+            prefill_chunk=8)
+        srv = mk()
+        rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
+        srv.step()
+        srv.step()                           # mid-flight, mid-chunk
+        snap = srv.snapshot()
+        standby = mk()
+        standby.restore(snap)
+        standby.run()
+        for r, ref in zip(rids, refs):
+            np.testing.assert_array_equal(standby.result(r), ref)
+        assert (standby.prefix_counts['hits']
+                >= srv.prefix_counts['hits'])
+        assert standby.allocator.in_use() == 0
+        # the "crashed" engine's books are also consistent if drained
+        for slot in range(srv.max_slots):
+            if srv._slot_req[slot] is not None:
+                srv.cancel(srv._slot_req[slot].rid)
+        assert srv.allocator.in_use() == 0
+
+    def test_injected_outofblocks_at_admit_returns_shares(self):
+        """An OutOfBlocks injected AFTER the hit's shares were taken
+        unwinds them: refcounts balanced, the request retries and
+        finishes exact."""
+        sys_p = _prompt(11, 16)
+        shared = np.concatenate([sys_p, _prompt(50, 4)])
+        again = np.concatenate([sys_p, _prompt(51, 4)])
+        refs = _refs([shared, again], [6, 6])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True)
+        r1 = srv.submit(shared, 6)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r1), refs[0])
+        with FaultInjector(seed=0) as inj:
+            # the fresh-suffix alloc of a hit admission dries up once
+            inj.script('alloc', exc=OutOfBlocks('injected: dry'),
+                       when=lambda c: c.get('phase') == 'admit', times=1)
+            r2 = srv.submit(again, 6)
+            srv.run()
+            assert inj.fired('alloc') == 1
+        np.testing.assert_array_equal(srv.result(r2), refs[1])
+        assert srv.allocator.in_use() == 0
+        assert srv.stats()['prefix']['hits'] >= 1
+
+    def test_cow_fault_fails_request_alone(self):
+        """A fault scripted on the CoW alloc (phase='cow') fails ONLY
+        the full-coverage-hit request; shares return, the engine keeps
+        serving, nothing leaks."""
+        sys_p = _prompt(12, 24)
+        donor = np.concatenate([sys_p, _prompt(60, 4)])
+        refs = _refs([donor], [6])
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True)
+        r1 = srv.submit(donor, 6)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r1), refs[0])
+        with FaultInjector(seed=0) as inj:
+            inj.script('alloc', exc=FaultError('poisoned cow'),
+                       when=lambda c: c.get('phase') == 'cow')
+            r2 = srv.submit(sys_p, 6)        # full-coverage hit -> CoW
+            r3 = srv.submit(donor, 6)        # innocent bystander
+            srv.run()
+            assert inj.fired('alloc') == 1
+        with pytest.raises(RequestFailed, match='fault at admission'):
+            srv.result(r2)
+        np.testing.assert_array_equal(srv.result(r3), refs[0])
+        assert srv.allocator.in_use() == 0
+
+    def test_cow_source_not_harvestable_before_copy(self):
+        """REGRESSION (review find): the CoW device copy is deferred
+        into the chunk dispatch, so the engine must PIN the source
+        page until that dispatch is issued — otherwise a same-sweep
+        admission could harvest the parked source off the LRU and its
+        (earlier-dispatched) standalone prefill would overwrite the
+        page the copy then reads, silently corrupting the hit
+        request's stream."""
+        sys_p = _prompt(17, 24)              # exactly 3 full pages
+        short = _prompt(18, 5)
+        ref_sys, ref_short = _refs([sys_p, short], [6, 6])
+        # pool sized to the brink: after the full-coverage hit revives
+        # its 3 cached pages and takes 2 fresh (CoW copy + growth),
+        # only the pinned source could possibly serve the short
+        # admission in the same sweep
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            num_blocks=6, max_context_len=32,
+                            max_new_tokens=6, decode_window=4,
+                            prefix_cache=True)
+        r1 = srv.submit(sys_p, 6)
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r1), ref_sys)
+        r2 = srv.submit(sys_p, 6)            # full-coverage hit -> CoW
+        r3 = srv.submit(short, 2)            # wants a page this sweep
+        srv.run()
+        np.testing.assert_array_equal(srv.result(r2), ref_sys)
+        np.testing.assert_array_equal(srv.result(r3),
+                                      ref_short[:len(short) + 2])
+        assert srv.stats()['prefix']['cow_pages'] == 1
+        assert srv.allocator.in_use() == 0
+
+    def test_chunk_dispatch_fault_isolates_group(self):
+        """A dispatch fault scripted at kind='chunk' fails the chunked
+        request alone — pages freed, the rest of the batch decodes."""
+        long_p = _prompt(13, 25)
+        short = _prompt(14, 5)
+        ref_short = _refs([short], [6])[0]
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=64, max_new_tokens=8,
+                            decode_window=4, prefill_chunk=8)
+        with FaultInjector(seed=0) as inj:
+            inj.script('dispatch', exc=FaultError('poisoned chunk'),
+                       when=lambda c: c.get('kind') == 'chunk')
+            rl = srv.submit(long_p, 6)
+            rs = srv.submit(short, 6)
+            srv.run()
+            assert inj.fired('dispatch') == 1
+        with pytest.raises(RequestFailed):
+            srv.result(rl)
+        np.testing.assert_array_equal(srv.result(rs), ref_short)
+        assert srv.allocator.in_use() == 0
+
+
+class TestObservabilityAndStats:
+    def test_prefix_metrics_and_real_bytes(self):
+        from paddle_tpu import observability as obs
+
+        obs.REGISTRY.reset()
+        sys_p = _prompt(15, 16)
+        prompts = [np.concatenate([sys_p, _prompt(s, 4)])
+                   for s in (70, 71, 72)]
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=8,
+                            decode_window=4, prefix_cache=True,
+                            prefill_chunk=8)
+        outs = srv.serve(prompts, 6)
+        assert len(outs) == 3
+        snap = obs.REGISTRY.snapshot()
+        assert snap.get('serve.prefix_hits', {}).get('value', 0) >= 1
+        assert snap.get('serve.prefix_hit_tokens', {}).get('value', 0) > 0
+        assert snap.get('serve.chunk_steps', {}).get('value', 0) >= 1
+        # gauges report REAL bytes: pages x per-page KV bytes
+        bpp = srv.allocator.bytes_per_page
+        st = srv.stats()['prefix']
+        assert st['bytes_cached'] == st['cached_pages'] * bpp
+        assert (snap.get('pool.prefix_cached_pages', {}).get('value')
+                == st['cached_pages'])
+        assert (snap.get('pool.prefix_cached_bytes', {}).get('value')
+                == st['bytes_cached'])
+
+    def test_skipped_hit_counts_in_neither_bucket(self):
+        """A matched-but-unprofitable hit (same-bucket short prompt)
+        increments hits_skipped ONLY — hit rate = hits/(hits+misses)
+        reads cache effectiveness, not the guard's declines (the
+        documented catalog semantics)."""
+        short = _prompt(19, 13)              # 1 full page, bucket 16
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=4,
+                            decode_window=4, prefix_cache=True)
+        srv.serve([short], 4)                # miss: registers page 0
+        srv.serve([short.copy()], 4)         # matches, but same bucket
+        st = srv.stats()['prefix']
+        assert st == dict(st, hits=0, misses=1, hits_skipped=1)
+        assert srv.allocator.in_use() == 0
+
+    def test_defaults_off_and_config_surfaces(self):
+        srv = ServingEngine(_model(), max_slots=2, block_size=8,
+                            max_context_len=32, max_new_tokens=4)
+        assert srv.prefix_cache is False and srv.prefill_chunk is None
+        cfg = srv.aot_config()
+        assert cfg['prefix_cache'] is False
+        assert cfg['prefill_chunk'] is None
+        with pytest.raises(ValueError, match='prefill_chunk'):
+            ServingEngine(_model(), max_slots=2, block_size=8,
+                          max_context_len=32, prefill_chunk=0)

@@ -41,8 +41,13 @@ if _ROOT not in sys.path:
 
 def run_workload(n_requests=16, decode_window=8, seed=0):
     """The gate-shaped serving workload: mixed budgets, every 4th
-    request long, priority-0 FIFO arrivals. Returns the engine (its
-    run has fed the process-global registry and tracer)."""
+    request long, priority-0 FIFO arrivals — now with the prefix
+    cache and chunked prefill ON and every second request sharing a
+    16-token system prefix, so the dump exercises (and the artifacts
+    carry) the `serve.prefix_*` / `serve.chunk*` / `pool.prefix_*`
+    series alongside the classic lifecycle metrics. Returns the
+    engine (its run has fed the process-global registry and
+    tracer)."""
     import numpy as np
 
     import paddle_tpu as pt
@@ -53,11 +58,15 @@ def run_workload(n_requests=16, decode_window=8, seed=0):
     model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
                                         layers=2))
     rng = np.random.default_rng(seed)
-    prompts = [rng.integers(3, 96, (6,)) for _ in range(n_requests)]
+    sys_prefix = rng.integers(3, 96, (16,))
+    prompts = [np.concatenate([sys_prefix, rng.integers(3, 96, (5,))])
+               if i % 2 else rng.integers(3, 96, (6,))
+               for i in range(n_requests)]
     mnts = [16 if i % 4 == 0 else 6 for i in range(n_requests)]
     srv = ServingEngine(model, max_slots=4, block_size=8,
-                        max_context_len=32, max_new_tokens=16,
-                        decode_window=decode_window)
+                        max_context_len=48, max_new_tokens=16,
+                        decode_window=decode_window,
+                        prefix_cache=True, prefill_chunk=16)
     rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
     srv.run()
     for r in rids:
@@ -120,6 +129,13 @@ def main(argv=None):
     print(f'queue_wait p99   {R.percentile("serve.queue_wait_ms", 99)}')
     print(f'tokens           '
           f'{snap.get("serve.tokens", {}).get("value")}')
+    pfx = srv.stats()['prefix']
+    print(f'prefix hits      {pfx["hits"]} ({pfx["misses"]} miss, '
+          f'{pfx["hit_tokens"]} tokens reused)')
+    print(f'prefix pool      {pfx["cached_pages"]} cached / '
+          f'{pfx["shared_pages"]} shared / {pfx["cow_pages"]} cow page(s)')
+    print(f'chunk steps      {pfx["chunk_steps"]} '
+          f'({pfx["chunked_admissions"]} chunked admission(s))')
     print(f'compile events   '
           f'{snap.get("compile.traces", {}).get("value")}')
     print(f'host spans       {len(obs.TRACER)}')
