@@ -119,14 +119,29 @@ class EngineArtifact:
     def stablehlo_dir(self):
         return os.path.join(self.path, 'stablehlo')
 
+    # manifest entries carry build metadata (key, build_s, stablehlo,
+    # cost) on top of the geometry params; strip it so a restored
+    # Geometry equals a freshly enumerated one
+    _GEOMETRY_META = ('key', 'build_s', 'stablehlo', 'cost')
+
     def geometry_set(self):
-        # manifest entries carry build metadata (key, build_s,
-        # stablehlo) on top of the geometry params; strip it so the
-        # restored Geometry equals a freshly enumerated one
-        meta = ('key', 'build_s', 'stablehlo')
         return _geometry.GeometrySet.from_manifest(
-            [{k: v for k, v in d.items() if k not in meta}
+            [{k: v for k, v in d.items() if k not in self._GEOMETRY_META}
              for d in self.manifest['geometries']])
+
+    def geometry_costs(self):
+        """(Geometry, cost dict) pairs for every manifest entry that
+        carries a usable cost stamp — what `warm_attach` feeds into the
+        engine's dispatch-cost table for the live MFU gauges."""
+        out = []
+        for d in self.manifest.get('geometries', ()):
+            cost = d.get('cost')
+            if isinstance(cost, dict) and cost.get('flops'):
+                g = _geometry.Geometry.from_dict(
+                    {k: v for k, v in d.items()
+                     if k not in self._GEOMETRY_META})
+                out.append((g, cost))
+        return out
 
     @classmethod
     def load(cls, path):
@@ -223,8 +238,25 @@ def _export_stablehlo(out_dir, engine, g, draft):
     return out
 
 
+def _geometry_cost(engine, g, draft):
+    """Per-geometry cost stamp for the manifest: flops / bytes via
+    observability.costs over the engine's `_cost_specs` (the live
+    dispatch functions; with the artifact's persistent cache wired the
+    compile inside is a disk read of the executable the build just
+    persisted). Failures degrade to an {'error': ...} stamp — costs
+    are observability, never allowed to fail a build."""
+    from ..observability import costs as _costs
+
+    try:
+        return _costs.geometry_cost(engine, g, draft=draft)
+    except NotImplementedError as e:
+        return {'error': f'skipped: {e}'}
+    except Exception as e:  # noqa: BLE001 - per-geometry, never fatal
+        return {'error': f'{type(e).__name__}: {e}'}
+
+
 def build(engine, out_dir, geometries=None, draft=None,
-          export_stablehlo=False, **workload):
+          export_stablehlo=False, stamp_costs=True, **workload):
     """Build an EngineArtifact for `engine` into `out_dir`.
 
     `geometries` — an explicit GeometrySet; default is
@@ -233,7 +265,13 @@ def build(engine, out_dir, geometries=None, draft=None,
     draft model, required when speculative geometries are enumerated.
     Compilation happens through the live dispatch path with the
     persistent cache wired to the artifact directory, so building is
-    also a warmup of the CURRENT process."""
+    also a warmup of the CURRENT process.
+
+    `stamp_costs` (default on) additionally records each geometry's
+    XLA cost analysis (flops / bytes accessed — observability.costs)
+    in the manifest; engines that later `warmup(artifact=...)` turn
+    those static numbers into live `serve.mfu_est`/`train.mfu_est`
+    gauges at their existing window syncs."""
     from .. import sysconfig
 
     if geometries is None:
@@ -276,6 +314,8 @@ def build(engine, out_dir, geometries=None, draft=None,
                 d = g.to_dict()
                 d['key'] = key_str(_portable_key(
                     _geometry._registry_key(engine, g)))
+                if stamp_costs:
+                    d['cost'] = _geometry_cost(engine, g, draft)
                 d['build_s'] = round(time.perf_counter() - gt0, 4)
                 if export_stablehlo:
                     d['stablehlo'] = _export_stablehlo(
@@ -346,11 +386,21 @@ def warm_attach(engine, artifact=None, geometries=None, draft=None):
     finally:
         if cache_dir is not None and prev_cache_dir != cache_dir:
             sysconfig.restore_persistent_compilation_cache(prev_cache_dir)
+    # the manifest's per-geometry cost stamps feed the engine's
+    # dispatch-cost table: from here on, window commits derive live
+    # mfu/roofline gauges from static flops x host wall — no lowering,
+    # no syncs, no retraces on the serving path
+    costs_loaded = 0
+    if artifact is not None and hasattr(engine, '_note_geometry_cost'):
+        for g, cost in artifact.geometry_costs():
+            engine._note_geometry_cost(g, cost)
+            costs_loaded += 1
     report = {
         'geometries': len(geometries),
         'seconds': round(time.perf_counter() - t0, 3),
         'traces': _all_traces() - traces0,
         'persistent_cache_dir': cache_dir,
+        'costs_loaded': costs_loaded,
     }
     _obs.set_gauge('aot.warmup_s', report['seconds'])
     return report

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import tracing as _obs_trace
 
@@ -76,11 +77,13 @@ def _count_trace(name):
     """Called from INSIDE to-be-jitted python bodies: runs only while
     tracing, so the counter is exactly the number of (re)compilations.
     Each firing is also a `compile.traces` tick in the process-global
-    metrics registry and a `trace:<name>` instant on the host trace
-    (observability's compile/retrace event accounting)."""
+    metrics registry, a `trace:<name>` instant on the host trace, and a
+    `trace` flight-recorder event (observability's compile/retrace
+    accounting)."""
     _TRACE_COUNTS[name] += 1
     _obs.inc('compile.traces')
     _obs_trace.compile_event(f'trace:{name}')
+    _journal.record('trace', fn=name)
 
 
 def trace_counts():
@@ -643,6 +646,39 @@ class DecodeEngine:
             eos_token_id=self.eos_token_id, padded=not exact))
         yield ('-decode', dec,
                (caches_sds, logits_sds, rl, jax.random.PRNGKey(0)))
+
+    def _cost_specs(self, g, draft=None):
+        """(jitted_fn, args, static_kwargs) triples for
+        `observability.costs.geometry_cost`: the module-level jitted
+        prefill + decode steps a `generate` of this geometry
+        dispatches, over ShapeDtypeStruct avals with the live model as
+        an argument (the served HLO, not an export variant).
+        Speculative geometries have no cost specs (NotImplementedError
+        — recorded, never fatal, by the callers)."""
+        p = g.params
+        if g.kind != 'decode':
+            raise NotImplementedError(
+                f'no cost specs for geometry kind {g.kind!r}')
+        B, L = int(p['batch']), int(p['prompt_len'])
+        mnt = int(p['max_new_tokens'])
+        Sb = bucket_length(L, self.buckets)
+        max_len = Sb + mnt
+        caches = jax.eval_shape(
+            functools.partial(self.model.init_cache, B, max_len))
+        ids = jax.ShapeDtypeStruct((B, Sb), jnp.int32)
+        rl = jax.ShapeDtypeStruct((B,), jnp.int32)
+        exact = L == Sb
+        pre = _prefill_exact if exact else _prefill_padded
+        pre_args = ((self.model, caches, ids) if exact
+                    else (self.model, caches, ids, rl))
+        logits_sds, caches_sds = jax.eval_shape(pre, *pre_args)
+        yield (pre, pre_args, {})
+        yield (_decode_loop,
+               (self.model, caches_sds, logits_sds, rl,
+                jax.random.PRNGKey(0)),
+               dict(max_new_tokens=mnt, temperature=self.temperature,
+                    top_k=self.top_k, top_p=self.top_p,
+                    eos_token_id=self.eos_token_id, padded=not exact))
 
     # -- generate ----------------------------------------------------------
 

@@ -80,12 +80,14 @@ import hashlib
 import heapq
 import inspect
 import itertools
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import tracing as _obs_trace
 from ..testing import faults as _faults
@@ -262,12 +264,15 @@ class BlockAllocator:
                 self._unindex(p)
                 del self._cached[p]
                 self._free.append(p)
+                _journal.record('prefix_evict', page=p, phase=self.phase)
             self.prefix_evictions += harvest
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
         self.alloc_count += n
         self.high_water = max(self.high_water, len(self._ref))
+        _journal.record('alloc', n=n, phase=self.phase,
+                        free=len(self._free))
         return pages
 
     def free(self, pages):
@@ -297,6 +302,7 @@ class BlockAllocator:
             else:
                 self._free.append(p)
         self.free_count += len(pages)
+        _journal.record('free', n=len(pages))
 
     # -- prefix index ------------------------------------------------------
 
@@ -331,6 +337,7 @@ class BlockAllocator:
                 self._ref[p] += 1
         self.prefix_shares += len(pages)
         self.high_water = max(self.high_water, len(self._ref))
+        _journal.record('share', n=len(pages))
         return pages
 
     def register_prefix(self, page, h):
@@ -367,6 +374,7 @@ class BlockAllocator:
         finally:
             self.phase = prev
         self.cow_count += 1
+        _journal.record('cow', src=page, new=new)
         return new
 
     def _unindex(self, page):
@@ -471,15 +479,22 @@ class Request:
         self.error = None        # underlying exception (failed only)
         self.result = None       # output ids (finished only)
 
-    def mark(self, event, t=None):
+    def mark(self, event, t=None, **fields):
         """Append one lifecycle timestamp (no-op while telemetry is
         off, so a disabled server keeps zero per-request overhead).
         Callers that already hold a fresh perf_counter (the window
         commit loop stamps every slot at one instant) pass it as `t`
-        instead of re-reading the clock per request."""
+        instead of re-reading the clock per request.
+
+        Every mark is ALSO one flight-recorder event keyed by rid —
+        `fields` carry the scheduler-decision context (slot, pages,
+        reason, token counts) the journal's `trail(rid)` replays; the
+        `times` list keeps only the (event, t) pairs the histograms
+        roll up."""
         if _obs.enabled():
-            self.times.append(
-                (event, time.perf_counter() if t is None else t))
+            t = time.perf_counter() if t is None else t
+            self.times.append((event, t))
+            _journal.record(event, rid=self.rid, t=t, **fields)
 
     def when(self, event):
         """First timestamp for `event`, or None."""
@@ -519,7 +534,7 @@ class RequestQueue:
         # queue-wait accounting starts here (covers first arrival AND
         # every preemption requeue — a resumed request waits again)
         req.enqueued_at = time.perf_counter()
-        req.mark('enqueued', req.enqueued_at)
+        req.mark('enqueued', req.enqueued_at, state=req.state)
         heapq.heappush(self._heap, (-req.priority, req.seq, req))
 
     def remove(self, req):
@@ -842,7 +857,8 @@ class ServingEngine:
                  temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
                  buckets=None, max_queue=None, admit_watermark=1.0,
                  shed_policy='reject', max_terminal=1024,
-                 prefix_cache=False, prefill_chunk=None):
+                 prefix_cache=False, prefill_chunk=None,
+                 postmortem_dir=None):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -994,6 +1010,26 @@ class ServingEngine:
         self._mgen = -1
         self._mx = None
         self._last_occ = None
+        # cost observatory: dispatch-tag -> static flops/bytes (loaded
+        # from an AOT artifact's manifest at warmup, or via
+        # costs.measure_dispatch_costs). Empty = one failed dict.get
+        # per step and no mfu gauges — the costless default.
+        self._dispatch_costs: dict = {}
+        self._peak_flops = None
+        self._last_mfu = None
+        # crash forensics: a propagating step() exception (the PR-8
+        # worker-death path) auto-dumps a postmortem bundle here
+        self.postmortem_dir = (postmortem_dir
+                               or os.environ.get(
+                                   'PADDLE_TPU_POSTMORTEM_DIR')
+                               or None)
+        self._postmortem_seq = 0
+        self.last_postmortem = None
+        # journal edge-trigger for pool-pressure pauses: the counter
+        # ticks every paused sweep, but the rid-keyed journal event
+        # fires once per STALL (a multi-hour stall must not grow the
+        # held head's live — hence unevictable — trail per step)
+        self._paused_head = None
         self._update_gauges()
 
     # -- bookkeeping -------------------------------------------------------
@@ -1108,6 +1144,10 @@ class ServingEngine:
                        'prefill_chunk': self.prefill_chunk,
                        **self.prefix_counts,
                        **self.allocator.stats()['prefix']},
+            # host-truth MFU record of the last all-hit window (tag,
+            # static flops, wall) — what gate_flight_recorder checks
+            # the serve.mfu_est gauge and the AOT manifest against
+            'mfu': self._last_mfu,
             'blocks': self.allocator.stats(),
             'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
                          'block_size': self.block_size,
@@ -1296,6 +1336,93 @@ class ServingEngine:
             raise NotImplementedError(
                 f'no StableHLO export for geometry kind {g.kind!r}')
 
+    def _cost_specs(self, g, draft=None):
+        """(jitted_fn, args, static_kwargs) triples for
+        `observability.costs.geometry_cost`: the SAME module-level
+        jitted steps the scheduler dispatches, over ShapeDtypeStruct
+        avals with the live model as the first argument — so the
+        lowered HLO (and its cost analysis) is exactly the served
+        executable's, not a weights-as-constants export variant."""
+        p = g.params
+        W = self.decode_window
+        K = self.max_slots
+
+        def sds(x):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x)
+
+        pages = sds(self._pages)
+        logits = sds(self._last_logits)
+        btab = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                    jnp.int32)
+        vec = jax.ShapeDtypeStruct((K,), jnp.int32)
+        live = jax.ShapeDtypeStruct((K,), jnp.bool_)
+        rng = sds(self._rng)
+        common = dict(window=W, temperature=self.temperature,
+                      top_k=self.top_k, top_p=self.top_p,
+                      eos_token_id=self.eos_token_id)
+        if g.kind == 'serve_step':
+            ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            yield (_serve_step,
+                   (self.model, pages, logits, ids, vec, btabs, vec,
+                    btab, vec, live, vec, rng), common)
+        elif g.kind == 'serve_window':
+            yield (_serve_window,
+                   (self.model, pages, logits, btab, vec, live, vec,
+                    rng), common)
+        elif g.kind == 'serve_prefill':
+            ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            yield (_paged_prefill,
+                   (self.model, pages, logits, ids, vec, btabs, vec), {})
+        elif g.kind == 'serve_chunk_step':
+            ids = jax.ShapeDtypeStruct((K, int(p['chunk'])), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            yield (_serve_chunk_step,
+                   (self.model, pages, logits, ids, vec, vec, btabs,
+                    vec, vec, vec, btab, vec, live, vec, rng),
+                   dict(ctx_bucket=int(p['bucket']), **common))
+        else:
+            raise NotImplementedError(
+                f'no cost specs for geometry kind {g.kind!r}')
+
+    def _geometry_cost_tag(self, g):
+        """The dispatch tag `step()` keys its registry notes with, for
+        one enumerated geometry — the join key between the manifest's
+        per-geometry costs and the live window-commit MFU math."""
+        p = g.params
+        W = int(p.get('window', self.decode_window))
+        if g.kind == 'serve_step':
+            return ('serve_step', W, int(p['bucket']))
+        if g.kind == 'serve_window':
+            return ('serve_window', W)
+        if g.kind == 'serve_prefill':
+            return ('serve_prefill', int(p['bucket']))
+        if g.kind == 'serve_chunk_step':
+            return ('serve_chunk_step', W, int(p['chunk']),
+                    int(p['bucket']))
+        return None
+
+    def _note_geometry_cost(self, g, cost):
+        """Bind one geometry's static flops/bytes (an aot manifest's
+        `cost` entry, or costs.geometry_cost output) to its dispatch
+        tag. From then on every all-hit window commit derives
+        `serve.mfu_est` / roofline gauges from host data alone — the
+        static flops and the wall clock the commit already reads."""
+        tag = self._geometry_cost_tag(g)
+        if tag is None or not isinstance(cost, dict) \
+                or not cost.get('flops'):
+            return
+        self._dispatch_costs[tag] = cost
+        if self._peak_flops is None:
+            from ..observability import costs as _costs
+
+            self._peak_flops = _costs.device_peak_flops()
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
@@ -1358,7 +1485,8 @@ class ServingEngine:
         if deadline_s is not None:
             req.deadline = time.perf_counter() + float(deadline_s)
             self._deadlines_live += 1
-        req.mark('arrival')
+        req.mark('arrival', prompt_len=plen, max_new_tokens=mnt,
+                 priority=priority)
         _obs.inc('serve.requests')
         self._live[req.rid] = req
         self.queue.push(req)
@@ -1570,11 +1698,25 @@ class ServingEngine:
 
         live = ([rec(r) for r in self.queue]
                 + [rec(r) for r in self._slot_req if r is not None])
+        terminal = [rec(r) for r in self._terminal.values()]
+        # flight-recorder trails ride the snapshot (JSON-able event
+        # dicts), so a restored replica's `trail(rid)` is still one
+        # ordered record from arrival to terminal state — restore()
+        # re-injects them with the journal seq bumped past ours
+        trails = {}
+        if _journal.journal_enabled():
+            for r in live + terminal:
+                t = _journal.trail(r['rid'])
+                if t:
+                    trails[str(r['rid'])] = t
+        _journal.record('snapshot', requests=len(live),
+                        terminal=len(terminal))
         return {
             'schema': 1,
             'config': self._snapshot_config(),
             'requests': live,
-            'terminal': [rec(r) for r in self._terminal.values()],
+            'terminal': terminal,
+            'trails': trails,
             'next_rid': self._rid,
             'preemptions': self.preemption_count,
             'counts': dict(self.counts),
@@ -1645,6 +1787,15 @@ class ServingEngine:
                     f'tokens — it cannot fit this engine '
                     f'(max_context_len {self.max_context_len}, '
                     f'{self.allocator.usable} usable pages)')
+        # re-register the snapshot's flight-recorder trails FIRST (the
+        # journal bumps its seq past the injected events), so the
+        # 'restored'/'enqueued' marks below extend each trail in order;
+        # a same-process hot standby shares the journal and injects
+        # nothing (the trails are already there)
+        for rid_s, evs in (snap.get('trails') or {}).items():
+            _journal.JOURNAL.inject_trail(int(rid_s), evs)
+        _journal.record('restore', requests=len(snap['requests']),
+                        terminal=len(snap['terminal']))
         for r in snap['requests']:
             req = rebuild(r)
             if req.state == 'running':
@@ -1652,6 +1803,8 @@ class ServingEngine:
                 # preempted so it keeps arrival order and re-prefills
                 req.state = 'preempted'
             max_seq = max(max_seq, req.seq if req.seq is not None else -1)
+            req.mark('restored', state=req.state,
+                     generated=len(req.generated))
             self._live[req.rid] = req
             if req.deadline is not None:
                 self._deadlines_live += 1
@@ -1707,10 +1860,40 @@ class ServingEngine:
         _step_span = _obs_trace.span('serve.step', cat='scheduler').begin()
         try:
             return self._step_impl(t0)
+        except Exception as e:
+            # the PR-8 worker-death path (a propagating window-dispatch
+            # or top-up fault): drop the forensic bundle — metrics,
+            # host trace, journal tail, restorable snapshot — BEFORE
+            # re-raising, so the supervisor that restarts this replica
+            # has the incident on disk
+            self._auto_postmortem(e)
+            raise
         finally:
             # ended in finally: a propagating window fault (worker
             # death) must not leak an open span into the host trace
             _step_span.end()
+
+    def _auto_postmortem(self, error):
+        """Best-effort crash-bundle dump (enabled by `postmortem_dir`
+        or PADDLE_TPU_POSTMORTEM_DIR; one numbered subdirectory per
+        crash). NEVER raises — forensics must not mask the crash being
+        recorded."""
+        if not self.postmortem_dir:
+            return
+        try:
+            from ..observability import postmortem as _postmortem
+
+            self._postmortem_seq += 1
+            out = os.path.join(
+                self.postmortem_dir,
+                f'postmortem-{os.getpid()}-{self._postmortem_seq}')
+            _journal.record('postmortem', error=repr(error))
+            _postmortem.dump_bundle(out, engine=self, error=error,
+                                    reason='worker death in step()')
+            self.last_postmortem = out
+            _obs.inc('serve.postmortems')
+        except Exception:  # noqa: BLE001 - never mask the real crash
+            pass
 
     def _step_impl(self, t0):
         groups = self._admit()
@@ -1795,7 +1978,7 @@ class ServingEngine:
             if not self._prefill_seam_ok(Sb, group):
                 continue
             for _s, r in group:
-                r.mark('prefill_dispatch')
+                r.mark('prefill_dispatch', bucket=Sb, fused=False)
             self._prefill_group(Sb, group)
             if self.prefix_cache:
                 for slot, r in group:
@@ -1841,7 +2024,7 @@ class ServingEngine:
             (ids, clen, cst, btabs, slots, cow_src, cow_dst, Cb,
              Sb) = self._chunk_args(chunk_rows)
             for _s, r, _p, _t in chunk_rows:
-                r.mark('prefill_dispatch')
+                r.mark('prefill_dispatch', chunk=True, start=_p, take=_t)
             hit = self._note('serve_chunk_step', W, Cb, Sb)
             dispatch_key = ('serve_chunk_step', W, Cb, Sb)
             toks, self._last_logits, self._pages, ctx_out = \
@@ -1865,7 +2048,7 @@ class ServingEngine:
         elif fused is not None:
             Sb, group = fused
             for _s, r in group:
-                r.mark('prefill_dispatch')
+                r.mark('prefill_dispatch', bucket=Sb, fused=True)
             ids, real_len, btabs, slots = self._prefill_args(Sb, group)
             hit = self._note('serve_step', W, Sb)
             dispatch_key = ('serve_step', W, Sb)
@@ -1900,6 +2083,10 @@ class ServingEngine:
                 f'compile:{dispatch_key[0]}', key=dispatch_key,
                 dur_s=t_commit - t_dispatch,
                 geometry=str(self._geometry()))
+            _journal.record(
+                'compile', dispatch=dispatch_key[0],
+                key=str(dispatch_key),
+                dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
         # steady-state per-token latency: the window advances every live
         # slot one token per scan step, so each committed token costs
         # window_wall / W — recorded once per token at this commit point
@@ -1947,7 +2134,8 @@ class ServingEngine:
                     mx['itl'].observe(per_tok_ms, n=itl_n)
                 else:
                     _obs.inc('serve.itl_skipped_compile', itl_n)
-                req.mark('window', t_commit)
+                req.mark('window', t_commit, n=len(committed),
+                         total=len(req.generated))
             done = (req.remaining == 0
                     or (self.eos_token_id is not None and committed
                         and committed[-1] == self.eos_token_id))
@@ -1969,6 +2157,35 @@ class ServingEngine:
             mx['steps'].inc()
             mx['tokens'].inc(step_tokens)
             mx['step_ms'].observe((time.perf_counter() - t0) * 1e3)
+            # live MFU / roofline: static flops of THIS dispatch's
+            # geometry (the AOT manifest's cost stamp) over the
+            # host-measured dispatch-to-commit wall — pure host
+            # arithmetic on numbers already in hand (zero new syncs,
+            # zero retraces). Cache-MISS windows are excluded like ITL:
+            # their wall is trace+compile, not model execution.
+            cost = (self._dispatch_costs.get(dispatch_key)
+                    if self._dispatch_costs and hit else None)
+            if cost is not None:
+                wall = t_commit - t_dispatch
+                fl = cost.get('flops')
+                if fl and wall > 0:
+                    fps = fl / wall
+                    _obs.set_gauge('serve.model_flops_per_s', fps)
+                    mfu = (fps / self._peak_flops
+                           if self._peak_flops else None)
+                    if mfu is not None:
+                        _obs.set_gauge('serve.mfu_est', mfu)
+                    ba = cost.get('bytes_accessed')
+                    if ba:
+                        _obs.set_gauge('serve.roofline_intensity',
+                                       fl / ba)
+                    self._last_mfu = {
+                        'tag': dispatch_key, 'flops': fl,
+                        'bytes_accessed': ba,
+                        'window_wall_ms': wall * 1e3,
+                        'flops_per_s': fps, 'mfu_est': mfu,
+                        'peak_flops': self._peak_flops,
+                    }
             self._update_gauges()
         return finished
 
@@ -2085,6 +2302,12 @@ class ServingEngine:
                     # LRU count as pressure too.
                     self.counts['admission_paused'] += 1
                     _obs.inc('serve.admission_paused')
+                    if self._paused_head != req.rid:
+                        # edge-triggered: one trail event per stall,
+                        # not one per paused scheduler step
+                        self._paused_head = req.rid
+                        _journal.record('admission_paused', rid=req.rid,
+                                        held_after=held_after)
                     break
                 self.queue.pop()
                 got = []             # references to return on unwind
@@ -2189,7 +2412,9 @@ class ServingEngine:
         self._dev = None
         req.state = 'running'
         req.admit_seq = next(self._admit_seq)
-        req.mark('admitted')
+        self._paused_head = None     # admission resumed: re-arm the
+                                     # admission_paused edge trigger
+        req.mark('admitted', slot=slot, pages=len(pages))
         if _obs.enabled():
             _obs.inc('serve.admissions')
             if req.enqueued_at is not None:
@@ -2389,7 +2614,7 @@ class ServingEngine:
         self._clear_slot(slot)
         req.state = 'preempted'
         self.preemption_count += 1
-        req.mark('preempted')
+        req.mark('preempted', generated=len(req.generated))
         _obs.inc('serve.preemptions')
         self.queue.push(req)
 
@@ -2407,7 +2632,7 @@ class ServingEngine:
         req.error = error
         if result is not None:
             req.result = result
-        req.mark(state)
+        req.mark(state, reason=reason, tokens=len(req.generated))
         if count:
             self.counts[state] += 1
             _obs.inc(f'serve.{state}')

@@ -190,21 +190,22 @@ def compilation_report(fn, *example_args, **kw):
     {compile_time_s, flops, bytes, hlo_text_head}."""
     import time
 
+    from ..observability.costs import analyze
+
     # tracelint: disable=TL001 - one-shot compile-time report
     jitted = jax.jit(fn, **kw)
     t0 = time.perf_counter()
     lowered = jitted.lower(*example_args)
     compiled = lowered.compile()
     dt = time.perf_counter() - t0
-    cost = {}
-    try:
-        cost = compiled.cost_analysis() or {}
-    except Exception:
-        pass
+    # quirk handling (list-vs-dict, raising backends) lives in
+    # observability.costs.analyze, shared with profiler.op_summary and
+    # the AOT manifest cost stamps
+    cost = analyze(compiled)
     return {
         'compile_time_s': dt,
-        'flops': cost.get('flops', 0),
-        'bytes_accessed': cost.get('bytes accessed', 0),
+        'flops': cost['flops'] or 0,
+        'bytes_accessed': cost['bytes_accessed'] or 0,
         'hlo_head': compiled.as_text()[:2000] if hasattr(compiled, 'as_text') else '',
     }
 

@@ -18,23 +18,38 @@ Contracts (tested in tests/test_observability.py, gated in bench.py):
   - cheap: telemetry-on serving stays within 3% of telemetry-off
     (`gate_observability_overhead`).
 
+The forensic + cost layer rides on top: `journal` (the flight
+recorder — bounded event journal with complete per-request trails),
+`costs` (one normalized reading of XLA's compile-time cost model,
+feeding the AOT manifest and the live MFU/roofline gauges), and
+`postmortem` (crash bundles composing metrics + trace + journal +
+engine snapshot).
+
 See docs/observability.md for the metric catalog and span taxonomy.
 """
 from __future__ import annotations
 
-from . import metrics, tracing  # noqa: F401
+from . import costs, journal, metrics, postmortem, tracing  # noqa: F401
+from .journal import (  # noqa: F401
+    JOURNAL, Journal, journal_enabled, set_journal_enabled,
+    trail, trail_complete,
+)
 from .metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry, enabled,
     inc, observe, set_enabled, set_gauge,
 )
+from .postmortem import dump_bundle, load_bundle, validate_bundle  # noqa: F401
 from .tracing import (  # noqa: F401
     TRACER, HostTracer, annotate, compile_event, instant, span,
 )
 
 __all__ = [
-    'metrics', 'tracing',
+    'metrics', 'tracing', 'journal', 'costs', 'postmortem',
     'REGISTRY', 'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
     'enabled', 'set_enabled', 'inc', 'set_gauge', 'observe',
     'TRACER', 'HostTracer', 'span', 'instant', 'compile_event',
     'annotate',
+    'JOURNAL', 'Journal', 'journal_enabled', 'set_journal_enabled',
+    'trail', 'trail_complete',
+    'dump_bundle', 'validate_bundle', 'load_bundle',
 ]

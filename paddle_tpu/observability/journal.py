@@ -1,0 +1,267 @@
+"""Journal — the flight recorder: a bounded, deterministic structured
+event journal for per-request forensics.
+
+PR 6's metrics answer "how is the fleet doing"; this module answers
+"what exactly happened to request 1742". Every scheduler decision
+(admit / preempt / shed / expire), allocator op (alloc / free / share /
+cow / prefix-evict), injected fault, and compile event is one appended
+dict, recorded at the SAME existing host points the metrics ride (the
+PR-6 zero-sync contract: no device_get, no retrace, a few dict ops per
+event). Two views over one stream:
+
+  - the chronological journal: a bounded ring (`max_events`, oldest
+    dropped and counted) exported as JSONL — what a postmortem bundle
+    tails;
+  - per-request trails: `trail(rid)` returns every event of one
+    request in order, COMPLETE even when the ring has wrapped — trails
+    are kept whole until the request is terminal and the
+    `max_trails` bound evicts the oldest CLOSED trail (a live request's
+    trail is never evicted, so forensics on an in-flight incident
+    cannot lose its head).
+
+Determinism contract: for a fixed workload (same submissions, same
+seeded fault script, no wall-clock-dependent config) the SEQUENCE of
+events — kind, rid, fields — is identical run to run; only the
+timing fields (`TIME_FIELDS`) vary. tests/test_flight_recorder.py and
+bench.py's `gate_flight_recorder` pin it.
+
+Trails survive `ServingEngine.snapshot()`/`restore()`: the snapshot
+carries each live and unretrieved request's trail, and `restore()`
+re-injects them (`inject_trail`) with the seq counter bumped past the
+snapshot's, so a post-failover trail is still one ordered record from
+arrival to terminal state.
+
+Like the metrics registry, this module is stdlib-only and gated by the
+global telemetry switch (`metrics.enabled()`); `set_journal_enabled`
+additionally switches JUST the journal (what the flight-recorder
+overhead gate diffs).
+"""
+from __future__ import annotations
+
+import collections
+import json
+
+from . import metrics as _metrics
+
+__all__ = ['Journal', 'JOURNAL', 'TERMINAL_KINDS', 'TIME_FIELDS',
+           'record', 'trail', 'save', 'tail', 'trail_complete',
+           'strip_times', 'set_journal_enabled', 'journal_enabled']
+
+# a trail is CLOSED (evictable once the bound is hit) when one of these
+# kinds lands — the serving engine's terminal request states
+TERMINAL_KINDS = frozenset(('finished', 'failed', 'expired', 'cancelled'))
+
+# wall-clock fields: excluded from determinism comparisons
+# (`strip_times`) — everything else in an event must be reproducible
+TIME_FIELDS = frozenset(('t', 'dur_ms'))
+
+_ENABLED = True
+
+
+def journal_enabled():
+    """Whether journal recording is on (both the journal's own switch
+    AND the global telemetry switch must be)."""
+    return _ENABLED and _metrics.enabled()
+
+
+def set_journal_enabled(on):
+    """Flip ONLY the journal (the global `metrics.set_enabled` still
+    gates it too) — the knob `gate_flight_recorder` diffs overhead
+    against."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+class Journal:
+    """Bounded event ring + complete per-request trails."""
+
+    def __init__(self, max_events=100_000, max_trails=4096):
+        self.max_events = int(max_events)
+        self.max_trails = int(max_trails)
+        self._events: collections.deque = collections.deque(
+            maxlen=self.max_events)
+        self._trails: dict = {}       # rid -> [event, ...] (complete)
+        self._closed: dict = {}       # rid -> None, oldest-closed first
+        self._seq = 0
+        self.dropped = 0              # ring overflow (chronological view
+                                      # only; trails never lose events)
+        self.trail_evictions = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, kind, rid=None, t=None, **fields):
+        """Append one event. `fields` must be JSON primitives (or short
+        lists of them) — the caller's contract; `t` is a perf_counter
+        stamp when the caller already holds one (a TIME_FIELD, excluded
+        from determinism comparisons)."""
+        # hot path: serving records a handful of events per scheduler
+        # step, so the off-switch is two module attribute reads (no
+        # function call) and the on-path is one dict + two appends
+        if not _ENABLED or not _metrics._ENABLED:
+            return None
+        ev = {'seq': self._seq, 'kind': kind}
+        self._seq += 1
+        if rid is not None:
+            ev['rid'] = rid
+        if t is not None:
+            ev['t'] = t
+        if fields:
+            ev.update(fields)
+        if len(self._events) == self.max_events:
+            self.dropped += 1
+        self._events.append(ev)
+        if rid is not None:
+            tr = self._trails.get(rid)
+            if tr is None:
+                tr = self._trails[rid] = []
+                self._evict()        # a NEW trail may push past the bound
+            tr.append(ev)
+            if kind in TERMINAL_KINDS:
+                self._close(rid)
+        return ev
+
+    def _append(self, ev):
+        if len(self._events) == self.max_events:
+            self.dropped += 1
+        self._events.append(ev)
+
+    def _close(self, rid):
+        self._closed[rid] = None
+        self._evict()
+
+    def _evict(self):
+        """Drop oldest-CLOSED trails past `max_trails`. Live trails are
+        never evicted (forensics on an in-flight incident must keep its
+        head) — an all-live overshoot is bounded by the engine's own
+        queue/slot/terminal bounds."""
+        while len(self._trails) > self.max_trails and self._closed:
+            victim = next(iter(self._closed))
+            del self._closed[victim]
+            self._trails.pop(victim, None)
+            self.trail_evictions += 1
+
+    def inject_trail(self, rid, events):
+        """Re-register a trail from a snapshot (the restore path).
+        Injected events keep their original seq/ts; the journal's own
+        counter jumps past the highest injected seq so later events
+        stay ordered after them. Events whose seq the existing trail
+        already covers are skipped — a same-process restore (hot
+        standby sharing this journal) injects nothing and duplicates
+        nothing. Returns the number of events injected."""
+        if not (_ENABLED and _metrics.enabled()):
+            return 0
+        cur = self._trails.get(rid)
+        last = max((e.get('seq', -1) for e in cur), default=-1) \
+            if cur else -1
+        evs = [dict(e) for e in events if e.get('seq', -1) > last]
+        if not evs:
+            return 0
+        for ev in evs:
+            self._append(ev)
+        self._trails.setdefault(rid, []).extend(evs)
+        mx = max(e.get('seq', -1) for e in evs)
+        if mx >= self._seq:
+            self._seq = mx + 1
+        if any(e.get('kind') in TERMINAL_KINDS for e in evs):
+            self._close(rid)
+        return len(evs)
+
+    # -- reading / export --------------------------------------------------
+
+    def events(self):
+        return list(self._events)
+
+    def tail(self, n=1000):
+        """The newest `n` events (the postmortem-bundle slice)."""
+        if n >= len(self._events):
+            return list(self._events)
+        return list(self._events)[-int(n):]
+
+    def trail(self, rid):
+        """Every event of request `rid` in order ([] when unknown or
+        evicted)."""
+        return list(self._trails.get(rid, ()))
+
+    def trails(self):
+        """rids with a retained trail."""
+        return list(self._trails)
+
+    def __len__(self):
+        return len(self._events)
+
+    def clear(self):
+        self._events.clear()
+        self._trails.clear()
+        self._closed.clear()
+        self._seq = 0
+        self.dropped = 0
+        self.trail_evictions = 0
+
+    def to_jsonl(self, events=None):
+        """One JSON object per line (default=str: a non-serializable
+        field degrades to its repr, never breaks the export)."""
+        evs = self._events if events is None else events
+        return ''.join(json.dumps(e, default=str) + '\n' for e in evs)
+
+    def save(self, path, tail=None):
+        """Write journal.jsonl (optionally only the newest `tail`
+        events) and return the path."""
+        evs = None if tail is None else self.tail(tail)
+        with open(path, 'w') as f:
+            f.write(self.to_jsonl(evs))
+        return path
+
+
+JOURNAL = Journal()
+
+
+# -- module-level conveniences over the global journal ----------------------
+
+# `record` is THE hot call (serving marks ride it several times per
+# scheduler step), so it is the bound method itself — no wrapper frame.
+# JOURNAL is never replaced (clear() resets it in place), so the
+# binding stays valid for the life of the process.
+record = JOURNAL.record
+
+
+def trail(rid):
+    return JOURNAL.trail(rid)
+
+
+def save(path, tail=None):
+    return JOURNAL.save(path, tail=tail)
+
+
+def tail(n=1000):
+    return JOURNAL.tail(n)
+
+
+# -- trail analysis (shared by tests, the bench gate, and the CLI) ----------
+
+def strip_times(events):
+    """Events minus the TIME_FIELDS — the determinism-comparable form."""
+    return [{k: v for k, v in e.items() if k not in TIME_FIELDS}
+            for e in events]
+
+
+def trail_complete(events, state=None):
+    """Problems with one request trail (empty list = complete and
+    ordered): non-empty, seq strictly increasing, starts at 'arrival',
+    ends at a terminal kind (matching `state` when given, e.g. the
+    engine's `status(rid)`)."""
+    problems = []
+    if not events:
+        return ['empty trail']
+    kinds = [e.get('kind') for e in events]
+    seqs = [e.get('seq') for e in events]
+    if kinds[0] != 'arrival':
+        problems.append(f"starts at {kinds[0]!r}, not 'arrival'")
+    if any(s is None for s in seqs) or any(
+            b <= a for a, b in zip(seqs, seqs[1:])):
+        problems.append('seq not strictly increasing')
+    if kinds[-1] not in TERMINAL_KINDS:
+        problems.append(f'last event {kinds[-1]!r} is not terminal')
+    elif state is not None and kinds[-1] != state:
+        problems.append(
+            f'terminal event {kinds[-1]!r} != request state {state!r}')
+    return problems

@@ -1,0 +1,250 @@
+"""postmortem — crash forensics bundles.
+
+When a serving worker dies (PR 8's `dispatch kind='window'` fault
+model, or any exception escaping `ServingEngine.step()`), the operator
+used to get a traceback and a counter bump. `dump_bundle(dir)`
+composes everything the process knows into one directory a human (or
+`tools/postmortem.py`) can read after the fact:
+
+    bundle.json       manifest: schema, env fingerprint, the error,
+                      engine census (stats() — allocator, geometry,
+                      resilience counters — plus the geometry-cost
+                      table), per-file status
+    metrics.json      full MetricsRegistry snapshot
+    host_trace.json   HostTracer Chrome trace_event array
+    journal.jsonl     flight-recorder tail (newest events)
+    snapshot.json     engine.snapshot() — the restorable host state,
+                      when the engine has one
+
+Every artifact is best-effort: a failure writing one piece is recorded
+in bundle.json's `errors` and never raised — forensics must not mask
+the crash being recorded. `ServingEngine(postmortem_dir=...)` (or env
+`PADDLE_TPU_POSTMORTEM_DIR`) auto-dumps a bundle on the worker-death
+path before re-raising; `validate_bundle` is the CLI's and the bench
+gate's acceptance check.
+
+Stdlib-only at import (the env fingerprint reaches for jax lazily), so
+bundles can be read and validated on boxes with no backend at all.
+"""
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+import traceback
+
+from . import journal as _journal
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ['BUNDLE_SCHEMA', 'BUNDLE_NAME', 'dump_bundle',
+           'validate_bundle', 'load_bundle', 'env_fingerprint']
+
+BUNDLE_SCHEMA = 1
+BUNDLE_NAME = 'bundle.json'
+
+# journal slice size in a bundle: enough for the whole incident window,
+# bounded so a bundle is always a quick read/copy
+JOURNAL_TAIL = 20_000
+
+# env var prefixes worth fingerprinting (config, never secrets)
+_ENV_PREFIXES = ('PADDLE_TPU_', 'JAX_', 'XLA_FLAGS', 'LIBTPU')
+
+
+def env_fingerprint():
+    """The process environment a postmortem reader needs to reproduce:
+    versions, backend, and the PADDLE_TPU_/JAX_/XLA knobs that were
+    set. jax is optional — a backendless box still fingerprints."""
+    fp = {
+        'python': sys.version.split()[0],
+        'platform': platform.platform(),
+        'pid': os.getpid(),
+        'argv': list(sys.argv),
+        'env': {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(_ENV_PREFIXES)},
+    }
+    try:
+        import jax
+        import jaxlib
+
+        fp['jax'] = jax.__version__
+        fp['jaxlib'] = jaxlib.__version__
+        fp['backend'] = jax.default_backend()
+        fp['device_kind'] = getattr(jax.devices()[0], 'device_kind', '?')
+    except Exception as e:  # noqa: BLE001 - no backend is a valid state
+        fp['jax_error'] = f'{type(e).__name__}: {e}'
+    return fp
+
+
+def _error_record(error):
+    if error is None:
+        return None
+    rec = {'type': type(error).__name__, 'repr': repr(error)}
+    tb = getattr(error, '__traceback__', None)
+    if tb is not None:
+        rec['traceback'] = ''.join(
+            traceback.format_exception(type(error), error, tb))[-8000:]
+    return rec
+
+
+def dump_bundle(out_dir, engine=None, error=None, reason=None,
+                extra=None):
+    """Write one postmortem bundle into `out_dir` (created). Returns a
+    report dict: {'path', 'written': [...], 'errors': {file: why}}.
+    NEVER raises past argument validation — each artifact is written
+    independently and failures are recorded in the manifest."""
+    out_dir = os.path.abspath(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    written, errors = [], {}
+
+    def _write(name, producer):
+        try:
+            producer(os.path.join(out_dir, name))
+            written.append(name)
+        except Exception as e:  # noqa: BLE001 - forensics: record, go on
+            errors[name] = f'{type(e).__name__}: {e}'
+
+    def _dump_json(path, payload):
+        with open(path, 'w') as f:
+            json.dump(payload, f, indent=2, default=str)
+
+    def _json_to(name, payload):
+        _write(name, lambda p: _dump_json(p, payload))
+
+    _json_to('metrics.json', _metrics.REGISTRY.snapshot())
+    _write('host_trace.json', _tracing.TRACER.export)
+    _write('journal.jsonl',
+           lambda p: _journal.JOURNAL.save(p, tail=JOURNAL_TAIL))
+
+    census = None
+    if engine is not None:
+        try:
+            census = engine.stats()
+        except Exception as e:  # noqa: BLE001
+            errors['stats'] = f'{type(e).__name__}: {e}'
+        costs = getattr(engine, '_dispatch_costs', None)
+        if costs:
+            # the geometry-cost census: what the MFU gauges divide by
+            census = dict(census or {})
+            census['dispatch_costs'] = {str(k): v
+                                        for k, v in costs.items()}
+        if hasattr(engine, 'snapshot'):
+            snap = None
+            try:
+                snap = engine.snapshot()
+            except Exception as e:  # noqa: BLE001 - snapshot can refuse
+                errors['snapshot.json'] = f'{type(e).__name__}: {e}'
+            if snap is not None:
+                _json_to('snapshot.json', snap)
+
+    manifest = {
+        'schema': BUNDLE_SCHEMA,
+        'created_at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+        'reason': reason,
+        'error': _error_record(error),
+        'fingerprint': env_fingerprint(),
+        'engine': census,
+        'journal': {
+            'events': len(_journal.JOURNAL),
+            'dropped': _journal.JOURNAL.dropped,
+            'trails': len(_journal.JOURNAL.trails()),
+        },
+        'extra': extra,
+        'files': sorted(written),
+        'errors': errors,
+    }
+    _json_to(BUNDLE_NAME, manifest)
+    return {'path': out_dir, 'written': sorted(written) + [BUNDLE_NAME],
+            'errors': errors}
+
+
+# files a valid bundle must carry and parse; snapshot.json is optional
+# (only engines with snapshot() write it)
+_REQUIRED = ('bundle.json', 'metrics.json', 'host_trace.json',
+             'journal.jsonl')
+
+
+def validate_bundle(path):
+    """(ok, problems) for a bundle directory: required files exist and
+    parse, manifest schema is known, the host trace is a trace_event
+    array, every journal line is JSON. The CLI's and the bench gate's
+    acceptance check."""
+    problems = []
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False, [f'not a directory: {path}']
+    for name in _REQUIRED:
+        if not os.path.isfile(os.path.join(path, name)):
+            problems.append(f'missing {name}')
+    if problems:
+        return False, problems
+    try:
+        with open(os.path.join(path, BUNDLE_NAME)) as f:
+            manifest = json.load(f)
+        if manifest.get('schema') != BUNDLE_SCHEMA:
+            problems.append(
+                f"unknown bundle schema {manifest.get('schema')!r} "
+                f'(this reader knows {BUNDLE_SCHEMA})')
+        if not isinstance(manifest.get('fingerprint'), dict):
+            problems.append('bundle.json lacks the env fingerprint')
+    except (OSError, ValueError) as e:
+        problems.append(f'bundle.json unreadable: {e}')
+    try:
+        with open(os.path.join(path, 'metrics.json')) as f:
+            if not isinstance(json.load(f), dict):
+                problems.append('metrics.json is not an object')
+    except (OSError, ValueError) as e:
+        problems.append(f'metrics.json unreadable: {e}')
+    try:
+        with open(os.path.join(path, 'host_trace.json')) as f:
+            trace = json.load(f)
+        if not isinstance(trace, list) or any(
+                not isinstance(e, dict) or 'ph' not in e or 'ts' not in e
+                for e in trace):
+            problems.append('host_trace.json is not a trace_event array')
+    except (OSError, ValueError) as e:
+        problems.append(f'host_trace.json unreadable: {e}')
+    try:
+        with open(os.path.join(path, 'journal.jsonl')) as f:
+            for i, line in enumerate(f):
+                if line.strip():
+                    json.loads(line)
+    except (OSError, ValueError) as e:
+        problems.append(f'journal.jsonl unreadable: {e}')
+    sp = os.path.join(path, 'snapshot.json')
+    if os.path.isfile(sp):
+        try:
+            with open(sp) as f:
+                json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f'snapshot.json unreadable: {e}')
+    return not problems, problems
+
+
+def load_bundle(path):
+    """Parsed bundle contents: {'manifest', 'metrics', 'host_trace',
+    'journal' (list of events), 'snapshot' (or None)}. Raises on a
+    bundle `validate_bundle` would reject — validate first when the
+    input is untrusted."""
+    path = os.path.abspath(path)
+    with open(os.path.join(path, BUNDLE_NAME)) as f:
+        manifest = json.load(f)
+    with open(os.path.join(path, 'metrics.json')) as f:
+        metrics = json.load(f)
+    with open(os.path.join(path, 'host_trace.json')) as f:
+        host_trace = json.load(f)
+    journal = []
+    with open(os.path.join(path, 'journal.jsonl')) as f:
+        for line in f:
+            if line.strip():
+                journal.append(json.loads(line))
+    snapshot = None
+    sp = os.path.join(path, 'snapshot.json')
+    if os.path.isfile(sp):
+        with open(sp) as f:
+            snapshot = json.load(f)
+    return {'manifest': manifest, 'metrics': metrics,
+            'host_trace': host_trace, 'journal': journal,
+            'snapshot': snapshot}

@@ -37,7 +37,7 @@ import time
 from . import metrics as _metrics
 
 __all__ = ['HostTracer', 'TRACER', 'span', 'instant', 'compile_event',
-           'annotate', 'export', 'to_chrome_trace']
+           'annotate', 'export', 'save', 'to_chrome_trace']
 
 # one process-wide epoch so every event's ts is comparable; perf_counter
 # is monotonic (wall-clock jumps cannot reorder spans)
@@ -103,7 +103,11 @@ class HostTracer:
         if args:
             ev['args'] = args
         if len(self._events) == self.max_events:
+            # silent event loss is itself an observability bug: surface
+            # ring overflow as a registry counter so dashboards see a
+            # truncated trace for what it is
             self.dropped += 1
+            _metrics.inc('trace.dropped_events')
         self._events.append(ev)
 
     def span(self, name, cat='host', **args):
@@ -162,6 +166,11 @@ class HostTracer:
             json.dump(self.to_chrome_trace(), f, default=str)
         return path
 
+    def save(self, path):
+        """`export` alias — the artifact-writing verb the registry
+        (`to_json`) and journal (`save`) families use."""
+        return self.export(path)
+
 
 TRACER = HostTracer()
 
@@ -181,6 +190,10 @@ def compile_event(name, key=None, dur_s=None, **args):
 
 
 def export(path):
+    return TRACER.export(path)
+
+
+def save(path):
     return TRACER.export(path)
 
 

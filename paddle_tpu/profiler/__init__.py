@@ -319,26 +319,17 @@ def op_summary(fn, *args, print_table=True, top=20, **kwargs):
             m = re.search(r'=\s+\S+\s+([a-z][\w-]*)\(', mod)
             if m:
                 hist[m.group(1)] += 1
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-    except Exception:
-        cost = {}
-    try:
-        mem = compiled.memory_analysis()
-        mem_stats = {
-            'argument_bytes': mem.argument_size_in_bytes,
-            'output_bytes': mem.output_size_in_bytes,
-            'temp_bytes': mem.temp_size_in_bytes,
-        }
-    except Exception:
-        mem_stats = {}
+    # cost/memory quirks (list-vs-dict, raising backends) are handled
+    # ONCE in observability.costs — the same normalized reading the AOT
+    # manifest cost stamps and the live MFU gauges use
+    from ..observability.costs import analyze
+
+    cost = analyze(compiled)
+    mem_stats = cost['memory']
     stats = {
         'opcode_histogram': dict(hist.most_common()),
-        'flops': float(cost.get('flops', 0.0)) if cost else None,
-        'bytes_accessed': (float(cost.get('bytes accessed', 0.0))
-                           if cost else None),
+        'flops': cost['flops'],
+        'bytes_accessed': cost['bytes_accessed'],
         'memory': mem_stats,
     }
     if print_table:

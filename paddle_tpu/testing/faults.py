@@ -67,7 +67,30 @@ from __future__ import annotations
 import copy
 import random
 
+from ..observability import journal as _journal
+
 __all__ = ['FaultError', 'FaultRule', 'FaultInjector', 'fire', 'active']
+
+# journal-event field sanitizing: the seam ctx is caller-shaped, so
+# only primitives (and short lists of them) ride into the flight
+# recorder, and keys that collide with the journal's own reserved
+# event fields are prefixed
+_RESERVED = frozenset(('kind', 'rid', 't', 'seq', 'site', 'call'))
+
+
+def _journal_fields(ctx):
+    out = {}
+    for k, v in ctx.items():
+        if k in ('site', 'call', 'rid'):
+            continue                       # passed explicitly
+        if k in _RESERVED:
+            k = f'ctx_{k}'
+        if isinstance(v, (str, int, float, bool, type(None))):
+            out[k] = v
+        elif isinstance(v, (list, tuple)) and len(v) <= 32 and all(
+                isinstance(x, (str, int, float, bool)) for x in v):
+            out[k] = list(v)
+    return out
 
 
 class FaultError(RuntimeError):
@@ -226,6 +249,11 @@ class FaultInjector:
             if rule._should_fire(ctx, self._rng) and exc is None:
                 rule.fired += 1
                 self.log.append((site, ctx))
+                # every fired injection is one flight-recorder event —
+                # with a rid in ctx it lands in that request's trail,
+                # so a postmortem shows exactly which fault led where
+                _journal.record('fault', rid=ctx.get('rid'), site=site,
+                                call=ctx['call'], **_journal_fields(ctx))
                 exc = rule._make_exc(ctx)
         if exc is not None:
             raise exc

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..framework.tree import split_trainable
 from ..inference.engine import CompileCache, model_struct, model_tag
+from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import tracing as _obs_trace
 
@@ -85,6 +86,7 @@ def _count_trace(name):
     _TRACE_COUNTS[name] += 1
     _obs.inc('compile.traces')
     _obs_trace.compile_event(f'trace:{name}')
+    _journal.record('trace', fn=name)
 
 
 def trace_counts():
@@ -370,6 +372,20 @@ class TrainEngine:
         self._window_tokens = 0
         self._last_scale_seen = None
         self._traces_mark = total_traces()
+        # cost observatory: (batch shape, dtype) -> static flops/bytes
+        # per fused step (loaded from an AOT artifact's manifest at
+        # warmup, or via costs.measure_dispatch_costs); step()
+        # accumulates the window's static flops so sync() can derive
+        # train.mfu_est from the wall it already measures
+        self._dispatch_costs: dict = {}
+        self._peak_flops = None
+        self._window_flops = 0.0
+        self._window_bytes = 0.0
+        # a window containing a compile-MISS step publishes no MFU:
+        # its wall is trace+compile, not model execution (the serving
+        # engine's per-dispatch MISS exclusion, at window granularity)
+        self._window_miss = False
+        self._last_mfu = None
 
     # -- lr resolution -----------------------------------------------------
 
@@ -544,6 +560,51 @@ class TrainEngine:
                (self.opt_state, self.scaler_state, inputs, labels,
                 self._host_lr(lr_mode)))
 
+    def _cost_specs(self, g, draft=None):
+        """(jitted_fn, args, static_kwargs) for
+        `observability.costs.geometry_cost`: the module-level fused
+        train step over ShapeDtypeStruct batch avals with the live
+        model/opt-state riding as arguments — the exact served HLO."""
+        if g.kind != 'train_step':
+            raise NotImplementedError(
+                f'no cost specs for geometry kind {g.kind!r}')
+        if self.optimizer is None:
+            raise NotImplementedError(
+                'eval-only engine: no train step to cost')
+        p = g.params
+
+        def sds(shapes, dtypes):
+            return tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                         for s, d in zip(shapes, dtypes))
+
+        inputs = sds(p['input_shapes'], p['input_dtypes'])
+        labels = sds(p.get('label_shapes', ()), p.get('label_dtypes', ()))
+        lr_mode = self._lr_mode()
+        yield (_fused_train_step,
+               (self.model, self.opt_state, self.scaler_state, inputs,
+                labels, self._host_lr(lr_mode)),
+               self._step_statics(lr_mode))
+
+    def _cost_key(self, shape, dtype):
+        return (tuple(int(s) for s in shape), str(dtype))
+
+    def _note_geometry_cost(self, g, cost):
+        """Bind one train-step geometry's static flops/bytes (an aot
+        manifest `cost` entry, or costs.geometry_cost output) to its
+        batch-shape key; `step()` then accumulates window flops and
+        `sync()` turns them into `train.mfu_est` — host arithmetic on
+        the wall the window sync already measures."""
+        if (g.kind != 'train_step' or not isinstance(cost, dict)
+                or not cost.get('flops')):
+            return
+        p = g.params
+        self._dispatch_costs[self._cost_key(
+            p['input_shapes'][0], p['input_dtypes'][0])] = cost
+        if self._peak_flops is None:
+            from ..observability import costs as _costs
+
+            self._peak_flops = _costs.device_peak_flops()
+
     # -- the hot path ------------------------------------------------------
 
     def step(self, inputs, labels=()):
@@ -573,8 +634,15 @@ class TrainEngine:
             self._window_tokens += int(inputs[0].size)
         lr_mode = self._lr_mode()
         if inputs:
-            TRAIN_COMPILE_CACHE.note(self.registry_key(
-                inputs[0].shape, inputs[0].dtype))
+            if not TRAIN_COMPILE_CACHE.note(self.registry_key(
+                    inputs[0].shape, inputs[0].dtype)):
+                self._window_miss = True
+            if self._dispatch_costs:
+                c = self._dispatch_costs.get(self._cost_key(
+                    inputs[0].shape, inputs[0].dtype))
+                if c is not None:
+                    self._window_flops += c.get('flops') or 0.0
+                    self._window_bytes += c.get('bytes_accessed') or 0.0
         (self.model, self.opt_state, self.scaler_state, loss,
          preds) = _fused_train_step(
             self.model, self.opt_state, self.scaler_state, inputs, labels,
@@ -632,6 +700,9 @@ class TrainEngine:
         if not _obs.enabled():
             self._window_t0 = None
             self._window_tokens = 0
+            self._window_flops = 0.0
+            self._window_bytes = 0.0
+            self._window_miss = False
             return
         now = time.perf_counter()
         if self._window_t0 is not None and n_steps:
@@ -639,6 +710,31 @@ class TrainEngine:
             if wall > 0:
                 _obs.set_gauge('train.tokens_per_s',
                                self._window_tokens / wall)
+                if self._window_flops and not self._window_miss:
+                    # live MFU / roofline: the window's accumulated
+                    # static step flops (the AOT manifest's cost
+                    # stamps) over the wall this sync already measures
+                    # — zero extra syncs, zero retraces. A window that
+                    # paid a compile publishes nothing (its wall is
+                    # not model execution — the MISS-exclusion rule)
+                    fps = self._window_flops / wall
+                    _obs.set_gauge('train.model_flops_per_s', fps)
+                    mfu = (fps / self._peak_flops
+                           if self._peak_flops else None)
+                    if mfu is not None:
+                        _obs.set_gauge('train.mfu_est', mfu)
+                    if self._window_bytes:
+                        _obs.set_gauge(
+                            'train.roofline_intensity',
+                            self._window_flops / self._window_bytes)
+                    self._last_mfu = {
+                        'flops': self._window_flops,
+                        'bytes_accessed': self._window_bytes or None,
+                        'window_wall_ms': wall * 1e3,
+                        'steps': n_steps, 'flops_per_s': fps,
+                        'mfu_est': mfu,
+                        'peak_flops': self._peak_flops,
+                    }
             # per-step time is known at window granularity only (the
             # steps never synced individually — that is the point)
             _obs.observe('train.step_ms', wall * 1e3 / n_steps,
@@ -664,6 +760,9 @@ class TrainEngine:
             self._last_scale_seen = s
         self._window_t0 = None
         self._window_tokens = 0
+        self._window_flops = 0.0
+        self._window_bytes = 0.0
+        self._window_miss = False
 
     def _feed_metrics(self, preds, labels):
         if preds is None or (isinstance(preds, tuple) and not preds):
@@ -739,6 +838,10 @@ class TrainEngine:
             'cache_keys': len(TRAIN_COMPILE_CACHE),
             'hits': TRAIN_COMPILE_CACHE.hits,
             'misses': TRAIN_COMPILE_CACHE.misses,
+            # host-truth MFU record of the last closed window (static
+            # window flops, wall, mfu_est) — what tests check the
+            # train.mfu_est gauge against
+            'mfu': self._last_mfu,
         }
 
 

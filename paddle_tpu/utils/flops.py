@@ -2,16 +2,19 @@
 
 TPU-native: instead of per-layer hooks, trace the model with jax and
 read XLA's own cost analysis — exact for whatever fuses to the device.
+The quirk handling (list-vs-dict returns, backends that raise) lives in
+observability.costs.analyze, shared with profiler.op_summary,
+jit.compilation_report, and the AOT manifest's cost stamps.
 """
 from __future__ import annotations
-
-import numpy as np
 
 
 def flops(net, input_size=None, inputs=None, custom_ops=None, print_detail=False):
     """Returns total FLOPs of one forward pass (XLA cost analysis)."""
     import jax
     import jax.numpy as jnp
+
+    from ..observability.costs import analyze
 
     if inputs is None:
         if input_size is None:
@@ -22,11 +25,7 @@ def flops(net, input_size=None, inputs=None, custom_ops=None, print_detail=False
 
     # tracelint: disable=TL001 - one-shot FLOPs analysis, never executed
     lowered = jax.jit(lambda m, *xs: m(*xs)).lower(net, *inputs)
-    try:
-        cost = lowered.compile().cost_analysis()
-        total = int(cost.get('flops', 0)) if cost else 0
-    except Exception:
-        total = 0
+    total = int(analyze(lowered)['flops'] or 0)
     if print_detail:
         print(f'Total FLOPs: {total:,}')
     return total

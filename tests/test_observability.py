@@ -525,7 +525,8 @@ class TestMetaTracelint:
         """The acceptance property for an instrumentation PR: adding
         telemetry introduced no jit/donation/host-sync violations, and
         the committed baseline is still ZERO (burned down in PR 3 —
-        observability must not regrow it)."""
+        neither the PR-6 metrics layer nor the PR-12 flight-recorder /
+        cost-observatory / postmortem instrumentation may regrow it)."""
         from paddle_tpu.analysis import (filter_new, lint_paths,
                                          load_baseline)
 
@@ -537,16 +538,29 @@ class TestMetaTracelint:
             v.render() for v in new)
         assert sum(baseline.get('counts', {}).values()) == 0, (
             'the tracelint baseline must stay ZERO')
+        # the flight-recorder modules specifically: the whole-tree lint
+        # above covers them, but pin the instrumentation baseline at
+        # zero BY NAME so a future per-file baseline bump here is loud
+        obs_dir = os.path.join(REPO, 'paddle_tpu', 'observability')
+        for name in ('journal.py', 'costs.py', 'postmortem.py'):
+            vs = lint_paths([os.path.join(obs_dir, name)], root=REPO)
+            assert vs == [], (
+                f'{name} must stay tracelint-clean:\n'
+                + '\n'.join(v.render() for v in vs))
 
     def test_observability_core_has_no_jax_dependency(self):
-        """The registry/tracer must be importable (and recordable)
-        without a backend — metrics.py is stdlib-only by design, and
-        tracing.py only reaches for jax inside annotate()."""
+        """The registry/tracer/journal/postmortem must be importable
+        (and recordable) without a backend — stdlib-only at module
+        level by design; tracing only reaches for jax inside
+        annotate(), costs only inside its device/lowering helpers."""
+        import paddle_tpu.observability.costs as c
+        import paddle_tpu.observability.journal as j
         import paddle_tpu.observability.metrics as m
+        import paddle_tpu.observability.postmortem as p
         import paddle_tpu.observability.tracing as t
 
         assert 'import jax' not in open(m.__file__).read()
-        # tracing's only jax touch is the lazy one inside annotate()
-        top_level = [ln for ln in open(t.__file__).read().splitlines()
-                     if ln.startswith(('import ', 'from '))]
-        assert not any('jax' in ln for ln in top_level)
+        for mod in (t, j, c, p):
+            top_level = [ln for ln in open(mod.__file__).read().splitlines()
+                         if ln.startswith(('import ', 'from '))]
+            assert not any('jax' in ln for ln in top_level), mod.__name__

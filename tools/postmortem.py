@@ -1,0 +1,184 @@
+"""Validate and pretty-print a paddle_tpu crash postmortem bundle.
+
+A bundle is the directory `observability.postmortem.dump_bundle` wrote
+(auto-dumped by `ServingEngine(postmortem_dir=...)` on the
+worker-death path, or by `tools/telemetry_dump.py`): bundle.json +
+metrics.json + host_trace.json + journal.jsonl (+ snapshot.json).
+
+    python tools/postmortem.py BUNDLE_DIR            # validate + summary
+    python tools/postmortem.py BUNDLE_DIR --rid 42   # one request trail
+    python tools/postmortem.py BUNDLE_DIR --json     # machine output
+
+Exit codes: 0 = bundle validates, 1 = bundle invalid, 2 = usage /
+unreadable path. Reading a bundle never touches a device — jax is
+imported (package side effect) but no backend is initialised, so
+bundles from a crashed TPU worker read fine on a laptop.
+"""
+import argparse
+import json
+import os
+import sys
+
+# `python tools/postmortem.py` puts tools/ (not the repo root) on
+# sys.path and paddle_tpu is not pip-installed on the dev boxes — make
+# the repo importable no matter where the script is launched from
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _fmt_count(snapshot, name):
+    m = snapshot.get(name) or {}
+    return m.get('value')
+
+
+def _print_summary(bundle, problems):
+    man = bundle['manifest']
+    fp = man.get('fingerprint') or {}
+    print('=' * 62)
+    print(f'postmortem bundle  (schema {man.get("schema")}, '
+          f'created {man.get("created_at")})')
+    print('=' * 62)
+    print(f'reason      {man.get("reason")}')
+    err = man.get('error')
+    if err:
+        print(f'error       {err.get("type")}: {err.get("repr")}')
+    print(f'env         jax {fp.get("jax")} / jaxlib {fp.get("jaxlib")} '
+          f'on {fp.get("backend")} ({fp.get("device_kind")}), '
+          f'python {fp.get("python")}')
+    eng = man.get('engine') or {}
+    if eng:
+        res = eng.get('resilience') or {}
+        blocks = eng.get('blocks') or {}
+        print(f'engine      {eng.get("in_flight")} in flight, '
+              f'{eng.get("queue_depth")} queued, '
+              f'{eng.get("preemptions")} preemption(s); terminal: '
+              + ', '.join(f'{k}={res.get(k)}' for k in
+                          ('finished', 'failed', 'expired', 'cancelled')
+                          if k in res))
+        print(f'pool        {blocks.get("in_use")}/{blocks.get("num_blocks")} '
+              f'pages in use, high water {blocks.get("high_water")}')
+        mfu = eng.get('mfu')
+        if mfu:
+            print(f'mfu         last window est '
+                  f'{mfu.get("mfu_est")} '
+                  f'({mfu.get("flops_per_s"):.3e} flops/s over tag '
+                  f'{mfu.get("tag")})')
+        if eng.get('dispatch_costs'):
+            print(f'costs       {len(eng["dispatch_costs"])} geometry '
+                  f'cost(s) loaded')
+    snap = bundle['metrics']
+    print(f'metrics     {len(snap)} series; tokens='
+          f'{_fmt_count(snap, "serve.tokens")}, requests='
+          f'{_fmt_count(snap, "serve.requests")}, compile.traces='
+          f'{_fmt_count(snap, "compile.traces")}')
+    jl = bundle['journal']
+    kinds = {}
+    for e in jl:
+        kinds[e.get('kind')] = kinds.get(e.get('kind'), 0) + 1
+    top = sorted(kinds.items(), key=lambda kv: -kv[1])[:8]
+    print(f'journal     {len(jl)} event(s): '
+          + ', '.join(f'{k}={n}' for k, n in top))
+    faults = [e for e in jl if e.get('kind') == 'fault']
+    if faults:
+        print(f'faults      {len(faults)} injected: ' + '; '.join(
+            f"{e.get('site')}#{e.get('call')}" for e in faults[:6]))
+    print(f'host trace  {len(bundle["host_trace"])} event(s)')
+    if bundle.get('snapshot') is not None:
+        s = bundle['snapshot']
+        print(f'snapshot    restorable: {len(s.get("requests", []))} '
+              f'live request(s), {len(s.get("terminal", []))} terminal, '
+              f'{len(s.get("trails", {}))} trail(s)')
+    print('-' * 62)
+    if problems:
+        print('INVALID:')
+        for p in problems:
+            print(f'  - {p}')
+    else:
+        print('bundle validates')
+
+
+def _bundle_trail(bundle, rid):
+    """One request's trail from a bundle: journal-tail events, or the
+    snapshot's carried trail when it is MORE complete (the ring may
+    have wrapped past the request's arrival) — the one extraction both
+    the pretty and --json paths use."""
+    evs = [e for e in bundle['journal'] if e.get('rid') == rid]
+    snap = bundle.get('snapshot') or {}
+    carried = (snap.get('trails') or {}).get(str(rid), [])
+    return carried if len(carried) > len(evs) else evs
+
+
+def _print_trail(bundle, rid):
+    from paddle_tpu.observability.journal import trail_complete
+
+    evs = _bundle_trail(bundle, rid)
+    if not evs:
+        print(f'no trail for rid {rid} in this bundle')
+        return 1
+    print(f'trail for request {rid} ({len(evs)} event(s)):')
+    for e in evs:
+        extra = {k: v for k, v in e.items()
+                 if k not in ('seq', 'kind', 'rid', 't')}
+        t = e.get('t')
+        ts = f'{t:.6f}' if isinstance(t, (int, float)) else '-'
+        print(f'  [{e.get("seq"):>6}] {ts:>14}  {e.get("kind"):<18}'
+              + (f' {extra}' if extra else ''))
+    probs = trail_complete(evs)
+    if probs:
+        print('trail problems: ' + '; '.join(probs))
+        return 1
+    print('trail is complete and ordered')
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('bundle', help='bundle directory to read')
+    ap.add_argument('--rid', type=int, default=None,
+                    help='print (and check) one request trail')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable verdict instead of the table')
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability.postmortem import (load_bundle,
+                                                     validate_bundle)
+
+    if not os.path.isdir(args.bundle):
+        print(f'postmortem: not a directory: {args.bundle}',
+              file=sys.stderr)
+        return 2
+    ok, problems = validate_bundle(args.bundle)
+    if not ok and args.json:
+        print(json.dumps({'valid': False, 'problems': problems}))
+        return 1
+    if not ok:
+        print('INVALID bundle:')
+        for p in problems:
+            print(f'  - {p}')
+        return 1
+    bundle = load_bundle(args.bundle)
+    if args.json:
+        out = {'valid': True,
+               'schema': bundle['manifest'].get('schema'),
+               'created_at': bundle['manifest'].get('created_at'),
+               'error': bundle['manifest'].get('error'),
+               'journal_events': len(bundle['journal']),
+               'metrics_series': len(bundle['metrics'])}
+        if args.rid is not None:
+            from paddle_tpu.observability.journal import trail_complete
+
+            evs = _bundle_trail(bundle, args.rid)
+            out['trail'] = evs
+            out['trail_problems'] = trail_complete(evs) if evs else \
+                ['no trail']
+        print(json.dumps(out, default=str))
+        return 0
+    _print_summary(bundle, problems)
+    if args.rid is not None:
+        return _print_trail(bundle, args.rid)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

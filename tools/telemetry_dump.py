@@ -15,6 +15,16 @@ with telemetry on, then writes two artifacts into --out:
                        shows the overlay recipe)
     telemetry.prom   — Prometheus text exposition of the same registry
                        (what a scrape endpoint would serve)
+    journal.jsonl    — the flight-recorder event journal (scheduler
+                       decisions, allocator ops, compile events, one
+                       line per event; `trail(rid)` material)
+    postmortem/      — a full postmortem bundle of the run (what the
+                       crash path would auto-dump; validate/pretty-
+                       print with tools/postmortem.py)
+
+The run also measures the engine's per-geometry dispatch costs
+(observability.costs) and prints the resulting live cost gauges
+(serve.mfu_est / model_flops_per_s / roofline_intensity).
 
 Importable anywhere (pytest collection, tracelint) without touching a
 backend — only main() initialises jax, and the same rc-2 guard
@@ -100,24 +110,47 @@ def main(argv=None):
         return 2
 
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import costs as obs_costs
+    from paddle_tpu.observability import journal as obs_journal
+    from paddle_tpu.observability import postmortem as obs_pm
 
     obs.set_enabled(True)
     obs.REGISTRY.reset()
     obs.TRACER.clear()
+    obs_journal.JOURNAL.clear()
 
     srv = run_workload(n_requests=args.requests)
+
+    # cost observatory: measure this engine's per-geometry static
+    # flops/bytes (one lower+compile each — off the serving path, so
+    # the retraces it counts are analysis, not regressions), then one
+    # more tiny pass so the window commits stamp the live mfu/roofline
+    # gauges from them
+    import numpy as np
+
+    cost_report = obs_costs.measure_dispatch_costs(srv)
+    # budgets spanning several windows: a first-time-compiled dispatch
+    # is excluded from the mfu gauges (its wall is compile, not model
+    # execution — the ITL rule), so the pass must outlive the warmup
+    srv.serve([np.arange(3, 9) for _ in range(6)], 16)
 
     os.makedirs(args.out, exist_ok=True)
     tpath = os.path.join(args.out, 'telemetry.json')
     with open(tpath, 'w') as f:
         json.dump({'backend': backend,
                    'engine_stats': srv.stats(),
+                   'dispatch_costs': {str(k): v for k, v in
+                                      srv._dispatch_costs.items()},
                    'metrics': obs.REGISTRY.snapshot()}, f, indent=2,
                   default=str)
     hpath = obs.TRACER.export(os.path.join(args.out, 'host_trace.json'))
     ppath = os.path.join(args.out, 'telemetry.prom')
     with open(ppath, 'w') as f:
         f.write(obs.REGISTRY.to_prometheus())
+    jpath = obs_journal.save(os.path.join(args.out, 'journal.jsonl'))
+    bdir = os.path.join(args.out, 'postmortem')
+    obs_pm.dump_bundle(bdir, engine=srv,
+                       reason='telemetry_dump reference bundle')
 
     snap = obs.REGISTRY.snapshot()
     R = obs.REGISTRY
@@ -139,9 +172,26 @@ def main(argv=None):
     print(f'compile events   '
           f'{snap.get("compile.traces", {}).get("value")}')
     print(f'host spans       {len(obs.TRACER)}')
+    # the cost observatory gauges (mfu_est needs a known peak: set
+    # PADDLE_TPU_PEAK_FLOPS explicitly on CPU boxes; TPU kinds resolve
+    # from the built-in table)
+    n_costed = sum(1 for v in cost_report.values()
+                   if isinstance(v, dict))
+    print(f'geometry costs   {n_costed}/{len(cost_report)} measured')
+    print(f'mfu_est          '
+          f'{snap.get("serve.mfu_est", {}).get("value")}')
+    print(f'model flops/s    '
+          f'{snap.get("serve.model_flops_per_s", {}).get("value")}')
+    print(f'roofline f/B     '
+          f'{snap.get("serve.roofline_intensity", {}).get("value")}')
+    print(f'journal events   {len(obs_journal.JOURNAL)} '
+          f'({len(obs_journal.JOURNAL.trails())} trails, '
+          f'{obs_journal.JOURNAL.dropped} dropped)')
     print(f'wrote {tpath}')
     print(f'wrote {hpath}')
     print(f'wrote {ppath}')
+    print(f'wrote {jpath}')
+    print(f'wrote {bdir}/ (postmortem bundle)')
     return 0
 
 
