@@ -1025,6 +1025,119 @@ def _prefix_gate(timeout_s=420):
         f"{ratio}"), payload
 
 
+_SERVING_TP_GATE_SRC = r'''
+import os
+# the virtual 8-device mesh must be forced BEFORE jax initialises a
+# backend (the tp=2/4 engines and the serving shardlint suites both
+# need it); JAX_PLATFORMS=cpu is already pinned by the gate runner
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+import json, time
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.inference.engine import total_traces
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+def mk():
+    pt.seed(0)
+    # kv_heads=4: both tp=2 and tp=4 head-shard the page pools
+    return LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                       layers=2, heads=4, kv_heads=4))
+
+rng = np.random.default_rng(0)
+n = 12
+prompts = [rng.integers(3, 96, (6,)) for _ in range(n)]
+mnts = [24 if i % 4 == 0 else 6 for i in range(n)]
+useful = sum(mnts)
+KW = dict(max_slots=4, block_size=8, max_context_len=32,
+          max_new_tokens=24, decode_window=6)
+
+def drive(engine):
+    rids = [engine.submit(p, m) for p, m in zip(prompts, mnts)]
+    engine.run()
+    return [engine.result(r) for r in rids]
+
+ref = ServingEngine(mk(), **KW)
+refs = drive(ref)
+
+payload = {'pool_bytes_global': True}
+for tp in (2, 4):
+    srv = ServingEngine(mk(), tp=tp, **KW)
+    drive(srv)                    # warmup: every geometry compiles here
+    t0s = total_traces()
+    t0 = time.perf_counter()
+    outs = drive(srv)
+    dt = time.perf_counter() - t0
+    payload[f'retraces_tp{tp}'] = total_traces() - t0s
+    payload[f'serve_tok_s_tp{tp}'] = round(useful / dt, 1)
+    payload[f'parity_tp{tp}'] = bool(all(
+        np.array_equal(a, b) for a, b in zip(refs, outs)))
+    # the satellite invariant: bytes gauges report GLOBAL pool bytes
+    # when the pools shard — per-shard itemsize x tp, equal to tp=1
+    k0 = srv._pages[0].kp
+    shard = next(iter(k0.addressable_shards)).data
+    per_shard = int(np.prod(shard.shape[1:])) * shard.dtype.itemsize
+    payload['pool_bytes_global'] = bool(
+        payload['pool_bytes_global']
+        and srv.allocator.bytes_per_page
+        == ref.allocator.bytes_per_page
+        == len(srv._pages) * 2 * per_shard * tp)
+
+# the declared per-window collective budget: lint exactly the
+# serving/* suites (the full-registry gate runs separately; this one
+# fails the TP gate on an undeclared kind or a census overrun even if
+# someone turns the registry gate off)
+from paddle_tpu.analysis.shard.engine import lint_and_report
+from paddle_tpu.analysis.shard.registry import all_entries
+ents = [e for e in all_entries() if e.name.startswith('serving/')]
+vs, _sup, comm = lint_and_report(ents, root=os.getcwd())
+payload['shardlint_serving_clean'] = not [
+    v for v in vs if v.severity == 'error']
+payload['serving_comm'] = comm
+print(json.dumps(payload))
+'''
+
+
+def _serving_tp_gate(timeout_s=420):
+    """TP-sharded ServingEngine gate, CPU-pinned on the virtual
+    8-device mesh like the other dynamic gates. Four sub-proofs in one
+    subprocess:
+
+      (a) tp=2 and tp=4 greedy streams BIT-EQUAL to the single-device
+          engine over the mixed-budget workload;
+      (b) zero steady-state retraces on the warmed sharded engines;
+      (c) the serving/* shardlint suites clean against their declared
+          per-window collective budgets (the per-layer all-reduce
+          census — an undeclared kind or an overrun fails here);
+      (d) pool byte accounting GLOBAL under sharding (per-shard bytes
+          x tp == the tp=1 figure — dashboards must not shrink).
+
+    Also stamps `serve_tok_s_tp2` / `serve_tok_s_tp4` (virtual-mesh
+    CPU numbers: a layout regression trend line, not chip throughput).
+    Returns (clean, detail, payload); clean is None when the gate
+    could not run (never poses as a pass)."""
+    payload, err = _gate_subprocess(_SERVING_TP_GATE_SRC, timeout_s)
+    if payload is None:
+        return None, err, {}
+    clean = (payload.get('parity_tp2') is True
+             and payload.get('parity_tp4') is True
+             and payload.get('retraces_tp2') == 0
+             and payload.get('retraces_tp4') == 0
+             and payload.get('pool_bytes_global') is True
+             and payload.get('shardlint_serving_clean') is True)
+    return clean, (
+        f"parity tp2={payload.get('parity_tp2')} "
+        f"tp4={payload.get('parity_tp4')}, retraces "
+        f"{payload.get('retraces_tp2')}/{payload.get('retraces_tp4')}, "
+        f"tok/s tp2 {payload.get('serve_tok_s_tp2')} tp4 "
+        f"{payload.get('serve_tok_s_tp4')}, pool bytes global="
+        f"{payload.get('pool_bytes_global')}, serving shardlint clean="
+        f"{payload.get('shardlint_serving_clean')}"), payload
+
+
 _FLIGHT_RECORDER_SRC = r'''
 import json, os, tempfile, time
 import numpy as np
@@ -1317,6 +1430,8 @@ def main():
     prefix_gate_clean, prefix_gate_detail, prefix_gate_payload = (
         _prefix_gate())
     print(f'# prefix/chunked gate: {prefix_gate_detail}', flush=True)
+    tp_gate_clean, tp_gate_detail, tp_gate_payload = _serving_tp_gate()
+    print(f'# serving tp gate: {tp_gate_detail}', flush=True)
     flight_gate_clean, flight_gate_detail, flight_gate_payload = (
         _flight_recorder_gate())
     print(f'# flight recorder gate: {flight_gate_detail}', flush=True)
@@ -1329,6 +1444,7 @@ def main():
                           or cold_gate_clean is False
                           or res_gate_clean is False
                           or prefix_gate_clean is False
+                          or tp_gate_clean is False
                           or flight_gate_clean is False)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
@@ -1412,6 +1528,19 @@ def main():
                 'itl_p99_ms_flood_chunked')
             det['serve_flood_stall_ratio'] = prefix_gate_payload.get(
                 'flood_stall_ratio')
+            # TP-sharded ServingEngine gate (CPU virtual-mesh proof):
+            # tp=2/4 bit-equal streams, zero retraces, serving suites
+            # within their declared collective budgets, global pool
+            # bytes — stamped like the other serving gates (new keys
+            # this round: the unsuffixed backfill below is null-only
+            # by construction)
+            det['gate_serving_tp'] = tp_gate_clean
+            det['serving_tp_gate'] = tp_gate_detail
+            det['serve_tok_s_tp2'] = tp_gate_payload.get(
+                'serve_tok_s_tp2')
+            det['serve_tok_s_tp4'] = tp_gate_payload.get(
+                'serve_tok_s_tp4')
+            det['serving_tp_comm'] = tp_gate_payload.get('serving_comm')
             # flight-recorder + cost-observatory gate (CPU subprocess
             # proof): journal+costs within 3% of off, complete ordered
             # trails under a faulted 128-request flood, validated
@@ -2005,6 +2134,15 @@ def main():
             'serve_prefix_hit_rate': prefix_gate_payload.get('hit_rate'),
             'serve_flood_stall_ratio': prefix_gate_payload.get(
                 'flood_stall_ratio'),
+            # TP-sharded ServingEngine gate (CPU virtual-mesh proof):
+            # tp=2/4 bit-equal, zero retraces, declared collective
+            # budgets clean, global pool bytes — plus the virtual-mesh
+            # tok/s trend lines per degree
+            'gate_serving_tp': tp_gate_clean,
+            'serving_tp_gate': tp_gate_detail,
+            'serve_tok_s_tp2': tp_gate_payload.get('serve_tok_s_tp2'),
+            'serve_tok_s_tp4': tp_gate_payload.get('serve_tok_s_tp4'),
+            'serving_tp_comm': tp_gate_payload.get('serving_comm'),
             # flight-recorder + cost-observatory gate (CPU subprocess
             # proof): journal overhead <=3%, complete faulted-flood
             # trails, validated postmortem bundle, manifest-consistent

@@ -6,9 +6,11 @@ registered here as a suite over `jax.ShapeDtypeStruct`s on a virtual
 `data_sharding` batch placement, the ZeRO `zero_spec` sharded update,
 ring and Ulysses sequence parallelism, the MoE dense dispatch, the
 GPipe and 1F1B pipeline schedules, and the raw `collective` wrappers —
-so ROADMAP items 1 (tensor-parallel serving) and 5 (≥50%-MFU hybrid
-pretrain) land against a linter that already knows their intended
-communication budget.
+plus, beyond distributed/, the TP-sharded ServingEngine's fused
+dispatches (`serving/*`: serve_step, serve_window, serve_chunk_step
+over head-sharded page pools), so ROADMAP item 1's serving wire cost
+and item 5's ≥50%-MFU hybrid pretrain both land against a linter that
+already knows their intended communication budget.
 
 Shapes keep the 7B RATIOS at a compile-friendly scale: unlike
 mosaiclint (which only abstract-traces), every suite here pays a real
@@ -323,6 +325,133 @@ def _build_collective_exchange():
 
 
 # ---------------------------------------------------------------------------
+# serving: the TP-sharded ServingEngine's fused dispatches
+# ---------------------------------------------------------------------------
+
+def _serving_fixture():
+    """Shared fixture for the serving suites: a tiny llama whose every
+    dim divides tp=8 (8 kv heads head-shard the page pools; 128-vocab
+    embedding and 128-wide MLP split cleanly), plus the SDS avals of
+    one fused serving dispatch at gate-like shapes. The model rides as
+    a Suite ARG with its declared megatron column->row specs
+    (`model_shardings`), the page pools as P(None, 'tp') kv-head
+    shards, and every host-fed arg — ids, block tables, slot/context
+    mirrors, budgets, rng — fully REPLICATED: exactly the layout
+    `ServingEngine(tp=...)` serves with, so the census this compiles
+    IS the per-window wire cost of the live engine."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.parallel import model_shardings
+    from paddle_tpu.models.generation import PagedKVCache
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    mesh = virtual_mesh(tp=8)
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, layers=2, heads=8, kv_heads=8,
+        intermediate_size=128, max_pos=64))
+    K, NB, BS, MAXB = 4, 17, 8, 8
+    page = _sds((NB, 8, BS, 8), 'float32')
+    shapes = {
+        'mesh': mesh,
+        'model': model,
+        'model_sds': _sds_like(model),
+        'model_sh': model_shardings(model, mesh),
+        'pages': [PagedKVCache(page, page) for _ in range(2)],
+        'pages_sh': NamedSharding(mesh, P(None, 'tp', None, None)),
+        'rep': NamedSharding(mesh, P()),
+        'logits': _sds((K, 128), 'float32'),
+        'vec': _sds((K,), 'int32'),
+        'live': _sds((K,), 'bool'),
+        'btab': _sds((K, MAXB), 'int32'),
+        'rng': jax.ShapeDtypeStruct((2,), jnp.uint32),
+        'statics': dict(window=4, temperature=0.0, top_k=0, top_p=1.0,
+                        eos_token_id=2),
+        'K': K,
+    }
+    return shapes
+
+
+def _build_serving_serve_step():
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._serve_step, '__wrapped__', srv._serve_step)
+    statics, Sb = f['statics'], 16
+
+    def serve_step(model, pages, logits, ids, real_len, btabs, slots,
+                   btab, ctx, live, budget, rng):
+        return body(model, pages, logits, ids, real_len, btabs, slots,
+                    btab, ctx, live, budget, rng, **statics)
+
+    ids = _sds((f['K'], Sb), 'int32')
+    rep = f['rep']
+    return Suite(
+        fn=serve_step,
+        args=(f['model_sds'], f['pages'], f['logits'], ids, f['vec'],
+              f['btab'], f['vec'], f['btab'], f['vec'], f['live'],
+              f['vec'], f['rng']),
+        mesh=f['mesh'],
+        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
+                      rep, rep, rep, rep, rep, rep),
+    )
+
+
+def _build_serving_serve_window():
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._serve_window, '__wrapped__', srv._serve_window)
+    statics = f['statics']
+
+    def serve_window(model, pages, logits, btab, ctx, live, budget, rng):
+        return body(model, pages, logits, btab, ctx, live, budget, rng,
+                    **statics)
+
+    rep = f['rep']
+    return Suite(
+        fn=serve_window,
+        args=(f['model_sds'], f['pages'], f['logits'], f['btab'],
+              f['vec'], f['live'], f['vec'], f['rng']),
+        mesh=f['mesh'],
+        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
+                      rep, rep),
+    )
+
+
+def _build_serving_chunk_step():
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._serve_chunk_step, '__wrapped__',
+                   srv._serve_chunk_step)
+    statics, Cb, Sb = f['statics'], 8, 16
+
+    def chunk_step(model, pages, logits, ids, chunk_len, start, btabs,
+                   slots, cow_src, cow_dst, btab, ctx, live, budget,
+                   rng):
+        return body(model, pages, logits, ids, chunk_len, start, btabs,
+                    slots, cow_src, cow_dst, btab, ctx, live, budget,
+                    rng, ctx_bucket=Sb, **statics)
+
+    ids = _sds((f['K'], Cb), 'int32')
+    rep = f['rep']
+    return Suite(
+        fn=chunk_step,
+        args=(f['model_sds'], f['pages'], f['logits'], ids, f['vec'],
+              f['vec'], f['btab'], f['vec'], f['vec'], f['vec'],
+              f['btab'], f['vec'], f['live'], f['vec'], f['rng']),
+        mesh=f['mesh'],
+        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
+                      rep, rep, rep, rep, rep, rep, rep, rep, rep),
+    )
+
+
+# ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
 
@@ -335,6 +464,7 @@ _MOE = 'paddle_tpu.distributed.moe:MoELayer'
 _GPIPE = 'paddle_tpu.distributed.pipeline:pipeline_apply'
 _1F1B = 'paddle_tpu.distributed.pipeline:pipeline_1f1b'
 _COLL = 'paddle_tpu.distributed.collective:send_recv'
+_SRV = 'paddle_tpu.inference.serving:ServingEngine'
 
 ENTRIES = (
     Entry('mp_layers/column_row_fwd_bwd', _MP, _build_mp_column_row,
@@ -366,6 +496,27 @@ ENTRIES = (
     Entry('collective/ring_exchange', _COLL, _build_collective_exchange,
           budget={'collective-permute': {'count': 1, 'bytes': 64 * KB},
                   'all-reduce': {'count': 1, 'bytes': 64 * KB}}),
+    # ServingEngine fused dispatches under tp=8 (ROADMAP item 1's
+    # "declared per-window collective budget"). The all-reduce census
+    # is exactly the megatron layout's: 2 per layer (attention o_proj
+    # + MLP down_proj row-parallel psums) + 1 for the vocab-parallel
+    # embedding = 2L+1 call sites per llama forward (5 at the
+    # fixture's 2 layers; the window scan counts its body ONCE).
+    # serve_step / serve_chunk_step fuse a prefill/chunk forward ahead
+    # of the window = 2 forwards = 10. The all-gathers are the
+    # host-facing replication pins (emitted tokens, next-step logits,
+    # ctx) — nothing else may appear: an undeclared reduce-scatter or
+    # a count bump here is a resharded pool or a broken pin, the
+    # regression this suite exists to catch before a real pod does.
+    Entry('serving/serve_step_tp', _SRV, _build_serving_serve_step,
+          budget={'all-reduce': {'count': 10, 'bytes': 112 * KB},
+                  'all-gather': {'count': 4, 'bytes': 8 * KB}}),
+    Entry('serving/serve_window_tp', _SRV, _build_serving_serve_window,
+          budget={'all-reduce': {'count': 5, 'bytes': 8 * KB},
+                  'all-gather': {'count': 3, 'bytes': 4 * KB}}),
+    Entry('serving/serve_chunk_step_tp', _SRV, _build_serving_chunk_step,
+          budget={'all-reduce': {'count': 10, 'bytes': 64 * KB},
+                  'all-gather': {'count': 4, 'bytes': 8 * KB}}),
 )
 
 

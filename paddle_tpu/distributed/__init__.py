@@ -27,6 +27,7 @@ from .mesh import (  # noqa: F401
     get_rank,
     get_world_size,
     init_parallel_env,
+    serving_mesh,
     set_mesh,
 )
 from .mp_layers import (  # noqa: F401

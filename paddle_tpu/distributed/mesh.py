@@ -103,6 +103,51 @@ def set_mesh(mesh: Mesh):
     _global_mesh = mesh
 
 
+def force_virtual_devices(n: int) -> None:
+    """Append `--xla_force_host_platform_device_count=max(n, 8)` to
+    XLA_FLAGS unless a count is already forced. Only effective BEFORE
+    the backend initialises (and ignored by jax afterwards) — callers
+    that need the devices to actually exist must still count them.
+    The 8 floor matches the shardlint / test-rig virtual mesh."""
+    import os
+
+    flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + f' --xla_force_host_platform_device_count='
+                    f'{max(int(n), 8)}').strip()
+
+
+def serving_mesh(tp: int, devices=None) -> Mesh:
+    """1-D tensor-parallel mesh for a TP-sharded `ServingEngine`
+    (`ServingEngine(model, tp=4)` builds one of these internally; pass
+    an explicit `devices` slice to pin which chips serve).
+
+    Virtual-device fallback: when `devices` is not given and jax has
+    not initialised a backend yet, the host-platform device-count flag
+    is forced (to at least `tp`, and at least the 8 the shardlint /
+    test rig uses) so CPU dev boxes can stand up a tp>1 engine without
+    exporting XLA_FLAGS by hand. A backend that already woke up with
+    fewer devices cannot be grown — that raises with the recipe
+    instead of silently serving single-device."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f'tp must be >= 1, got {tp}')
+    if devices is None:
+        # the len() check below is the real gate either way
+        force_virtual_devices(tp)
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) < tp:
+        raise ValueError(
+            f'serving_mesh(tp={tp}) needs {tp} devices, found '
+            f'{len(devices)}: the backend initialised before the '
+            f'virtual-device flag could be set — run with XLA_FLAGS='
+            f'--xla_force_host_platform_device_count={max(tp, 8)} '
+            f'(and JAX_PLATFORMS=cpu) for a virtual mesh')
+    return build_mesh(devices=devices[:tp], tp=tp)
+
+
 def get_world_size() -> int:
     return jax.device_count()
 

@@ -54,6 +54,18 @@ the ITERATION level instead:
      until its last chunk commits — then its first window runs in the
      SAME dispatch, preserving monolithic semantics exactly.
 
+  5. Tensor parallelism (docs/serving.md#tp-sharded-serving):
+     `ServingEngine(model, tp=4)` (or `mesh=serving_mesh(4)`) runs the
+     SAME scheduler loop against TP-sharded device state — page pools
+     carry a NamedSharding splitting the kv-head dim over the 'tp'
+     axis, the fused dispatches run the llama forward through the
+     megatron column->row layout (GSPMD inserts the per-layer
+     all-reduces; the `serving/*` shardlint suites gate the census
+     against a declared budget), and block tables, slot/context
+     mirrors, and ALL host scheduler state stay replicated — greedy
+     streams are bit-equal to the single-device engine, zero
+     steady-state retraces included.
+
 Sampling config is pinned at engine construction (it is part of the
 compilation key), greedy (temperature=0) is the parity-tested path:
 per-request outputs are exactly `DecodeEngine.generate`'s batch-1
@@ -75,6 +87,7 @@ uninterrupted run. Failure paths are exercised on purpose through the
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 import heapq
@@ -581,6 +594,30 @@ class RequestQueue:
 # Module-level compiled steps (the persistent jit cache, PR-1 style)
 # ---------------------------------------------------------------------------
 
+def _pin(x, *spec_entries):
+    """Sharding pin for the fused serving dispatches: a
+    `with_sharding_constraint` that degrades to identity when no mesh
+    is active (the single-device engines trace through here with the
+    graph unchanged). Under a TP mesh the pins keep every dispatch
+    OUTPUT on the layout its matching input was uploaded with — pools
+    kv-head-sharded over 'tp', everything host-facing replicated — so
+    the jit cache key is stable from the very first call (warmup and
+    live dispatch compile the same executable; zero steady-state
+    retraces holds on the sharded engine exactly as it does on one
+    chip)."""
+    from ..distributed.mp_layers import sharding_constraint
+
+    return sharding_constraint(x, *spec_entries)
+
+
+def _pin_pages(pages):
+    """Pin every page pool to the kv-head 'tp' split (identity without
+    a mesh; clamps to replicated when kv_heads does not divide tp —
+    the same GQA fallback `init_paged_cache` places with)."""
+    return [type(pc)(_pin(pc.kp, None, 'tp'), _pin(pc.vp, None, 'tp'))
+            for pc in pages]
+
+
 def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
     """Bucketed BATCHED admission prefill INTO pages (traced body,
     shared by the standalone `_paged_prefill` jit and the fused
@@ -622,7 +659,7 @@ def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
         out_pages.append(type(pc)(kp, vp))
     last_logits = last_logits.at[slots].set(
         last.astype(last_logits.dtype), mode='drop')
-    return last_logits, out_pages
+    return _pin(last_logits), _pin_pages(out_pages)
 
 
 def _window_body(model, pages, last_logits, btab, ctx, live, budget,
@@ -671,7 +708,7 @@ def _window_body(model, pages, last_logits, btab, ctx, live, budget,
              rng_key)
     (last_logits, pages, ctx, _, _), toks = jax.lax.scan(
         step, state, jnp.arange(window, dtype=jnp.int32))
-    return toks.T, last_logits, pages, ctx
+    return _pin(toks.T), _pin(last_logits), _pin_pages(pages), _pin(ctx)
 
 
 @functools.partial(jax.jit, donate_argnames=('pages', 'last_logits'))
@@ -796,7 +833,7 @@ def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
         out_pages.append(type(pc)(kp, vp))
     last_logits = last_logits.at[slots].set(
         last.astype(last_logits.dtype), mode='drop')
-    return last_logits, out_pages
+    return _pin(last_logits), _pin_pages(out_pages)
 
 
 @functools.partial(
@@ -858,7 +895,7 @@ class ServingEngine:
                  buckets=None, max_queue=None, admit_watermark=1.0,
                  shed_policy='reject', max_terminal=1024,
                  prefix_cache=False, prefill_chunk=None,
-                 postmortem_dir=None):
+                 postmortem_dir=None, mesh=None, tp=None):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -870,6 +907,75 @@ class ServingEngine:
             raise NotImplementedError(
                 'sliding-window models are not paged-servable yet: the '
                 'paged kernel has no window fast path — use DecodeEngine')
+        # tensor-parallel serving (docs/serving.md#tp-sharded-serving):
+        # the engine owns ONE mesh whose only >1 axis is 'tp'. Device
+        # state shards kv-heads over it (page pools, via
+        # init_paged_cache); block tables, slot/context mirrors, and
+        # every other host-fed arg upload REPLICATED; and the whole
+        # host scheduler loop — admission, preemption, prefix
+        # refcounts, CoW, deadlines, snapshot/restore, the journal —
+        # runs on replicated host state exactly as on one chip.
+        # Accepts a Mesh, a bare tp=int (serving_mesh builds the 1-D
+        # mesh, virtual-device fallback included), or — when neither
+        # is passed — adopts an ambient tp-only global mesh the way
+        # generate() does.
+        from ..distributed.mesh import get_mesh, serving_mesh
+
+        if tp is not None and mesh is not None:
+            raise ValueError(
+                'pass ServingEngine(mesh=...) OR tp=..., not both')
+        if tp is not None:
+            tp = int(tp)
+            if tp < 1:
+                raise ValueError(f'tp must be >= 1, got {tp}')
+            mesh = serving_mesh(tp) if tp > 1 else None
+        elif mesh is None:
+            amb = get_mesh()
+            if (amb is not None and 'tp' in amb.axis_names
+                    and amb.shape['tp'] > 1
+                    and all(amb.shape[a] == 1 for a in amb.axis_names
+                            if a != 'tp')):
+                mesh = amb
+        if mesh is not None:
+            if 'tp' not in mesh.axis_names:
+                raise ValueError(
+                    f"ServingEngine mesh needs a 'tp' axis; got axes "
+                    f'{tuple(mesh.axis_names)}')
+            extra = [a for a in mesh.axis_names
+                     if a != 'tp' and mesh.shape[a] > 1]
+            if extra:
+                raise ValueError(
+                    f'ServingEngine shards over tp only; mesh axes '
+                    f'{extra} have degree > 1 — run dp replicas as '
+                    f'separate engines behind one queue')
+            if mesh.shape['tp'] == 1:
+                mesh = None          # degree 1 IS single-device serving
+        self.mesh = mesh
+        self.tp = int(mesh.shape['tp']) if mesh is not None else 1
+        self._rep = None             # replicated NamedSharding, lazy below
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            self._rep = NamedSharding(self.mesh, P())
+            kvh = (getattr(model.config, 'num_key_value_heads', None)
+                   or model.config.num_attention_heads)
+            if kvh % self.tp != 0:
+                import warnings
+
+                warnings.warn(
+                    f'{kvh} kv heads do not divide tp={self.tp}: the '
+                    f'page pools clamp to REPLICATED (correct, but the '
+                    f'KV cache no longer splits across chips) — pick a '
+                    f'tp degree dividing the kv heads', stacklevel=2)
+            # place the model per its declared PartitionSpecs (the
+            # Llama family ships megatron column->row specs on every
+            # projection; a caller that already parallelize()d gets
+            # the identical placement re-applied)
+            from ..distributed.parallel import shard_model
+
+            with self._use_mesh():
+                model = shard_model(model, self.mesh)
         self.model = model
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
@@ -933,18 +1039,28 @@ class ServingEngine:
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError('prefill_chunk must be >= 1 (or None)')
 
-        # device state, allocated ONCE (shapes never change)
-        self._pages = model.init_paged_cache(num_blocks, self.block_size)
+        # device state, allocated ONCE (shapes never change). Under a
+        # tp mesh the pools come back kv-head-sharded and the logits /
+        # rng upload committed-replicated (self._put), so every later
+        # dispatch sees the same input shardings the first one did.
+        with self._use_mesh():
+            self._pages = model.init_paged_cache(num_blocks,
+                                                 self.block_size)
+            vocab = model.config.vocab_size
+            self._last_logits = self._put(
+                jnp.zeros((self.max_slots, vocab), model.cache_dtype()))
+            self._rng = self._put(jax.random.PRNGKey(0))
         # real-unit pool accounting: one page costs k+v bytes per layer
         # at the pool dtype (pages x page_bytes x layers x dtype) —
-        # threaded into allocator.stats() and the pool.* gauges
+        # threaded into allocator.stats() and the pool.* gauges.
+        # kp.shape is the GLOBAL logical shape even when the pool is
+        # tp-sharded (each shard holds kv_heads/tp of it), so the
+        # bytes_* gauges keep reporting whole-pool HBM — per-shard
+        # itemsize x tp — and capacity dashboards never shrink by 1/tp
+        # (tests/test_serving_tp.py pins the arithmetic)
         self.allocator.bytes_per_page = int(sum(
             2 * int(np.prod(pc.kp.shape[1:])) * pc.kp.dtype.itemsize
             for pc in self._pages))
-        vocab = model.config.vocab_size
-        self._last_logits = jnp.zeros((self.max_slots, vocab),
-                                      model.cache_dtype())
-        self._rng = jax.random.PRNGKey(0)
 
         # host-authoritative per-slot state (device copies ride in as
         # small int32/bool args each window)
@@ -1034,13 +1150,51 @@ class ServingEngine:
 
     # -- bookkeeping -------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _use_mesh(self):
+        """Pin the process-global mesh to THIS engine's mesh (None
+        included) for a dispatch: the traced bodies' sharding pins and
+        the model's own `sharding_constraint`s read `get_mesh()` at
+        trace time, so every trace — warmup or live — must see exactly
+        the engine's mesh regardless of ambient state. Identity-check
+        fast path: steady-state steps under an already-matching (or
+        absent) global mesh pay two attribute reads."""
+        from ..distributed import mesh as _mesh_mod
+
+        prev = _mesh_mod.get_mesh()
+        if prev is self.mesh:
+            yield
+            return
+        _mesh_mod.set_mesh(self.mesh)
+        try:
+            yield
+        finally:
+            _mesh_mod.set_mesh(prev)
+
+    def _put(self, x):
+        """Upload one host-fed dispatch arg. Unsharded engines:
+        `jnp.asarray` (prior behavior, byte for byte). TP engines:
+        committed-REPLICATED over the mesh — mixing committed sharded
+        pools with uncommitted single-device mirrors would give the
+        first and second dispatch of a geometry different input
+        shardings (one retrace each), and warm-attach zero-compile
+        plus the steady-state zero-retrace contract both need the key
+        stable from call one."""
+        if self._rep is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, self._rep)
+
     def _sampling_key(self):
         return (self.max_new_tokens, self.temperature, self.top_k,
                 self.top_p, self.eos_token_id)
 
     def _geometry(self):
+        # tp is part of the geometry: a tp=1 and a tp=2 engine over
+        # the same pool shape dispatch DIFFERENT executables (jax keys
+        # them by input sharding), so the CompileCache registry must
+        # not let their notes collide either
         return ('paged', self.max_slots, self.allocator.num_blocks,
-                self.block_size, self.max_blocks_per_seq)
+                self.block_size, self.max_blocks_per_seq, self.tp)
 
     def registry_key(self, *tag):
         """The EXACT CompileCache key `_note(*tag)` records (the shared
@@ -1153,7 +1307,8 @@ class ServingEngine:
                          'block_size': self.block_size,
                          'num_blocks': self.allocator.num_blocks,
                          'max_blocks_per_seq': self.max_blocks_per_seq,
-                         'decode_window': self.decode_window},
+                         'decode_window': self.decode_window,
+                         'tp': self.tp},
         }
 
     # -- AOT artifact hooks (paddle_tpu.aot) -------------------------------
@@ -1182,6 +1337,11 @@ class ServingEngine:
             'buckets': list(self.buckets),
             'prefix_cache': self.prefix_cache,
             'prefill_chunk': self.prefill_chunk,
+            # the mesh degree is compilation-relevant: a tp=4
+            # artifact's executables are 4-shard SPMD programs a tp=1
+            # engine can never look up — attaching across degrees must
+            # refuse (ArtifactMismatch names this field)
+            'tp': self.tp,
         }
 
     def _aot_jitted_fns(self):
@@ -1217,48 +1377,58 @@ class ServingEngine:
                 f'cannot warm a ServingEngine with {self.in_flight()} '
                 f'request(s) in flight: drain the batch (run()) before '
                 f'warmup/aot.build')
-        dev = self._device_state()
-        budget = jnp.asarray(self._budget)
-        common = dict(window=W, temperature=self.temperature,
-                      top_k=self.top_k, top_p=self.top_p,
-                      eos_token_id=self.eos_token_id)
-        # a fixed dummy key with the live aval: warming must NOT
-        # consume the engine's sampling stream (self._rng), or a warmed
-        # and an unwarmed replica seeded identically would emit
-        # different sampled tokens
-        sub = jax.random.PRNGKey(0)
-        if g.kind == 'serve_step':
-            ids, real_len, btabs, slots = self._prefill_args(p['bucket'], [])
-            self._note('serve_step', W, p['bucket'])
-            _, self._last_logits, self._pages, _ = _serve_step(
-                self.model, self._pages, self._last_logits, ids, real_len,
-                btabs, slots, dev['btab'], dev['ctx'], dev['live'], budget,
-                sub, **common)
-        elif g.kind == 'serve_window':
-            self._note('serve_window', W)
-            _, self._last_logits, self._pages, _ = _serve_window(
-                self.model, self._pages, self._last_logits, dev['btab'],
-                dev['ctx'], dev['live'], budget, sub, **common)
-        elif g.kind == 'serve_prefill':
-            ids, real_len, btabs, slots = self._prefill_args(p['bucket'], [])
-            self._note('serve_prefill', p['bucket'])
-            self._last_logits, self._pages = _paged_prefill(
-                self.model, self._pages, self._last_logits, ids, real_len,
-                btabs, slots)
-        elif g.kind == 'serve_chunk_step':
-            Cb, Sb = int(p['chunk']), int(p['bucket'])
-            K = self.max_slots
-            ids = jnp.zeros((K, Cb), jnp.int32)
-            z = jnp.zeros((K,), jnp.int32)
-            btabs = jnp.zeros((K, self.max_blocks_per_seq), jnp.int32)
-            slots = jnp.full((K,), K, jnp.int32)      # all dummies: drop
-            self._note('serve_chunk_step', W, Cb, Sb)
-            _, self._last_logits, self._pages, _ = _serve_chunk_step(
-                self.model, self._pages, self._last_logits, ids, z, z,
-                btabs, slots, z, z, dev['btab'], dev['ctx'], dev['live'],
-                budget, sub, ctx_bucket=Sb, **common)
-        else:
-            raise ValueError(f'unknown serving geometry kind {g.kind!r}')
+        with self._use_mesh():
+            dev = self._device_state()
+            budget = self._put(self._budget)
+            common = dict(window=W, temperature=self.temperature,
+                          top_k=self.top_k, top_p=self.top_p,
+                          eos_token_id=self.eos_token_id)
+            # a fixed dummy key with the live aval (and the live
+            # placement — under tp the live key is committed
+            # replicated, and a differently-placed warm key would
+            # compile a SECOND executable): warming must NOT consume
+            # the engine's sampling stream (self._rng), or a warmed
+            # and an unwarmed replica seeded identically would emit
+            # different sampled tokens
+            sub = self._put(jax.random.PRNGKey(0))
+            if g.kind == 'serve_step':
+                ids, real_len, btabs, slots = self._prefill_args(
+                    p['bucket'], [])
+                self._note('serve_step', W, p['bucket'])
+                _, self._last_logits, self._pages, _ = _serve_step(
+                    self.model, self._pages, self._last_logits, ids,
+                    real_len, btabs, slots, dev['btab'], dev['ctx'],
+                    dev['live'], budget, sub, **common)
+            elif g.kind == 'serve_window':
+                self._note('serve_window', W)
+                _, self._last_logits, self._pages, _ = _serve_window(
+                    self.model, self._pages, self._last_logits,
+                    dev['btab'], dev['ctx'], dev['live'], budget, sub,
+                    **common)
+            elif g.kind == 'serve_prefill':
+                ids, real_len, btabs, slots = self._prefill_args(
+                    p['bucket'], [])
+                self._note('serve_prefill', p['bucket'])
+                self._last_logits, self._pages = _paged_prefill(
+                    self.model, self._pages, self._last_logits, ids,
+                    real_len, btabs, slots)
+            elif g.kind == 'serve_chunk_step':
+                Cb, Sb = int(p['chunk']), int(p['bucket'])
+                K = self.max_slots
+                ids = self._put(np.zeros((K, Cb), np.int32))
+                z = self._put(np.zeros((K,), np.int32))
+                btabs = self._put(
+                    np.zeros((K, self.max_blocks_per_seq), np.int32))
+                slots = self._put(
+                    np.full((K,), K, np.int32))   # all dummies: drop
+                self._note('serve_chunk_step', W, Cb, Sb)
+                _, self._last_logits, self._pages, _ = _serve_chunk_step(
+                    self.model, self._pages, self._last_logits, ids, z,
+                    z, btabs, slots, z, z, dev['btab'], dev['ctx'],
+                    dev['live'], budget, sub, ctx_bucket=Sb, **common)
+            else:
+                raise ValueError(
+                    f'unknown serving geometry kind {g.kind!r}')
 
     def warmup(self, artifact=None, geometries=None, draft=None):
         """Pre-populate the module-level jit caches (and the
@@ -1832,7 +2002,7 @@ class ServingEngine:
         # phantom throughput spike on every failover
         self._serve_time = float(snap.get('serve_time', self._serve_time))
         if snap.get('rng') is not None:
-            self._rng = jnp.asarray(np.asarray(snap['rng'], np.uint32))
+            self._rng = self._put(np.asarray(snap['rng'], np.uint32))
         self._update_gauges()
         return {'requests': len(snap['requests']),
                 'terminal': len(snap['terminal']),
@@ -1859,7 +2029,11 @@ class ServingEngine:
         t0 = time.perf_counter()
         _step_span = _obs_trace.span('serve.step', cat='scheduler').begin()
         try:
-            return self._step_impl(t0)
+            # the engine's mesh (None included) is pinned for the whole
+            # iteration: any trace this step pays — first-time buckets,
+            # chunk pairs — sees exactly the engine's sharding world
+            with self._use_mesh():
+                return self._step_impl(t0)
         except Exception as e:
             # the PR-8 worker-death path (a propagating window-dispatch
             # or top-up fault): drop the forensic bundle — metrics,
@@ -1962,6 +2136,11 @@ class ServingEngine:
         W = self.decode_window
         if self.temperature != 0.0:
             self._rng, sub = jax.random.split(self._rng)
+            if self._rep is not None:
+                # re-pin the split halves replicated: split's output
+                # placement is the compiler's choice, and a drifting
+                # key sharding would flap the dispatch cache key
+                self._rng, sub = self._put(self._rng), self._put(sub)
         else:
             sub = self._rng               # unused inside a greedy trace
         # admissions beyond the fused dispatch prefill standalone (a
@@ -1993,7 +2172,7 @@ class ServingEngine:
             self._update_gauges()
             return []
         dev = self._device_state()
-        budget = jnp.asarray(self._budget)      # shrinks every window
+        budget = self._put(self._budget)        # shrinks every window
         common = dict(window=W, temperature=self.temperature,
                       top_k=self.top_k, top_p=self.top_p,
                       eos_token_id=self.eos_token_id)
@@ -2214,9 +2393,9 @@ class ServingEngine:
                         btab[i] = 0
                         ctx[i] = 0
             self._dev = {
-                'btab': jnp.asarray(btab),
-                'ctx': jnp.asarray(ctx),
-                'live': jnp.asarray(np.asarray(live)),
+                'btab': self._put(btab),
+                'ctx': self._put(ctx),
+                'live': self._put(np.asarray(live)),
             }
         return self._dev
 
@@ -2441,8 +2620,8 @@ class ServingEngine:
             real_len[i] = len(toks)
             btabs[i] = self._btab[slot]
             slots[i] = slot
-        return (jnp.asarray(ids), jnp.asarray(real_len),
-                jnp.asarray(btabs), jnp.asarray(slots))
+        return (self._put(ids), self._put(real_len),
+                self._put(btabs), self._put(slots))
 
     def _prefill_group(self, Sb, group):
         """Standalone prefill dispatch for an admission group that did
@@ -2495,9 +2674,9 @@ class ServingEngine:
                 # from then on the device dataflow orders any reuse of
                 # the page after the copy that read it)
                 self._cow_release.append(pair[0])
-        return (jnp.asarray(ids), jnp.asarray(clen), jnp.asarray(start),
-                jnp.asarray(btabs), jnp.asarray(slots),
-                jnp.asarray(cow_src), jnp.asarray(cow_dst), Cb, Sb)
+        return (self._put(ids), self._put(clen), self._put(start),
+                self._put(btabs), self._put(slots),
+                self._put(cow_src), self._put(cow_dst), Cb, Sb)
 
     def _chunk_seam_ok(self, rows):
         """Fire the per-dispatch fault seam for the chunk group

@@ -225,8 +225,34 @@ class GenerationMixin:
                     or cfg.num_attention_heads)
         dtype = dtype or self.cache_dtype()
         shape = (int(num_blocks), kv_heads, int(block_size), head_dim)
-        return [PagedKVCache(jnp.zeros(shape, dtype),
-                             jnp.zeros(shape, dtype))
+
+        def make():
+            return jnp.zeros(shape, dtype)
+
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is not None:
+            # TP-sharded serving (ROADMAP item 1; the ServingEngine
+            # activates its mesh around this call): the page pools
+            # carry a NamedSharding splitting the kv-head dim over
+            # 'tp' — a 7B-class model's paged KV splits across chips
+            # instead of replicating, mirroring init_cache's layout.
+            # Page ids / block tables stay replicated host state.
+            # kv_heads % tp != 0 clamps to replicated (the GQA
+            # fallback, same as init_cache).
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..distributed.parallel import _valid_spec
+
+            spec = _valid_spec(P(None, 'tp', None, None), shape, mesh)
+            sharding = NamedSharding(mesh, spec)
+
+            def make():  # noqa: F811 - mesh-aware variant
+                return jax.device_put(jnp.zeros(shape, dtype), sharding)
+
+        return [PagedKVCache(make(), make())
                 for _ in range(cfg.num_hidden_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,

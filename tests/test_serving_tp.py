@@ -1,0 +1,326 @@
+"""TP-sharded ServingEngine — the serving stack's first multi-chip
+milestone (ROADMAP item 1).
+
+Contract pinned here, all on the conftest-forced virtual 8-device CPU
+mesh:
+
+  - `ServingEngine(tp=N)` / `ServingEngine(mesh=serving_mesh(N))` runs
+    the UNCHANGED scheduler loop against TP-sharded device state: page
+    pools carry a NamedSharding splitting the kv-head dim over 'tp',
+    block tables / slot mirrors / every host-fed arg stay replicated,
+    and greedy streams are BIT-EQUAL to the single-device engine —
+    across preemption, prefix-cache hits, chunked admission, injected
+    faults, and a snapshot taken on tp=2 restored on a fresh tp=2
+    standby.
+  - Zero steady-state retraces as the admission mix changes (requests
+    joining/leaving never change a traced shape OR an input sharding).
+  - `aot` geometry enumeration == live keys on the sharded engine, and
+    the registry keys of different tp degrees never collide.
+  - An artifact built for one mesh degree refuses (`ArtifactMismatch`,
+    naming the field) to attach to an engine of another.
+  - Pool byte accounting stays GLOBAL when the pools shard: per-shard
+    bytes x tp, identical to the tp=1 engine — capacity dashboards
+    must not silently shrink by 1/tp.
+"""
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.aot.artifact import (ArtifactMismatch, EngineArtifact,
+                                     config_hash, fingerprint)
+from paddle_tpu.distributed.mesh import serving_mesh
+from paddle_tpu.inference.engine import COMPILE_CACHE, total_traces
+from paddle_tpu.inference.serving import OutOfBlocks, ServingEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.testing.faults import FaultInjector
+
+pytestmark = pytest.mark.tier1
+
+
+def mk_model():
+    # kv_heads=4 so BOTH tp=2 and tp=4 head-shard the page pools
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                       layers=2, heads=4, kv_heads=4))
+
+
+KW = dict(max_slots=4, block_size=8, max_context_len=32,
+          max_new_tokens=16, decode_window=4)
+
+_RNG = np.random.default_rng(7)
+PROMPTS = [_RNG.integers(3, 96, (6,)) for _ in range(8)]
+MNTS = [16 if i % 4 == 0 else 5 for i in range(8)]
+
+
+def run_mixed(engine, prompts=PROMPTS, mnts=MNTS):
+    rids = [engine.submit(p, m) for p, m in zip(prompts, mnts)]
+    engine.run()
+    return [engine.result(r) for r in rids]
+
+
+@pytest.fixture(scope='module')
+def ref_streams():
+    """Single-device greedy streams for the canonical mixed workload —
+    the oracle every sharded engine must reproduce bit for bit."""
+    return run_mixed(ServingEngine(mk_model(), **KW))
+
+
+@pytest.fixture(scope='module')
+def tp2():
+    """One module-shared tp=2 engine (drained between tests)."""
+    return ServingEngine(mk_model(), tp=2, **KW)
+
+
+class TestServingMesh:
+    def test_serving_mesh_shape(self):
+        mesh = serving_mesh(2)
+        assert mesh.shape['tp'] == 2
+        assert all(mesh.shape[a] == 1 for a in mesh.axis_names
+                   if a != 'tp')
+
+    def test_engine_accepts_mesh_or_tp(self):
+        a = ServingEngine(mk_model(), tp=2, **KW)
+        b = ServingEngine(mk_model(), mesh=serving_mesh(2), **KW)
+        assert a.tp == b.tp == 2
+        assert a._geometry() == b._geometry()
+
+    def test_tp1_is_single_device(self):
+        a = ServingEngine(mk_model(), tp=1, **KW)
+        b = ServingEngine(mk_model(), mesh=serving_mesh(1), **KW)
+        assert a.mesh is None and b.mesh is None
+        assert a.tp == b.tp == 1
+
+    def test_mesh_and_tp_are_exclusive(self):
+        with pytest.raises(ValueError, match='not both'):
+            ServingEngine(mk_model(), tp=2, mesh=serving_mesh(2), **KW)
+
+    def test_non_tp_mesh_refuses(self):
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+
+        mesh = build_mesh(devices=jax.devices()[:4], tp=2)  # dp absorbs 2
+        with pytest.raises(ValueError, match='tp only'):
+            ServingEngine(mk_model(), mesh=mesh, **KW)
+
+    def test_serving_mesh_too_few_devices(self):
+        import jax
+
+        with pytest.raises(ValueError, match='needs 16 devices'):
+            serving_mesh(16, devices=jax.devices())
+
+
+class TestShardedState:
+    def test_pools_are_head_sharded(self, tp2):
+        k0 = tp2._pages[0].kp
+        assert k0.sharding.spec == P(None, 'tp', None, None)
+        # kv_heads=4 over tp=2: each shard holds TWO heads' pages
+        NB = tp2.allocator.num_blocks
+        assert {s.data.shape for s in k0.addressable_shards} == {
+            (NB, 2, 8, 16)}
+
+    def test_host_mirrors_stay_replicated(self, tp2):
+        dev = tp2._device_state()
+        for name in ('btab', 'ctx', 'live'):
+            assert dev[name].sharding.is_fully_replicated, name
+        assert tp2._last_logits.sharding.is_fully_replicated
+
+    def test_pool_bytes_stay_global(self, tp2, ref_streams):
+        """The satellite invariant: bytes_per_page is per-shard
+        itemsize x tp — the whole-pool figure, equal at every degree,
+        so capacity dashboards never shrink by 1/tp."""
+        one = ServingEngine(mk_model(), **KW)
+        assert tp2.allocator.bytes_per_page == one.allocator.bytes_per_page
+        k0 = tp2._pages[0].kp
+        shard = next(iter(k0.addressable_shards)).data
+        per_shard = int(np.prod(shard.shape[1:])) * shard.dtype.itemsize
+        layers = len(tp2._pages)
+        assert tp2.allocator.bytes_per_page == layers * 2 * per_shard * tp2.tp
+        s1, s2 = one.allocator.stats(), tp2.allocator.stats()
+        assert s1['bytes_total'] == s2['bytes_total']
+        assert tp2.stats()['geometry']['tp'] == 2
+
+    def test_gqa_indivisible_warns_and_replicates(self):
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny(
+            vocab_size=96, hidden_size=64, layers=1, heads=4, kv_heads=2))
+        with pytest.warns(UserWarning, match='do not divide tp=4'):
+            srv = ServingEngine(model, tp=4, **KW)
+        assert srv._pages[0].kp.sharding.spec == P(None, None, None, None)
+        # bytes still global (trivially: the pool is replicated)
+        assert srv.allocator.bytes_per_page == int(
+            2 * np.prod(srv._pages[0].kp.shape[1:])
+            * srv._pages[0].kp.dtype.itemsize) * len(srv._pages)
+
+
+class TestParity:
+    def test_tp2_bit_equal(self, ref_streams, tp2):
+        outs = run_mixed(tp2)
+        for a, b in zip(ref_streams, outs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_tp4_bit_equal(self, ref_streams):
+        outs = run_mixed(ServingEngine(mk_model(), tp=4, **KW))
+        for a, b in zip(ref_streams, outs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_zero_steady_state_retraces(self, ref_streams, tp2):
+        """A different admission mix on the warmed tp engine — more
+        requests, different interleave — must add zero traces."""
+        run_mixed(tp2)                        # warm every geometry
+        t0 = total_traces()
+        outs = run_mixed(tp2, PROMPTS[::-1], MNTS[::-1])
+        assert total_traces() - t0 == 0
+        for a, b in zip(ref_streams[::-1], outs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_preemption_parity(self, ref_streams):
+        """A 9-page pool forces mid-decode evictions; the resumed
+        streams must still match single-device (which preempts
+        identically — the host scheduler is unchanged)."""
+        kw = dict(KW, num_blocks=9)
+        one = ServingEngine(mk_model(), **kw)
+        two = ServingEngine(mk_model(), tp=2, **kw)
+        oa, ob = run_mixed(one), run_mixed(two)
+        assert one.preemption_count == two.preemption_count > 0
+        for a, b in zip(oa, ob):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(ref_streams, ob):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefix_and_chunked_parity(self):
+        """Prefix-cache hits (shared pages + CoW) and chunked
+        admissions ride the sharded chunk dispatch bit-equal."""
+        kw = dict(max_slots=4, block_size=8, max_context_len=48,
+                  max_new_tokens=8, decode_window=4, prefix_cache=True,
+                  prefill_chunk=8)
+        rng = np.random.default_rng(3)
+        sysp = rng.integers(3, 96, (16,))
+        prompts = [np.concatenate([sysp, rng.integers(3, 96, (5,))])
+                   if i % 2 else rng.integers(3, 96, (26,))
+                   for i in range(6)]
+        one = ServingEngine(mk_model(), **kw)
+        two = ServingEngine(mk_model(), tp=2, **kw)
+        oa = run_mixed(one, prompts, [8] * 6)
+        ob = run_mixed(two, prompts, [8] * 6)
+        assert two.prefix_counts['hits'] > 0
+        assert two.prefix_counts['chunked_admissions'] > 0
+        assert two.allocator.in_use() == 0 or two.allocator.cached() >= 0
+        for a, b in zip(oa, ob):
+            np.testing.assert_array_equal(a, b)
+
+    def test_injected_fault_parity(self):
+        """A scripted pool-dry spell fails/preempts the same requests
+        with the same outcomes at tp=2 as on one chip (failure
+        isolation is host logic; sharding must not perturb it)."""
+
+        def drive(engine):
+            rids = [engine.submit(p, 10) for p in PROMPTS[:6]]
+            with FaultInjector(seed=0) as inj:
+                inj.script('alloc', at=3, times=1,
+                           exc=OutOfBlocks('injected dry spell'))
+                engine.run()
+            out = []
+            for r in rids:
+                try:
+                    out.append(engine.result(r))
+                except Exception as e:  # noqa: BLE001 - typed terminal
+                    out.append(type(e).__name__)
+            return out
+
+        kw = dict(KW, num_blocks=9)
+        oa = drive(ServingEngine(mk_model(), **kw))
+        two = ServingEngine(mk_model(), tp=2, **kw)
+        ob = drive(two)
+        assert two.allocator.in_use() == 0          # zero leaked pages
+        for a, b in zip(oa, ob):
+            if isinstance(a, str):
+                assert a == b
+            else:
+                np.testing.assert_array_equal(a, b)
+
+    def test_snapshot_tp2_restore_tp2_standby(self, ref_streams):
+        """Mid-run snapshot on tp=2, restored on a FRESH tp=2 standby:
+        every stream finishes bit-equal to the uninterrupted
+        single-device run."""
+        primary = ServingEngine(mk_model(), tp=2, **KW)
+        rids = [primary.submit(p, m) for p, m in zip(PROMPTS, MNTS)]
+        primary.step()
+        primary.step()
+        snap = primary.snapshot()
+        standby = ServingEngine(mk_model(), tp=2, **KW)
+        standby.restore(snap)
+        standby.run()
+        outs = [standby.result(r) for r in rids]
+        for a, b in zip(ref_streams, outs):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestAOT:
+    def test_enumeration_matches_live_tp(self):
+        """for_serving_engine(tp engine) == the keys the live sharded
+        engine notes — the test_aot contract, on the sharded engine."""
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny(
+            vocab_size=64, hidden_size=32, layers=1, heads=2, kv_heads=2))
+        srv = ServingEngine(model, tp=2, max_slots=2, block_size=4,
+                            max_context_len=8, max_new_tokens=3,
+                            decode_window=2, buckets=(4, 8))
+        gs = aot.for_serving_engine(srv)
+        want = set(gs.registry_keys(srv))
+        before = set(COMPILE_CACHE.keys())
+        srv.submit(np.arange(1, 4), 3)          # bucket 4
+        srv.submit(np.arange(1, 6), 3)          # bucket 8
+        srv.step()
+        srv.run()
+        srv.submit(np.arange(1, 6), 3)          # bucket 8 first
+        srv.submit(np.arange(1, 4), 3)          # bucket 4 standalone
+        srv.step()
+        srv.run()
+        got = set(COMPILE_CACHE.keys()) - before
+        assert got == want, (
+            f'missing={sorted(want - got)} extra={sorted(got - want)}')
+
+    def test_warmup_then_zero_traces(self):
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny(
+            vocab_size=64, hidden_size=32, layers=1, heads=2, kv_heads=2))
+        srv = ServingEngine(model, tp=2, max_slots=2, block_size=4,
+                            max_context_len=8, max_new_tokens=3,
+                            decode_window=2, buckets=(4, 8))
+        srv.warmup(geometries=aot.for_serving_engine(srv))
+        t0 = total_traces()
+        srv.serve([np.arange(1, 4)], 3)
+        assert total_traces() - t0 == 0
+
+    def test_registry_keys_tp_distinct(self, tp2):
+        """tp is part of the geometry: a tp=1 and a tp=2 engine over
+        one pool shape must never collide in the CompileCache."""
+        one = ServingEngine(mk_model(), **KW)
+        assert (one.registry_key('serve_window', 4)
+                != tp2.registry_key('serve_window', 4))
+        assert one._geometry()[-1] == 1 and tp2._geometry()[-1] == 2
+
+    def test_artifact_tp_mismatch_refuses(self, tp2, tmp_path):
+        """A tp=2 artifact must refuse a tp=1 engine (and vice versa)
+        with ArtifactMismatch naming the differing field — attaching
+        across mesh degrees would silently recompile everything."""
+        one = ServingEngine(mk_model(), **KW)
+        cfg2 = tp2.aot_config()
+        assert cfg2['tp'] == 2 and one.aot_config()['tp'] == 1
+        art = EngineArtifact(str(tmp_path), {
+            'version': 1, 'fingerprint': fingerprint(), 'engine': cfg2,
+            'config_hash': config_hash(cfg2), 'geometries': [],
+        })
+        with pytest.raises(ArtifactMismatch, match="'tp'"):
+            art.check(one)
+        art.check(tp2)              # same degree attaches
+        cfg1 = one.aot_config()
+        art1 = EngineArtifact(str(tmp_path), {
+            'version': 1, 'fingerprint': fingerprint(), 'engine': cfg1,
+            'config_hash': config_hash(cfg1), 'geometries': [],
+        })
+        with pytest.raises(ArtifactMismatch, match="'tp'"):
+            art1.check(tp2)

@@ -600,3 +600,24 @@ class TestMeta:
         `budget=None` is for fixtures, not the registry."""
         for e in all_entries():
             assert e.budget is not None, e.name
+
+    def test_serving_suites_registered_with_budgets(self):
+        """ROADMAP item 1's contract: the TP-sharded ServingEngine's
+        fused dispatches are a registered suite FAMILY — decode window,
+        fused bucketed prefill, and the chunk variant — each with a
+        MANDATORY declared per-window collective budget (counts exact:
+        the per-layer all-reduce census is the product being gated)."""
+        names = {e.name for e in all_entries()}
+        want = {'serving/serve_step_tp', 'serving/serve_window_tp',
+                'serving/serve_chunk_step_tp'}
+        assert want <= names, want - names
+        for e in all_entries():
+            if not e.name.startswith('serving/'):
+                continue
+            assert isinstance(e.budget, dict) and e.budget, e.name
+            assert 'all-reduce' in e.budget, (
+                f'{e.name}: the serving budget exists to pin the '
+                f'per-layer all-reduce census')
+            for kind, b in e.budget.items():
+                assert isinstance(b, dict) and b.get('count'), (e.name,
+                                                                kind)

@@ -88,14 +88,32 @@ def main(argv=None):
     rows = []
     for name, flags in FAMILIES:
         rc, payload = run_family(name, flags, args.root)
-        rows.append({
+        row = {
             'family': name,
             'rc': rc,
             'new': payload.get('new'),
             'baselined': payload.get('baselined'),
             'suppressed': payload.get('suppressed'),
             'violations': payload.get('violations', []),
-        })
+        }
+        if name == 'shardlint':
+            # surface WHAT the shardlint leg covered: suite count per
+            # registry family (mp_layers, ring, ..., serving — the
+            # TP-sharded ServingEngine dispatches), so a registry
+            # entry silently dropping out is visible in this summary
+            # instead of only as a quieter census
+            try:
+                from paddle_tpu.analysis.shard.registry import \
+                    all_entries
+
+                fams: dict = {}
+                for e in all_entries():
+                    fam = e.name.split('/', 1)[0]
+                    fams[fam] = fams.get(fam, 0) + 1
+                row['suites'] = fams
+            except Exception:  # noqa: BLE001 - summary only
+                row['suites'] = None
+        rows.append(row)
 
     combined = (1 if any(r['rc'] == 1 for r in rows)
                 else 2 if any(r['rc'] not in (0, 1) for r in rows)
@@ -114,6 +132,10 @@ def main(argv=None):
 
         print(f'{r["family"]:<12} {fmt(r["rc"]):>3} {fmt(r["new"]):>5} '
               f'{fmt(r["baselined"]):>10} {fmt(r["suppressed"]):>11}')
+        if r.get('suites'):
+            per = ' '.join(f'{k}({n})'
+                           for k, n in sorted(r['suites'].items()))
+            print(f'    suites: {per}')
         for v in r['violations']:
             print(f'    {v["path"]}:{v["line"]}: {v["rule"]} '
                   f'[{v["severity"]}] {v["message"]}')

@@ -49,7 +49,7 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
-def run_workload(n_requests=16, decode_window=8, seed=0):
+def run_workload(n_requests=16, decode_window=8, seed=0, tp=1):
     """The gate-shaped serving workload: mixed budgets, every 4th
     request long, priority-0 FIFO arrivals — now with the prefix
     cache and chunked prefill ON and every second request sharing a
@@ -73,10 +73,16 @@ def run_workload(n_requests=16, decode_window=8, seed=0):
                if i % 2 else rng.integers(3, 96, (6,))
                for i in range(n_requests)]
     mnts = [16 if i % 4 == 0 else 6 for i in range(n_requests)]
+    # tp > 1 exercises the TP-sharded path (page pools head-sharded
+    # over the serving mesh, fused dispatches through the megatron
+    # layout) — the dumped telemetry/journal then carries the sharded
+    # engine's gauges; kv_heads=2 in the tiny model, so tp=2 is the
+    # largest degree that still head-shards
     srv = ServingEngine(model, max_slots=4, block_size=8,
                         max_context_len=48, max_new_tokens=16,
                         decode_window=decode_window,
-                        prefix_cache=True, prefill_chunk=16)
+                        prefix_cache=True, prefill_chunk=16,
+                        **({'tp': int(tp)} if tp and int(tp) > 1 else {}))
     rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
     srv.run()
     for r in rids:
@@ -92,10 +98,22 @@ def main(argv=None):
                     help='workload size (default 16)')
     ap.add_argument('--cpu', action='store_true',
                     help='pin JAX_PLATFORMS=cpu (skip TPU probing)')
+    ap.add_argument('--tp', type=int, default=1,
+                    help='tensor-parallel degree for the ServingEngine '
+                         '(>1 runs the TP-sharded serving path; on a '
+                         'CPU box the virtual-device flag is forced '
+                         'automatically)')
     args = ap.parse_args(argv)
 
     if args.cpu:
         os.environ['JAX_PLATFORMS'] = 'cpu'
+    if args.tp and args.tp > 1:
+        # must land BEFORE jax initialises a backend, like the
+        # shardlint recipe (serving_mesh would force it too, but only
+        # if nothing woke the backend first — do it here, determinate)
+        from paddle_tpu.distributed.mesh import force_virtual_devices
+
+        force_virtual_devices(args.tp)
 
     # backend guard, mosaic_check-style: a guard rather than an assert
     # (python -O strips asserts), and rc 2 distinguishes "no backend"
@@ -119,7 +137,7 @@ def main(argv=None):
     obs.TRACER.clear()
     obs_journal.JOURNAL.clear()
 
-    srv = run_workload(n_requests=args.requests)
+    srv = run_workload(n_requests=args.requests, tp=args.tp)
 
     # cost observatory: measure this engine's per-geometry static
     # flops/bytes (one lower+compile each — off the serving path, so
@@ -156,6 +174,11 @@ def main(argv=None):
     R = obs.REGISTRY
 
     print(f'backend          {backend}')
+    if srv.tp > 1:
+        k0 = srv._pages[0].kp
+        print(f'tp degree        {srv.tp} (pool sharding '
+              f'{k0.sharding.spec}, {len(k0.addressable_shards)} '
+              f'shard(s))')
     print(f'ttft_ms p50/p99  {R.percentile("serve.ttft_ms", 50)} / '
           f'{R.percentile("serve.ttft_ms", 99)}')
     print(f'itl_ms p99       {R.percentile("serve.itl_ms", 99)}')
