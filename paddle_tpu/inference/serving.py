@@ -102,7 +102,13 @@ import numpy as np
 
 from ..observability import journal as _journal
 from ..observability import metrics as _obs
+from ..observability import timeseries as _obs_ts
 from ..observability import tracing as _obs_trace
+# top-level like the rest of the observability imports: the package
+# __init__ already pulls watchdog/httpd eagerly, so deferring these
+# would save nothing and only hide the dependency
+from ..observability import watchdog as _obs_wd
+from ..observability.httpd import start_ops_server as _start_ops_server
 from ..testing import faults as _faults
 from .engine import (COMPILE_CACHE, DEFAULT_BUCKETS, _count_trace,
                      bucket_length, total_traces, trace_counts)
@@ -895,7 +901,9 @@ class ServingEngine:
                  buckets=None, max_queue=None, admit_watermark=1.0,
                  shed_policy='reject', max_terminal=1024,
                  prefix_cache=False, prefill_chunk=None,
-                 postmortem_dir=None, mesh=None, tp=None):
+                 postmortem_dir=None, mesh=None, tp=None,
+                 ops_port=None, ops_host='127.0.0.1', watchdog=None,
+                 slo_rules=None, ts_interval_s=None):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -1146,6 +1154,48 @@ class ServingEngine:
         # fires once per STALL (a multi-hour stall must not grow the
         # held head's live — hence unevictable — trail per step)
         self._paused_head = None
+        # live operability layer (docs/observability.md#slo-watchdog):
+        # a windowed timeseries committed at the existing per-window
+        # sync, an SLO watchdog evaluated per committed window, and an
+        # opt-in ops HTTP endpoint. With none of the knobs set the
+        # engine feeds the PROCESS-default ring (so `serve.tok_s` is
+        # live for free) and runs no watchdog — zero new journal
+        # events, prior behavior bit-identical. Any knob set gives the
+        # engine a PRIVATE ring: its window BOUNDARIES and interval
+        # are its own (another engine's commit cadence can't shear its
+        # SLO windows), but the windowed DATA still comes from the
+        # process-global registry — per-replica SLO isolation means
+        # one engine per process, the dp-replica fleet shape (or a
+        # custom Watchdog over a WindowedTimeseries(registry=...)).
+        # `draining` flips /healthz to 503 and refuses new
+        # submissions — the rolling-restart half the supervisor recipe
+        # needs (drain, wait out in-flight, snapshot, close(), hand
+        # off).
+        self.draining = False
+        self.ops_server = None
+        self._watchdog = None
+        want_ops = (ops_port is not None or watchdog is not None
+                    or slo_rules is not None or ts_interval_s is not None)
+        if want_ops:
+            self._ts = _obs_ts.WindowedTimeseries(
+                interval_s=(1.0 if ts_interval_s is None
+                            else float(ts_interval_s)))
+            if watchdog is not False:
+                if isinstance(watchdog, _obs_wd.Watchdog):
+                    self._watchdog = watchdog
+                    if self._watchdog.postmortem_engine is None:
+                        self._watchdog.postmortem_engine = self
+                else:
+                    rules = (slo_rules if slo_rules is not None
+                             else _obs_wd.default_serving_rules(
+                                 engine=self))
+                    self._watchdog = _obs_wd.Watchdog(
+                        rules, postmortem_engine=self)
+        else:
+            self._ts = _obs_ts.TIMESERIES
+        if ops_port is not None:
+            self.ops_server = _start_ops_server(self, port=ops_port,
+                                                host=ops_host)
         self._update_gauges()
 
     # -- bookkeeping -------------------------------------------------------
@@ -1302,6 +1352,12 @@ class ServingEngine:
             # static flops, wall) — what gate_flight_recorder checks
             # the serve.mfu_est gauge and the AOT manifest against
             'mfu': self._last_mfu,
+            # host-truth health verdict (None when no watchdog is
+            # configured) + drain state — what /statusz and a
+            # supervisor poll without parsing /healthz
+            'watchdog': (self._watchdog.verdict()
+                         if self._watchdog is not None else None),
+            'draining': self.draining,
             'blocks': self.allocator.stats(),
             'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
                          'block_size': self.block_size,
@@ -1605,6 +1661,15 @@ class ServingEngine:
         if it expires while queued). Raises `QueueFull` when the queue
         is at `max_queue` and the shed policy keeps the newcomer out —
         the caller's backpressure signal."""
+        if self.draining:
+            # drain is admission control, not validation: refuse with
+            # the same typed backpressure signal a full queue gives,
+            # counted under 'rejected' so the refusals are visible
+            self.counts['rejected'] += 1
+            _obs.inc('serve.rejected')
+            raise QueueFull(
+                'engine draining: new submissions refused — route to '
+                'another replica (drain(False) reopens admission)')
         mnt = (self.max_new_tokens if max_new_tokens is None
                else int(max_new_tokens))
         if mnt < 1:
@@ -1774,6 +1839,35 @@ class ServingEngine:
         self._update_gauges()
         return True
 
+    def drain(self, on=True):
+        """Stop accepting new work while in-flight requests finish —
+        the supervisor's rolling-restart first half (drain, wait for
+        `in_flight() == 0` stepping the remainder out, `snapshot()`,
+        hand off). While draining, `submit()` refuses with QueueFull
+        (counted under 'rejected') and `/healthz` answers 503
+        `{"status": "draining"}` so a router stops sending traffic
+        immediately, whatever the SLO rules say. `drain(False)`
+        reopens admission."""
+        on = bool(on)
+        if on == self.draining:
+            return
+        self.draining = on
+        _journal.record('drain', on=on)
+        _obs.set_gauge('serve.draining', 1.0 if on else 0.0)
+
+    def close(self):
+        """Release the engine's external resources — today that is the
+        ops HTTP server's listening socket and thread (idempotent;
+        engines without `ops_port` have nothing to release). The
+        supervisor hand-off MUST call this on the old replica before
+        binding a replacement on the same port: a daemon server thread
+        dies with the process, not with the engine object, so two
+        engine generations in one process would otherwise collide with
+        EADDRINUSE."""
+        if self.ops_server is not None:
+            self.ops_server.close()
+            self.ops_server = None
+
     def serve(self, prompts, max_new_tokens=None):
         """Submit + run + collect, preserving submission order.
 
@@ -1799,6 +1893,8 @@ class ServingEngine:
                     rid = self.submit(p, max_new_tokens)
                     break
                 except QueueFull:
+                    if self.draining:
+                        raise       # stepping can never reopen a drain
                     self.step()
             rids.append(rid)
             self._collect_guard.add(rid)
@@ -1887,6 +1983,11 @@ class ServingEngine:
             'requests': live,
             'terminal': terminal,
             'trails': trails,
+            # SLO health history rides along (schema-1 compatible,
+            # like 'trails'): a restored standby reports the primary's
+            # breach state instead of silently re-arming every rule
+            'watchdog': (self._watchdog.snapshot_state()
+                         if self._watchdog is not None else None),
             'next_rid': self._rid,
             'preemptions': self.preemption_count,
             'counts': dict(self.counts),
@@ -2003,6 +2104,12 @@ class ServingEngine:
         self._serve_time = float(snap.get('serve_time', self._serve_time))
         if snap.get('rng') is not None:
             self._rng = self._put(np.asarray(snap['rng'], np.uint32))
+        # continuous health history across the failover: rules matched
+        # by name, so a standby with a tweaked ruleset still adopts
+        # the states both sides define (a snapshot without watchdog
+        # state — or a standby without a watchdog — is a no-op)
+        if snap.get('watchdog') and self._watchdog is not None:
+            self._watchdog.load_state(snap['watchdog'])
         self._update_gauges()
         return {'requests': len(snap['requests']),
                 'terminal': len(snap['terminal']),
@@ -2033,7 +2140,7 @@ class ServingEngine:
             # iteration: any trace this step pays — first-time buckets,
             # chunk pairs — sees exactly the engine's sharding world
             with self._use_mesh():
-                return self._step_impl(t0)
+                finished = self._step_impl(t0)
         except Exception as e:
             # the PR-8 worker-death path (a propagating window-dispatch
             # or top-up fault): drop the forensic bundle — metrics,
@@ -2046,6 +2153,22 @@ class ServingEngine:
             # ended in finally: a propagating window fault (worker
             # death) must not leak an open span into the host trace
             _step_span.end()
+        # windowed timeseries + SLO watchdog ride the step boundary —
+        # an existing host point that fires on EVERY outcome, including
+        # a step whose whole admission group failed (nothing
+        # dispatched, nothing committed — exactly the windows an
+        # error-rate rule must see). OUTSIDE the try above: an
+        # exception from a user-supplied on_breach callback must
+        # surface as its own error, not masquerade as a worker death
+        # and dump a false crash bundle. Off the interval boundary the
+        # probe is two compares; on it, one pass over the registry
+        # plus the rule evaluations — pure host arithmetic, zero new
+        # syncs, zero retraces (gate_watchdog holds the tok/s ratio
+        # within 3%)
+        w = self._ts.maybe_commit(time.perf_counter())
+        if w is not None and self._watchdog is not None:
+            self._watchdog.evaluate(w, self._ts)
+        return finished
 
     def _auto_postmortem(self, error):
         """Best-effort crash-bundle dump (enabled by `postmortem_dir`

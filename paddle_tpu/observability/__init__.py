@@ -25,11 +25,22 @@ feeding the AOT manifest and the live MFU/roofline gauges), and
 `postmortem` (crash bundles composing metrics + trace + journal +
 engine snapshot).
 
+The LIVE operability layer answers "is this engine healthy right
+now": `timeseries` (fixed-interval windowed rings over the registry —
+rates, deltas, rolling percentiles — committed at the existing sync
+points), `watchdog` (declarative SLO rules with hysteresis and a
+machine-readable verdict, breaches journaled), and `httpd` (the
+opt-in stdlib ops endpoint: /metrics, /healthz, /statusz, /slo).
+
 See docs/observability.md for the metric catalog and span taxonomy.
 """
 from __future__ import annotations
 
-from . import costs, journal, metrics, postmortem, tracing  # noqa: F401
+from . import (  # noqa: F401
+    costs, httpd, journal, metrics, postmortem, timeseries, tracing,
+    watchdog,
+)
+from .httpd import OpsServer, start_ops_server  # noqa: F401
 from .journal import (  # noqa: F401
     JOURNAL, Journal, journal_enabled, set_journal_enabled,
     trail, trail_complete,
@@ -39,12 +50,15 @@ from .metrics import (  # noqa: F401
     inc, observe, set_enabled, set_gauge,
 )
 from .postmortem import dump_bundle, load_bundle, validate_bundle  # noqa: F401
+from .timeseries import TIMESERIES, WindowedTimeseries  # noqa: F401
 from .tracing import (  # noqa: F401
     TRACER, HostTracer, annotate, compile_event, instant, span,
 )
+from .watchdog import SLORule, Watchdog, default_serving_rules  # noqa: F401
 
 __all__ = [
     'metrics', 'tracing', 'journal', 'costs', 'postmortem',
+    'timeseries', 'watchdog', 'httpd',
     'REGISTRY', 'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
     'enabled', 'set_enabled', 'inc', 'set_gauge', 'observe',
     'TRACER', 'HostTracer', 'span', 'instant', 'compile_event',
@@ -52,4 +66,7 @@ __all__ = [
     'JOURNAL', 'Journal', 'journal_enabled', 'set_journal_enabled',
     'trail', 'trail_complete',
     'dump_bundle', 'validate_bundle', 'load_bundle',
+    'TIMESERIES', 'WindowedTimeseries',
+    'SLORule', 'Watchdog', 'default_serving_rules',
+    'OpsServer', 'start_ops_server',
 ]

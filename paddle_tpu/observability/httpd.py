@@ -1,0 +1,197 @@
+"""Ops HTTP endpoint — scrape, health, and status for one process.
+
+The operational surface every production serving stack exposes
+(vLLM/SGLang ship the same shape) and ROADMAP item 1's fleet needs: a
+router polls `/healthz` to route around a sick replica, Prometheus
+scrapes `/metrics`, an operator curls `/statusz` before deciding
+whether to drain. Opt-in and stdlib-only: `ThreadingHTTPServer` on a
+daemon thread, no framework, no jax at import, started either by
+`ServingEngine(ops_port=...)` or standalone:
+
+    srv = start_ops_server(engine, port=9100)   # port 0 = ephemeral
+    ...
+    srv.close()
+
+Endpoints (GET only):
+
+    /metrics   Prometheus text exposition of the process registry
+               (includes the windowed-rate gauges `serve.tok_s` etc.
+               the timeseries publishes) — text/plain 0.0.4;
+    /healthz   the watchdog verdict as JSON: 200 when healthy, 503
+               when any SLO rule is in breach — and DRAIN-AWARE: a
+               draining engine answers 503 `{"status": "draining"}`
+               regardless of rule state, so a rolling restart stops
+               routing before the snapshot is cut. No watchdog
+               configured = 200 with `"watchdog": false` (liveness
+               only);
+    /statusz   one JSON page of engine truth: `engine.stats()`,
+               geometry, the dispatch-cost table, the journal tail,
+               and the recent timeseries windows;
+    /slo       per-rule config + live state (`Watchdog.state()`).
+
+Consistency contract: handlers run on the server thread while the
+scheduler mutates host state, protected by the GIL but NOT by a lock
+— a read is a best-effort point-in-time view (a torn `stats()` read
+retries, then reports 500). That is the right trade: serving never
+blocks on a scrape, and scrapers tolerate a failed poll.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import journal as _journal
+from . import metrics as _metrics
+
+__all__ = ['OpsServer', 'start_ops_server']
+
+
+class OpsServer:
+    """The background ops endpoint. Resolves its data sources once at
+    construction: the process registry, plus — when an engine is
+    given — that engine's timeseries, watchdog, drain flag, stats and
+    dispatch costs."""
+
+    def __init__(self, engine=None, *, host='127.0.0.1', port=0,
+                 registry=None, timeseries=None, watchdog=None,
+                 journal_tail=200, ts_tail=30):
+        self.engine = engine
+        self.registry = registry if registry is not None else _metrics.REGISTRY
+        self.timeseries = (timeseries if timeseries is not None
+                           else getattr(engine, '_ts', None))
+        self.watchdog = (watchdog if watchdog is not None
+                         else getattr(engine, '_watchdog', None))
+        self.journal_tail = int(journal_tail)
+        self.ts_tail = int(ts_tail)
+        self.host = host
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # scrapes arrive every few seconds forever; logging each
+            # to stderr is noise the serving logs cannot afford
+            def log_message(self, fmt, *args):      # noqa: ARG002
+                pass
+
+            def do_GET(self):                        # noqa: N802
+                try:
+                    ops._route(self)
+                except BrokenPipeError:
+                    pass                             # client went away
+                except Exception as e:  # noqa: BLE001 - a scrape must
+                    #   never kill the server thread; report and move on
+                    try:
+                        ops._send(self, 500,
+                                  {'error': repr(e)})
+                    except Exception:   # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f'paddle-tpu-ops:{self.port}', daemon=True)
+        self._thread.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def url(self, path='/'):
+        return f'http://{self.host}:{self.port}{path}'
+
+    def close(self):
+        """Stop the server and join its thread (idempotent)."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread.join(timeout=5)
+
+    @staticmethod
+    def _send(handler, code, payload, content_type='application/json'):
+        if isinstance(payload, str):
+            body = payload.encode()
+        else:
+            body = json.dumps(payload, default=repr).encode()
+        handler.send_response(code)
+        handler.send_header('Content-Type', content_type)
+        handler.send_header('Content-Length', str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _route(self, handler):
+        path = handler.path.split('?', 1)[0].rstrip('/') or '/'
+        if path == '/metrics':
+            self._send(handler, 200, self.registry.to_prometheus(),
+                       content_type='text/plain; version=0.0.4; '
+                                    'charset=utf-8')
+        elif path == '/healthz':
+            code, payload = self.health()
+            self._send(handler, code, payload)
+        elif path == '/statusz':
+            self._send(handler, 200, self.statusz())
+        elif path == '/slo':
+            if self.watchdog is None:
+                self._send(handler, 404, {'error': 'no watchdog '
+                                                   'configured'})
+            else:
+                self._send(handler, 200,
+                           {'verdict': self.watchdog.verdict(),
+                            'rules': self.watchdog.state()})
+        else:
+            self._send(handler, 404, {'error': f'unknown path {path!r}',
+                                      'paths': ['/metrics', '/healthz',
+                                                '/statusz', '/slo']})
+
+    # -- verdicts (also callable in-process, no HTTP round trip) -----------
+
+    def health(self):
+        """(status_code, payload) for /healthz. Drain wins over rule
+        state: a draining replica must fall out of the router NOW even
+        if every SLO is green."""
+        if getattr(self.engine, 'draining', False):
+            return 503, {'status': 'draining'}
+        if self.watchdog is None:
+            return 200, {'status': 'ok', 'watchdog': False}
+        v = self.watchdog.verdict()
+        if v['healthy']:
+            return 200, {'status': 'ok', **v}
+        return 503, {'status': 'breach', **v}
+
+    def statusz(self):
+        payload = {}
+        if self.engine is not None:
+            # the scheduler may mutate mid-read (GIL-safe, not
+            # lock-safe): one retry absorbs the torn iteration, a
+            # second failure reports instead of raising
+            for _ in range(2):
+                try:
+                    payload['engine'] = self.engine.stats()
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                payload['engine'] = {'error': 'stats() contended'}
+            costs = getattr(self.engine, '_dispatch_costs', None)
+            if costs:
+                payload['dispatch_costs'] = {str(k): v
+                                             for k, v in costs.items()}
+            payload['draining'] = bool(getattr(self.engine, 'draining',
+                                               False))
+        if self.timeseries is not None:
+            payload['timeseries'] = {
+                'interval_s': self.timeseries.interval_s,
+                'windows': self.timeseries.windows(self.ts_tail)}
+        if self.watchdog is not None:
+            payload['watchdog'] = self.watchdog.verdict()
+        payload['journal_tail'] = _journal.tail(self.journal_tail)
+        return payload
+
+
+def start_ops_server(engine=None, port=0, host='127.0.0.1', **kw):
+    """Start the ops endpoint for `engine` (or a bare metrics/health
+    endpoint with no engine). Returns the running OpsServer; `port=0`
+    binds an ephemeral port (read `.port`). The server thread is a
+    daemon — it dies with the process — but long-lived callers should
+    `close()` it deterministically."""
+    return OpsServer(engine, host=host, port=port, **kw)

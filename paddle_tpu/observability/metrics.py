@@ -254,7 +254,13 @@ class MetricsRegistry:
         return round(v, round_to) if v is not None else None
 
     def names(self):
-        return sorted(self._metrics)
+        # copied under the lock: readers (the ops-server scrape
+        # thread, a timeseries commit) iterate concurrently with lazy
+        # metric registration on the scheduler thread, and a bare
+        # sorted(dict) can raise 'dictionary changed size' exactly at
+        # state-transition moments (first drain refusal, first breach)
+        with self._lock:
+            return sorted(self._metrics)
 
     def reset(self):
         """Drop every metric (tests and the overhead gate isolate runs
@@ -265,25 +271,46 @@ class MetricsRegistry:
             self.generation += 1
 
     def snapshot(self):
-        """{name: metric snapshot} — the telemetry.json artifact."""
-        return {name: self._metrics[name].snapshot()
-                for name in sorted(self._metrics)}
+        """{name: metric snapshot} — the telemetry.json artifact.
+        The name set is copied under the lock (see `names`); the
+        per-metric reads run outside it (attribute reads are
+        GIL-atomic, and a concurrently-ticking counter is an ordinary
+        torn-read race any snapshot accepts)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {name: metrics[name].snapshot()
+                for name in sorted(metrics)}
 
     def to_json(self, **kw):
         return json.dumps(self.snapshot(), **kw)
 
     def to_prometheus(self):
         """Prometheus text exposition (format 0.0.4). Metric names are
-        sanitized (dots -> underscores) to the legal charset; histogram
-        buckets emit cumulative `_bucket{le=...}` rows plus `_sum` and
-        `_count`, the standard shape scrapers expect."""
+        sanitized (dots -> underscores) to the legal charset — with
+        COLLIDING sanitizations disambiguated per `_prom_names` so two
+        distinct registry names can never emit duplicate series;
+        `# HELP` text is spec-escaped (backslash, newline) and
+        `# TYPE`/`# HELP` headers are emitted at most once per
+        exposition name; histogram buckets emit cumulative
+        `_bucket{le=...}` rows plus `_sum` and `_count`, the standard
+        shape scrapers expect."""
         lines = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
-            pname = _prom_name(name)
-            if m.help:
-                lines.append(f'# HELP {pname} {m.help}')
-            lines.append(f'# TYPE {pname} {m.kind}')
+        # copied under the lock: the ops-server scrape runs on its own
+        # thread while the scheduler lazily registers metrics
+        with self._lock:
+            metrics = dict(self._metrics)
+        names = sorted(metrics)
+        pnames = _prom_names(metrics)
+        emitted = set()
+        for name in names:
+            m = metrics[name]
+            pname = pnames[name]
+            if pname not in emitted:
+                emitted.add(pname)
+                if m.help:
+                    lines.append(
+                        f'# HELP {pname} {_prom_escape_help(m.help)}')
+                lines.append(f'# TYPE {pname} {m.kind}')
             if m.kind == 'counter':
                 lines.append(f'{pname} {m.value}')
             elif m.kind == 'gauge':
@@ -309,6 +336,72 @@ def _prom_name(name):
                                or (ch.isdigit() and i > 0))
         out.append(ch if ok else '_')
     return ''.join(out)
+
+
+def _prom_escape_help(text):
+    """Spec escaping for `# HELP` text (exposition format 0.0.4):
+    backslash first, then newline — unescaped, a multi-line help
+    string would inject arbitrary exposition rows."""
+    return str(text).replace('\\', r'\\').replace('\n', r'\n')
+
+
+_COLLISIONS_WARNED: set = set()
+
+
+def _prom_claims(pname, kind):
+    """Every exposition series name one metric emits: histograms own
+    their `_bucket`/`_sum`/`_count` suffix rows too, so a counter
+    literally named `x_count` collides with histogram `x` even though
+    their BASE names differ."""
+    if kind == 'histogram':
+        return (pname, f'{pname}_bucket', f'{pname}_sum',
+                f'{pname}_count')
+    return (pname,)
+
+
+def _prom_names(metrics):
+    """Map each registry name to a UNIQUE exposition name. Sanitizing
+    is lossy ('serve.tok/s' and 'serve.tok_s' both become
+    'serve_tok_s'), and two distinct metrics sharing one exposition
+    series name silently emit duplicate samples — the scrape keeps
+    only one, whichever sorts last. Collisions are judged over every
+    series a metric EMITS (`_prom_claims`, so histogram suffix rows
+    count); every collider gets an 8-hex blake2b suffix of its RAW
+    name — a function of the name alone, so the mapping is
+    deterministic across processes and registration orders — and each
+    collision warns once per process. Takes the registry's
+    name -> metric dict."""
+    import hashlib
+    import warnings
+
+    sanitized = {n: _prom_name(n) for n in metrics}
+    owners: dict = {}
+    for n, pn in sanitized.items():
+        for claim in _prom_claims(pn, metrics[n].kind):
+            owners.setdefault(claim, []).append(n)
+    colliding = {n for names in owners.values()
+                 if len(names) > 1 for n in names}
+    out = {}
+    for n, pn in sanitized.items():
+        if n in colliding:
+            suffix = hashlib.blake2b(n.encode(),
+                                     digest_size=4).hexdigest()
+            out[n] = f'{pn}_{suffix}'
+            if pn not in _COLLISIONS_WARNED:
+                _COLLISIONS_WARNED.add(pn)
+                group = sorted({
+                    r for claim in _prom_claims(pn, metrics[n].kind)
+                    for r in owners.get(claim, ())
+                    if len(owners[claim]) > 1})
+                warnings.warn(
+                    f'metric names {group} collide after Prometheus '
+                    f'sanitization (around {pn!r}); disambiguating '
+                    f'every collider with a name-hash suffix — rename '
+                    f'the metrics to avoid the collision',
+                    RuntimeWarning, stacklevel=3)
+        else:
+            out[n] = pn
+    return out
 
 
 REGISTRY = MetricsRegistry()

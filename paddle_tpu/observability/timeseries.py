@@ -1,0 +1,360 @@
+"""Windowed timeseries — live rates and rolling percentiles over the
+metrics registry.
+
+PR 6's registry answers "what happened since boot": cumulative
+counters, since-boot histograms. A router deciding where to send the
+next request — or a watchdog deciding whether this replica is healthy
+RIGHT NOW — needs the other question: what happened in the last
+second. This module derives that view from the cumulative registry
+with zero new instrumentation and zero new host syncs:
+
+  - the engines call `maybe_commit()` at their EXISTING host points
+    (the serving per-window commit, the train `sync()`), passing the
+    perf_counter stamp they already hold. Off the commit boundary it
+    is two compares; on it, one pass over the registry;
+  - each committed window snapshots every registered metric and diffs
+    it against the previous snapshot: counters become `{delta, rate}`,
+    histograms become per-window counts + interpolated p50/p95/p99
+    over the window's bucket DELTAS (the rolling percentile the
+    cumulative histogram can never give back once it has absorbed a
+    bad hour), gauges ride as last-written values;
+  - well-known counters additionally publish live rate GAUGES back
+    into the registry (`serve.tok_s`, `serve.req_s`,
+    `serve.preempt_s`, `serve.err_rate`, `train.tok_s`), so the
+    Prometheus exposition and `/metrics` carry the windowed rates a
+    fleet router reads (ROADMAP item 1's load-aware routing);
+  - memory is bounded: a fixed ring of `max_windows` window records
+    plus ONE previous-cumulative snapshot, regardless of uptime.
+
+Windows are wall-interval paced (`interval_s`), not step paced: a
+commit point landing past the interval closes one window spanning the
+ACTUAL elapsed time (`dur_s`), and rates divide by that — an idle
+engine produces long truthful windows instead of a backlog of empty
+ones. Tests drive `commit(now=...)` directly for exact arithmetic.
+
+Like the rest of the package this module is stdlib-only at import
+(no jax, no numpy) and gated by the global telemetry switch
+(`metrics.enabled()`). The SLO watchdog (`watchdog.py`) evaluates its
+rules against each committed window; the ops endpoint (`httpd.py`)
+serves the ring as JSON.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+from . import journal as _journal
+from . import metrics as _metrics
+
+__all__ = [
+    'WindowedTimeseries', 'TIMESERIES', 'DERIVED_RATES',
+    'percentile_from_buckets', 'maybe_commit',
+]
+
+# counter -> gauge published on every commit: the window's per-second
+# rate of the counter's delta. The serving/fleet set the ROADMAP's
+# load-aware router polls; absent counters publish nothing.
+DERIVED_RATES = (
+    ('serve.tokens', 'serve.tok_s'),
+    ('serve.requests', 'serve.req_s'),
+    ('serve.preemptions', 'serve.preempt_s'),
+    ('train.tokens', 'train.tok_s'),
+)
+
+# the serving terminal-state counters: `serve.err_rate` is the
+# window's failed fraction of terminal outcomes (None published — the
+# gauge left untouched — on a window with no terminals)
+_TERMINAL_COUNTERS = ('serve.finished', 'serve.failed', 'serve.expired',
+                      'serve.cancelled')
+
+
+def percentile_from_buckets(edges, counts, p):
+    """Interpolated p-th percentile over ONE window's bucket counts
+    (the registry Histogram's algorithm applied to deltas). The first
+    bucket interpolates from 0, and the +inf bucket clamps to the last
+    finite edge — a window has no observed min/max, only its bucket
+    deltas, so the estimate is exact to bucket resolution. None when
+    the window saw no observations."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = (p / 100.0) * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            if i == len(edges):          # +inf bucket
+                return edges[-1]
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[i]
+            frac = (rank - prev_cum) / c
+            return lo + (hi - lo) * max(0.0, min(1.0, frac))
+    return edges[-1]
+
+
+class WindowedTimeseries:
+    """Fixed-interval windowed ring over a MetricsRegistry.
+
+    One instance per consumer scope: the module-global `TIMESERIES`
+    is the process default (fed by every engine that has no private
+    operability config), while a `ServingEngine(watchdog=...)` or
+    `(ops_port=...)` owns a private instance so its SLO windows are
+    isolated from other engines in the process. Thread-safe for the
+    ops-endpoint reader: `commit` and the read accessors share one
+    lock (uncontended — commits happen once per interval)."""
+
+    def __init__(self, interval_s=1.0, max_windows=120, registry=None,
+                 derive=True):
+        self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            raise ValueError('interval_s must be > 0')
+        self.max_windows = int(max_windows)
+        if self.max_windows < 1:
+            raise ValueError('max_windows must be >= 1')
+        self.registry = registry if registry is not None else _metrics.REGISTRY
+        self.derive = bool(derive)
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.max_windows)
+        self._lock = threading.Lock()
+        self._idx = 0                 # total windows ever committed
+        self._prev = None             # cumulative baseline snapshot
+        self._prev_t = None
+        self._prev_gen = None
+        self._edges: dict = {}        # histogram name -> bucket edges
+
+    # -- committing --------------------------------------------------------
+
+    def _cumulative(self):
+        """One pass over the registry: {'counters': {name: value},
+        'gauges': {name: value}, 'hists': {name: (counts, count, sum)}}
+        plus the journal's overflow count as a pseudo-counter (the
+        watchdog's journal-overflow-growth rule reads its delta)."""
+        counters, gauges, hists = {}, {}, {}
+        for name in self.registry.names():
+            m = self.registry.get(name)
+            if m is None:
+                continue
+            if m.kind == 'counter':
+                counters[name] = m.value
+            elif m.kind == 'gauge':
+                gauges[name] = m.value
+            else:
+                self._edges[name] = m.edges
+                hists[name] = (tuple(m.counts), m.count, m.sum)
+        counters['journal.dropped_events'] = _journal.JOURNAL.dropped
+        return {'counters': counters, 'gauges': gauges, 'hists': hists}
+
+    def _rebase(self, now):
+        self._prev = self._cumulative()
+        self._prev_t = now
+        self._prev_gen = self.registry.generation
+
+    def maybe_commit(self, now=None):
+        """Commit one window iff the interval has elapsed since the
+        last commit (or baseline). The engines call this at their
+        existing sync points with the perf_counter stamp already in
+        hand; the miss path is two compares (the unlocked interval
+        read is a benign race — the interval is re-checked under the
+        lock, so two threads sharing one ring can never double-commit
+        a degenerate zero-duration window). Returns the committed
+        window dict, or None."""
+        if not _metrics.enabled():
+            return None
+        if now is None:
+            now = time.perf_counter()
+        if (self._prev_t is not None
+                and now - self._prev_t < self.interval_s):
+            return None
+        return self._commit(now, require_interval=True)
+
+    def commit(self, now=None):
+        """Force-close the current window at `now` regardless of the
+        interval (tests and the dump tool use this for exact, clock-
+        independent arithmetic). A registry `reset()` since the last
+        baseline re-reads the baseline as zero — counters restarted,
+        so the delta IS the current cumulative value, never negative.
+        Returns the committed window dict, or None with telemetry
+        off."""
+        if not _metrics.enabled():
+            return None
+        if now is None:
+            now = time.perf_counter()
+        return self._commit(now, require_interval=False)
+
+    def _commit(self, now, require_interval):
+        with self._lock:
+            if self._prev_t is None:   # first call opens the window
+                self._rebase(now)
+                return None
+            if (require_interval
+                    and now - self._prev_t < self.interval_s):
+                return None            # another thread just committed
+            cur = self._cumulative()
+            prev = self._prev
+            if self._prev_gen != self.registry.generation:
+                prev = {'counters': {}, 'gauges': {}, 'hists': {}}
+            dt = max(now - self._prev_t, 1e-9)
+            window = {'idx': self._idx, 't0': self._prev_t, 't1': now,
+                      'dur_s': dt, 'counters': {}, 'gauges': {},
+                      'hists': {}}
+            for name, v in cur['counters'].items():
+                # clamped at 0: registry counters only shrink across a
+                # reset (caught by the generation check above), but the
+                # journal-overflow pseudo-counter can also shrink on a
+                # JOURNAL.clear() — a negative "events dropped" rate is
+                # never the truthful answer
+                d = max(v - prev['counters'].get(name, 0), 0)
+                window['counters'][name] = {'delta': d, 'rate': d / dt}
+            window['gauges'] = dict(cur['gauges'])
+            for name, (counts, count, total) in cur['hists'].items():
+                pc, pn, ps = prev['hists'].get(
+                    name, ((0,) * len(counts), 0, 0.0))
+                if len(pc) != len(counts):    # re-registered, new buckets
+                    pc = (0,) * len(counts)
+                dcounts = [c - p for c, p in zip(counts, pc)]
+                dcount = count - pn
+                dsum = total - ps
+                edges = self._edges[name]
+                window['hists'][name] = {
+                    'count': dcount, 'sum': dsum,
+                    'rate': dcount / dt,
+                    'mean': (dsum / dcount) if dcount > 0 else None,
+                    'p50': percentile_from_buckets(edges, dcounts, 50),
+                    'p95': percentile_from_buckets(edges, dcounts, 95),
+                    'p99': percentile_from_buckets(edges, dcounts, 99),
+                    'buckets': dcounts,
+                }
+            self._ring.append(window)
+            self._idx += 1
+            self._prev = cur
+            self._prev_t = now
+            self._prev_gen = self.registry.generation
+            # published INSIDE the ring lock: two threads sharing the
+            # process-default ring must publish in window order, or a
+            # descheduled earlier committer could overwrite a newer
+            # window's serve.tok_s with stale rates. Lock order is
+            # ring -> registry only (the registry never takes a ring
+            # lock), so no inversion is possible.
+            if self.derive:
+                self._publish_derived(window)
+        return window
+
+    def _publish_derived(self, window):
+        """Windowed rates back into THIS ring's registry as gauges —
+        the live `serve.tok_s` a fleet router polls off `/metrics`.
+        Published into `self.registry` (not the process global), so
+        the private-registry isolation recipe carries its own rate
+        gauges instead of clobbering another replica's."""
+        if not _metrics.enabled():
+            return
+        ctrs = window['counters']
+        for counter, gauge in DERIVED_RATES:
+            c = ctrs.get(counter)
+            if c is not None:
+                self.registry.gauge(gauge).set(c['rate'])
+        terms = [ctrs[n]['delta'] for n in _TERMINAL_COUNTERS if n in ctrs]
+        total = sum(terms)
+        if total > 0:
+            failed = ctrs.get('serve.failed', {}).get('delta', 0)
+            self.registry.gauge('serve.err_rate').set(failed / total)
+
+    # -- reading -----------------------------------------------------------
+
+    def __len__(self):
+        return len(self._ring)
+
+    def last(self):
+        """The most recently committed window, or None."""
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def windows(self, n=None):
+        """The last `n` committed windows, oldest first (all of the
+        ring when n is None)."""
+        with self._lock:
+            ws = list(self._ring)
+        return ws if n is None else ws[-int(n):]
+
+    def rate(self, name, windows=1):
+        """Average per-second rate of counter `name` over the last
+        `windows` committed windows (delta sums over duration sums);
+        None when nothing is committed or the counter never appeared."""
+        ws = self.windows(windows)
+        ds = [w['counters'][name]['delta'] for w in ws
+              if name in w['counters']]
+        if not ds:
+            return None
+        dur = sum(w['dur_s'] for w in ws if name in w['counters'])
+        return sum(ds) / dur if dur > 0 else None
+
+    def delta(self, name, windows=1):
+        """Summed counter (or histogram-count) delta over the last
+        `windows` windows; None when the metric never appeared."""
+        ws = self.windows(windows)
+        out = None
+        for w in ws:
+            if name in w['counters']:
+                out = (out or 0) + w['counters'][name]['delta']
+            elif name in w['hists']:
+                out = (out or 0) + w['hists'][name]['count']
+        return out
+
+    def gauge(self, name):
+        """Gauge value as of the last committed window, or None."""
+        w = self.last()
+        return w['gauges'].get(name) if w else None
+
+    def wpercentile(self, name, p, windows=1):
+        """Rolling percentile of histogram `name` over the last
+        `windows` windows' MERGED bucket deltas — the SLO view
+        ('p99 TTFT over the last minute'), immune to everything the
+        cumulative histogram absorbed before that."""
+        ws = self.windows(windows)
+        merged = None
+        for w in ws:
+            h = w['hists'].get(name)
+            if h is None:
+                continue
+            if merged is None:
+                merged = list(h['buckets'])
+            else:
+                merged = [a + b for a, b in zip(merged, h['buckets'])]
+        if merged is None:
+            return None
+        return percentile_from_buckets(self._edges[name], merged, p)
+
+    def snapshot(self):
+        """JSON-able view of the ring — the timeseries.json artifact."""
+        return {'interval_s': self.interval_s,
+                'max_windows': self.max_windows,
+                'committed': self._idx,
+                'windows': self.windows()}
+
+    def to_json(self, **kw):
+        return json.dumps(self.snapshot(), **kw)
+
+    def reset(self):
+        """Drop the ring and the baseline (test isolation)."""
+        with self._lock:
+            self._ring.clear()
+            self._idx = 0
+            self._prev = None
+            self._prev_t = None
+            self._prev_gen = None
+
+
+# process default: fed by every engine without a private operability
+# config (ServingEngine steps and TrainEngine sync() both call
+# maybe_commit on it), read by tools/telemetry_dump.py and the
+# standalone ops server
+TIMESERIES = WindowedTimeseries()
+
+
+def maybe_commit(now=None):
+    """Module-level convenience over the process-default ring."""
+    return TIMESERIES.maybe_commit(now)

@@ -1,0 +1,440 @@
+"""SLO watchdog — declarative health rules over the windowed
+timeseries, with a machine-readable verdict.
+
+The flight recorder (PR 12) answers "what happened to request 1742";
+this module answers the question a supervisor, router, or autoscaler
+asks every second: "is this engine healthy RIGHT NOW — yes or no, and
+if no, which contract is it breaking?" The shape is the SRE standard:
+declarative rules over windowed metrics with hysteresis, evaluated at
+window-commit granularity, breach/recovery EDGES journaled and
+counted, verdict served by `/healthz` (httpd.py).
+
+An `SLORule` is one inequality over one windowed expression:
+
+    SLORule('ttft_p99', 'p99(serve.ttft_ms)', '>', 500.0,
+            for_windows=3, clear_windows=2)
+
+Expression forms (all evaluated against ONE committed window, plus
+the ring for rolling forms):
+
+    rate(counter)      per-second rate of the window's counter delta
+    delta(counter)     the window's counter (or histogram-count) delta
+    gauge(name)        gauge value as of the window  (alias: value)
+    counter(name)      CUMULATIVE counter value (since boot)
+    p50/p95/p99(hist)  the window's interpolated percentile over the
+                       histogram's bucket DELTAS
+    mean(hist)         the window's mean observation
+    ratio(a, b)        delta(a) / delta(b); no-data when delta(b) == 0
+
+An expression that resolves to None — metric absent, empty window,
+zero denominator — is NO DATA: the rule reports 'no_data', its
+true-streak resets (missing evidence never pages), and an active
+breach is held until `clear_windows` consecutive HEALTHY windows
+actually clear it.
+
+Hysteresis: a rule breaches only after its condition holds for
+`for_windows` CONSECUTIVE windows, and recovers only after it fails
+for `clear_windows` consecutive windows — single-window blips neither
+page nor flap a recovery. Both edges journal a structured event
+(`slo_breach` / `slo_recovered`, rule + value + threshold) and tick
+`watchdog.breaches` / `watchdog.recoveries`; every evaluated window
+ticks `watchdog.evaluations` and refreshes the `watchdog.healthy` /
+`watchdog.breaching_rules` gauges.
+
+A breach edge can additionally auto-dump a THROTTLED postmortem
+bundle through the PR-12 crash path (`postmortem_engine=` an engine
+with `postmortem_dir` set, `postmortem_min_interval_s` between
+dumps) — the incident bundle exists before anyone ssh'es in.
+
+Watchdog state is JSON-able (`snapshot_state()` / `load_state()`) and
+rides `ServingEngine.snapshot()`/`restore()`, so a restored standby
+continues the primary's health history: an active breach stays active
+across the failover instead of silently re-arming.
+
+Stdlib-only at import (no jax, no numpy), like the whole package.
+"""
+from __future__ import annotations
+
+import re
+import time
+
+from . import journal as _journal
+from . import metrics as _metrics
+
+__all__ = ['SLORule', 'Watchdog', 'default_serving_rules']
+
+_OPS = {
+    '>': lambda a, b: a > b,
+    '>=': lambda a, b: a >= b,
+    '<': lambda a, b: a < b,
+    '<=': lambda a, b: a <= b,
+    '==': lambda a, b: a == b,
+    '!=': lambda a, b: a != b,
+}
+
+_FNS = ('rate', 'delta', 'gauge', 'value', 'counter', 'mean',
+        'p50', 'p95', 'p99', 'ratio')
+
+_EXPR_RE = re.compile(
+    r'^\s*(?P<fn>[a-z0-9]+)\s*\(\s*(?P<a>[\w./-]+)'
+    r'\s*(?:,\s*(?P<b>[\w./-]+)\s*)?\)\s*$')
+
+
+class SLORule:
+    """One declarative SLO: windowed expression, comparison, threshold,
+    hysteresis. Immutable config; the mutable evaluation state lives in
+    the Watchdog so one ruleset object can serve many engines."""
+
+    def __init__(self, name, expr, op, threshold, *, for_windows=1,
+                 clear_windows=1, help=''):
+        self.name = str(name)
+        self.expr = str(expr)
+        m = _EXPR_RE.match(self.expr)
+        if not m or m.group('fn') not in _FNS:
+            raise ValueError(
+                f'rule {name!r}: unparseable expr {expr!r} — expected '
+                f'fn(metric) with fn in {_FNS} (ratio takes two)')
+        self._fn = m.group('fn')
+        self._a = m.group('a')
+        self._b = m.group('b')
+        if (self._fn == 'ratio') != (self._b is not None):
+            raise ValueError(
+                f'rule {name!r}: ratio(a, b) takes exactly two metrics; '
+                f'every other form takes one')
+        if op not in _OPS:
+            raise ValueError(
+                f'rule {name!r}: op {op!r} not in {sorted(_OPS)}')
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_windows = int(for_windows)
+        self.clear_windows = int(clear_windows)
+        if self.for_windows < 1 or self.clear_windows < 1:
+            raise ValueError(
+                f'rule {name!r}: for_windows and clear_windows must '
+                f'be >= 1')
+        self.help = help
+
+    def evaluate(self, window, ts=None):
+        """The expression's value for one committed window (None = no
+        data). `ts` (the WindowedTimeseries) backs the cumulative
+        `counter()` form's registry read."""
+        fn, a = self._fn, self._a
+
+        def delta_of(name):
+            # counter delta, or histogram observation-count delta —
+            # the same resolution delta() uses, so ratio() really is
+            # delta(a)/delta(b) for every metric kind that has one
+            c = window['counters'].get(name)
+            if c is not None:
+                return c['delta']
+            h = window['hists'].get(name)
+            return h['count'] if h is not None else None
+
+        if fn in ('rate', 'delta'):
+            c = window['counters'].get(a)
+            if c is not None:
+                return c[fn]
+            h = window['hists'].get(a)
+            if h is not None:
+                return h['rate'] if fn == 'rate' else h['count']
+            return None
+        if fn in ('gauge', 'value'):
+            return window['gauges'].get(a)
+        if fn == 'counter':
+            reg = ts.registry if ts is not None else _metrics.REGISTRY
+            m = reg.get(a)
+            return m.value if m is not None and m.kind == 'counter' else None
+        if fn == 'ratio':
+            num = delta_of(a)
+            den = delta_of(self._b)
+            if num is None or den is None or den == 0:
+                return None
+            return num / den
+        h = window['hists'].get(a)            # mean / p50 / p95 / p99
+        return h[fn] if h is not None else None
+
+    def config(self):
+        return {'expr': self.expr, 'op': self.op,
+                'threshold': self.threshold,
+                'for_windows': self.for_windows,
+                'clear_windows': self.clear_windows, 'help': self.help}
+
+
+def _fresh_state():
+    return {'state': 'ok', 'last': None, 'last_value': None,
+            'true_streak': 0, 'false_streak': 0, 'breaches': 0,
+            'recoveries': 0, 'breached_at_idx': None,
+            'windows_evaluated': 0}
+
+
+class Watchdog:
+    """Evaluates a ruleset against each committed window and holds the
+    per-rule breach state machine. `verdict()` is the machine-readable
+    health answer `/healthz` serves."""
+
+    def __init__(self, rules, *, postmortem_engine=None,
+                 postmortem_min_interval_s=300.0, on_breach=None,
+                 on_recover=None):
+        self.rules = list(rules)
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f'duplicate rule names in {names}')
+        self._state = {r.name: _fresh_state() for r in self.rules}
+        self.windows_evaluated = 0
+        self.breaches_total = 0
+        self.recoveries_total = 0
+        self.last_window_idx = None
+        # throttled auto-postmortem through the PR-12 crash path: the
+        # engine's own `_auto_postmortem` (bundle + journal event +
+        # serve.postmortems counter), at most one per min-interval so
+        # a flapping rule cannot fill the disk with bundles
+        self.postmortem_engine = postmortem_engine
+        self.postmortem_min_interval_s = float(postmortem_min_interval_s)
+        self._last_postmortem_t = None
+        self.on_breach = on_breach
+        self.on_recover = on_recover
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, window, ts=None):
+        """Run every rule against one committed window. Called by the
+        engines right after their timeseries commit — pure host
+        arithmetic on the window record, zero syncs, zero retraces.
+        Returns the list of rules that EDGED into breach this window
+        (usually empty)."""
+        edges = []
+        self.windows_evaluated += 1
+        self.last_window_idx = window['idx']
+        for rule in self.rules:
+            st = self._state[rule.name]
+            st['windows_evaluated'] += 1
+            value = rule.evaluate(window, ts)
+            st['last_value'] = value
+            if value is None:
+                # missing evidence: never counts TOWARD a breach, and
+                # never TOWARD a recovery either — both streaks reset,
+                # so breach still needs for_windows CONSECUTIVE
+                # breaching windows and recovery clear_windows
+                # CONSECUTIVE healthy ones, with actual data in each.
+                # An engine that stops reporting while breached stays
+                # breached.
+                st['last'] = 'no_data'
+                st['true_streak'] = 0
+                st['false_streak'] = 0
+                continue
+            cond = _OPS[rule.op](value, rule.threshold)
+            if cond:
+                st['last'] = 'breaching'
+                st['true_streak'] += 1
+                st['false_streak'] = 0
+                if (st['state'] == 'ok'
+                        and st['true_streak'] >= rule.for_windows):
+                    self._edge_breach(rule, st, window, value)
+                    edges.append(rule)
+            else:
+                st['last'] = 'ok'
+                st['false_streak'] += 1
+                st['true_streak'] = 0
+                if (st['state'] == 'breach'
+                        and st['false_streak'] >= rule.clear_windows):
+                    self._edge_recover(rule, st, window, value)
+        _metrics.inc('watchdog.evaluations')
+        breaching = self.breaching()
+        _metrics.set_gauge('watchdog.healthy',
+                           0.0 if breaching else 1.0)
+        _metrics.set_gauge('watchdog.breaching_rules', len(breaching))
+        return edges
+
+    def _edge_breach(self, rule, st, window, value):
+        st['state'] = 'breach'
+        st['breaches'] += 1
+        st['breached_at_idx'] = window['idx']
+        self.breaches_total += 1
+        _metrics.inc('watchdog.breaches')
+        _journal.record('slo_breach', rule=rule.name, expr=rule.expr,
+                        op=rule.op, threshold=rule.threshold,
+                        value=_num(value), windows=st['true_streak'],
+                        window_idx=window['idx'])
+        if self.on_breach is not None:
+            self.on_breach(rule, st)
+        self._maybe_postmortem(rule, value)
+
+    def _edge_recover(self, rule, st, window, value):
+        st['state'] = 'ok'
+        st['recoveries'] += 1
+        self.recoveries_total += 1
+        _metrics.inc('watchdog.recoveries')
+        # clamped at 0: after a snapshot/restore failover the carried
+        # breached_at_idx indexes the PRIMARY's ring while this ring
+        # restarted at 0 — the true duration spans two rings and is
+        # unknowable here, so report 0 rather than a negative count
+        since = st['breached_at_idx']
+        breached = (max(0, window['idx'] - since)
+                    if since is not None else None)
+        _journal.record('slo_recovered', rule=rule.name,
+                        value=_num(value),
+                        breached_windows=breached,
+                        window_idx=window['idx'])
+        if self.on_recover is not None:
+            self.on_recover(rule, st)
+
+    def _maybe_postmortem(self, rule, value):
+        eng = self.postmortem_engine
+        if eng is None or not getattr(eng, 'postmortem_dir', None):
+            return
+        now = time.perf_counter()
+        if (self._last_postmortem_t is not None
+                and now - self._last_postmortem_t
+                < self.postmortem_min_interval_s):
+            return
+        self._last_postmortem_t = now
+        try:
+            eng._auto_postmortem(RuntimeError(
+                f'slo breach: {rule.name} ({rule.expr} {rule.op} '
+                f'{rule.threshold}, value {_num(value)})'))
+        except Exception:       # noqa: BLE001 - forensics never crash serving
+            pass
+
+    # -- verdict / state ---------------------------------------------------
+
+    def breaching(self):
+        """Names of the rules currently in breach (sorted)."""
+        return sorted(n for n, st in self._state.items()
+                      if st['state'] == 'breach')
+
+    def healthy(self):
+        return not self.breaching()
+
+    def verdict(self):
+        """The machine-readable health answer: healthy iff NO rule is
+        in breach. What `/healthz` serializes (plus drain state, which
+        is the engine's, not the watchdog's)."""
+        breaching = self.breaching()
+        return {'healthy': not breaching, 'breaching': breaching,
+                'rules': len(self.rules),
+                'windows_evaluated': self.windows_evaluated,
+                'breaches_total': self.breaches_total,
+                'recoveries_total': self.recoveries_total,
+                'last_window_idx': self.last_window_idx}
+
+    def state(self):
+        """Per-rule config + live state — what `/slo` serves."""
+        return {r.name: {**r.config(), **self._state[r.name]}
+                for r in self.rules}
+
+    def snapshot_state(self):
+        """JSON-able mutable state (per-rule + totals) — rides
+        `ServingEngine.snapshot()` so a restored standby continues the
+        primary's health history."""
+        return {'schema': 1,
+                'rules': {n: dict(st) for n, st in self._state.items()},
+                'windows_evaluated': self.windows_evaluated,
+                'breaches_total': self.breaches_total,
+                'recoveries_total': self.recoveries_total}
+
+    def load_state(self, snap):
+        """Adopt a `snapshot_state()`. Rules are matched BY NAME:
+        state for rules this watchdog does not define is dropped, and
+        rules the snapshot never saw keep their fresh state (a standby
+        with an extended ruleset restores cleanly). Returns the number
+        of rules adopted."""
+        if not snap or snap.get('schema') != 1:
+            raise ValueError(
+                f"unsupported watchdog state schema "
+                f"{(snap or {}).get('schema')!r}")
+        adopted = 0
+        for name, st in (snap.get('rules') or {}).items():
+            mine = self._state.get(name)
+            if mine is None:
+                continue
+            for k in mine:
+                if k in st:
+                    mine[k] = st[k]
+            adopted += 1
+        self.windows_evaluated = int(snap.get('windows_evaluated', 0))
+        self.breaches_total = int(snap.get('breaches_total', 0))
+        self.recoveries_total = int(snap.get('recoveries_total', 0))
+        return adopted
+
+
+def _num(v):
+    """Journal-safe number: python float/int only (the journal's
+    primitives contract)."""
+    if isinstance(v, bool) or v is None:
+        return v
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+    return int(f) if f.is_integer() else round(f, 6)
+
+
+def default_serving_rules(*, engine=None, ttft_p99_ms=10_000.0,
+                          itl_p99_ms=1_000.0, error_rate=0.25,
+                          queue_depth=None, pool_pressure=1.0,
+                          mfu_floor=0.0, for_windows=3,
+                          clear_windows=2):
+    """The production serving ruleset (docs/observability.md catalogs
+    each row). Thresholds are keyword-tunable; the defaults are loose
+    ceilings meant to catch an engine that is WRONG, not one that is
+    merely busy:
+
+      - ttft_p99 / itl_p99: windowed p99 latency ceilings;
+      - error_rate: failed fraction of submissions in the window;
+      - steady_retraces: ANY compile.traces growth sustained for
+        `for_windows` windows — warmup bursts are shorter than the
+        hysteresis by construction, steady-state retraces are the
+        serving contract's cardinal sin;
+      - pool_pressure / queue_depth: saturation watermarks
+        (queue_depth defaults to 90% of the engine's max_queue when an
+        engine with a bounded queue is passed; unbounded configs get
+        no queue rule unless a threshold is given);
+      - trace_drops / journal_drops: observability self-health — the
+        forensics rings are overflowing, so the NEXT incident would be
+        blind (single-window trigger: any sustained growth pages);
+      - mfu_floor: `serve.mfu_est` below the floor while costs are
+        loaded (no data — costs absent — never breaches). The default
+        floor 0.0 makes the rule present-but-inert; give a real floor
+        once the deployment's expected MFU is known.
+    """
+    rules = [
+        SLORule('ttft_p99', 'p99(serve.ttft_ms)', '>', ttft_p99_ms,
+                for_windows=for_windows, clear_windows=clear_windows,
+                help='windowed p99 time-to-first-token ceiling (ms)'),
+        SLORule('itl_p99', 'p99(serve.itl_ms)', '>', itl_p99_ms,
+                for_windows=for_windows, clear_windows=clear_windows,
+                help='windowed p99 inter-token latency ceiling (ms)'),
+        SLORule('error_rate', 'ratio(serve.failed,serve.requests)', '>',
+                error_rate, for_windows=max(1, for_windows - 1),
+                clear_windows=clear_windows,
+                help='failed fraction of submissions in the window'),
+        SLORule('steady_retraces', 'delta(compile.traces)', '>', 0,
+                for_windows=max(3, for_windows),
+                clear_windows=clear_windows,
+                help='zero steady-state retraces: sustained trace '
+                     'growth means the jit keys are flapping'),
+        SLORule('pool_pressure', 'gauge(serve.pool_pressure)', '>=',
+                pool_pressure, for_windows=for_windows,
+                clear_windows=clear_windows,
+                help='KV pool at/over the admission watermark'),
+        SLORule('trace_drops', 'delta(trace.dropped_events)', '>', 0,
+                for_windows=1, clear_windows=clear_windows,
+                help='host-tracer ring overflowing (forensics at risk)'),
+        SLORule('journal_drops', 'delta(journal.dropped_events)', '>', 0,
+                for_windows=1, clear_windows=clear_windows,
+                help='flight-recorder ring overflowing'),
+        SLORule('mfu_floor', 'gauge(serve.mfu_est)', '<', mfu_floor,
+                for_windows=for_windows, clear_windows=clear_windows,
+                help='MFU below floor while dispatch costs are loaded'),
+    ]
+    if queue_depth is None and engine is not None:
+        mq = getattr(engine, 'max_queue', None)
+        if mq:
+            queue_depth = 0.9 * mq
+    if queue_depth is not None:
+        rules.append(SLORule(
+            'queue_depth', 'gauge(serve.queue_depth)', '>=',
+            queue_depth, for_windows=for_windows,
+            clear_windows=clear_windows,
+            help='request queue near its bound'))
+    return rules

@@ -68,6 +68,7 @@ from ..framework.tree import split_trainable
 from ..inference.engine import CompileCache, model_struct, model_tag
 from ..observability import journal as _journal
 from ..observability import metrics as _obs
+from ..observability import timeseries as _obs_ts
 from ..observability import tracing as _obs_trace
 
 # ---------------------------------------------------------------------------
@@ -758,6 +759,11 @@ class TrainEngine:
                     and s < self._last_scale_seen):
                 _obs.inc('train.scale_backoffs')
             self._last_scale_seen = s
+        # the windowed timeseries commits at THIS existing sync point
+        # (the training mirror of the serving per-window commit): the
+        # process-default ring derives train.tok_s and windowed
+        # train.step_ms percentiles with zero new syncs
+        _obs_ts.TIMESERIES.maybe_commit(now)
         self._window_t0 = None
         self._window_tokens = 0
         self._window_flops = 0.0

@@ -225,6 +225,149 @@ class TestPrometheus:
         assert 'serve_ttft_ms_count 2' in text
         assert '# HELP serve_tokens tokens committed' in text
 
+    def test_sanitization_collisions_disambiguated(self):
+        """Two distinct names sanitizing to one Prometheus name must
+        NOT emit duplicate series: every collider gets a deterministic
+        name-hash suffix, non-colliders keep their plain sanitized
+        name, and the collision warns once."""
+        import warnings
+
+        from paddle_tpu.observability.metrics import _COLLISIONS_WARNED
+
+        r = MetricsRegistry()
+        r.counter('serve.tok/s').inc(1)
+        r.counter('serve.tok_s').inc(2)
+        r.counter('serve.tokens').inc(3)
+        _COLLISIONS_WARNED.discard('serve_tok_s')
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter('always')
+            text = r.to_prometheus()
+        assert any('serve_tok_s' in str(w.message) for w in caught)
+        series = {ln.split()[0]: ln.split()[1]
+                  for ln in text.splitlines() if not ln.startswith('#')}
+        # both colliders present, under DISTINCT suffixed names
+        suffixed = sorted(k for k in series
+                          if k.startswith('serve_tok_s_'))
+        assert len(suffixed) == 2 and len(set(suffixed)) == 2
+        assert {series[k] for k in suffixed} == {'1', '2'}
+        assert 'serve_tok_s' not in series      # no bare duplicate
+        assert series['serve_tokens'] == '3'    # non-collider untouched
+        # deterministic: a second exposition maps identically
+        with warnings.catch_warnings():
+            warnings.simplefilter('ignore')
+            assert r.to_prometheus() == text
+
+    def test_exposition_safe_under_concurrent_registration(self):
+        """The ops-server scrape thread runs to_prometheus()/snapshot()
+        while the scheduler lazily registers metrics — the name set is
+        copied under the registry lock, so the scrape can never die
+        with 'dictionary changed size during iteration' at exactly the
+        state-transition moments a scrape cares about."""
+        import threading
+
+        r = MetricsRegistry()
+        stop = threading.Event()
+
+        def churn():
+            # fresh registries in a cycle: every loop REGISTERS new
+            # names (the racing mutation), but the registry stays
+            # small so the scrape side stays O(small) per call
+            while not stop.is_set():
+                with r._lock:
+                    r._metrics.clear()
+                for i in range(32):
+                    r.counter(f'm{i}').inc()
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for _ in range(300):
+                r.to_prometheus()
+                r.snapshot()
+                r.names()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_histogram_suffix_row_collisions_disambiguated(self):
+        """A counter literally named `x_count` collides with histogram
+        `x`'s derived `_count` row — collision detection covers every
+        series a metric EMITS, not just base names."""
+        import warnings
+
+        from paddle_tpu.observability.metrics import _COLLISIONS_WARNED
+
+        r = MetricsRegistry()
+        r.histogram('serve.ttft_ms', buckets=(1.0,)).observe(0.5)
+        r.counter('serve.ttft_ms_count').inc(7)
+        _COLLISIONS_WARNED.discard('serve_ttft_ms')
+        _COLLISIONS_WARNED.discard('serve_ttft_ms_count')
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter('always')
+            text = r.to_prometheus()
+        assert caught
+        samples = {}
+        for ln in text.splitlines():
+            if not ln.startswith('#'):
+                name, value = ln.rsplit(maxsplit=1)
+                assert name not in samples, f'duplicate series {name!r}'
+                samples[name] = value
+        # both metrics present under distinct (suffixed) names, the
+        # histogram's _count row included
+        assert any(k.startswith('serve_ttft_ms_count_')
+                   and samples[k] == '7' for k in samples)
+        assert any(k.startswith('serve_ttft_ms_')
+                   and k.endswith('_count') and samples[k] == '1'
+                   for k in samples)
+
+    def test_help_text_escaped(self):
+        r = MetricsRegistry()
+        r.counter('c', help='line one\nback\\slash').inc(1)
+        text = r.to_prometheus()
+        assert '# HELP c line one\\nback\\\\slash' in text
+        # the exposition stays one-row-per-line parseable
+        assert all(ln.startswith(('#', 'c ')) for ln in
+                   text.strip().splitlines())
+
+    def test_exposition_round_trip(self):
+        """Parse the exposition text back and recover every value —
+        the format contract a real scraper depends on: one unique
+        series name per sample row, TYPE emitted exactly once per
+        name, histogram bucket rows cumulative and capped by +Inf."""
+        r = MetricsRegistry()
+        r.counter('serve.tokens', help='tokens').inc(42)
+        r.gauge('pool.utilization').set(0.25)
+        h = r.histogram('serve.ttft_ms', buckets=(1.0, 10.0),
+                        help='ttft with\nnewline')
+        h.observe(0.5, n=3)
+        h.observe(5.0, n=2)
+        h.observe(100.0)
+        text = r.to_prometheus()
+
+        types, samples = {}, {}
+        for ln in text.splitlines():
+            if ln.startswith('# TYPE'):
+                _, _, name, kind = ln.split(maxsplit=3)
+                assert name not in types, f'duplicate TYPE for {name}'
+                types[name] = kind
+            elif ln.startswith('# HELP'):
+                _, _, name, help_text = ln.split(maxsplit=3)
+                assert '\n' not in help_text
+            elif ln:
+                name, value = ln.rsplit(maxsplit=1)
+                assert name not in samples, f'duplicate series {name!r}'
+                samples[name] = float(value)
+        assert types == {'serve_tokens': 'counter',
+                         'pool_utilization': 'gauge',
+                         'serve_ttft_ms': 'histogram'}
+        assert samples['serve_tokens'] == 42
+        assert samples['pool_utilization'] == 0.25
+        assert samples['serve_ttft_ms_bucket{le="1.0"}'] == 3
+        assert samples['serve_ttft_ms_bucket{le="10.0"}'] == 5
+        assert samples['serve_ttft_ms_bucket{le="+Inf"}'] == 6
+        assert samples['serve_ttft_ms_count'] == 6
+        assert samples['serve_ttft_ms_sum'] == pytest.approx(111.5)
+
 
 # ---------------------------------------------------------------------------
 # Host tracer
@@ -542,7 +685,8 @@ class TestMetaTracelint:
         # above covers them, but pin the instrumentation baseline at
         # zero BY NAME so a future per-file baseline bump here is loud
         obs_dir = os.path.join(REPO, 'paddle_tpu', 'observability')
-        for name in ('journal.py', 'costs.py', 'postmortem.py'):
+        for name in ('journal.py', 'costs.py', 'postmortem.py',
+                     'timeseries.py', 'watchdog.py', 'httpd.py'):
             vs = lint_paths([os.path.join(obs_dir, name)], root=REPO)
             assert vs == [], (
                 f'{name} must stay tracelint-clean:\n'
@@ -554,13 +698,16 @@ class TestMetaTracelint:
         level by design; tracing only reaches for jax inside
         annotate(), costs only inside its device/lowering helpers."""
         import paddle_tpu.observability.costs as c
+        import paddle_tpu.observability.httpd as hs
         import paddle_tpu.observability.journal as j
         import paddle_tpu.observability.metrics as m
         import paddle_tpu.observability.postmortem as p
+        import paddle_tpu.observability.timeseries as s
         import paddle_tpu.observability.tracing as t
+        import paddle_tpu.observability.watchdog as w
 
         assert 'import jax' not in open(m.__file__).read()
-        for mod in (t, j, c, p):
+        for mod in (t, j, c, p, s, w, hs):
             top_level = [ln for ln in open(mod.__file__).read().splitlines()
                          if ln.startswith(('import ', 'from '))]
             assert not any('jax' in ln for ln in top_level), mod.__name__

@@ -14,17 +14,30 @@ with telemetry on, then writes two artifacts into --out:
                        jax.profiler device trace (docs/observability.md
                        shows the overlay recipe)
     telemetry.prom   — Prometheus text exposition of the same registry
-                       (what a scrape endpoint would serve)
+                       (what the /metrics ops endpoint serves)
     journal.jsonl    — the flight-recorder event journal (scheduler
                        decisions, allocator ops, compile events, one
                        line per event; `trail(rid)` material)
+    timeseries.json  — the windowed-timeseries ring (per-window counter
+                       deltas/rates, gauge values, rolling histogram
+                       percentiles — the live view /statusz serves)
     postmortem/      — a full postmortem bundle of the run (what the
                        crash path would auto-dump; validate/pretty-
                        print with tools/postmortem.py)
 
 The run also measures the engine's per-geometry dispatch costs
-(observability.costs) and prints the resulting live cost gauges
-(serve.mfu_est / model_flops_per_s / roofline_intensity).
+(observability.costs), prints the resulting live cost gauges
+(serve.mfu_est / model_flops_per_s / roofline_intensity), and runs
+the workload under the default SLO watchdog: the verdict and every
+rule's state are printed, and the engine's ops endpoint is scraped
+once (/healthz + /metrics) to prove the served verdict matches the
+in-process one.
+
+Exit code contract (calling automation keys off it):
+    0 — artifacts written, watchdog verdict healthy;
+    1 — artifacts written, but an SLO rule is in ACTIVE breach at the
+        end of the run (the printed rule states say which);
+    2 — no usable jax backend (nothing ran; retry with --cpu).
 
 Importable anywhere (pytest collection, tracelint) without touching a
 backend — only main() initialises jax, and the same rc-2 guard
@@ -78,10 +91,15 @@ def run_workload(n_requests=16, decode_window=8, seed=0, tp=1):
     # layout) — the dumped telemetry/journal then carries the sharded
     # engine's gauges; kv_heads=2 in the tiny model, so tp=2 is the
     # largest degree that still head-shards
+    # watchdog=True arms the default serving SLO ruleset over a
+    # private windowed ring (50ms windows so even this tiny workload
+    # commits several) — the dump's verdict/ruleset printout and the
+    # timeseries.json artifact both come from it
     srv = ServingEngine(model, max_slots=4, block_size=8,
                         max_context_len=48, max_new_tokens=16,
                         decode_window=decode_window,
                         prefix_cache=True, prefill_chunk=16,
+                        watchdog=True, ts_interval_s=0.05,
                         **({'tp': int(tp)} if tp and int(tp) > 1 else {}))
     rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
     srv.run()
@@ -166,6 +184,16 @@ def main(argv=None):
     with open(ppath, 'w') as f:
         f.write(obs.REGISTRY.to_prometheus())
     jpath = obs_journal.save(os.path.join(args.out, 'journal.jsonl'))
+    # close the tail window so the run's last partial interval is in
+    # the ring — and run the watchdog over it, so a breach that
+    # manifests only in the final <interval slice still flips the
+    # verdict (the rc-1 contract below) — then dump the windowed view
+    w = srv._ts.commit()
+    if w is not None:
+        srv._watchdog.evaluate(w, srv._ts)
+    spath = os.path.join(args.out, 'timeseries.json')
+    with open(spath, 'w') as f:
+        f.write(srv._ts.to_json(indent=2))
     bdir = os.path.join(args.out, 'postmortem')
     obs_pm.dump_bundle(bdir, engine=srv,
                        reason='telemetry_dump reference bundle')
@@ -210,12 +238,51 @@ def main(argv=None):
     print(f'journal events   {len(obs_journal.JOURNAL)} '
           f'({len(obs_journal.JOURNAL.trails())} trails, '
           f'{obs_journal.JOURNAL.dropped} dropped)')
+    print(f'windows          {len(srv._ts)} committed '
+          f'(interval {srv._ts.interval_s}s)')
+    print(f'serve.tok_s      '
+          f'{snap.get("serve.tok_s", {}).get("value")}')
+
+    # the SLO watchdog verdict + per-rule states, and one scrape of
+    # the live ops endpoint to prove the SERVED verdict matches
+    verdict = srv._watchdog.verdict()
+    print(f'watchdog         '
+          f'{"HEALTHY" if verdict["healthy"] else "BREACH"} '
+          f'({verdict["windows_evaluated"]} windows evaluated, '
+          f'{verdict["breaches_total"]} breach(es), '
+          f'{verdict["recoveries_total"]} recovery(ies))')
+    for name, st in sorted(srv._watchdog.state().items()):
+        print(f'  rule {name:<18} {st["state"]:<7} '
+              f'last={st["last"]} value={st["last_value"]} '
+              f'({st["expr"]} {st["op"]} {st["threshold"]})')
+    try:
+        import urllib.request
+
+        from paddle_tpu.observability.httpd import start_ops_server
+
+        ops = start_ops_server(srv)
+        try:
+            code = urllib.request.urlopen(
+                ops.url('/healthz'), timeout=5).status
+        except urllib.error.HTTPError as e:  # 503 on breach IS the answer
+            code = e.code
+        prom = urllib.request.urlopen(
+            ops.url('/metrics'), timeout=5).read().decode()
+        print(f'ops endpoint     /healthz {code}, /metrics '
+              f'{len(prom.splitlines())} lines (port {ops.port})')
+        ops.close()
+    except Exception as e:  # noqa: BLE001 - the scrape is a demo, not a gate
+        print(f'ops endpoint     scrape failed: {e!r}')
+
     print(f'wrote {tpath}')
     print(f'wrote {hpath}')
     print(f'wrote {ppath}')
     print(f'wrote {jpath}')
+    print(f'wrote {spath}')
     print(f'wrote {bdir}/ (postmortem bundle)')
-    return 0
+    # rc contract: 1 = artifacts written but an SLO rule is in active
+    # breach (0 healthy, 2 no backend — see module docstring)
+    return 0 if verdict['healthy'] else 1
 
 
 if __name__ == '__main__':
