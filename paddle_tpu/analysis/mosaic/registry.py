@@ -141,6 +141,31 @@ def _build_paged(quant=False):
     return build
 
 
+def _build_paged_rowscale():
+    """The QuantPagedKVCache variant: int8 pages whose PER-ROW scales
+    ride in page-shaped (NB, Hkv, BS) pools, the scale block prefetched
+    by the same block-table index map as its page — the serving
+    engine's kv_cache_dtype='int8' decode dispatch."""
+    def build():
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention)
+
+        slots, Hkv, D, Hq = 8, 8, 128, 32
+        BS = 32                              # int8 sublane = 32
+        maxb = 2048 // BS
+        NB = slots * maxb + 1
+        q = _sds((slots, 1, Hq, D), 'bfloat16')
+        cache = _sds((NB, Hkv, BS, D), 'int8')
+        tbl = _sds((slots, maxb), 'int32')
+        lens = _sds((slots,), 'int32')
+        scale = _sds((NB, Hkv, BS), 'float32')
+        return (lambda q, k, v, t, c, ks, vs: paged_decode_attention(
+                    q, k, v, t, c, k_scale=ks, v_scale=vs),
+                (q, cache, cache, tbl, lens, scale, scale), {})
+
+    return build
+
+
 def _build_paged_serving(quant=False):
     """The ServingEngine block-table call pattern at a production-scale
     serving geometry: 8 in-flight slots, 2048-token contexts over
@@ -412,6 +437,8 @@ ENTRIES = (
           onchip=_onchip_serve_decode),
     Entry('paged_attention/serve_decode_int8', _PAGED,
           _build_paged_serving(quant=True)),
+    Entry('paged_attention/serve_decode_int8_rowscale', _PAGED,
+          _build_paged_rowscale()),
     Entry('paged_attention/headmajor', _HEADMAJOR, _build_headmajor,
           onchip=_onchip_headmajor),
     Entry('quant_matmul/int8', _QMM, _build_quant_matmul('int8')),

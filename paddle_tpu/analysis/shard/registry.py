@@ -339,8 +339,6 @@ def _serving_fixture():
     mirrors, budgets, rng — fully REPLICATED: exactly the layout
     `ServingEngine(tp=...)` serves with, so the census this compiles
     IS the per-window wire cost of the live engine."""
-    import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
@@ -366,13 +364,19 @@ def _serving_fixture():
         'rep': NamedSharding(mesh, P()),
         'logits': _sds((K, 128), 'float32'),
         'vec': _sds((K,), 'int32'),
+        'fvec': _sds((K,), 'float32'),
+        'svec': _sds((K,), 'uint32'),
         'live': _sds((K,), 'bool'),
         'btab': _sds((K, MAXB), 'int32'),
-        'rng': jax.ShapeDtypeStruct((2,), jnp.uint32),
-        'statics': dict(window=4, temperature=0.0, top_k=0, top_p=1.0,
-                        eos_token_id=2),
+        # per-request sampling params ride as replicated DEVICE data
+        # (PR 15): temp/topk/topp/seed/plen — the statics shrink to
+        # the truly static window/eos pair
+        'statics': dict(window=4, eos_token_id=2),
         'K': K,
     }
+    # temp, topk, topp, seed, plen — appended to every dispatch
+    shapes['samp'] = (shapes['fvec'], shapes['vec'], shapes['fvec'],
+                      shapes['svec'], shapes['vec'])
     return shapes
 
 
@@ -384,9 +388,11 @@ def _build_serving_serve_step():
     statics, Sb = f['statics'], 16
 
     def serve_step(model, pages, logits, ids, real_len, btabs, slots,
-                   btab, ctx, live, budget, rng):
+                   btab, ctx, live, budget, temp, topk, topp, seed,
+                   plen):
         return body(model, pages, logits, ids, real_len, btabs, slots,
-                    btab, ctx, live, budget, rng, **statics)
+                    btab, ctx, live, budget, temp, topk, topp, seed,
+                    plen, **statics)
 
     ids = _sds((f['K'], Sb), 'int32')
     rep = f['rep']
@@ -394,10 +400,9 @@ def _build_serving_serve_step():
         fn=serve_step,
         args=(f['model_sds'], f['pages'], f['logits'], ids, f['vec'],
               f['btab'], f['vec'], f['btab'], f['vec'], f['live'],
-              f['vec'], f['rng']),
+              f['vec']) + f['samp'],
         mesh=f['mesh'],
-        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
-                      rep, rep, rep, rep, rep, rep),
+        in_shardings=(f['model_sh'], f['pages_sh']) + (rep,) * 14,
     )
 
 
@@ -408,18 +413,18 @@ def _build_serving_serve_window():
     body = getattr(srv._serve_window, '__wrapped__', srv._serve_window)
     statics = f['statics']
 
-    def serve_window(model, pages, logits, btab, ctx, live, budget, rng):
-        return body(model, pages, logits, btab, ctx, live, budget, rng,
-                    **statics)
+    def serve_window(model, pages, logits, btab, ctx, live, budget,
+                     temp, topk, topp, seed, plen):
+        return body(model, pages, logits, btab, ctx, live, budget,
+                    temp, topk, topp, seed, plen, **statics)
 
     rep = f['rep']
     return Suite(
         fn=serve_window,
         args=(f['model_sds'], f['pages'], f['logits'], f['btab'],
-              f['vec'], f['live'], f['vec'], f['rng']),
+              f['vec'], f['live'], f['vec']) + f['samp'],
         mesh=f['mesh'],
-        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
-                      rep, rep),
+        in_shardings=(f['model_sh'], f['pages_sh']) + (rep,) * 10,
     )
 
 
@@ -433,10 +438,11 @@ def _build_serving_chunk_step():
 
     def chunk_step(model, pages, logits, ids, chunk_len, start, btabs,
                    slots, cow_src, cow_dst, btab, ctx, live, budget,
-                   rng):
+                   temp, topk, topp, seed, plen, ftok, forced):
         return body(model, pages, logits, ids, chunk_len, start, btabs,
                     slots, cow_src, cow_dst, btab, ctx, live, budget,
-                    rng, ctx_bucket=Sb, **statics)
+                    temp, topk, topp, seed, plen, ftok, forced,
+                    ctx_bucket=Sb, **statics)
 
     ids = _sds((f['K'], Cb), 'int32')
     rep = f['rep']
@@ -444,10 +450,53 @@ def _build_serving_chunk_step():
         fn=chunk_step,
         args=(f['model_sds'], f['pages'], f['logits'], ids, f['vec'],
               f['vec'], f['btab'], f['vec'], f['vec'], f['vec'],
-              f['btab'], f['vec'], f['live'], f['vec'], f['rng']),
+              f['btab'], f['vec'], f['live'], f['vec']) + f['samp']
+             + (f['vec'], f['live']),
         mesh=f['mesh'],
-        in_shardings=(f['model_sh'], f['pages_sh'], rep, rep, rep, rep,
-                      rep, rep, rep, rep, rep, rep, rep, rep, rep),
+        in_shardings=(f['model_sh'], f['pages_sh']) + (rep,) * 19,
+    )
+
+
+def _build_serving_spec_step():
+    """The speculative serving dispatch (PR 15): draft propose (k+1
+    paged single-token steps on the DRAFT model) + target verify (one
+    (K, k+1) forward over the gathered prefix) + the commit rule, all
+    in one fused program over head-sharded pools for BOTH models. The
+    census is the megatron forward count of draft + target work: the
+    draft scan contributes its per-layer all-reduces k+1 times, the
+    verify once."""
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._serve_spec_window, '__wrapped__',
+                   srv._serve_spec_window)
+    k = 2
+
+    def spec_window(model, dmodel, pages, dpages, logits, ftok, forced,
+                    btab, ctx, live, budget, temp, topk, topp, seed,
+                    plen):
+        return body(model, dmodel, pages, dpages, logits, ftok, forced,
+                    btab, ctx, live, budget, temp, topk, topp, seed,
+                    plen, k=k, ctx_bucket=16,
+                    eos_token_id=f['statics']['eos_token_id'])
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.parallel import model_shardings
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    pt.seed(1)
+    dmodel = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, layers=1, heads=8, kv_heads=8,
+        intermediate_size=128, max_pos=64))
+    rep = f['rep']
+    return Suite(
+        fn=spec_window,
+        args=(f['model_sds'], _sds_like(dmodel), f['pages'],
+              f['pages'][:1], f['logits'], f['vec'], f['live'],
+              f['btab'], f['vec'], f['live'], f['vec']) + f['samp'],
+        mesh=f['mesh'],
+        in_shardings=(f['model_sh'], model_shardings(dmodel, f['mesh']),
+                      f['pages_sh'], f['pages_sh']) + (rep,) * 12,
     )
 
 
@@ -508,15 +557,36 @@ ENTRIES = (
     # ctx) — nothing else may appear: an undeclared reduce-scatter or
     # a count bump here is a resharded pool or a broken pin, the
     # regression this suite exists to catch before a real pod does.
+    # PR 15 moved the sampling params from jit statics to replicated
+    # per-slot DEVICE data: each window body gained one all-reduce
+    # (the batched nucleus-filter's row reductions over the
+    # vocab-parallel logits), a handful of sub-KB all-gather pins on
+    # the sampling-path outputs, and 4 byte-scale collective-permutes
+    # from the per-row threefry fold_in lowering — all flat in batch
+    # and model size. Counts stay exact; byte ceilings carry ~25%
+    # headroom over the measured payload.
     Entry('serving/serve_step_tp', _SRV, _build_serving_serve_step,
-          budget={'all-reduce': {'count': 10, 'bytes': 112 * KB},
-                  'all-gather': {'count': 4, 'bytes': 8 * KB}}),
+          budget={'all-reduce': {'count': 11, 'bytes': 112 * KB},
+                  'all-gather': {'count': 8, 'bytes': 12 * KB},
+                  'collective-permute': {'count': 4, 'bytes': KB}}),
     Entry('serving/serve_window_tp', _SRV, _build_serving_serve_window,
-          budget={'all-reduce': {'count': 5, 'bytes': 8 * KB},
-                  'all-gather': {'count': 3, 'bytes': 4 * KB}}),
+          budget={'all-reduce': {'count': 6, 'bytes': 9 * KB},
+                  'all-gather': {'count': 7, 'bytes': 9 * KB},
+                  'collective-permute': {'count': 4, 'bytes': KB}}),
     Entry('serving/serve_chunk_step_tp', _SRV, _build_serving_chunk_step,
-          budget={'all-reduce': {'count': 10, 'bytes': 64 * KB},
-                  'all-gather': {'count': 4, 'bytes': 8 * KB}}),
+          budget={'all-reduce': {'count': 11, 'bytes': 60 * KB},
+                  'all-gather': {'count': 8, 'bytes': 12 * KB},
+                  'collective-permute': {'count': 4, 'bytes': KB}}),
+    # the speculative window: the 1-layer draft's scan contributes its
+    # per-layer megatron all-reduces once per fused draft step (k+1 =
+    # 3), the 2-layer target verify once, plus the sampling-path
+    # reductions — 17 sites measured exactly; all-gathers are the
+    # host-facing replication pins (cand/ncommit/next_tok/logits/ctx +
+    # both pools), permutes the two models' fold_in lowerings
+    Entry('serving/serve_spec_step_tp', _SRV, _build_serving_spec_step,
+          budget={'all-reduce': {'count': 17, 'bytes': 29 * KB},
+                  'all-gather': {'count': 15, 'bytes': 30 * KB},
+                  'collective-permute': {'count': 8, 'bytes': KB}}),
 )
 
 

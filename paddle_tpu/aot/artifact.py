@@ -195,9 +195,11 @@ def _register_export_containers():
     the same class raises and is swallowed)."""
     from jax import export as jax_export
 
-    from ..models.generation import PagedKVCache, QuantKVCache
+    from ..models.generation import (PagedKVCache, QuantKVCache,
+                                     QuantPagedKVCache, RowQuantKVCache)
 
-    for cls in (PagedKVCache, QuantKVCache):
+    for cls in (PagedKVCache, QuantKVCache, QuantPagedKVCache,
+                RowQuantKVCache):
         try:
             jax_export.register_namedtuple_serialization(
                 cls, serialized_name=f'paddle_tpu.{cls.__name__}')

@@ -41,6 +41,14 @@ class Geometry:
                        buckets the per-step token width, `bucket` the
                        contiguous temp-cache length — the largest end
                        position in the batch)
+      serve_spec_step  spec, bucket, ctx (speculative propose/verify
+                       window fused with an admission prefill: `spec`
+                       is the draft window k, `bucket` the admission
+                       prefill bucket, `ctx` the verify's gathered
+                       temp-cache length — bucket(max live context +
+                       k + 1))
+      serve_spec_window spec, ctx (a pure speculative window, no
+                       admissions this step)
       train_step       input_shapes, input_dtypes, label_shapes,
                        label_dtypes (shape entries are tuples/lists of int)
     """
@@ -160,6 +168,12 @@ def _registry_key(engine, g):
     if g.kind == 'serve_chunk_step':
         return engine.registry_key('serve_chunk_step', p['window'],
                                    p['chunk'], p['bucket'])
+    if g.kind == 'serve_spec_step':
+        return engine.registry_key('serve_spec_step', p['spec'],
+                                   p['bucket'], p['ctx'])
+    if g.kind == 'serve_spec_window':
+        return engine.registry_key('serve_spec_window', p['spec'],
+                                   p['ctx'])
     if g.kind == 'train_step':
         return engine.registry_key(p['input_shapes'][0],
                                    p['input_dtypes'][0])
@@ -223,7 +237,8 @@ def for_decode_engine(engine, prompt_lens, batch_sizes=(1,),
 
 
 def for_serving_engine(engine, prompt_lens=None,
-                       include_standalone_prefill=True):
+                       include_standalone_prefill=True,
+                       max_new_tokens=None):
     """Geometries a ServingEngine dispatches: one fused admit+decode
     step per admission bucket, the pure decode window, (when
     `include_standalone_prefill`) the standalone prefill each bucket
@@ -251,6 +266,7 @@ def for_serving_engine(engine, prompt_lens=None,
     prompt_lens = [int(L) for L in prompt_lens]
     chunk = getattr(engine, 'prefill_chunk', None)
     prefix = bool(getattr(engine, 'prefix_cache', False))
+    spec = getattr(engine, 'spec_window', None)
     mono_lens = (prompt_lens if chunk is None
                  else [L for L in prompt_lens if L <= chunk])
     buckets = []
@@ -258,9 +274,52 @@ def for_serving_engine(engine, prompt_lens=None,
         b = bucket_length(L, engine.buckets)
         if b not in buckets:
             buckets.append(b)
-    entries = [Geometry('serve_step', window=W, bucket=b)
-               for b in buckets]
-    entries.append(Geometry('serve_window', window=W))
+    if spec is None:
+        entries = [Geometry('serve_step', window=W, bucket=b)
+                   for b in buckets]
+        entries.append(Geometry('serve_window', window=W))
+    else:
+        # a speculative engine dispatches serve_spec_step /
+        # serve_spec_window on every non-chunk iteration — the plain
+        # serve_step/serve_window executables are never reached, so
+        # enumerating them would stamp dead executables into the
+        # artifact. The verify's gathered temp-cache length is
+        # bucket(max live context + k + 1): live contexts M run from
+        # the smallest declared admission length up to the largest
+        # context a still-decoding row can hold — min(max prompt +
+        # max_new_tokens, max_context_len) - 1 (a live row always has
+        # >= 1 token of budget left), honoring per-call
+        # `max_new_tokens` overrides when declared.
+        k = int(spec)
+        mnts = (max_new_tokens if isinstance(max_new_tokens,
+                                             (list, tuple))
+                else [max_new_tokens])
+        budget = max(engine.max_new_tokens if m is None else int(m)
+                     for m in mnts)
+        m_lo = min(prompt_lens)
+        m_hi = min(max(prompt_lens) + budget,
+                   engine.max_context_len) - 1
+        ladder, v = [], m_lo + k + 1
+        while v <= m_hi + k + 1:
+            b = bucket_length(v, engine.buckets)
+            ladder.append(b)
+            v = b + 1
+        entries = []
+        # fused admission + spec window: the verify bucket can never
+        # sit below the smallest context this admission bucket can
+        # contribute (the admitted row is live, so max-live-ctx >= its
+        # own length); every ladder entry at or above that floor is
+        # reachable by batching the admission with a longer-context
+        # in-flight row
+        for Sb in buckets:
+            lmin = min(L for L in mono_lens
+                       if bucket_length(L, engine.buckets) == Sb)
+            floor = bucket_length(lmin + k + 1, engine.buckets)
+            entries.extend(
+                Geometry('serve_spec_step', spec=k, bucket=Sb, ctx=c)
+                for c in ladder if c >= floor)
+        entries.extend(Geometry('serve_spec_window', spec=k, ctx=c)
+                       for c in ladder)
     if include_standalone_prefill:
         entries.extend(Geometry('serve_prefill', bucket=b)
                        for b in buckets)

@@ -282,6 +282,7 @@ from .engine import (  # noqa: E402
 # non-success states).
 from .serving import (  # noqa: E402
     BlockAllocator,
+    InvalidSamplingParams,
     OutOfBlocks,
     QueueFull,
     RequestCancelled,

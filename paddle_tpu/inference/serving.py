@@ -66,11 +66,27 @@ the ITERATION level instead:
      streams are bit-equal to the single-device engine, zero
      steady-state retraces included.
 
-Sampling config is pinned at engine construction (it is part of the
-compilation key), greedy (temperature=0) is the parity-tested path:
-per-request outputs are exactly `DecodeEngine.generate`'s batch-1
-outputs. See docs/serving.md for the scheduler loop and the block-table
-layout.
+  6. Speculative + quantized + per-request-sampled serving
+     (docs/serving.md#speculative-and-quantized-serving):
+     `ServingEngine(model, draft=..., num_draft_tokens=k)` turns every
+     non-chunk iteration into a fused draft-propose / target-verify
+     window (the DecodeEngine's shared draft contract over the paged
+     pool: per-slot accept counts make the step output ragged,
+     committed through the per-row kv_write_pos machinery) — greedy
+     streams bit-equal to the non-speculative engine, sampled rows
+     rejection-sampled distribution-correct. `kv_cache_dtype='int8'`
+     backs the slots with int8 paged pools (QuantPagedKVCache:
+     per-row scales ride with the pages, so quantization survives
+     prefix sharing, CoW, preemption, and restore bit-identically) —
+     double the effective KV capacity. Sampling params (temperature,
+     top-k/p, per-request seed) are SLOT STATE, uploaded as device
+     data: a mixed greedy/sampled/speculative workload shares one
+     batch with zero retraces as the mix changes.
+
+Engine-level sampling config provides per-request defaults; greedy
+(temperature=0) is the parity-tested path: per-request outputs are
+exactly `DecodeEngine.generate`'s batch-1 outputs. See docs/serving.md
+for the scheduler loop and the block-table layout.
 
 Resilience (docs/serving.md#resilience): requests carry optional
 deadlines and can be cancelled; `submit()` load-sheds against a
@@ -126,6 +142,13 @@ class QueueFull(RuntimeError):
     deterministic load-shedding signal — callers back off and retry,
     instead of the queue growing without bound until preemption storms
     or host OOM kill every in-flight request."""
+
+
+class InvalidSamplingParams(ValueError):
+    """`submit()` rejected a request's per-request sampling params
+    (temperature < 0, top_p outside (0, 1]) BEFORE the prompt copy was
+    paid — the typed pre-admission validation signal (top_k is clamped
+    to the vocab instead, mirroring `filter_logits`'s HF semantics)."""
 
 
 class RequestError(RuntimeError):
@@ -479,13 +502,31 @@ class Request:
 
     __slots__ = ('rid', 'prompt', 'max_new_tokens', 'priority', 'generated',
                  'seq', 'state', 'admit_seq', 'times', 'enqueued_at',
-                 'deadline', 'reason', 'error', 'result', 'page_hashes')
+                 'deadline', 'reason', 'error', 'result', 'page_hashes',
+                 'temperature', 'top_k', 'top_p', 'sample_seed',
+                 'spec_next')
 
-    def __init__(self, rid, prompt, max_new_tokens, priority):
+    def __init__(self, rid, prompt, max_new_tokens, priority,
+                 temperature=0.0, top_k=0, top_p=1.0, sample_seed=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
+        # per-request sampling params — SLOT STATE, not engine statics:
+        # the engine uploads them as (SLOTS,) device data each window,
+        # so a batch mixing greedy/top-k/nucleus rows never retraces.
+        # `sample_seed` keys the stateless per-token PRNG chain (rid by
+        # default — deterministic, and it rides snapshot/restore so
+        # resumed sampled streams stay bit-equal). `spec_next` is the
+        # speculative window's carried next-token choice (the verify's
+        # committed pick, incl. the rejection resample), persisted so
+        # preemption/restore resumes mid-stream bit-equal.
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.sample_seed = int(sample_seed if sample_seed is not None
+                               else rid)
+        self.spec_next = None
         self.generated: list = []
         self.page_hashes = None  # full-prompt-page chain hashes, lazy
         self.seq = None          # arrival order, stamped by RequestQueue
@@ -619,29 +660,176 @@ def _pin(x, *spec_entries):
 def _pin_pages(pages):
     """Pin every page pool to the kv-head 'tp' split (identity without
     a mesh; clamps to replicated when kv_heads does not divide tp —
-    the same GQA fallback `init_paged_cache` places with)."""
-    return [type(pc)(_pin(pc.kp, None, 'tp'), _pin(pc.vp, None, 'tp'))
+    the same GQA fallback `init_paged_cache` places with). Every field
+    of both pool containers (PagedKVCache kp/vp, QuantPagedKVCache
+    kp/vp/ks/vs) carries the kv-head dim at axis 1, so one spec pins
+    them all."""
+    return [type(pc)(*[_pin(f, None, 'tp') for f in pc]) for pc in pages]
+
+
+def _pool_quant(pages):
+    """Whether the page pools are int8 (QuantPagedKVCache — per-row
+    scale fields ride along with the data pages)."""
+    return hasattr(pages[0], 'ks')
+
+
+def _tmp_cache(model, pages, K, Sb):
+    """Throwaway contiguous temp cache for a fused multi-token body
+    (admission prefill, chunk continuation, speculative verify), in the
+    POOL's quantization world: plain bf16 (k, v) pairs for PagedKVCache
+    pools; RowQuantKVCache for int8 pools — the forward then writes
+    per-row-quantized rows and attends dequantized ones, so every value
+    it sees is exactly the int8-roundtripped value a paged decode step
+    sees. That shared world is what keeps int8 greedy streams bit-equal
+    across monolithic prefill, chunked prefill, speculative windows,
+    preemption re-prefill, and prefix-cache hits."""
+    if _pool_quant(pages):
+        from ..models.generation import RowQuantKVCache
+
+        _, Hkv, _, D = pages[0].kp.shape
+        z8 = jnp.zeros((K, Sb, Hkv, D), jnp.int8)
+        zs = jnp.zeros((K, Sb, Hkv), jnp.float32)
+        return [RowQuantKVCache(z8, z8, zs, zs) for _ in pages]
+    return model.init_cache(K, Sb)
+
+
+def _pool_scatter(pc, tmp_entry, pflat, sflat, take=None):
+    """Scatter one layer's temp-cache rows into its page pool at
+    (pflat, sflat) flat (page, slot) targets. `take` (K, S) optionally
+    re-gathers a sub-range of the temp cache first (the chunk/verify
+    bodies scatter only the rows they wrote, clamped in-range). Int8
+    pools copy int8 bytes AND the per-row scales — no requantization,
+    so the pool holds exactly what the temp-cache write produced."""
+    if hasattr(pc, 'ks'):
+        kq, vq, ks, vs = tmp_entry
+        if take is not None:
+            idx4 = take[:, :, None, None]
+            idx3 = take[:, :, None]
+            kq = jnp.take_along_axis(kq, idx4, axis=1)
+            vq = jnp.take_along_axis(vq, idx4, axis=1)
+            ks = jnp.take_along_axis(ks, idx3, axis=1)
+            vs = jnp.take_along_axis(vs, idx3, axis=1)
+        rows = (pflat.shape[0],) + kq.shape[2:]
+        srows = (pflat.shape[0],) + ks.shape[2:]
+        return type(pc)(
+            pc.kp.at[pflat, :, sflat, :].set(kq.reshape(rows)),
+            pc.vp.at[pflat, :, sflat, :].set(vq.reshape(rows)),
+            pc.ks.at[pflat, :, sflat].set(ks.reshape(srows)),
+            pc.vs.at[pflat, :, sflat].set(vs.reshape(srows)))
+    k, v = tmp_entry
+    if take is not None:
+        idx4 = take[:, :, None, None]
+        k = jnp.take_along_axis(k, idx4, axis=1)
+        v = jnp.take_along_axis(v, idx4, axis=1)
+    rows = (pflat.shape[0],) + k.shape[2:]
+    return type(pc)(
+        pc.kp.at[pflat, :, sflat, :].set(k.reshape(rows).astype(pc.kp.dtype)),
+        pc.vp.at[pflat, :, sflat, :].set(v.reshape(rows).astype(pc.vp.dtype)))
+
+
+def _pool_gather(pages, btabs, st, Sb):
+    """Gather each row's committed prefix [0, st[b]) out of its pages
+    into a contiguous temp cache of static length Sb (positions >=
+    st read the scratch page — never attended, the per-row mask stops
+    at the write position). Int8 pools gather int8 bytes + per-row
+    scales into a RowQuantKVCache, so the continuation forward attends
+    the SAME roundtripped values a paged decode step would."""
+    from ..models.generation import RowQuantKVCache
+
+    K = btabs.shape[0]
+    bs = pages[0].kp.shape[2]
+    maxb = btabs.shape[1]
+    s = jnp.arange(Sb)
+    blk = jnp.minimum(s // bs, maxb - 1)
+    gpage = jnp.take_along_axis(
+        btabs, jnp.broadcast_to(blk[None, :], (K, Sb)), axis=1)
+    gpage = jnp.where(s[None, :] < st[:, None], gpage, 0)
+    soff = jnp.broadcast_to((s % bs)[None, :], (K, Sb))
+    if _pool_quant(pages):
+        return [RowQuantKVCache(pc.kp[gpage, :, soff, :],
+                                pc.vp[gpage, :, soff, :],
+                                pc.ks[gpage, :, soff],
+                                pc.vs[gpage, :, soff])
+                for pc in pages]
+    return [(pc.kp[gpage, :, soff, :], pc.vp[gpage, :, soff, :])
             for pc in pages]
 
 
-def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
+# per-request sampling randomness is STATELESS: the key for one
+# sampled event is fold_in(fold_in(PRNGKey(request seed), generated
+# token index), sub-stream id). A resumed request (preemption requeue,
+# snapshot/restore) re-derives exactly the keys the uninterrupted run
+# used — sampled streams stay bit-equal with no carried key state.
+_SUB_PROPOSE = 0      # sampling a token (decode windows, draft props)
+_SUB_ACCEPT = 1       # the speculative accept coin
+_SUB_RESAMPLE = 2     # the speculative rejection resample
+
+
+def _row_keys(seed, gen, sub):
+    """One PRNG key per batch row: fold the row's generated-token index
+    and the sub-stream id into its request seed."""
+    def one(s, n):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(s), n), sub)
+
+    return jax.vmap(one)(jnp.asarray(seed, jnp.uint32),
+                         jnp.asarray(gen, jnp.int32))
+
+
+def _sample_rows(logits, temp, topk, topp, keys):
+    """Per-row next-token choice over one batch of logits: greedy
+    argmax where temp == 0, categorical over the row's filtered /
+    tempered distribution elsewhere — all branches live in ONE trace,
+    so a batch mixing greedy and sampled rows (the per-request
+    sampling contract) never retraces as the mix changes. (The unused
+    dist output is dead code XLA eliminates — one sampling body, no
+    drift between decode windows and draft proposals.)"""
+    return _sample_rows_dist(logits, temp, topk, topp, keys)[0]
+
+
+def _filtered_dist(logits, temp, topk, topp):
+    """Per-row filtered/tempered probability dist over (K, V) logits
+    (rows with temp == 0 use temp 1 — their dist is never consumed;
+    the greedy rule takes argmax instead)."""
+    from ..models.generation import filter_logits_batched
+
+    lg = logits.astype(jnp.float32)
+    safe_t = jnp.where(temp > 0, temp, 1.0)
+    return jax.nn.softmax(
+        filter_logits_batched(lg / safe_t[:, None], topk, topp), -1)
+
+
+def _sample_rows_dist(logits, temp, topk, topp, keys):
+    """`_sample_rows` + the row's filtered dist from ONE shared filter
+    pass (the speculative draft loop needs both per proposal — two
+    separate calls would double the full-vocab sorts in the hottest
+    scan of the spec window)."""
+    from ..models.generation import filter_logits_batched
+
+    lg = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temp > 0, temp, 1.0)
+    f = filter_logits_batched(lg / safe_t[:, None], topk, topp)
+    sampled = jax.vmap(jax.random.categorical)(keys, f).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy), jax.nn.softmax(f, -1)
+
+
+def _prefill_kv(model, pages, ids, real_len, btabs):
     """Bucketed BATCHED admission prefill INTO pages (traced body,
     shared by the standalone `_paged_prefill` jit and the fused
-    `_serve_step`): run the model once over up to max_slots
-    RIGHT-padded prompts (K, Sb) with a throwaway contiguous cache (the
-    standard causal path — pad rows come after the real tokens, so rows
-    < real_len never see them), then scatter every K/V row into its
-    request's pages: row s of request b lands in page btabs[b, s // BS]
-    slot s % BS, pad and DUMMY rows (real_len == 0) land on the scratch
-    page 0, and each request's next-token logits land in its slot's row
-    of `last_logits` (dummy rows carry slot == SLOTS, dropped by the
-    out-of-bounds scatter). The batch width is FIXED at max_slots and
-    real lengths ride as device data, so one compilation per bucket
-    serves every admission count and every prompt length in the bucket
-    — admitting requests costs one dispatch per (step, bucket), not
-    one per request."""
+    `_serve_step`/`_serve_spec_step`): run the model once over up to
+    max_slots RIGHT-padded prompts (K, Sb) with a throwaway contiguous
+    cache in the pool's quantization world (the standard causal path —
+    pad rows come after the real tokens, so rows < real_len never see
+    them), then scatter every K/V row into its request's pages: row s
+    of request b lands in page btabs[b, s // BS] slot s % BS, pad and
+    DUMMY rows (real_len == 0) land on the scratch page 0. The batch
+    width is FIXED at max_slots and real lengths ride as device data,
+    so one compilation per bucket serves every admission count and
+    every prompt length in the bucket. Returns (per-row last-token
+    logits (K, V), pages)."""
     K, Sb = ids.shape
-    tmp = model.init_cache(K, Sb)
+    tmp = _tmp_cache(model, pages, K, Sb)
     logits, tmp = model(ids, caches=tmp, cache_index=0)
     rl = jnp.reshape(jnp.asarray(real_len, jnp.int32), (K,))
     last = jnp.take_along_axis(
@@ -655,51 +843,57 @@ def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
                      0)                                       # (K, Sb)
     pflat = page.reshape(-1)
     sflat = jnp.broadcast_to(s % bs, (K, Sb)).reshape(-1)
-    out_pages = []
-    for (k, v), pc in zip(tmp, pages):
-        rows = (K * Sb,) + k.shape[2:]
-        kp = pc.kp.at[pflat, :, sflat, :].set(
-            k.reshape(rows).astype(pc.kp.dtype))
-        vp = pc.vp.at[pflat, :, sflat, :].set(
-            v.reshape(rows).astype(pc.vp.dtype))
-        out_pages.append(type(pc)(kp, vp))
+    out_pages = [_pool_scatter(pc, t, pflat, sflat)
+                 for t, pc in zip(tmp, pages)]
+    return last, out_pages
+
+
+def _prefill_body(model, pages, last_logits, ids, real_len, btabs, slots):
+    """`_prefill_kv` plus the per-slot logits commit: each request's
+    next-token logits land in its slot's row of `last_logits` (dummy
+    rows carry slot == SLOTS, dropped by the out-of-bounds scatter)."""
+    last, out_pages = _prefill_kv(model, pages, ids, real_len, btabs)
     last_logits = last_logits.at[slots].set(
         last.astype(last_logits.dtype), mode='drop')
     return _pin(last_logits), _pin_pages(out_pages)
 
 
 def _window_body(model, pages, last_logits, btab, ctx, live, budget,
-                 rng_key, *, window, temperature, top_k, top_p,
-                 eos_token_id):
+                 temp, topk, topp, seed, plen, *, window, eos_token_id,
+                 forced_tok=None, forced=None):
     """One decode window for the whole fixed-slot batch as ONE compiled
     lax.scan (traced body, shared by `_serve_window` and the fused
-    `_serve_step`): per step, sample every slot's next token from the
-    carried logits, step the model over the paged caches (per-row write
+    `_serve_step`): per step, choose every slot's next token from the
+    carried logits under ITS OWN sampling params (temp/topk/topp/seed
+    ride as (SLOTS,) device data — a batch mixing greedy and sampled
+    requests shares this one trace, and changing the mix never
+    retraces), step the model over the paged caches (per-row write
     positions = ctx, attention through the block tables), advance the
-    committed length of live rows. Rows freeze when they hit eos, burn
-    their budget, or were never live (empty slots): frozen rows still
-    ride through the static-shape forward but write only to their
-    frozen position / the scratch page and commit nothing — exactly how
-    requests leave the batch without changing a traced shape. Returns
-    (tokens (SLOTS, window), last_logits, pages, ctx); the host reads
-    the tokens ONCE per window and does all bookkeeping there."""
-
-    def sample(logits, key):
-        from ..models.generation import filter_logits
-
-        logits = filter_logits(
-            logits.astype(jnp.float32) / temperature, top_k, top_p)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
-
+    committed length of live rows. Sampled rows draw their key
+    statelessly from (request seed, generated-token index), so a
+    resumed request replays exactly the keys the uninterrupted run
+    used. Rows freeze when they hit eos, burn their budget, or were
+    never live (empty slots): frozen rows still ride through the
+    static-shape forward but write only to their frozen position / the
+    scratch page and commit nothing — exactly how requests leave the
+    batch without changing a traced shape. Returns (tokens (SLOTS,
+    window), last_logits, pages, ctx); the host reads the tokens ONCE
+    per window and does all bookkeeping there."""
     pad_tok = eos_token_id if eos_token_id is not None else 0
+    plen = jnp.asarray(plen, jnp.int32)
 
     def step(carry, t):
-        last_logits, pages, ctx, finished, key = carry
-        if temperature == 0.0:
-            tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        else:
-            key, sub = jax.random.split(key)
-            tok = sample(last_logits, sub)
+        last_logits, pages, ctx, finished = carry
+        keys = _row_keys(seed, ctx - plen, _SUB_PROPOSE)
+        tok = _sample_rows(last_logits, temp, topk, topp, keys)
+        if forced is not None:
+            # a speculative engine's chunk step: rows carrying a
+            # pending verify-chosen next-token (incl. the rejection
+            # RESAMPLE for sampled rows) consume it as this window's
+            # FIRST token instead of re-sampling — the carried choice
+            # is the committed one, whatever dispatch shape lands it
+            tok = jnp.where(forced & (t == 0),
+                            jnp.asarray(forced_tok, tok.dtype), tok)
         frozen = finished | (t >= budget)
         tok = jnp.where(frozen, jnp.asarray(pad_tok, tok.dtype), tok)
         commit = ~frozen
@@ -708,11 +902,10 @@ def _window_body(model, pages, last_logits, btab, ctx, live, budget,
         logits, pages = model(tok[:, None], caches=pages,
                               kv_write_pos=ctx, block_tables=btab)
         ctx = ctx + commit.astype(jnp.int32)
-        return (logits[:, -1, :], pages, ctx, finished, key), tok
+        return (logits[:, -1, :], pages, ctx, finished), tok
 
-    state = (last_logits, pages, jnp.asarray(ctx, jnp.int32), ~live,
-             rng_key)
-    (last_logits, pages, ctx, _, _), toks = jax.lax.scan(
+    state = (last_logits, pages, jnp.asarray(ctx, jnp.int32), ~live)
+    (last_logits, pages, ctx, _), toks = jax.lax.scan(
         step, state, jnp.arange(window, dtype=jnp.int32))
     return _pin(toks.T), _pin(last_logits), _pin_pages(pages), _pin(ctx)
 
@@ -729,27 +922,23 @@ def _paged_prefill(model, pages, last_logits, ids, real_len, btabs, slots):
 
 @functools.partial(
     jax.jit, donate_argnames=('pages', 'last_logits'),
-    static_argnames=('window', 'temperature', 'top_k', 'top_p',
-                     'eos_token_id'))
+    static_argnames=('window', 'eos_token_id'))
 def _serve_window(model, pages, last_logits, btab, ctx, live, budget,
-                  rng_key, *, window, temperature, top_k, top_p,
-                  eos_token_id):
+                  temp, topk, topp, seed, plen, *, window, eos_token_id):
     """A pure decode window (no admissions this step): see
     _window_body."""
     _count_trace('serve_window')
     return _window_body(model, pages, last_logits, btab, ctx, live,
-                        budget, rng_key, window=window,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
-                        eos_token_id=eos_token_id)
+                        budget, temp, topk, topp, seed, plen,
+                        window=window, eos_token_id=eos_token_id)
 
 
 @functools.partial(
     jax.jit, donate_argnames=('pages', 'last_logits'),
-    static_argnames=('window', 'temperature', 'top_k', 'top_p',
-                     'eos_token_id'))
+    static_argnames=('window', 'eos_token_id'))
 def _serve_step(model, pages, last_logits, ids, real_len, btabs, slots,
-                btab, ctx, live, budget, rng_key, *, window, temperature,
-                top_k, top_p, eos_token_id):
+                btab, ctx, live, budget, temp, topk, topp, seed, plen, *,
+                window, eos_token_id):
     """THE scheduler iteration as one fused jitted dispatch: freshly
     admitted rows bucket-prefill into their newly allocated pages
     (_prefill_body), then every slot — new and old — decodes a window
@@ -760,9 +949,197 @@ def _serve_step(model, pages, last_logits, ids, real_len, btabs, slots,
     last_logits, pages = _prefill_body(model, pages, last_logits, ids,
                                        real_len, btabs, slots)
     return _window_body(model, pages, last_logits, btab, ctx, live,
-                        budget, rng_key, window=window,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
-                        eos_token_id=eos_token_id)
+                        budget, temp, topk, topp, seed, plen,
+                        window=window, eos_token_id=eos_token_id)
+
+
+def _spec_window_impl(target, draft, pages, dpages, last_logits,
+                      forced_tok, forced, btab, ctx, live, budget, temp,
+                      topk, topp, seed, plen, *, k, ctx_bucket,
+                      eos_token_id):
+    """One speculative propose/verify/commit window over the fixed-slot
+    batch (traced body of `_serve_spec_window` / `_serve_spec_step`) —
+    the DecodeEngine's fused window contract composed with the paged
+    pool and per-request sampling:
+
+      1. candidate 0: the previous window's carried next-token
+         (`forced_tok` where `forced` — the committed choice the verify
+         already made, incl. the rejection RESAMPLE for sampled rows)
+         or, on a slot's first window after admission, a per-row
+         sample/argmax off the prefill's `last_logits`;
+      2. draft propose: k+1 single-token steps through the DRAFT's
+         paged pools (same block tables, same kv_write_pos offsets —
+         the k-th proposal's own KV row is written too, the
+         DecodeEngine pattern), each proposal chosen under the row's
+         own sampling params;
+      3. target verify: ONE (K, k+1) forward over the target with the
+         committed prefix GATHERED from its pages into a contiguous
+         temp cache of static length `ctx_bucket` (the chunked-prefill
+         machinery — per-row kv_write_pos offsets, zero model changes);
+      4. commit rule per row: greedy rows accept the longest draft
+         prefix the target's argmax agrees with; sampled rows run the
+         Leviathan/Chen accept coin min(1, pt/pd) per position with a
+         rejection RESAMPLE from the normalised residual (pt - pd)+ —
+         the output law equals sampling the target directly. ncommit =
+         accepted + 1, clamped by budget and truncated at eos;
+      5. only the committed rows' target K/V scatter back into pages
+         (rejected rows land on the scratch page), so the pages hold
+         exactly what a non-speculative step would have written —
+         greedy streams stay bit-equal spec-on vs spec-off.
+
+    Returns (cand (K, k+1), ncommit (K,), next_tok (K,), last_logits,
+    pages, dpages, ctx): `cand[:ncommit]` are this window's committed
+    tokens; `next_tok` is the carried choice the host feeds back as
+    `forced_tok` (and persists per request, so preemption and
+    snapshot/restore resume sampled streams bit-equal)."""
+    K, V = last_logits.shape
+    ctx = jnp.asarray(ctx, jnp.int32)
+    plen = jnp.asarray(plen, jnp.int32)
+    budget = jnp.asarray(budget, jnp.int32)
+    gen0 = ctx - plen
+    sampled_row = temp > 0
+    keys0 = _row_keys(seed, gen0, _SUB_PROPOSE)
+    cand0 = jnp.where(forced, jnp.asarray(forced_tok, jnp.int32),
+                      _sample_rows(last_logits, temp, topk, topp, keys0))
+
+    def dstep(carry, i):
+        tok, dpages = carry
+        dlogits, dpages = draft(tok[:, None], caches=dpages,
+                                kv_write_pos=ctx + i, block_tables=btab)
+        gkeys = _row_keys(seed, gen0 + i + 1, _SUB_PROPOSE)
+        nxt, pd = _sample_rows_dist(dlogits[:, -1, :], temp, topk,
+                                    topp, gkeys)
+        return (nxt, dpages), (nxt, pd)
+
+    (_, dpages), (toks, pds) = jax.lax.scan(
+        dstep, (cand0, dpages), jnp.arange(k + 1, dtype=jnp.int32))
+    drafts = jnp.swapaxes(toks[:k], 0, 1)                  # (K, k)
+    pd = jnp.swapaxes(pds[:k], 0, 1)                       # (K, k, V)
+    window_ids = jnp.concatenate([cand0[:, None], drafts], axis=1)
+    # verify: the whole (K, k+1) window in one target forward over the
+    # gathered contiguous prefix (rows write at ctx..ctx+k inside tmp)
+    tmp = _pool_gather(pages, btab, ctx, ctx_bucket)
+    tlogits, tmp = target(window_ids, caches=tmp, kv_write_pos=ctx)
+    tlg = tlogits.astype(jnp.float32)                      # (K, k+1, V)
+    tchoice = jnp.argmax(tlg, axis=-1).astype(jnp.int32)   # (K, k+1)
+    # per-row filtered target dists at every window position
+    flat = tlg.reshape(K * (k + 1), V)
+    rep = lambda x: jnp.repeat(x, k + 1, axis=0)  # noqa: E731
+    pt = _filtered_dist(flat, rep(temp), rep(topk),
+                        rep(topp)).reshape(K, k + 1, V)
+    # accept rule per draft position
+    greedy_acc = drafts == tchoice[:, :k]
+    px_t = jnp.take_along_axis(pt[:, :k, :], drafts[:, :, None],
+                               axis=-1)[..., 0]            # (K, k)
+    px_d = jnp.take_along_axis(pd, drafts[:, :, None], axis=-1)[..., 0]
+
+    def coin(s, n):
+        kk = jax.random.fold_in(jax.random.fold_in(
+            jax.random.PRNGKey(s), n), _SUB_ACCEPT)
+        return jax.random.uniform(kk)
+
+    u = jax.vmap(lambda s_, n0: jax.vmap(
+        lambda i: coin(s_, n0 + i + 1))(jnp.arange(k)))(
+            jnp.asarray(seed, jnp.uint32), gen0)           # (K, k)
+    samp_acc = u < jnp.minimum(1.0, px_t / jnp.maximum(px_d, 1e-30))
+    acc = jnp.where(sampled_row[:, None], samp_acc, greedy_acc)
+    m = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    # the carried next token: greedy rows take the target's choice at
+    # the first disagreement; sampled rows resample from the residual
+    # (pt - pd)+ — a full-accept row's pd pads to 0, so its residual
+    # IS pt_k (the bonus-token rule falls out of the same expression)
+    pt_m = jnp.take_along_axis(pt, m[:, None, None], axis=1)[:, 0]
+    pd_pad = jnp.concatenate([pd, jnp.zeros((K, 1, V), pd.dtype)],
+                             axis=1)
+    pd_m = jnp.take_along_axis(pd_pad, m[:, None, None], axis=1)[:, 0]
+    res = jnp.maximum(pt_m - pd_m, 0.0)
+    rs = jnp.sum(res, axis=-1, keepdims=True)
+    res = jnp.where(rs > 0, res / jnp.maximum(rs, 1e-30), pt_m)
+    rkeys = _row_keys(seed, gen0 + m + 1, _SUB_RESAMPLE)
+    sampled_next = jax.vmap(jax.random.categorical)(
+        rkeys, jnp.log(jnp.maximum(res, 1e-30))).astype(jnp.int32)
+    greedy_next = jnp.take_along_axis(tchoice, m[:, None],
+                                      axis=1)[:, 0]
+    next_tok = jnp.where(sampled_row, sampled_next, greedy_next)
+    # commit count: accepted prefix + the candidate that started it,
+    # clamped by the row's remaining budget, truncated at the first
+    # eos inside the committed prefix, zero for dead rows
+    nc = jnp.minimum(m + 1, budget)
+    if eos_token_id is not None:
+        iseos = window_ids == eos_token_id
+        first = jnp.argmax(iseos, axis=1)
+        nc = jnp.where(jnp.any(iseos, axis=1) & (first < nc),
+                       first + 1, nc)
+    nc = jnp.where(live, nc, 0)
+    # scatter ONLY the committed rows' target K/V back into pages
+    # (rejected/beyond-budget rows go to the scratch page — the next
+    # window rewrites those positions anyway)
+    bs = pages[0].kp.shape[2]
+    maxb = btab.shape[1]
+    i = jnp.arange(k + 1)
+    wpos = ctx[:, None] + i[None, :]                       # (K, k+1)
+    wblk = jnp.minimum(wpos // bs, maxb - 1)
+    wpage = jnp.where(i[None, :] < nc[:, None],
+                      jnp.take_along_axis(btab, wblk, axis=1), 0)
+    pflat = wpage.reshape(-1)
+    sflat = (wpos % bs).reshape(-1)
+    take = jnp.minimum(wpos, ctx_bucket - 1)
+    pages = [_pool_scatter(pc, t, pflat, sflat, take=take)
+             for t, pc in zip(tmp, pages)]
+    # next window's sampling base for rows that keep going: the
+    # target's logits at the last committed position (rows that stop —
+    # eos/budget — are retired by the host before the next window)
+    last = jnp.take_along_axis(
+        tlg, jnp.maximum(nc - 1, 0)[:, None, None], axis=1)[:, 0]
+    last_logits = jnp.where(live[:, None],
+                            last.astype(last_logits.dtype), last_logits)
+    ctx = ctx + nc
+    return (_pin(jnp.asarray(window_ids, jnp.int32)), _pin(nc),
+            _pin(next_tok), _pin(last_logits), _pin_pages(pages),
+            _pin_pages(dpages), _pin(ctx))
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('pages', 'dpages', 'last_logits'),
+    static_argnames=('k', 'ctx_bucket', 'eos_token_id'))
+def _serve_spec_window(target, draft, pages, dpages, last_logits,
+                       forced_tok, forced, btab, ctx, live, budget, temp,
+                       topk, topp, seed, plen, *, k, ctx_bucket,
+                       eos_token_id):
+    """A pure speculative window (no admissions this step): see
+    _spec_window_impl."""
+    _count_trace('serve_spec_window')
+    return _spec_window_impl(target, draft, pages, dpages, last_logits,
+                             forced_tok, forced, btab, ctx, live, budget,
+                             temp, topk, topp, seed, plen, k=k,
+                             ctx_bucket=ctx_bucket,
+                             eos_token_id=eos_token_id)
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('pages', 'dpages', 'last_logits'),
+    static_argnames=('k', 'ctx_bucket', 'eos_token_id'))
+def _serve_spec_step(target, draft, pages, dpages, last_logits, ids,
+                     real_len, btabs, slots, forced_tok, forced, btab,
+                     ctx, live, budget, temp, topk, topp, seed, plen, *,
+                     k, ctx_bucket, eos_token_id):
+    """The speculative scheduler iteration as one fused jitted
+    dispatch: freshly admitted rows bucket-prefill into their pages on
+    BOTH models (the draft's pool mirrors the target's block tables,
+    so one allocator serves both), then every slot runs a
+    propose/verify/commit window (_spec_window_impl). One compilation
+    per (k, bucket, ctx bucket) triple covers every admission count
+    and sampling mix."""
+    _count_trace('serve_spec_step')
+    last_logits, pages = _prefill_body(target, pages, last_logits, ids,
+                                       real_len, btabs, slots)
+    _, dpages = _prefill_kv(draft, dpages, ids, real_len, btabs)
+    dpages = _pin_pages(dpages)
+    return _spec_window_impl(target, draft, pages, dpages, last_logits,
+                             forced_tok, forced, btab, ctx, live, budget,
+                             temp, topk, topp, seed, plen, k=k,
+                             ctx_bucket=ctx_bucket,
+                             eos_token_id=eos_token_id)
 
 
 def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
@@ -798,20 +1175,16 @@ def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
     Sb = int(ctx_bucket)
     cl = jnp.reshape(jnp.asarray(chunk_len, jnp.int32), (K,))
     st = jnp.reshape(jnp.asarray(start, jnp.int32), (K,))
-    pages = [type(pc)(pc.kp.at[cow_dst].set(pc.kp[cow_src]),
-                      pc.vp.at[cow_dst].set(pc.vp[cow_src]))
+    # CoW copies first (every pool field — int8 pools copy the per-row
+    # scale rows with their page, so a shared page's quantization
+    # survives the private fork byte for byte)
+    pages = [type(pc)(*[f.at[cow_dst].set(f[cow_src]) for f in pc])
              for pc in pages]
     # gather each row's prefix rows [0, start) into a contiguous
-    # (K, Sb, Hkv, D) temp cache; positions >= start read the scratch
-    # page (never attended: the per-row causal mask stops at qpos)
-    s = jnp.arange(Sb)
-    blk = jnp.minimum(s // bs, maxb - 1)
-    gpage = jnp.take_along_axis(
-        btabs, jnp.broadcast_to(blk[None, :], (K, Sb)), axis=1)
-    gpage = jnp.where(s[None, :] < st[:, None], gpage, 0)
-    soff = jnp.broadcast_to((s % bs)[None, :], (K, Sb))
-    tmp = [(pc.kp[gpage, :, soff, :], pc.vp[gpage, :, soff, :])
-           for pc in pages]
+    # (K, Sb, ...) temp cache in the pool's quantization world;
+    # positions >= start read the scratch page (never attended: the
+    # per-row causal mask stops at qpos)
+    tmp = _pool_gather(pages, btabs, st, Sb)
     logits, tmp = model(ids, caches=tmp, kv_write_pos=st)
     last = jnp.take_along_axis(
         logits, jnp.maximum(cl - 1, 0)[:, None, None], axis=1)[:, 0]
@@ -825,18 +1198,9 @@ def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
                       jnp.take_along_axis(btabs, wblk, axis=1), 0)
     pflat = wpage.reshape(-1)
     sflat = (wpos % bs).reshape(-1)
-    out_pages = []
-    for (k, v), pc in zip(tmp, pages):
-        rows = (K * Cb,) + k.shape[2:]
-        kc = jnp.take_along_axis(
-            k, jnp.minimum(wpos, Sb - 1)[:, :, None, None], axis=1)
-        vc = jnp.take_along_axis(
-            v, jnp.minimum(wpos, Sb - 1)[:, :, None, None], axis=1)
-        kp = pc.kp.at[pflat, :, sflat, :].set(
-            kc.reshape(rows).astype(pc.kp.dtype))
-        vp = pc.vp.at[pflat, :, sflat, :].set(
-            vc.reshape(rows).astype(pc.vp.dtype))
-        out_pages.append(type(pc)(kp, vp))
+    take = jnp.minimum(wpos, Sb - 1)
+    out_pages = [_pool_scatter(pc, t, pflat, sflat, take=take)
+                 for t, pc in zip(tmp, pages)]
     last_logits = last_logits.at[slots].set(
         last.astype(last_logits.dtype), mode='drop')
     return _pin(last_logits), _pin_pages(out_pages)
@@ -844,12 +1208,11 @@ def _chunk_body(model, pages, last_logits, ids, chunk_len, start, btabs,
 
 @functools.partial(
     jax.jit, donate_argnames=('pages', 'last_logits'),
-    static_argnames=('ctx_bucket', 'window', 'temperature', 'top_k',
-                     'top_p', 'eos_token_id'))
+    static_argnames=('ctx_bucket', 'window', 'eos_token_id'))
 def _serve_chunk_step(model, pages, last_logits, ids, chunk_len, start,
                       btabs, slots, cow_src, cow_dst, btab, ctx, live,
-                      budget, rng_key, *, ctx_bucket, window, temperature,
-                      top_k, top_p, eos_token_id):
+                      budget, temp, topk, topp, seed, plen, forced_tok,
+                      forced, *, ctx_bucket, window, eos_token_id):
     """The chunked-prefill scheduler iteration as one fused jitted
     dispatch: every in-progress chunked/continuation row appends its
     chunk into its pages (_chunk_body — CoW copies first, prefix
@@ -865,9 +1228,29 @@ def _serve_chunk_step(model, pages, last_logits, ids, chunk_len, start,
                                      cow_src, cow_dst,
                                      ctx_bucket=ctx_bucket)
     return _window_body(model, pages, last_logits, btab, ctx, live,
-                        budget, rng_key, window=window,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
-                        eos_token_id=eos_token_id)
+                        budget, temp, topk, topp, seed, plen,
+                        window=window, eos_token_id=eos_token_id,
+                        forced_tok=forced_tok, forced=forced)
+
+
+@functools.partial(
+    jax.jit, donate_argnames=('dpages', 'dlogits'),
+    static_argnames=('ctx_bucket',))
+def _draft_chunk(draft, dpages, dlogits, ids, chunk_len, start, btabs,
+                 slots, cow_src, cow_dst, *, ctx_bucket):
+    """Draft-side mirror of the chunk/continuation prefill: a
+    speculative engine must keep the DRAFT's pages current through
+    every admission path, or chunk-admitted and prefix-hit rows would
+    draft against missing prompt KV and speculation would silently
+    degrade to pure overhead (accept rate collapse with no error).
+    Same body as the target's chunk leg — CoW copies fork the draft's
+    pages too, the gathered prefix is the draft's own — with the
+    logits commit dropped by all-dummy slot indices (`dlogits` is a
+    throwaway donated buffer)."""
+    _count_trace('serve_draft_chunk')
+    return _chunk_body(draft, dpages, dlogits, ids, chunk_len, start,
+                       btabs, slots, cow_src, cow_dst,
+                       ctx_bucket=ctx_bucket)
 
 
 def _ceil_div(a, b):
@@ -903,13 +1286,55 @@ class ServingEngine:
                  prefix_cache=False, prefill_chunk=None,
                  postmortem_dir=None, mesh=None, tp=None,
                  ops_port=None, ops_host='127.0.0.1', watchdog=None,
-                 slo_rules=None, ts_interval_s=None):
+                 slo_rules=None, ts_interval_s=None,
+                 draft=None, num_draft_tokens=4, kv_cache_dtype=None):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
                 f'{type(model).__name__} lacks block_tables in its '
                 f'cached forward: paged serving needs the Llama-family '
                 f'cached_attention; use DecodeEngine for this model')
+        # speculative serving (docs/serving.md#speculative-serving):
+        # draft != None turns every non-chunk scheduler iteration into
+        # a propose/verify window — the DecodeEngine's fused
+        # speculative contract (docs/decode_engine.md) composed with
+        # the paged pool. The draft keeps its OWN page pools indexed
+        # by the SAME block tables (page ids are bookkeeping, so one
+        # allocator covers both models); greedy streams stay bit-equal
+        # to the non-speculative engine, sampled streams are
+        # distribution-correct (Leviathan/Chen rejection sampling).
+        self.draft = draft
+        self.spec_window = None
+        if draft is not None:
+            self.spec_window = int(num_draft_tokens)
+            if self.spec_window < 1:
+                raise ValueError('num_draft_tokens must be >= 1')
+            dparams = inspect.signature(draft.forward).parameters
+            for need in ('block_tables', 'kv_write_pos'):
+                if need not in dparams:
+                    raise NotImplementedError(
+                        f'{type(draft).__name__} lacks {need} in its '
+                        f'cached forward: the speculative draft runs '
+                        f'paged single-token steps at per-row offsets')
+            if 'kv_write_pos' not in params:
+                raise NotImplementedError(
+                    f'{type(model).__name__} lacks kv_write_pos: the '
+                    f'speculative verify commits at per-row offsets')
+        # kv_cache_dtype='int8' backs the slots with int8 paged pools
+        # (QuantPagedKVCache: per-row scales ride with the pages, so
+        # quantization is write-order independent — preemption
+        # re-prefill, prefix sharing, CoW, and snapshot/restore all
+        # reproduce bit-identical pages). None = the model's cache
+        # dtype (prior behavior, byte for byte).
+        if kv_cache_dtype is None:
+            self.kv_cache_dtype = None
+        else:
+            kd = jnp.dtype(kv_cache_dtype)
+            if kd != jnp.int8:
+                raise ValueError(
+                    f"kv_cache_dtype must be None or 'int8', got "
+                    f'{kv_cache_dtype!r}')
+            self.kv_cache_dtype = kd
         if getattr(getattr(model, 'config', None), 'sliding_window',
                    None) is not None:
             raise NotImplementedError(
@@ -984,6 +1409,8 @@ class ServingEngine:
 
             with self._use_mesh():
                 model = shard_model(model, self.mesh)
+                if self.draft is not None:
+                    self.draft = shard_model(self.draft, self.mesh)
         self.model = model
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
@@ -1052,23 +1479,63 @@ class ServingEngine:
         # rng upload committed-replicated (self._put), so every later
         # dispatch sees the same input shardings the first one did.
         with self._use_mesh():
-            self._pages = model.init_paged_cache(num_blocks,
-                                                 self.block_size)
+            self._pages = model.init_paged_cache(
+                num_blocks, self.block_size, dtype=self.kv_cache_dtype)
+            self._dpages = None
+            if self.draft is not None:
+                # the draft's pools share the target's page-id space:
+                # same num_blocks/block_size, indexed by the same block
+                # tables — one allocator, zero extra bookkeeping
+                self._dpages = self.draft.init_paged_cache(
+                    num_blocks, self.block_size,
+                    dtype=self.kv_cache_dtype)
             vocab = model.config.vocab_size
             self._last_logits = self._put(
                 jnp.zeros((self.max_slots, vocab), model.cache_dtype()))
-            self._rng = self._put(jax.random.PRNGKey(0))
-        # real-unit pool accounting: one page costs k+v bytes per layer
-        # at the pool dtype (pages x page_bytes x layers x dtype) —
-        # threaded into allocator.stats() and the pool.* gauges.
-        # kp.shape is the GLOBAL logical shape even when the pool is
-        # tp-sharded (each shard holds kv_heads/tp of it), so the
-        # bytes_* gauges keep reporting whole-pool HBM — per-shard
-        # itemsize x tp — and capacity dashboards never shrink by 1/tp
-        # (tests/test_serving_tp.py pins the arithmetic)
-        self.allocator.bytes_per_page = int(sum(
-            2 * int(np.prod(pc.kp.shape[1:])) * pc.kp.dtype.itemsize
-            for pc in self._pages))
+            # sampling randomness is STATELESS per request (seed +
+            # generated index fold_in chains) — the engine carries no
+            # PRNG key. The draft's throwaway logits buffer feeds the
+            # draft-side prefill dispatches (its per-slot scatter is
+            # dropped by all-dummy slot indices; the buffer only
+            # donates and comes back).
+            self._dlogits = None
+            self._dummy_slots = None
+            if self.draft is not None:
+                self._dlogits = self._put(jnp.zeros(
+                    (self.max_slots, self.draft.config.vocab_size),
+                    self.draft.cache_dtype()))
+                # all-dummy slot indices: the draft-side prefill legs
+                # drop their logits commit through the OOB scatter
+                self._dummy_slots = self._put(np.full(
+                    (self.max_slots,), self.max_slots, np.int32))
+        # (chunk bucket, ctx bucket) shapes the draft's chunk/catch-up
+        # legs have dispatched — a fresh shape's step counts as a
+        # cache MISS (it paid trace + compile)
+        self._draft_shapes: set = set()
+        # constant all-zero forced args for the non-speculative chunk
+        # path (spec_next can never be set without a draft, so the
+        # per-step _forced_state scan is pure waste there)
+        self._zero_ftok = self._put(np.zeros((self.max_slots,),
+                                             np.int32))
+        self._zero_forced = self._put(np.zeros((self.max_slots,), bool))
+        # real-unit pool accounting: one page costs the sum of every
+        # pool field's per-page bytes (k+v per layer; int8 pools add
+        # their per-row scale rows; a draft's mirrored pools add
+        # theirs) — threaded into allocator.stats() and the pool.*
+        # gauges. Field shapes are the GLOBAL logical shapes even when
+        # the pool is tp-sharded (each shard holds kv_heads/tp of it),
+        # so the bytes_* gauges keep reporting whole-pool HBM —
+        # per-shard itemsize x tp — and capacity dashboards never
+        # shrink by 1/tp (tests/test_serving_tp.py pins the arithmetic)
+
+        def _pool_page_bytes(pages):
+            return int(sum(
+                int(np.prod(f.shape[1:])) * f.dtype.itemsize
+                for pc in pages for f in pc))
+
+        self.allocator.bytes_per_page = (
+            _pool_page_bytes(self._pages)
+            + (_pool_page_bytes(self._dpages) if self._dpages else 0))
 
         # host-authoritative per-slot state (device copies ride in as
         # small int32/bool args each window)
@@ -1078,6 +1545,24 @@ class ServingEngine:
                               np.int32)
         self._ctx = np.zeros((self.max_slots,), np.int32)
         self._budget = np.zeros((self.max_slots,), np.int32)
+        # per-slot sampling params — DATA, not statics (the traced
+        # bodies take them as (SLOTS,) device args): a mixed
+        # greedy/sampled/speculative workload shares one batch with
+        # zero retraces as the mix changes. Mutated only at
+        # place/clear, so the device copies ride the _dev mirror.
+        self._temp = np.zeros((self.max_slots,), np.float32)
+        self._topk = np.zeros((self.max_slots,), np.int32)
+        self._topp = np.ones((self.max_slots,), np.float32)
+        self._seed = np.zeros((self.max_slots,), np.uint32)
+        self._plen = np.zeros((self.max_slots,), np.int32)
+        # speculative engines track how much of each slot's context the
+        # DRAFT's pages hold (_dctx <= _ctx): tokens committed by a
+        # chunk-step's plain decode window never pass through the
+        # draft, so the next speculative step first catches the draft
+        # up over the hole (a _draft_chunk dispatch) — without it the
+        # draft would propose against missing KV and the accept rate
+        # would silently collapse
+        self._dctx = np.zeros((self.max_slots,), np.int32)
         # per-slot prefill progress: None = fully prefilled (decoding);
         # an int = context tokens already in pages — the slot is mid
         # chunked/continuation prefill, rides decode windows frozen on
@@ -1126,6 +1611,11 @@ class ServingEngine:
         self.prefix_counts = {'hits': 0, 'misses': 0, 'hits_skipped': 0,
                               'hit_tokens': 0, 'chunked_admissions': 0,
                               'chunk_steps': 0}
+        # host-truth speculative counters (stats()['spec'] reports them
+        # even with telemetry off; snapshot()/restore() carries them
+        # like `counts` so accept-rate dashboards see no discontinuity
+        # across a failover)
+        self.spec_counts = {'windows': 0, 'proposed': 0, 'accepted': 0}
         # telemetry hot-path caches: metric handles (refreshed when the
         # registry generation changes, i.e. after a reset) and the last
         # occupancy tuple (gauges re-set only when it moves) — keeps
@@ -1242,19 +1732,32 @@ class ServingEngine:
         # tp is part of the geometry: a tp=1 and a tp=2 engine over
         # the same pool shape dispatch DIFFERENT executables (jax keys
         # them by input sharding), so the CompileCache registry must
-        # not let their notes collide either
-        return ('paged', self.max_slots, self.allocator.num_blocks,
-                self.block_size, self.max_blocks_per_seq, self.tp)
+        # not let their notes collide either. A speculative engine
+        # additionally folds in its draft's identity + window: two
+        # engines over the same target but different drafts trace
+        # different programs.
+        g = ('paged', self.max_slots, self.allocator.num_blocks,
+             self.block_size, self.max_blocks_per_seq, self.tp)
+        if self.draft is not None:
+            from .engine import model_tag
+
+            g = g + ('spec', self.spec_window, model_tag(self.draft))
+        return g
 
     def registry_key(self, *tag):
         """The EXACT CompileCache key `_note(*tag)` records (the shared
-        recipe: pool shape + dtype + sampling config + `tag` +
+        recipe: pool shape + POOL dtype + sampling config + `tag` +
         geometry). Tags are the dispatch kinds step() uses:
         ('serve_step', W, Sb), ('serve_window', W),
-        ('serve_prefill', Sb). Exposed so aot.GeometrySet enumeration
-        and the live engine provably agree key-for-key."""
+        ('serve_prefill', Sb), ('serve_chunk_step', W, Cb, Sb),
+        ('serve_spec_step', k, Sb, Cx), ('serve_spec_window', k, Cx).
+        The pool dtype (int8 vs the model's cache dtype) keys here, so
+        a quantized and an unquantized engine over one model never
+        collide. Exposed so aot.GeometrySet enumeration and the live
+        engine provably agree key-for-key."""
         return COMPILE_CACHE.key(
-            self.model, self._pages[0].kp.shape, self.model.cache_dtype(),
+            self.model, self._pages[0].kp.shape,
+            self._pages[0].kp.dtype,
             self._sampling_key() + tag, geometry=self._geometry())
 
     def _note(self, *tag):
@@ -1348,6 +1851,17 @@ class ServingEngine:
                        'prefill_chunk': self.prefill_chunk,
                        **self.prefix_counts,
                        **self.allocator.stats()['prefix']},
+            # host-truth speculative record: accept_rate is accepted
+            # draft tokens over proposed (None before the first window)
+            'spec': {'enabled': self.draft is not None,
+                     'num_draft_tokens': self.spec_window,
+                     'kv_cache_dtype': (str(self.kv_cache_dtype)
+                                        if self.kv_cache_dtype else None),
+                     **self.spec_counts,
+                     'accept_rate': (
+                         self.spec_counts['accepted']
+                         / self.spec_counts['proposed']
+                         if self.spec_counts['proposed'] else None)},
             # host-truth MFU record of the last all-hit window (tag,
             # static flops, wall) — what gate_flight_recorder checks
             # the serve.mfu_est gauge and the AOT manifest against
@@ -1393,6 +1907,18 @@ class ServingEngine:
             'buckets': list(self.buckets),
             'prefix_cache': self.prefix_cache,
             'prefill_chunk': self.prefill_chunk,
+            # speculative + quantized serving are compilation-relevant:
+            # a spec artifact's executables close over the draft's
+            # structure, an int8 artifact's over the pool dtype —
+            # attaching across either must refuse (ArtifactMismatch
+            # names the field)
+            'kv_cache_dtype': (str(self.kv_cache_dtype)
+                               if self.kv_cache_dtype else None),
+            'num_draft_tokens': self.spec_window,
+            'draft': (model_tag(self.draft) if self.draft is not None
+                      else None),
+            'draft_struct': (model_struct(self.draft)
+                             if self.draft is not None else None),
             # the mesh degree is compilation-relevant: a tp=4
             # artifact's executables are 4-shard SPMD programs a tp=1
             # engine can never look up — attaching across degrees must
@@ -1405,7 +1931,8 @@ class ServingEngine:
         dispatch — what `aot.build` cache-evicts (per FUNCTION, not
         process-wide) to force real persisting compiles."""
         return (_paged_prefill, _serve_window, _serve_step,
-                _serve_chunk_step)
+                _serve_chunk_step, _serve_spec_window, _serve_spec_step,
+                _draft_chunk)
 
     def _warm_geometry(self, g, draft=None):
         """Drive ONE enumerated geometry through the SAME module-level
@@ -1436,17 +1963,10 @@ class ServingEngine:
         with self._use_mesh():
             dev = self._device_state()
             budget = self._put(self._budget)
-            common = dict(window=W, temperature=self.temperature,
-                          top_k=self.top_k, top_p=self.top_p,
-                          eos_token_id=self.eos_token_id)
-            # a fixed dummy key with the live aval (and the live
-            # placement — under tp the live key is committed
-            # replicated, and a differently-placed warm key would
-            # compile a SECOND executable): warming must NOT consume
-            # the engine's sampling stream (self._rng), or a warmed
-            # and an unwarmed replica seeded identically would emit
-            # different sampled tokens
-            sub = self._put(jax.random.PRNGKey(0))
+            common = dict(window=W, eos_token_id=self.eos_token_id)
+            sample_args = (dev['temp'], dev['topk'], dev['topp'],
+                           dev['seed'], dev['plen'])
+            K = self.max_slots
             if g.kind == 'serve_step':
                 ids, real_len, btabs, slots = self._prefill_args(
                     p['bucket'], [])
@@ -1454,13 +1974,13 @@ class ServingEngine:
                 _, self._last_logits, self._pages, _ = _serve_step(
                     self.model, self._pages, self._last_logits, ids,
                     real_len, btabs, slots, dev['btab'], dev['ctx'],
-                    dev['live'], budget, sub, **common)
+                    dev['live'], budget, *sample_args, **common)
             elif g.kind == 'serve_window':
                 self._note('serve_window', W)
                 _, self._last_logits, self._pages, _ = _serve_window(
                     self.model, self._pages, self._last_logits,
-                    dev['btab'], dev['ctx'], dev['live'], budget, sub,
-                    **common)
+                    dev['btab'], dev['ctx'], dev['live'], budget,
+                    *sample_args, **common)
             elif g.kind == 'serve_prefill':
                 ids, real_len, btabs, slots = self._prefill_args(
                     p['bucket'], [])
@@ -1468,9 +1988,13 @@ class ServingEngine:
                 self._last_logits, self._pages = _paged_prefill(
                     self.model, self._pages, self._last_logits, ids,
                     real_len, btabs, slots)
+                if self.draft is not None:
+                    # the live standalone prefill runs a draft leg too
+                    self._dlogits, self._dpages = _paged_prefill(
+                        self.draft, self._dpages, self._dlogits, ids,
+                        real_len, btabs, self._dummy_slots)
             elif g.kind == 'serve_chunk_step':
                 Cb, Sb = int(p['chunk']), int(p['bucket'])
-                K = self.max_slots
                 ids = self._put(np.zeros((K, Cb), np.int32))
                 z = self._put(np.zeros((K,), np.int32))
                 btabs = self._put(
@@ -1478,13 +2002,87 @@ class ServingEngine:
                 slots = self._put(
                     np.full((K,), K, np.int32))   # all dummies: drop
                 self._note('serve_chunk_step', W, Cb, Sb)
+                zb = self._put(np.zeros((K,), bool))
+                if self.draft is not None:
+                    # the live chunk step runs a draft leg too
+                    self._draft_shapes.add((Cb, Sb))
+                    self._dlogits, self._dpages = _draft_chunk(
+                        self.draft, self._dpages, self._dlogits, ids,
+                        z, z, btabs, slots, z, z, ctx_bucket=Sb)
+                    self._warm_draft_catchup(Sb, z, btabs)
                 _, self._last_logits, self._pages, _ = _serve_chunk_step(
                     self.model, self._pages, self._last_logits, ids, z,
                     z, btabs, slots, z, z, dev['btab'], dev['ctx'],
-                    dev['live'], budget, sub, ctx_bucket=Sb, **common)
+                    dev['live'], budget, *sample_args, z, zb,
+                    ctx_bucket=Sb, **common)
+            elif g.kind in ('serve_spec_step', 'serve_spec_window'):
+                if self.draft is None:
+                    raise ValueError(
+                        f'geometry {g.label()} needs a speculative '
+                        f'engine (construct with draft=...)')
+                k = int(p['spec'])
+                if k != self.spec_window:
+                    raise ValueError(
+                        f'geometry {g.label()} was enumerated for '
+                        f'num_draft_tokens {k}, engine has '
+                        f'{self.spec_window}')
+                Cx = int(p['ctx'])
+                z = self._put(np.zeros((K,), np.int32))
+                forced = self._put(np.zeros((K,), bool))
+                scommon = dict(k=k, ctx_bucket=Cx,
+                               eos_token_id=self.eos_token_id)
+                if self.prefill_chunk is not None or self.prefix_cache:
+                    # chunk steps can commit window tokens past the
+                    # draft; the catch-up `_draft_chunk` shapes a live
+                    # spec step can then dispatch (hole bucket x THIS
+                    # geometry's ctx bucket) must be warm too, or a
+                    # warm-attached engine would compile mid-serve
+                    self._warm_draft_catchup(
+                        Cx, z,
+                        self._put(np.zeros(
+                            (K, self.max_blocks_per_seq), np.int32)))
+                if g.kind == 'serve_spec_step':
+                    ids, real_len, btabs, slots = self._prefill_args(
+                        p['bucket'], [])
+                    self._note('serve_spec_step', k, p['bucket'], Cx)
+                    (_, _, _, self._last_logits, self._pages,
+                     self._dpages, _) = _serve_spec_step(
+                        self.model, self.draft, self._pages,
+                        self._dpages, self._last_logits, ids, real_len,
+                        btabs, slots, z, forced, dev['btab'],
+                        dev['ctx'], dev['live'], budget, *sample_args,
+                        **scommon)
+                else:
+                    self._note('serve_spec_window', k, Cx)
+                    (_, _, _, self._last_logits, self._pages,
+                     self._dpages, _) = _serve_spec_window(
+                        self.model, self.draft, self._pages,
+                        self._dpages, self._last_logits, z, forced,
+                        dev['btab'], dev['ctx'], dev['live'], budget,
+                        *sample_args, **scommon)
             else:
                 raise ValueError(
                     f'unknown serving geometry kind {g.kind!r}')
+
+    def _warm_draft_catchup(self, Sb, z, btabs):
+        """Warm the draft catch-up `_draft_chunk` shapes reachable at
+        context bucket `Sb`: holes are bounded by one decode window
+        per step, so their chunk buckets are the ladder entries at or
+        below bucket(decode_window)."""
+        K = self.max_slots
+        cbs, v = [], 1
+        while v <= self.decode_window:
+            b = bucket_length(v, self.buckets)
+            cbs.append(b)
+            v = b + 1
+        for cb in cbs:
+            if (cb, Sb) in self._draft_shapes:
+                continue
+            self._draft_shapes.add((cb, Sb))
+            ids = self._put(np.zeros((K, cb), np.int32))
+            self._dlogits, self._dpages = _draft_chunk(
+                self.draft, self._dpages, self._dlogits, ids, z, z,
+                btabs, self._dummy_slots, z, z, ctx_bucket=Sb)
 
     def warmup(self, artifact=None, geometries=None, draft=None):
         """Pre-populate the module-level jit caches (and the
@@ -1521,10 +2119,12 @@ class ServingEngine:
         ctx = jax.ShapeDtypeStruct((K,), jnp.int32)
         live = jax.ShapeDtypeStruct((K,), jnp.bool_)
         budget = jax.ShapeDtypeStruct((K,), jnp.int32)
-        common = dict(window=W, temperature=self.temperature,
-                      top_k=self.top_k, top_p=self.top_p,
-                      eos_token_id=self.eos_token_id)
-        if g.kind in ('serve_step', 'serve_prefill'):
+        fvec = jax.ShapeDtypeStruct((K,), jnp.float32)
+        svec = jax.ShapeDtypeStruct((K,), jnp.uint32)
+        ivec = jax.ShapeDtypeStruct((K,), jnp.int32)
+        samp = (fvec, ivec, fvec, svec, ivec)   # temp/topk/topp/seed/plen
+        common = dict(window=W, eos_token_id=self.eos_token_id)
+        if g.kind in ('serve_step', 'serve_prefill', 'serve_spec_step'):
             ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
             rl = jax.ShapeDtypeStruct((K,), jnp.int32)
             btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
@@ -1537,27 +2137,45 @@ class ServingEngine:
                                          jnp.int32)
             slots = jax.ShapeDtypeStruct((K,), jnp.int32)
 
-        def wrap(base, **statics):
+        def wrap(base, *extra_models, **statics):
             # tracelint: disable=TL001 - one-shot export wrapper (model
             # and statics baked into the closure; never a hot path)
             return jax.jit(functools.partial(
-                getattr(base, '__wrapped__', base), self.model, **statics))
+                getattr(base, '__wrapped__', base), self.model,
+                *extra_models, **statics))
 
         if g.kind == 'serve_step':
             yield ('', wrap(_serve_step, **common),
                    (pages, logits, ids, rl, btabs, slots, btab, ctx,
-                    live, budget, self._rng))
+                    live, budget) + samp)
         elif g.kind == 'serve_window':
             yield ('', wrap(_serve_window, **common),
-                   (pages, logits, btab, ctx, live, budget, self._rng))
+                   (pages, logits, btab, ctx, live, budget) + samp)
         elif g.kind == 'serve_prefill':
             yield ('', wrap(_paged_prefill),
                    (pages, logits, ids, rl, btabs, slots))
         elif g.kind == 'serve_chunk_step':
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
             yield ('', wrap(_serve_chunk_step,
                             ctx_bucket=int(p['bucket']), **common),
                    (pages, logits, ids, rl, rl, btabs, slots, rl, rl,
-                    btab, ctx, live, budget, self._rng))
+                    btab, ctx, live, budget) + samp + (ivec, fbool))
+        elif g.kind == 'serve_spec_step':
+            dpages = sds(self._dpages)
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
+            yield ('', wrap(_serve_spec_step, self.draft,
+                            k=int(p['spec']), ctx_bucket=int(p['ctx']),
+                            eos_token_id=self.eos_token_id),
+                   (pages, dpages, logits, ids, rl, btabs, slots, ivec,
+                    fbool, btab, ctx, live, budget) + samp)
+        elif g.kind == 'serve_spec_window':
+            dpages = sds(self._dpages)
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
+            yield ('', wrap(_serve_spec_window, self.draft,
+                            k=int(p['spec']), ctx_bucket=int(p['ctx']),
+                            eos_token_id=self.eos_token_id),
+                   (pages, dpages, logits, ivec, fbool, btab, ctx,
+                    live, budget) + samp)
         else:
             raise NotImplementedError(
                 f'no StableHLO export for geometry kind {g.kind!r}')
@@ -1583,21 +2201,21 @@ class ServingEngine:
                                     jnp.int32)
         vec = jax.ShapeDtypeStruct((K,), jnp.int32)
         live = jax.ShapeDtypeStruct((K,), jnp.bool_)
-        rng = sds(self._rng)
-        common = dict(window=W, temperature=self.temperature,
-                      top_k=self.top_k, top_p=self.top_p,
-                      eos_token_id=self.eos_token_id)
+        fvec = jax.ShapeDtypeStruct((K,), jnp.float32)
+        svec = jax.ShapeDtypeStruct((K,), jnp.uint32)
+        samp = (fvec, vec, fvec, svec, vec)
+        common = dict(window=W, eos_token_id=self.eos_token_id)
         if g.kind == 'serve_step':
             ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
             btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
                                          jnp.int32)
             yield (_serve_step,
                    (self.model, pages, logits, ids, vec, btabs, vec,
-                    btab, vec, live, vec, rng), common)
+                    btab, vec, live, vec) + samp, common)
         elif g.kind == 'serve_window':
             yield (_serve_window,
-                   (self.model, pages, logits, btab, vec, live, vec,
-                    rng), common)
+                   (self.model, pages, logits, btab, vec, live, vec)
+                   + samp, common)
         elif g.kind == 'serve_prefill':
             ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
             btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
@@ -1608,10 +2226,32 @@ class ServingEngine:
             ids = jax.ShapeDtypeStruct((K, int(p['chunk'])), jnp.int32)
             btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
                                          jnp.int32)
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
             yield (_serve_chunk_step,
                    (self.model, pages, logits, ids, vec, vec, btabs,
-                    vec, vec, vec, btab, vec, live, vec, rng),
+                    vec, vec, vec, btab, vec, live, vec) + samp
+                   + (vec, fbool),
                    dict(ctx_bucket=int(p['bucket']), **common))
+        elif g.kind == 'serve_spec_step':
+            dpages = sds(self._dpages)
+            ids = jax.ShapeDtypeStruct((K, int(p['bucket'])), jnp.int32)
+            btabs = jax.ShapeDtypeStruct((K, self.max_blocks_per_seq),
+                                         jnp.int32)
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
+            yield (_serve_spec_step,
+                   (self.model, self.draft, pages, dpages, logits, ids,
+                    vec, btabs, vec, vec, fbool, btab, vec, live, vec)
+                   + samp,
+                   dict(k=int(p['spec']), ctx_bucket=int(p['ctx']),
+                        eos_token_id=self.eos_token_id))
+        elif g.kind == 'serve_spec_window':
+            dpages = sds(self._dpages)
+            fbool = jax.ShapeDtypeStruct((K,), jnp.bool_)
+            yield (_serve_spec_window,
+                   (self.model, self.draft, pages, dpages, logits, vec,
+                    fbool, btab, vec, live, vec) + samp,
+                   dict(k=int(p['spec']), ctx_bucket=int(p['ctx']),
+                        eos_token_id=self.eos_token_id))
         else:
             raise NotImplementedError(
                 f'no cost specs for geometry kind {g.kind!r}')
@@ -1631,6 +2271,11 @@ class ServingEngine:
         if g.kind == 'serve_chunk_step':
             return ('serve_chunk_step', W, int(p['chunk']),
                     int(p['bucket']))
+        if g.kind == 'serve_spec_step':
+            return ('serve_spec_step', int(p['spec']), int(p['bucket']),
+                    int(p['ctx']))
+        if g.kind == 'serve_spec_window':
+            return ('serve_spec_window', int(p['spec']), int(p['ctx']))
         return None
 
     def _note_geometry_cost(self, g, cost):
@@ -1652,7 +2297,8 @@ class ServingEngine:
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None):
+               deadline_s=None, temperature=None, top_k=None,
+               top_p=None, seed=None):
         """Queue one request; returns its id for `result()`. Validated
         against the pool so an undeliverable request fails HERE, not as
         a livelock mid-serve. `deadline_s` (seconds from now) bounds
@@ -1660,7 +2306,29 @@ class ServingEngine:
         to state 'expired' at the next window commit (or at admission,
         if it expires while queued). Raises `QueueFull` when the queue
         is at `max_queue` and the shed policy keeps the newcomer out —
-        the caller's backpressure signal."""
+        the caller's backpressure signal.
+
+        `temperature`/`top_k`/`top_p`/`seed` are PER-REQUEST sampling
+        params (default: the engine's construction-time config; seed
+        defaults to the rid). They ride as slot data, so any mix of
+        greedy and sampled requests shares one batch with zero
+        retraces. Validated HERE with a typed `InvalidSamplingParams`
+        BEFORE the prompt copy is paid: temperature < 0 and
+        top_p outside (0, 1] reject; top_k clamps to the vocab (the
+        `filter_logits` HF semantics — top_k > V means keep-all,
+        top_k <= 0 disables the filter)."""
+        temperature = (self.temperature if temperature is None
+                       else float(temperature))
+        if temperature < 0:
+            raise InvalidSamplingParams(
+                f'temperature must be >= 0 (0 = greedy), got '
+                f'{temperature}')
+        top_p = self.top_p if top_p is None else float(top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise InvalidSamplingParams(
+                f'top_p must be in (0, 1], got {top_p}')
+        top_k = self.top_k if top_k is None else int(top_k)
+        top_k = max(0, min(top_k, int(self.model.config.vocab_size)))
         if self.draining:
             # drain is admission control, not validation: refuse with
             # the same typed backpressure signal a full queue gives,
@@ -1713,7 +2381,10 @@ class ServingEngine:
         # construction succeeds, so a malformed prompt that np.asarray
         # rejects cannot cancel an innocent queued request on its way
         # to raising
-        req = Request(self._rid, prompt, mnt, priority)
+        req = Request(self._rid, prompt, mnt, priority,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      sample_seed=(self._rid if seed is None
+                                   else int(seed)))
         if victim is not None:
             self._shed(victim)
         self._rid += 1
@@ -1960,6 +2631,15 @@ class ServingEngine:
                                     if req.deadline is not None else None),
                 'result': (req.result.tolist()
                            if req.result is not None else None),
+                # per-request sampling params + the speculative carried
+                # next-token (schema-1 compatible additions): a
+                # restored sampled stream re-derives its stateless key
+                # chain from (seed, generated index), and a restored
+                # speculative stream resumes from exactly the verify's
+                # pending choice — both bit-equal to uninterrupted
+                'temperature': req.temperature, 'top_k': req.top_k,
+                'top_p': req.top_p, 'sample_seed': req.sample_seed,
+                'spec_next': req.spec_next,
             }
 
         live = ([rec(r) for r in self.queue]
@@ -1992,9 +2672,9 @@ class ServingEngine:
             'preemptions': self.preemption_count,
             'counts': dict(self.counts),
             'prefix_counts': dict(self.prefix_counts),
+            'spec_counts': dict(self.spec_counts),
             'tokens_out': self._tokens_out,
             'serve_time': self._serve_time,
-            'rng': np.asarray(self._rng).tolist(),
         }
 
     def restore(self, snap):
@@ -2032,7 +2712,14 @@ class ServingEngine:
 
         def rebuild(r):
             req = Request(r['rid'], r['prompt'], r['max_new_tokens'],
-                          r['priority'])
+                          r['priority'],
+                          temperature=r.get('temperature',
+                                            self.temperature),
+                          top_k=r.get('top_k', self.top_k),
+                          top_p=r.get('top_p', self.top_p),
+                          sample_seed=r.get('sample_seed'))
+            sn = r.get('spec_next')
+            req.spec_next = int(sn) if sn is not None else None
             req.generated = [int(t) for t in r['generated']]
             req.seq = r['seq']
             req.state = r['state']
@@ -2097,13 +2784,17 @@ class ServingEngine:
         for k, v in snap.get('prefix_counts', {}).items():
             if k in self.prefix_counts:
                 self.prefix_counts[k] = int(v)
+        for k, v in snap.get('spec_counts', {}).items():
+            if k in self.spec_counts:
+                self.spec_counts[k] = int(v)
         self._tokens_out = int(snap.get('tokens_out', self._tokens_out))
         # without the matching serve-time, tokens_per_s would divide the
         # lifetime token total by the standby's near-zero wall time — a
         # phantom throughput spike on every failover
         self._serve_time = float(snap.get('serve_time', self._serve_time))
-        if snap.get('rng') is not None:
-            self._rng = self._put(np.asarray(snap['rng'], np.uint32))
+        # older snapshots carry an 'rng' key from the pre-PR-15 shared
+        # sampling stream; per-request stateless keys made it
+        # meaningless, so it is accepted and ignored
         # continuous health history across the failover: rules matched
         # by name, so a standby with a tweaked ruleset still adopts
         # the states both sides define (a snapshot without watchdog
@@ -2257,15 +2948,6 @@ class ServingEngine:
         if chunk_rows and not self._chunk_seam_ok(chunk_rows):
             chunk_rows = []
         W = self.decode_window
-        if self.temperature != 0.0:
-            self._rng, sub = jax.random.split(self._rng)
-            if self._rep is not None:
-                # re-pin the split halves replicated: split's output
-                # placement is the compiler's choice, and a drifting
-                # key sharding would flap the dispatch cache key
-                self._rng, sub = self._put(self._rng), self._put(sub)
-        else:
-            sub = self._rng               # unused inside a greedy trace
         # admissions beyond the fused dispatch prefill standalone (a
         # step that admits across buckets, or any monolithic admission
         # landing on a step where a chunk group holds the fused slot).
@@ -2296,9 +2978,10 @@ class ServingEngine:
             return []
         dev = self._device_state()
         budget = self._put(self._budget)        # shrinks every window
-        common = dict(window=W, temperature=self.temperature,
-                      top_k=self.top_k, top_p=self.top_p,
-                      eos_token_id=self.eos_token_id)
+        common = dict(window=W, eos_token_id=self.eos_token_id)
+        spec = self.draft is not None and not chunk_rows
+        sample_args = (dev['temp'], dev['topk'], dev['topp'],
+                       dev['seed'], dev['plen'])
         # a fault scripted at kind='window' models the whole worker
         # dying mid-serve and PROPAGATES out of step() by design, so a
         # supervisor snapshots and restores — the crash path
@@ -2321,20 +3004,125 @@ class ServingEngine:
                 if self._slot_req[slot] is r:
                     self._demote(slot, r)
             raise
+        if spec:
+            # the draft-dispatch fault seam (testing/faults.py): a
+            # draft-model fault is ISOLATING, not a worker death — it
+            # fails exactly the requests whose window needed the draft
+            # (every live decoding slot this step, the fused admission
+            # group included), pages freed, and the engine stays
+            # steppable: queued requests admit next step and decode
+            # bit-equal, nothing was dispatched with a half-written
+            # draft cache
+            try:
+                if _faults.ACTIVE is not None:
+                    _faults.fire(
+                        'draft_dispatch', k=self.spec_window,
+                        rids=[r.rid for s, r in enumerate(self._slot_req)
+                              if r is not None
+                              and self._pfill[s] is None])
+            except Exception as e:  # noqa: BLE001 - scripted faults
+                self._fail_group(
+                    [(s, r) for s, r in enumerate(self._slot_req)
+                     if r is not None and self._pfill[s] is None], e)
+                self._serve_time += time.perf_counter() - t0
+                self._update_gauges()
+                return []
+        spec_out = None
         t_dispatch = time.perf_counter()
-        if chunk_rows:
+        if spec:
+            k = self.spec_window
+            max_ctx = max(int(self._ctx[s])
+                          for s, r in enumerate(self._slot_req)
+                          if r is not None and self._pfill[s] is None)
+            Sb_ctx = bucket_length(max_ctx + k + 1, self.buckets)
+            ftok_d, forced_d = self._forced_state()
+            # draft catch-up first (rows whose commits bypassed the
+            # draft on a chunk step): the spec window's proposals must
+            # run against complete draft KV. Sb_ctx covers every
+            # row's end position by construction.
+            catchup = self._draft_catchup_rows()
+            fresh_draft = bool(catchup) and self._draft_advance(
+                catchup, Sb_ctx)
+            scommon = dict(k=k, ctx_bucket=Sb_ctx,
+                           eos_token_id=self.eos_token_id)
+            if fused is not None:
+                Sb, group = fused
+                for _s, r in group:
+                    r.mark('prefill_dispatch', bucket=Sb, fused=True)
+                ids, real_len, btabs, slots = self._prefill_args(Sb,
+                                                                 group)
+                hit = self._note('serve_spec_step', k, Sb, Sb_ctx)
+                dispatch_key = ('serve_spec_step', k, Sb, Sb_ctx)
+                (cand, nc, nxt, self._last_logits, self._pages,
+                 self._dpages, ctx_out) = _serve_spec_step(
+                    self.model, self.draft, self._pages, self._dpages,
+                    self._last_logits, ids, real_len, btabs, slots,
+                    ftok_d, forced_d, dev['btab'], dev['ctx'],
+                    dev['live'], budget, *sample_args, **scommon)
+                if self.prefix_cache:
+                    for slot, r in group:
+                        self._register_prefix_pages(slot, r, 0,
+                                                    r.context_len)
+            else:
+                hit = self._note('serve_spec_window', k, Sb_ctx)
+                dispatch_key = ('serve_spec_window', k, Sb_ctx)
+                (cand, nc, nxt, self._last_logits, self._pages,
+                 self._dpages, ctx_out) = _serve_spec_window(
+                    self.model, self.draft, self._pages, self._dpages,
+                    self._last_logits, ftok_d, forced_d, dev['btab'],
+                    dev['ctx'], dev['live'], budget, *sample_args,
+                    **scommon)
+            spec_out = (cand, nc, nxt)
+            # a fresh draft catch-up shape paid its compile inside
+            # this step's wall: count the window as a MISS so the
+            # compile time is excluded from ITL/MFU like any other
+            hit = hit and not fresh_draft
+            self.spec_counts['windows'] += 1
+        elif chunk_rows:
             (ids, clen, cst, btabs, slots, cow_src, cow_dst, Cb,
              Sb) = self._chunk_args(chunk_rows)
             for _s, r, _p, _t in chunk_rows:
                 r.mark('prefill_dispatch', chunk=True, start=_p, take=_t)
             hit = self._note('serve_chunk_step', W, Cb, Sb)
             dispatch_key = ('serve_chunk_step', W, Cb, Sb)
+            if self.draft is not None:
+                # keep the DRAFT's pages current through the chunk
+                # path: same chunk/CoW args, logits commit dropped —
+                # issued before the CoW pins are released below, so
+                # both dispatches read the pinned source pages
+                if (Cb, Sb) not in self._draft_shapes:
+                    self._draft_shapes.add((Cb, Sb))
+                    hit = False          # this step pays its compile
+                self._dlogits, self._dpages = _draft_chunk(
+                    self.draft, self._dpages, self._dlogits, ids, clen,
+                    cst, btabs, self._dummy_slots, cow_src, cow_dst,
+                    ctx_bucket=Sb)
+                for s, _r, p, t in chunk_rows:
+                    self._dctx[s] = p + t
+                # decoding rows' draft holes (the PREVIOUS chunk-step
+                # window's commits) catch up eagerly, so no hole ever
+                # exceeds one window
+                catchup = self._draft_catchup_rows()
+                if catchup and self._draft_advance(
+                        catchup,
+                        bucket_length(max(p + t for _s, _r, p, t
+                                          in catchup), self.buckets)):
+                    hit = False
+                # decoding rows may carry a pending verify-chosen next
+                # token (spec_next): the chunk window consumes it as
+                # each row's first token
+                ftok_d, forced_d = self._forced_state()
+            else:
+                # non-speculative engines can never have forced rows —
+                # the constant zero uploads skip the per-step scan
+                ftok_d, forced_d = self._zero_ftok, self._zero_forced
             toks, self._last_logits, self._pages, ctx_out = \
                 _serve_chunk_step(
                     self.model, self._pages, self._last_logits, ids,
                     clen, cst, btabs, slots, cow_src, cow_dst,
-                    dev['btab'], dev['ctx'], dev['live'], budget, sub,
-                    ctx_bucket=Sb, **common)
+                    dev['btab'], dev['ctx'], dev['live'], budget,
+                    *sample_args, ftok_d, forced_d, ctx_bucket=Sb,
+                    **common)
             self.prefix_counts['chunk_steps'] += 1
             _obs.inc('serve.chunk_steps')
             if self._cow_release:
@@ -2357,7 +3145,7 @@ class ServingEngine:
             toks, self._last_logits, self._pages, ctx_out = _serve_step(
                 self.model, self._pages, self._last_logits, ids, real_len,
                 btabs, slots, dev['btab'], dev['ctx'], dev['live'],
-                budget, sub, **common)
+                budget, *sample_args, **common)
             if self.prefix_cache:
                 for slot, r in group:
                     self._register_prefix_pages(slot, r, 0, r.context_len)
@@ -2366,16 +3154,23 @@ class ServingEngine:
             dispatch_key = ('serve_window', W)
             toks, self._last_logits, self._pages, ctx_out = _serve_window(
                 self.model, self._pages, self._last_logits,
-                dev['btab'], dev['ctx'], dev['live'], budget, sub,
-                **common)
+                dev['btab'], dev['ctx'], dev['live'], budget,
+                *sample_args, **common)
         # the returned ctx equals the host's post-commit view whenever
         # no slot is retired below (retiring invalidates the mirror)
         dev['ctx'] = ctx_out
         # ONE batched host read per window — the scheduler needs the
-        # emitted tokens to detect eos/budget and refill the batch; all
-        # other state is host-authoritative.
+        # emitted tokens (and, speculatively, the per-slot accept
+        # counts + carried next-token) to detect eos/budget and refill
+        # the batch; all other state is host-authoritative.
         # tracelint: disable=TL002 - single sync per window by design
-        tokens = np.asarray(jax.device_get(toks))
+        if spec_out is not None:
+            cand_h, nc_h, nxt_h = jax.device_get(spec_out)
+            cand_h, nc_h, nxt_h = (np.asarray(cand_h), np.asarray(nc_h),
+                                   np.asarray(nxt_h))
+            tokens = None
+        else:
+            tokens = np.asarray(jax.device_get(toks))
         t_commit = time.perf_counter()
         if not hit:
             # a NEW registry key means this dispatch paid trace +
@@ -2408,13 +3203,36 @@ class ServingEngine:
                 # emitted pad tokens and commit nothing until their
                 # last chunk lands
                 continue
-            take = min(W, req.remaining)
-            committed = []
-            for t in range(take):
-                tok = int(tokens[slot, t])
-                committed.append(tok)
-                if self.eos_token_id is not None and tok == self.eos_token_id:
-                    break
+            if spec_out is not None:
+                # ragged speculative commit: the device already
+                # clamped the accept count by budget and truncated at
+                # eos (ncommit); the carried next-token persists on
+                # the request so preemption/restore resumes bit-equal
+                take = int(nc_h[slot])
+                committed = [int(t) for t in cand_h[slot, :take]]
+                req.spec_next = int(nxt_h[slot])
+                # the draft scan wrote every committed position's KV
+                self._dctx[slot] += take
+                self.spec_counts['proposed'] += self.spec_window
+                self.spec_counts['accepted'] += max(0, take - 1)
+                if telemetry:
+                    _obs.inc('serve.spec_proposed', self.spec_window)
+                    _obs.inc('serve.spec_accepted', max(0, take - 1))
+            else:
+                take = min(W, req.remaining)
+                committed = []
+                for t in range(take):
+                    tok = int(tokens[slot, t])
+                    committed.append(tok)
+                    if (self.eos_token_id is not None
+                            and tok == self.eos_token_id):
+                        break
+                if committed:
+                    # the window consumed any pending speculative
+                    # carried token as its first commit (the forced
+                    # path) — a stale spec_next must not force a later
+                    # spec window at the wrong position
+                    req.spec_next = None
             req.generated.extend(committed)
             self._ctx[slot] += len(committed)
             # keep the device-side freeze live: next window's budget is
@@ -2432,8 +3250,14 @@ class ServingEngine:
                     if arrived is not None:
                         mx['ttft'].observe((t_commit - arrived) * 1e3)
                     itl_n -= 1        # the first-ever token is TTFT
-                if per_tok_ms is not None:
-                    mx['itl'].observe(per_tok_ms, n=itl_n)
+                row_ms = per_tok_ms
+                if spec_out is not None and hit:
+                    # ragged window: this row's per-token latency is
+                    # the window wall over ITS committed count
+                    row_ms = ((t_commit - t_dispatch) * 1e3
+                              / max(len(committed), 1))
+                if row_ms is not None:
+                    mx['itl'].observe(row_ms, n=itl_n)
                 else:
                     _obs.inc('serve.itl_skipped_compile', itl_n)
                 req.mark('window', t_commit, n=len(committed),
@@ -2496,6 +3320,71 @@ class ServingEngine:
     def _free_slots(self):
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
+    def _draft_catchup_rows(self):
+        """Decoding slots whose draft pages lag their committed context
+        (tokens a chunk-step's plain decode window committed never
+        passed through the draft): (slot, req, start, take) rows for a
+        `_draft_chunk` catch-up dispatch. Holes are bounded by one
+        window per step (catch-up runs every speculative AND chunk
+        step), so the take always buckets at or below the decode
+        window's bucket."""
+        rows = []
+        for s, r in enumerate(self._slot_req):
+            if r is None or self._pfill[s] is not None:
+                continue
+            hole = int(self._ctx[s]) - int(self._dctx[s])
+            if hole > 0:
+                rows.append((s, r, int(self._dctx[s]), hole))
+        return rows
+
+    def _draft_advance(self, rows, Sb):
+        """One `_draft_chunk` dispatch appending each row's tokens
+        [start, start+take) into the DRAFT's pages (no CoW — catch-up
+        rows are past-prefill decoding slots), then advance their
+        draft-valid context. Returns True when this (chunk bucket, ctx
+        bucket) shape is NEW to the engine — its dispatch paid trace +
+        compile, so the caller must count the step as a cache MISS
+        (the wall would otherwise pollute the ITL/MFU gauges as decode
+        time). Warmup drives the reachable shapes (`_warm_geometry`),
+        so a warm-attached engine never sees a fresh one."""
+        K = self.max_slots
+        Cb = bucket_length(max(t for *_x, t in rows), self.buckets)
+        fresh = (Cb, Sb) not in self._draft_shapes
+        self._draft_shapes.add((Cb, Sb))
+        ids = np.zeros((K, Cb), np.int32)
+        clen = np.zeros((K,), np.int32)
+        start = np.zeros((K,), np.int32)
+        btabs = np.zeros((K, self.max_blocks_per_seq), np.int32)
+        for i, (slot, req, p, take) in enumerate(rows):
+            toks = np.concatenate([req.prompt,
+                                   np.asarray(req.generated, np.int32)])
+            ids[i, :take] = toks[p:p + take]
+            clen[i] = take
+            start[i] = p
+            btabs[i] = self._btab[slot]
+        z = self._put(np.zeros((K,), np.int32))
+        self._dlogits, self._dpages = _draft_chunk(
+            self.draft, self._dpages, self._dlogits, self._put(ids),
+            self._put(clen), self._put(start), self._put(btabs),
+            self._dummy_slots, z, z, ctx_bucket=Sb)
+        for slot, req, p, take in rows:
+            self._dctx[slot] = p + take
+        return fresh
+
+    def _forced_state(self):
+        """Per-slot (forced_tok, forced) device args: rows carrying a
+        speculative window's pending next-token choice (req.spec_next)
+        commit it as their next token, whatever dispatch shape runs
+        them. All-False on non-speculative engines (spec_next is never
+        set) — the shared chunk-step trace stays identical."""
+        forced = np.zeros((self.max_slots,), bool)
+        ftok = np.zeros((self.max_slots,), np.int32)
+        for s, r in enumerate(self._slot_req):
+            if r is not None and r.spec_next is not None:
+                forced[s] = True
+                ftok[s] = r.spec_next
+        return self._put(ftok), self._put(forced)
+
     def _device_state(self):
         """Device copies of the per-slot scheduler state, cached until
         a slot mutation invalidates them (self._dev = None). Slots mid
@@ -2519,6 +3408,14 @@ class ServingEngine:
                 'btab': self._put(btab),
                 'ctx': self._put(ctx),
                 'live': self._put(np.asarray(live)),
+                # per-slot sampling params ride the same slot-mutation
+                # cadence (set at place, zeroed at clear) — a steady
+                # window re-uses these uploads untouched
+                'temp': self._put(self._temp),
+                'topk': self._put(self._topk),
+                'topp': self._put(self._topp),
+                'seed': self._put(self._seed),
+                'plen': self._put(self._plen),
             }
         return self._dev
 
@@ -2690,6 +3587,10 @@ class ServingEngine:
                     # its last chunk commits
                     self._pfill[slot] = start
                     self._cow_pending[slot] = cow_pair
+                    # the draft holds only the shared-prefix pages so
+                    # far (valid: previous owners wrote them); its
+                    # chunk legs advance this alongside the target's
+                    self._dctx[slot] = start
                     if chunked:
                         self.prefix_counts['chunked_admissions'] += 1
                         _obs.inc('serve.chunked_admissions')
@@ -2711,6 +3612,15 @@ class ServingEngine:
         self._btab[slot, :len(pages)] = pages
         self._ctx[slot] = req.context_len
         self._budget[slot] = req.remaining
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._seed[slot] = np.uint32(req.sample_seed)
+        self._plen[slot] = len(req.prompt)
+        # monolithic admissions prefill BOTH models this same step; a
+        # chunk-path admission overrides this to its continuation start
+        # right after placement (_admit)
+        self._dctx[slot] = req.context_len
         self._dev = None
         req.state = 'running'
         req.admit_seq = next(self._admit_seq)
@@ -2750,12 +3660,19 @@ class ServingEngine:
         """Standalone prefill dispatch for an admission group that did
         not fit the fused step (multi-bucket admission steps, or any
         monolithic admission landing on a step whose fused dispatch is
-        the chunk group's)."""
+        the chunk group's). A speculative engine prefills the DRAFT's
+        pages too — the draft must hold every admitted row's prompt KV
+        or its proposals would be conditioned on zeros and the accept
+        rate would silently collapse."""
         ids, real_len, btabs, slots = self._prefill_args(Sb, group)
         self._note('serve_prefill', Sb)
         self._last_logits, self._pages = _paged_prefill(
             self.model, self._pages, self._last_logits, ids, real_len,
             btabs, slots)
+        if self.draft is not None:
+            self._dlogits, self._dpages = _paged_prefill(
+                self.draft, self._dpages, self._dlogits, ids, real_len,
+                btabs, self._dummy_slots)
 
     def _chunk_args(self, rows):
         """Device args for one fixed-width chunk-continuation batch
@@ -2842,6 +3759,12 @@ class ServingEngine:
         step() keeps decoding whatever remains; `OutOfBlocks` never
         escapes the scheduler."""
         a = self.allocator
+        # per-step maximum commit: a speculative window can land up to
+        # k+1 tokens (draft writes beyond the committed region fall on
+        # the scratch page, so coverage only needs the committable max)
+        adv = self.decode_window
+        if self.spec_window is not None:
+            adv = max(adv, self.spec_window + 1)
         for slot in range(self.max_slots):
             req = self._slot_req[slot]
             if req is None or self._pfill[slot] is not None:
@@ -2850,8 +3773,7 @@ class ServingEngine:
                 # top-up until their last chunk commits
                 continue
             target = _ceil_div(
-                int(self._ctx[slot]) + min(self.decode_window,
-                                           req.remaining),
+                int(self._ctx[slot]) + min(adv, req.remaining),
                 self.block_size)
             while (self._slot_req[slot] is req
                    and target > len(self._slot_pages[slot])):
@@ -2999,6 +3921,12 @@ class ServingEngine:
         self._btab[slot] = 0
         self._ctx[slot] = 0
         self._budget[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._seed[slot] = 0
+        self._plen[slot] = 0
+        self._dctx[slot] = 0
         self._pfill[slot] = None
         self._cow_pending[slot] = None
         self._dev = None
@@ -3006,4 +3934,5 @@ class ServingEngine:
 
 __all__ = ['ServingEngine', 'BlockAllocator', 'RequestQueue', 'Request',
            'OutOfBlocks', 'QueueFull', 'RequestError', 'RequestFailed',
-           'RequestExpired', 'RequestCancelled', 'prompt_page_hashes']
+           'RequestExpired', 'RequestCancelled', 'InvalidSamplingParams',
+           'prompt_page_hashes']

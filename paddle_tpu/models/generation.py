@@ -88,6 +88,48 @@ class QuantKVCache(typing.NamedTuple):
     vscale: jax.Array    # f32 (Hkv, D)
 
 
+class QuantPagedKVCache(typing.NamedTuple):
+    """Int8 paged KV pool with PER-ROW scales (the ServingEngine's
+    `kv_cache_dtype='int8'` layout — ref capability: the reference
+    serving stack's cache-KV int8 block_multihead_attention). K/V pages
+    live int8; each written row (one token's K or V at one kv head)
+    carries its own f32 scale at `ks[page, head, slot]` /
+    `vs[page, head, slot]`, computed from that row alone
+    (`quantize_kv_row`). Per-row scales make quantization a pure
+    function of the token's bf16 K/V row — independent of write
+    batching — so re-prefill after preemption, prefix-cache sharing,
+    CoW copies, and snapshot/restore all reproduce bit-identical int8
+    pages, which is what keeps greedy serving streams bit-equal across
+    every scheduler path. Storage overhead is 4/D per element (~6% at
+    D=64). Halves the decode cache stream vs bf16 — the binding term
+    at batch >= 8 and long contexts."""
+
+    kp: jax.Array        # int8 (num_blocks, Hkv, block_size, D)
+    vp: jax.Array        # int8 (num_blocks, Hkv, block_size, D)
+    ks: jax.Array        # f32 (num_blocks, Hkv, block_size)
+    vs: jax.Array        # f32 (num_blocks, Hkv, block_size)
+
+
+class RowQuantKVCache(typing.NamedTuple):
+    """CONTIGUOUS int8 KV cache with per-row scales — the temp-cache
+    twin of QuantPagedKVCache, used by the serving engine's fused
+    multi-token bodies (admission prefill, chunked prefill, the
+    speculative verify): rows gathered from int8 pages stay int8 here
+    (scales ride along), and rows the forward writes quantize with the
+    SAME per-row rule the paged pools use. Attending through this
+    cache therefore sees exactly the int8-roundtripped values a paged
+    decode step would — the invariant that makes int8 serving streams
+    bit-equal across monolithic prefill, chunked prefill, speculative
+    windows, and plain decode (every path attends the same quantized
+    world). Layouts: kq/vq (B, max_len, Hkv, D) int8, ks/vs
+    (B, max_len, Hkv) f32."""
+
+    kq: jax.Array
+    vq: jax.Array
+    ks: jax.Array
+    vs: jax.Array
+
+
 class PagedKVCache(typing.NamedTuple):
     """Paged (block-table) KV cache for continuous-batching serving
     (ref capability: the reference serving stack's
@@ -117,6 +159,55 @@ def calibrate_kv_scale(x, margin=1.0):
     """Per-(kv-head, dim) amax scales from the prefill rows."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(0, 1))
     return jnp.maximum(amax * margin, 1e-6) / 127.0
+
+
+def quantize_kv_row(x):
+    """PER-ROW symmetric int8 quantization: each (..., Hkv, D) row
+    quantizes against its own per-(row, head) amax — a pure function
+    of the row's values, so the SAME bf16 row always produces the SAME
+    int8 bytes + scale no matter when or where it is written (prefill
+    scatter, decode append, chunk continuation, speculative verify,
+    re-prefill after preemption). Returns (q int8 (..., Hkv, D),
+    scale f32 (..., Hkv))."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-6) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv_row(q, scale, dtype):
+    """Inverse of `quantize_kv_row`: int8 rows x their per-row scales,
+    cast to the compute dtype. The ONE dequant expression every
+    attention path shares (paged gather reference, RowQuant contiguous
+    fallback, pallas in-VMEM) so the attended values are bit-identical
+    across them."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def filter_logits_batched(logits, top_k, top_p):
+    """Per-ROW top-k / nucleus filtering: `top_k` (B,) int32 and
+    `top_p` (B,) f32 ride as DEVICE data, so a batch can mix greedy,
+    top-k, and nucleus rows in one trace (the serving engine's
+    per-request sampling — changing the mix never retraces). Semantics
+    per row match `filter_logits` exactly: top_k <= 0 keeps all,
+    top_k > V clamps to keep-all, top_p == 1.0 is a no-op (masked, not
+    skipped — the cumsum's float roundoff must not drop valid tokens
+    for keep-all rows)."""
+    V = logits.shape[-1]
+    k = jnp.clip(jnp.asarray(top_k, jnp.int32), 1, V)
+    srt = jnp.sort(logits, axis=-1)
+    kth = jnp.take_along_axis(srt, (V - k)[:, None], axis=-1)
+    logits = jnp.where((jnp.asarray(top_k, jnp.int32) > 0)[:, None],
+                       jnp.where(logits < kth, -jnp.inf, logits), logits)
+    tp = jnp.asarray(top_p, jnp.float32)
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), -1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < tp[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+    nucleus = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jnp.where((tp < 1.0)[:, None], nucleus, logits)
 
 
 class GenerationMixin:
@@ -224,10 +315,13 @@ class GenerationMixin:
         kv_heads = (getattr(cfg, 'num_key_value_heads', None)
                     or cfg.num_attention_heads)
         dtype = dtype or self.cache_dtype()
+        dtype = jnp.dtype(dtype)
+        quant = dtype == jnp.int8
         shape = (int(num_blocks), kv_heads, int(block_size), head_dim)
+        sshape = shape[:3]                    # per-row scales (NB,Hkv,BS)
 
-        def make():
-            return jnp.zeros(shape, dtype)
+        def make(sh=shape, dt=dtype):
+            return jnp.zeros(sh, dt)
 
         from ..distributed.mesh import get_mesh
 
@@ -248,10 +342,21 @@ class GenerationMixin:
 
             spec = _valid_spec(P(None, 'tp', None, None), shape, mesh)
             sharding = NamedSharding(mesh, spec)
+            sspec = _valid_spec(P(None, 'tp', None), sshape, mesh)
+            ssharding = NamedSharding(mesh, sspec)
 
-            def make():  # noqa: F811 - mesh-aware variant
-                return jax.device_put(jnp.zeros(shape, dtype), sharding)
+            def make(sh=shape, dt=dtype):  # noqa: F811 - mesh-aware
+                s = ssharding if len(sh) == 3 else sharding
+                return jax.device_put(jnp.zeros(sh, dt), s)
 
+        if quant:
+            # int8 pages + per-row f32 scales (QuantPagedKVCache): the
+            # scale pools shard on the same kv-head axis, so one page's
+            # data AND its scales live on the same shard
+            return [QuantPagedKVCache(make(), make(),
+                                      make(sshape, jnp.float32),
+                                      make(sshape, jnp.float32))
+                    for _ in range(cfg.num_hidden_layers)]
         return [PagedKVCache(make(), make())
                 for _ in range(cfg.num_hidden_layers)]
 

@@ -229,10 +229,12 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
     (S == 1); rows whose table entry is 0 write to the reserved scratch
     page (inference/serving.py parks inactive slots there)."""
     from .generation import (PagedKVCache, QuantKVCache,
-                             calibrate_kv_scale, quantize_kv_rows)
+                             QuantPagedKVCache, RowQuantKVCache,
+                             calibrate_kv_scale, dequantize_kv_row,
+                             quantize_kv_row, quantize_kv_rows)
 
     B, S, H, D = q.shape
-    if isinstance(cache, PagedKVCache):
+    if isinstance(cache, (PagedKVCache, QuantPagedKVCache)):
         return _paged_cached_attention(q, k, v, cache, kv_write_pos,
                                        block_tables, window, kvalid,
                                        kv_start)
@@ -248,6 +250,31 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
         def write(buf, new):
             return jax.lax.dynamic_update_slice(
                 buf, new.astype(buf.dtype), (0, cache_index, 0, 0))
+    rowquant = isinstance(cache, RowQuantKVCache)
+    if rowquant:
+        # per-row int8 (the serving engine's fused multi-token bodies):
+        # rows quantize one at a time against their own amax — the
+        # exact rule the QuantPagedKVCache pools apply — and the whole
+        # cache dequantizes EAGERLY for attention, so every attended
+        # value is the int8-roundtripped one a paged decode step would
+        # see. That shared roundtrip is what keeps int8 serving streams
+        # bit-equal across prefill / chunk / speculative / decode paths.
+        kq, vq, ks, vs = cache
+        knew, ks_new = quantize_kv_row(k)
+        vnew, vs_new = quantize_kv_row(v)
+        kq = write(kq, knew)
+        vq = write(vq, vnew)
+        if kv_write_pos is not None:
+            ks = ks.at[rows, wcols].set(ks_new)
+            vs = vs.at[rows, wcols].set(vs_new)
+        else:
+            ks = jax.lax.dynamic_update_slice(ks, ks_new,
+                                              (0, cache_index, 0))
+            vs = jax.lax.dynamic_update_slice(vs, vs_new,
+                                              (0, cache_index, 0))
+        new_cache = RowQuantKVCache(kq, vq, ks, vs)
+        ck = dequantize_kv_row(kq, ks, q.dtype)
+        cv = dequantize_kv_row(vq, vs, q.dtype)
     quant = isinstance(cache, QuantKVCache)
     if quant:
         kq, vq, kscale, vscale = cache
@@ -267,7 +294,7 @@ def cached_attention(q, k, v, cache, cache_index, kvalid=None,
         vq = write(vq, quantize_kv_rows(v, vscale))
         new_cache = QuantKVCache(kq, vq, kscale, vscale)
         ck, cv = kq, vq
-    else:
+    elif not rowquant:                 # rowquant set ck/cv above
         ck, cv = cache
         ck = write(ck, k)
         cv = write(cv, v)
@@ -417,7 +444,14 @@ def _paged_cached_attention(q, k, v, cache, kv_write_pos, block_tables,
             'sliding-window attention over a paged cache is not '
             'supported: serve SWA models through the contiguous '
             'DecodeEngine path')
-    kp, vp = cache
+    from .generation import (QuantPagedKVCache, dequantize_kv_row,
+                             quantize_kv_row)
+
+    quant = isinstance(cache, QuantPagedKVCache)
+    if quant:
+        kp, vp, kss, vss = cache
+    else:
+        kp, vp = cache
     NB, Hkv, BS, _ = kp.shape
     tbl = jnp.asarray(block_tables, jnp.int32)
     maxb = tbl.shape[1]
@@ -429,9 +463,22 @@ def _paged_cached_attention(q, k, v, cache, kv_write_pos, block_tables,
     # the scratch page, so the clamped write stays harmless)
     page = tbl[rows, jnp.minimum(wp // BS, maxb - 1)]
     slot = wp % BS
-    kp = kp.at[page, :, slot, :].set(k[:, 0].astype(kp.dtype))
-    vp = vp.at[page, :, slot, :].set(v[:, 0].astype(vp.dtype))
-    new_cache = PagedKVCache(kp, vp)
+    if quant:
+        # per-row int8: the new row quantizes against its own amax (the
+        # same pure-function rule the serving prefill scatter applies),
+        # so this row's int8 bytes are identical whether it was written
+        # here or by a re-prefill after preemption
+        kq, ksr = quantize_kv_row(k[:, 0])       # (B, Hkv, D), (B, Hkv)
+        vq, vsr = quantize_kv_row(v[:, 0])
+        kp = kp.at[page, :, slot, :].set(kq)
+        vp = vp.at[page, :, slot, :].set(vq)
+        kss = kss.at[page, :, slot].set(ksr)
+        vss = vss.at[page, :, slot].set(vsr)
+        new_cache = QuantPagedKVCache(kp, vp, kss, vss)
+    else:
+        kp = kp.at[page, :, slot, :].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page, :, slot, :].set(v[:, 0].astype(vp.dtype))
+        new_cache = PagedKVCache(kp, vp)
     counts = wp + 1
     out = None
     if D % 8 == 0:
@@ -442,16 +489,24 @@ def _paged_cached_attention(q, k, v, cache, kv_write_pos, block_tables,
                 from ..ops.pallas.paged_attention import (
                     paged_decode_attention)
 
-                out = paged_decode_attention(q, kp, vp, tbl, counts)
+                out = paged_decode_attention(
+                    q, kp, vp, tbl, counts,
+                    k_scale=kss if quant else None,
+                    v_scale=vss if quant else None)
             except Exception as e:
                 from ..ops import pallas_failed
 
                 pallas_failed('paged_attention', e)
     if out is None:
         # gather reference (CPU tests / non-TPU): pages -> a contiguous
-        # (B, MAXB*BS, Hkv, D) view, masked by per-row valid length
-        ck = jnp.swapaxes(kp[tbl], 2, 3).reshape(B, maxb * BS, Hkv, D)
-        cv = jnp.swapaxes(vp[tbl], 2, 3).reshape(B, maxb * BS, Hkv, D)
+        # (B, MAXB*BS, Hkv, D) view, masked by per-row valid length;
+        # int8 pools dequantize with the shared per-row expression
+        gk, gv = kp[tbl], vp[tbl]                # (B, maxb, Hkv, BS, D)
+        if quant:
+            gk = dequantize_kv_row(gk, kss[tbl], q.dtype)
+            gv = dequantize_kv_row(gv, vss[tbl], q.dtype)
+        ck = jnp.swapaxes(gk, 2, 3).reshape(B, maxb * BS, Hkv, D)
+        cv = jnp.swapaxes(gv, 2, 3).reshape(B, maxb * BS, Hkv, D)
         mask = (jnp.arange(maxb * BS)[None, :]
                 < counts[:, None])[:, None, None, :]
         out = F.scaled_dot_product_attention(q, ck, cv, attn_mask=mask)

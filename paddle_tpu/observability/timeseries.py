@@ -262,6 +262,15 @@ class WindowedTimeseries:
         if total > 0:
             failed = ctrs.get('serve.failed', {}).get('delta', 0)
             self.registry.gauge('serve.err_rate').set(failed / total)
+        # windowed speculative accept rate: accepted draft tokens over
+        # proposed, THIS window only (untouched on windows with no
+        # proposals — a drained engine keeps its last reading instead
+        # of snapping to a meaningless 0)
+        sp = ctrs.get('serve.spec_proposed')
+        if sp is not None and sp['delta'] > 0:
+            acc = ctrs.get('serve.spec_accepted', {}).get('delta', 0)
+            self.registry.gauge('serve.spec_accept_rate').set(
+                acc / sp['delta'])
 
     # -- reading -----------------------------------------------------------
 

@@ -372,8 +372,8 @@ def _num(v):
 def default_serving_rules(*, engine=None, ttft_p99_ms=10_000.0,
                           itl_p99_ms=1_000.0, error_rate=0.25,
                           queue_depth=None, pool_pressure=1.0,
-                          mfu_floor=0.0, for_windows=3,
-                          clear_windows=2):
+                          mfu_floor=0.0, spec_accept_floor=0.0,
+                          for_windows=3, clear_windows=2):
     """The production serving ruleset (docs/observability.md catalogs
     each row). Thresholds are keyword-tunable; the defaults are loose
     ceilings meant to catch an engine that is WRONG, not one that is
@@ -396,6 +396,14 @@ def default_serving_rules(*, engine=None, ttft_p99_ms=10_000.0,
         loaded (no data — costs absent — never breaches). The default
         floor 0.0 makes the rule present-but-inert; give a real floor
         once the deployment's expected MFU is known.
+      - spec_accept_floor: `serve.spec_accept_rate` (the windowed
+        accepted/proposed draft-token ratio the timeseries ring
+        publishes) below the floor — a collapsing accept rate means
+        the draft has drifted off the traffic and speculation is now
+        COSTING throughput. Inert at the default 0.0 (the rate is
+        never negative; non-speculative engines publish no gauge, so
+        the rule sees no data and never pages); give a real floor once
+        the deployment's steady accept rate is known.
     """
     rules = [
         SLORule('ttft_p99', 'p99(serve.ttft_ms)', '>', ttft_p99_ms,
@@ -426,6 +434,11 @@ def default_serving_rules(*, engine=None, ttft_p99_ms=10_000.0,
         SLORule('mfu_floor', 'gauge(serve.mfu_est)', '<', mfu_floor,
                 for_windows=for_windows, clear_windows=clear_windows,
                 help='MFU below floor while dispatch costs are loaded'),
+        SLORule('spec_accept_floor', 'gauge(serve.spec_accept_rate)',
+                '<', spec_accept_floor, for_windows=for_windows,
+                clear_windows=clear_windows,
+                help='speculative accept rate below floor — the draft '
+                     'has drifted off the traffic'),
     ]
     if queue_depth is None and engine is not None:
         mq = getattr(engine, 'max_queue', None)

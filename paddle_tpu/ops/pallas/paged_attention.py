@@ -32,7 +32,7 @@ def _interpret():
 
 
 def _body(cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc, m_scr,
-          l_scr, *, scale, nb, bs, hkv, group):
+          l_scr, *, scale, nb, bs, hkv, group, rowscale=False):
     """Shared head-major online-softmax pass. Column order: the (hkv, bs,
     D) block flattens to c = h*bs + s, so head(c) = c // bs and
     position(c) = j*bs + c % bs."""
@@ -53,9 +53,17 @@ def _body(cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc, m_scr,
     v = v_ref[0].astype(jnp.float32)
     if ks_ref is not None:
         # int8 dequant rides the (hkv, bs, D) layout BEFORE the
-        # major-dim collapse (the Mosaic-proven pattern)
-        k = k * ks_ref[...][:, None, :]
-        v = v * vs_ref[...][:, None, :]
+        # major-dim collapse (the Mosaic-proven pattern). Two scale
+        # layouts: (Hkv, D) global per-(head, dim) calibration
+        # (QuantKVCache), or (1, Hkv, BS) PER-ROW scales riding the
+        # page itself (QuantPagedKVCache — each token row carries its
+        # own amax, so quantization is write-order independent)
+        if rowscale:
+            k = k * ks_ref[0][:, :, None]
+            v = v * vs_ref[0][:, :, None]
+        else:
+            k = k * ks_ref[...][:, None, :]
+            v = v * vs_ref[...][:, None, :]
     k = k.reshape(cols, D)
     v = v.reshape(cols, D)
 
@@ -137,8 +145,12 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
     q: (B, 1, Hq, D); key_cache/value_cache: (NB, Hkv, BS, D) pages;
     block_tables: (B, MAXB) int32 page ids (entries past the sequence's
     pages may be any value — they are clamped and masked); context_lens:
-    (B,) valid positions per row. Optional k_scale/v_scale (Hkv, D) f32
-    dequantize int8 pages in VMEM. Returns (B, 1, Hq, D).
+    (B,) valid positions per row. Optional k_scale/v_scale dequantize
+    int8 pages in VMEM, in either of two layouts: (Hkv, D) f32 global
+    per-(head, dim) calibration (QuantKVCache), or (NB, Hkv, BS) f32
+    PER-ROW scales riding page-shaped pools (QuantPagedKVCache — the
+    scale block is prefetched by the same block-table index map as its
+    page). Returns (B, 1, Hq, D).
     """
     B, Sq, Hq, D = q.shape
     if Sq != 1:
@@ -158,6 +170,7 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
         nb * BS)
 
     quant = k_scale is not None
+    rowscale = quant and k_scale.ndim == 3
     in_specs = [
         pl.BlockSpec((1, 1, Hq, D), lambda b, j, cl, tbl: (b, 0, 0, 0)),
         # the prefetched block table IS the page index: grid step (b, j)
@@ -168,11 +181,19 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
                      lambda b, j, cl, tbl: (tbl[b, j], 0, 0, 0)),
     ]
     args = [cl, tbl, q, key_cache, value_cache]
-    kw = dict(scale=scale, nb=nb, bs=BS, hkv=Hkv, group=group)
+    kw = dict(scale=scale, nb=nb, bs=BS, hkv=Hkv, group=group,
+              rowscale=rowscale)
     if quant:
         kernel = functools.partial(_kernel_q8, **kw)
-        in_specs += [pl.BlockSpec((Hkv, D),
-                                  lambda b, j, cl, tbl: (0, 0))] * 2
+        if rowscale:
+            # per-row scales live in page-shaped (NB, Hkv, BS) pools:
+            # the scale block for grid step (b, j) is the same
+            # prefetched page the K/V blocks DMA
+            in_specs += [pl.BlockSpec(
+                (1, Hkv, BS), lambda b, j, cl, tbl: (tbl[b, j], 0, 0))] * 2
+        else:
+            in_specs += [pl.BlockSpec((Hkv, D),
+                                      lambda b, j, cl, tbl: (0, 0))] * 2
         args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     else:
         kernel = functools.partial(_kernel, **kw)

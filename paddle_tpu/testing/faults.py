@@ -50,6 +50,7 @@ Seam sites wired in-tree (callers pass site-specific context):
   | `admit`        | `ServingEngine._admit`, per admission     | `rid`, `need` |
   | `preempt`      | `ServingEngine._preempt_one`, pre-evict   | `rid`, `slot` |
   | `dispatch`     | `ServingEngine.step`, per dispatch        | `kind` ('prefill'/'chunk'/'window'), `rids`/`bucket` |
+  | `draft_dispatch` | `ServingEngine.step`, before each speculative propose/verify dispatch | `k`, `rids` (the live decoding requests riding the window) |
   | `shm_push`     | `io.dataloader._push_with_backoff`        | `worker_id`, `timeout` |
 
 Every ctx also carries `site` and `call` (1-based per-site call count
@@ -59,8 +60,12 @@ faults to the affected request or group (an admission fault under a
 prefix-cache hit returns its page shares — refcounts stay balanced),
 treats alloc faults as pool pressure, and lets a `dispatch
 kind='window'` fault propagate (that one models the whole worker
-dying — the crash `snapshot()`/`restore()` recovers from). See
-docs/serving.md#resilience.
+dying — the crash `snapshot()`/`restore()` recovers from). A
+`draft_dispatch` fault is ISOLATING by contract: the draft model
+failing is not a worker death — it fails exactly the requests whose
+speculative window needed the draft (pages freed, refcounts
+balanced) while the engine stays steppable and every other request
+decodes bit-equal. See docs/serving.md#resilience.
 """
 from __future__ import annotations
 

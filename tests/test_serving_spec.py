@@ -1,0 +1,486 @@
+"""Speculative + int8-KV + per-request-sampled continuous batching
+(inference/serving.py, PR 15).
+
+Pins the composition contracts:
+
+  - greedy streams BIT-EQUAL spec-on vs spec-off (bf16 and int8),
+    across eos stops, preemption, prefix-cache hits, and
+    snapshot/restore — the speculative window changes the cost, never
+    the stream;
+  - per-request sampling params are slot DATA: a batch mixing greedy /
+    top-k / nucleus rows shares one trace and changing the mix never
+    retraces; per-request seeds make sampled streams deterministic,
+    batch-independent, and bit-equal across preemption and restore;
+  - int8 pools (QuantPagedKVCache, per-row scales) keep refcounts and
+    scales balanced through CoW, preemption, and injected OutOfBlocks;
+  - the draft_dispatch fault seam is ISOLATING: a draft-model fault
+    fails only the window's requests, the engine stays steppable and
+    later requests decode bit-equal;
+  - AOT enumeration == live keys EXACTLY for the speculative geometry
+    product, and a warmed spec+int8 engine serves its first request
+    with zero traces and zero registry misses.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.inference.engine import COMPILE_CACHE, total_traces
+from paddle_tpu.inference.serving import (InvalidSamplingParams,
+                                          OutOfBlocks, RequestFailed,
+                                          ServingEngine)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.testing.faults import FaultInjector
+
+_CACHE = {}
+
+
+def _model(seed=0, **kw):
+    key = (seed, tuple(sorted(kw.items())))
+    if key not in _CACHE:
+        pt.seed(seed)
+        cfg = dict(vocab_size=96, hidden_size=64, layers=2, heads=4,
+                   kv_heads=2, max_pos=256)
+        cfg.update(kw)
+        _CACHE[key] = LlamaForCausalLM(llama_tiny(**cfg))
+    return _CACHE[key]
+
+
+def _prompts(n=4, lo=4, hi=14, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(3, 96, size=int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _engine(model=None, **kw):
+    base = dict(max_slots=3, block_size=8, max_new_tokens=10,
+                eos_token_id=2)
+    base.update(kw)
+    return ServingEngine(model if model is not None else _model(), **base)
+
+
+def _same(a, b):
+    return (np.asarray(a).shape == np.asarray(b).shape
+            and (np.asarray(a) == np.asarray(b)).all())
+
+
+class TestSpecGreedyParity:
+    def test_bf16_spec_matches_nonspec(self):
+        ps = _prompts()
+        want = _engine().serve(ps)
+        spec = _engine(draft=_model(1), num_draft_tokens=3)
+        got = spec.serve(ps)
+        assert all(_same(a, b) for a, b in zip(want, got))
+        assert spec.spec_counts['windows'] > 0
+
+    def test_int8_spec_matches_int8_nonspec(self):
+        ps = _prompts(seed=3)
+        want = _engine(kv_cache_dtype='int8').serve(ps)
+        got = _engine(draft=_model(1), num_draft_tokens=3,
+                      kv_cache_dtype='int8').serve(ps)
+        assert all(_same(a, b) for a, b in zip(want, got))
+
+    def test_self_speculation_accepts_every_draft(self):
+        """draft == target weights: every greedy proposal verifies, so
+        the accept rate is exactly 1.0 — the accept machinery's upper
+        anchor. Budget divisible by k+1 and no eos, so no window is
+        truncated (truncated windows count their proposals but not the
+        cut-off accepts — by design)."""
+        e = _engine(draft=_model(), num_draft_tokens=3,
+                    max_new_tokens=8, eos_token_id=None)
+        e.serve(_prompts())
+        assert e.stats()['spec']['accept_rate'] == 1.0
+
+    def test_spec_int8_preemption_parity(self):
+        """A pool too small for the load forces preemptions mid-spec;
+        resumed streams must equal the uninterrupted engine's."""
+        ps = _prompts(seed=5)
+        want = _engine(max_slots=4, block_size=4,
+                       kv_cache_dtype='int8').serve(ps)
+        tight = _engine(max_slots=4, block_size=4, num_blocks=14,
+                        draft=_model(1), num_draft_tokens=3,
+                        kv_cache_dtype='int8')
+        got = tight.serve(ps)
+        assert tight.preemption_count > 0
+        assert all(_same(a, b) for a, b in zip(want, got))
+        assert tight.allocator.in_use() == 0
+
+    def test_spec_prefix_hit_parity_int8(self):
+        """Prefix-cache hits hand a spec+int8 request already-quantized
+        shared pages; per-row scales make those pages bit-identical to
+        what its own prefill would write, so hit streams equal
+        cold-cache streams."""
+        rng = np.random.default_rng(7)
+        sys_p = rng.integers(3, 96, (16,)).astype(np.int32)
+        ps = [np.concatenate([sys_p, rng.integers(3, 96, (4 + i,))
+                              .astype(np.int32)]) for i in range(3)]
+        cold = _engine(draft=_model(1), num_draft_tokens=3,
+                       kv_cache_dtype='int8')
+        want = [cold.serve([p])[0] for p in ps]
+        warm = _engine(draft=_model(1), num_draft_tokens=3,
+                       kv_cache_dtype='int8', prefix_cache=True,
+                       block_size=8)
+        # sequential serves: each request's prompt pages are indexed
+        # before the next arrives (same-step admissions can't hit)
+        got = [warm.serve([p])[0] for p in ps]
+        assert warm.prefix_counts['hits'] > 0
+        assert all(_same(a, b) for a, b in zip(want, got))
+        assert warm.allocator.in_use() == 0
+
+    def test_spec_chunked_admission_parity(self):
+        """A long prompt arriving mid-decode routes the step through
+        the chunk dispatch: decoding spec rows consume their carried
+        verify-chosen token as the chunk window's first commit (the
+        forced path) and the stale spec_next never forces a later
+        window — streams stay bit-equal to the non-spec engine."""
+        rng = np.random.default_rng(41)
+        short = [rng.integers(3, 96, (5,)).astype(np.int32)
+                 for _ in range(2)]
+        long_p = rng.integers(3, 96, (40,)).astype(np.int32)
+        kw = dict(max_slots=3, block_size=8, max_new_tokens=12,
+                  max_context_len=128, prefill_chunk=16,
+                  eos_token_id=2)
+        ref = ServingEngine(_model(), **kw)
+        r_ids = [ref.submit(p) for p in short]
+        ref.step()
+        r_long = ref.submit(long_p)
+        ref.run()
+        want = [np.asarray(ref.result(r)) for r in r_ids + [r_long]]
+        spec = ServingEngine(_model(), draft=_model(1),
+                             num_draft_tokens=3, **kw)
+        s_ids = [spec.submit(p) for p in short]
+        spec.step()
+        s_long = spec.submit(long_p)
+        spec.run()
+        assert spec.prefix_counts['chunk_steps'] > 0
+        got = [np.asarray(spec.result(r)) for r in s_ids + [s_long]]
+        assert all(_same(a, b) for a, b in zip(want, got))
+
+    def test_draft_pool_follows_every_admission_path(self):
+        """The draft's pages must hold every admitted row's prompt KV
+        whatever path admitted it — chunked, standalone multi-bucket,
+        or fused — or proposals run against zeros and the accept rate
+        silently collapses. Self-draft makes the check exact: accept
+        rate stays 1.0 across all admission paths."""
+        # max_new > decode_window: the chunk-step's plain window
+        # commits the first tokens (bypassing the draft), then spec
+        # windows run over the caught-up draft pool
+        kw = dict(max_slots=3, block_size=8, max_new_tokens=24,
+                  max_context_len=128, eos_token_id=None)
+        rng = np.random.default_rng(43)
+        # chunked admission path
+        e = ServingEngine(_model(), draft=_model(), num_draft_tokens=3,
+                          prefill_chunk=16, **kw)
+        e.serve([rng.integers(3, 96, (40,)).astype(np.int32)])
+        assert e.prefix_counts['chunked_admissions'] > 0
+        assert e.spec_counts['windows'] > 0
+        assert e.stats()['spec']['accept_rate'] == 1.0
+        # standalone multi-bucket admission path (two buckets, one
+        # step: the smaller group prefills standalone)
+        e2 = ServingEngine(_model(), draft=_model(),
+                           num_draft_tokens=3, **kw)
+        e2.submit(rng.integers(3, 96, (5,)).astype(np.int32))
+        e2.submit(rng.integers(3, 96, (20,)).astype(np.int32))
+        e2.run()
+        assert e2.stats()['spec']['accept_rate'] == 1.0
+
+    def test_spec_snapshot_restore_parity(self):
+        ps = _prompts(seed=9)
+        e = _engine(draft=_model(1), num_draft_tokens=3, max_slots=2)
+        rids = [e.submit(p) for p in ps]
+        e.step()
+        e.step()
+        import json
+
+        snap = json.loads(json.dumps(e.snapshot()))
+        e.run()
+        want = {r: np.asarray(e.result(r)) for r in rids}
+        standby = _engine(draft=_model(1), num_draft_tokens=3,
+                          max_slots=2)
+        standby.restore(snap)
+        standby.run()
+        for r in rids:
+            assert _same(standby.result(r), want[r])
+
+
+class TestPerRequestSampling:
+    def test_mixed_batch_zero_retraces_as_mix_changes(self):
+        e = _engine(max_new_tokens=6)
+        ps = _prompts(6, seed=11)
+        e.submit(ps[0])
+        e.submit(ps[1], temperature=0.9, top_k=20)
+        e.submit(ps[2], temperature=0.8, top_p=0.9)
+        e.run()
+        t0 = total_traces()
+        e.submit(ps[3], temperature=1.2, top_k=5, seed=3)
+        e.submit(ps[4])                          # greedy again
+        e.submit(ps[5], temperature=0.5, top_p=0.7, top_k=9)
+        e.run()
+        assert total_traces() - t0 == 0
+
+    def test_sampled_stream_is_batch_independent(self):
+        """Per-row stateless keys: a request's sampled stream depends
+        only on (its tokens, its seed), not on its batchmates."""
+        ps = _prompts(3, seed=13)
+        solo = _engine(max_new_tokens=8)
+        want = solo.serve([ps[0]])[0]            # engine defaults
+        solo2 = _engine(max_new_tokens=8)
+        r0 = solo2.submit(ps[0])
+        solo2.submit(ps[1], temperature=1.0, seed=5)
+        solo2.submit(ps[2], temperature=0.7, top_k=12, seed=6)
+        solo2.run()
+        assert _same(solo2.result(r0), want)
+
+    def test_same_seed_reproduces_different_seed_diverges(self):
+        p = _prompts(1, lo=8, hi=9, seed=17)[0]
+        outs = []
+        for seed in (21, 21, 22):
+            e = _engine(max_new_tokens=12, eos_token_id=None)
+            r = e.submit(p, temperature=1.0, seed=seed)
+            e.run()
+            outs.append(np.asarray(e.result(r)))
+        assert _same(outs[0], outs[1])
+        assert not _same(outs[0], outs[2])
+
+    def test_sampled_resume_bit_equal_after_preemption(self):
+        p = _prompts(2, lo=10, hi=12, seed=19)
+        free = _engine(max_slots=2, block_size=4, max_new_tokens=10,
+                       eos_token_id=None)
+        ra = free.submit(p[0], temperature=0.9, seed=4)
+        rb = free.submit(p[1], temperature=1.1, seed=5)
+        free.run()
+        want = [np.asarray(free.result(ra)), np.asarray(free.result(rb))]
+        tight = _engine(max_slots=2, block_size=4, num_blocks=8,
+                        max_new_tokens=10, eos_token_id=None)
+        ra = tight.submit(p[0], temperature=0.9, seed=4)
+        rb = tight.submit(p[1], temperature=1.1, seed=5)
+        tight.run()
+        assert tight.preemption_count > 0
+        assert _same(tight.result(ra), want[0])
+        assert _same(tight.result(rb), want[1])
+
+    def test_submit_validation_typed_and_early(self):
+        e = _engine()
+        with pytest.raises(InvalidSamplingParams, match='temperature'):
+            e.submit(np.arange(1, 5), temperature=-0.5)
+        with pytest.raises(InvalidSamplingParams, match='top_p'):
+            e.submit(np.arange(1, 5), top_p=0.0)
+        with pytest.raises(InvalidSamplingParams, match='top_p'):
+            e.submit(np.arange(1, 5), top_p=1.5)
+        assert len(e.queue) == 0 and not e._live
+        # top_k CLAMPS (filter_logits HF semantics), never raises
+        rid = e.submit(np.arange(1, 5), temperature=0.5, top_k=10_000)
+        assert e._live[rid].top_k == 96
+        rid2 = e.submit(np.arange(1, 5), top_k=-3)
+        assert e._live[rid2].top_k == 0
+
+    def test_sampled_spec_distribution_sane_and_deterministic(self):
+        """Sampled speculative streams are deterministic per seed and
+        emit in-vocab tokens; exactness of the rejection identity is
+        pinned at the math level in test_decode.py — here the serving
+        composition must at least be reproducible and mixed-batch
+        safe."""
+        p = _prompts(1, lo=6, hi=7, seed=23)[0]
+        outs = []
+        for _ in range(2):
+            e = _engine(draft=_model(1), num_draft_tokens=3,
+                        max_new_tokens=10, eos_token_id=None)
+            r = e.submit(p, temperature=1.0, top_k=40, seed=31)
+            e.run()
+            outs.append(np.asarray(e.result(r)))
+        assert _same(outs[0], outs[1])
+        gen = outs[0][len(p):]
+        assert ((gen >= 0) & (gen < 96)).all()
+
+
+class TestInt8Pool:
+    def test_quant_pool_bytes_accounting(self):
+        from paddle_tpu.models.generation import QuantPagedKVCache
+
+        e = _engine(kv_cache_dtype='int8', block_size=8)
+        pc = e._pages[0]
+        assert isinstance(pc, QuantPagedKVCache)
+        per_layer = (2 * int(np.prod(pc.kp.shape[1:]))       # int8 k+v
+                     + 2 * 4 * int(np.prod(pc.ks.shape[1:])))  # f32 scales
+        assert e.allocator.bytes_per_page == per_layer * len(e._pages)
+        st = e.allocator.stats()
+        assert st['bytes_total'] == e.allocator.num_blocks * per_layer * \
+            len(e._pages)
+
+    def test_spec_pool_bytes_include_draft(self):
+        solo = _engine(kv_cache_dtype='int8')
+        spec = _engine(draft=_model(1, layers=1), num_draft_tokens=2,
+                       kv_cache_dtype='int8')
+        assert spec.allocator.bytes_per_page > \
+            solo.allocator.bytes_per_page
+
+    def test_int8_cow_refcounts_balanced_under_preemption(self):
+        """Full-coverage prefix hits CoW their boundary page on int8
+        pools (data AND scale rows copied); preemption and drain must
+        return every reference."""
+        rng = np.random.default_rng(29)
+        sys_p = rng.integers(3, 96, (16,)).astype(np.int32)
+        e = _engine(kv_cache_dtype='int8', prefix_cache=True,
+                    block_size=8, max_slots=2, num_blocks=16,
+                    max_new_tokens=6)
+        ps = [np.concatenate([sys_p, rng.integers(3, 96, (3,))
+                              .astype(np.int32)]) for _ in range(4)]
+        ps.append(sys_p.copy())                  # full-coverage hit
+        e.serve(ps)
+        assert e.allocator.in_use() == 0
+        a = e.allocator
+        assert len(a._free) + len(a._cached) == a.usable
+
+    def test_int8_refcounts_balanced_under_injected_outofblocks(self):
+        e = _engine(kv_cache_dtype='int8', prefix_cache=True,
+                    block_size=8, max_slots=2, max_new_tokens=6)
+        ps = _prompts(4, seed=31)
+        inj = FaultInjector(seed=0)
+        inj.script('alloc', exc=OutOfBlocks('injected: pool dry'),
+                   after=2, times=2)
+        with inj:
+            outs = e.serve(ps)
+        assert len(outs) == len(ps)
+        assert e.allocator.in_use() == 0
+
+
+class TestDraftFaultSeam:
+    def test_draft_fault_fails_only_window_requests(self):
+        e = _engine(draft=_model(1), num_draft_tokens=3, max_slots=2,
+                    max_new_tokens=6)
+        ps = _prompts(4, seed=37)
+        want = _engine(draft=_model(1), num_draft_tokens=3,
+                       max_slots=2, max_new_tokens=6).serve(ps)
+        rids = [e.submit(p) for p in ps]
+        inj = FaultInjector(seed=0)
+        rule = inj.script('draft_dispatch', at=2)
+        with inj:
+            e.run()
+        assert rule.fired == 1
+        failed = [r for r in rids
+                  if e.status(r) == 'failed']
+        finished = [r for r in rids if e.status(r) == 'finished']
+        assert failed and finished
+        # survivors (admitted after the fault) are bit-equal
+        for r in finished:
+            assert _same(e.result(r), want[rids.index(r)])
+        for r in failed:
+            with pytest.raises(RequestFailed):
+                e.result(r)
+        assert e.allocator.in_use() == 0
+        # engine stays steppable: a fresh request serves fine
+        out = e.serve([ps[0]])
+        assert _same(out[0], want[0])
+
+
+class TestSpecAOT:
+    def test_enumeration_equals_live_exact(self):
+        """The spec geometry product (spec window x prefill bucket x
+        ctx bucket) enumerated for a small engine equals EXACTLY the
+        keys a workload covering every reachable shape notes."""
+        m, d = _model(hidden_size=32, layers=1), _model(1, hidden_size=32,
+                                                        layers=1)
+        e = ServingEngine(m, draft=d, num_draft_tokens=3, max_slots=3,
+                          block_size=4, max_new_tokens=4,
+                          max_context_len=40)
+        gs = aot.for_serving_engine(e)
+        enum = set(gs.registry_keys(e))
+        before = set(COMPILE_CACHE.keys())
+        rng = np.random.default_rng(0)
+
+        def req(n, **kw):
+            return e.submit(rng.integers(3, 96, (n,)).astype(np.int32),
+                            **kw)
+
+        # multi-bucket same-step admissions hit every standalone
+        # prefill bucket; a long-context row in flight while short ones
+        # admit sweeps the (bucket, ctx) product; solo drains sweep the
+        # window ctx ladder
+        for L in range(1, 37):
+            req(L)
+            if L % 3 == 0:
+                e.run()
+        e.run()
+        for hi in (20, 28, 36):
+            long_r = req(hi)                     # long row in flight
+            e.step()
+            for lo in (1, 5, 17):
+                if lo + 4 <= 40:
+                    req(lo)
+            e.run()
+        # force multi-bucket admission steps (standalone prefills)
+        for _ in range(3):
+            req(3)
+            req(18)
+            req(33)
+            e.run()
+        live = {k for k in COMPILE_CACHE.keys() if k not in before}
+        assert live == enum, (
+            f'missing={sorted(map(str, enum - live))[:4]} '
+            f'extra={sorted(map(str, live - enum))[:4]}')
+
+    def test_warm_attach_zero_compile_spec_int8(self, tmp_path):
+        m, d = _model(hidden_size=32, layers=1), _model(1, hidden_size=32,
+                                                        layers=1)
+
+        def mk():
+            return ServingEngine(m, draft=d, num_draft_tokens=2,
+                                 max_slots=2, block_size=4,
+                                 max_new_tokens=4, max_context_len=16,
+                                 kv_cache_dtype='int8')
+
+        e = mk()
+        e.warmup(geometries=aot.for_serving_engine(e), draft=d)
+        t0, m0 = total_traces(), COMPILE_CACHE.misses
+        rid = e.submit(np.arange(1, 6, dtype=np.int32))
+        e.run()
+        assert e.result(rid) is not None
+        assert total_traces() - t0 == 0
+        assert COMPILE_CACHE.misses - m0 == 0
+
+    def test_warm_attach_covers_draft_catchup_shapes(self):
+        """A warmed speculative engine WITH chunking must not compile
+        mid-serve when a chunk-step window commits tokens past the
+        draft and the next spec step runs its catch-up dispatch."""
+        m, d = _model(hidden_size=32, layers=1), _model(1, hidden_size=32,
+                                                        layers=1)
+
+        def mk():
+            return ServingEngine(m, draft=d, num_draft_tokens=2,
+                                 max_slots=2, block_size=4,
+                                 max_new_tokens=8, max_context_len=48,
+                                 prefill_chunk=8, decode_window=4,
+                                 eos_token_id=None)
+
+        e = mk()
+        e.warmup(geometries=aot.for_serving_engine(e), draft=d)
+        t0 = total_traces()
+        # short request decoding while a long one chunk-admits: the
+        # chunk-step's window commits past the draft, forcing the
+        # catch-up path on the following spec step
+        r1 = e.submit(np.arange(1, 5, dtype=np.int32))
+        e.step()
+        r2 = e.submit((np.arange(30, dtype=np.int32) % 90) + 3)
+        e.run()
+        assert e.result(r1) is not None and e.result(r2) is not None
+        assert e.spec_counts['windows'] > 0
+        assert total_traces() - t0 == 0
+
+    def test_registry_keys_distinct_by_dtype_and_draft(self):
+        plain = _engine()
+        i8 = _engine(kv_cache_dtype='int8')
+        spec = _engine(draft=_model(1), num_draft_tokens=3)
+        assert plain.registry_key('serve_window', 2) != \
+            i8.registry_key('serve_window', 2)
+        assert plain._geometry() != spec._geometry()
+
+    def test_spec_int8_aot_config_fields(self):
+        e = _engine(draft=_model(1), num_draft_tokens=3,
+                    kv_cache_dtype='int8')
+        cfg = e.aot_config()
+        assert cfg['kv_cache_dtype'] == 'int8'
+        assert cfg['num_draft_tokens'] == 3
+        assert cfg['draft'] and cfg['draft_struct']
+        plain_cfg = _engine().aot_config()
+        assert plain_cfg['kv_cache_dtype'] is None
+        assert plain_cfg['draft'] is None

@@ -95,10 +95,14 @@ def run_workload(n_requests=16, decode_window=8, seed=0, tp=1):
     # private windowed ring (50ms windows so even this tiny workload
     # commits several) — the dump's verdict/ruleset printout and the
     # timeseries.json artifact both come from it
+    # draft=model is self-speculation (accept rate 1.0 for greedy
+    # rows): the dump exercises the speculative window path and the
+    # serve.spec_* counters without needing a second checkpoint
     srv = ServingEngine(model, max_slots=4, block_size=8,
                         max_context_len=48, max_new_tokens=16,
                         decode_window=decode_window,
                         prefix_cache=True, prefill_chunk=16,
+                        draft=model, num_draft_tokens=3,
                         watchdog=True, ts_interval_s=0.05,
                         **({'tp': int(tp)} if tp and int(tp) > 1 else {}))
     rids = [srv.submit(p, m) for p, m in zip(prompts, mnts)]
@@ -220,6 +224,13 @@ def main(argv=None):
           f'{pfx["shared_pages"]} shared / {pfx["cow_pages"]} cow page(s)')
     print(f'chunk steps      {pfx["chunk_steps"]} '
           f'({pfx["chunked_admissions"]} chunked admission(s))')
+    spc = srv.stats()['spec']
+    ar = spc['accept_rate']
+    print(f'spec windows     {spc["windows"]} '
+          f'({spc["accepted"]}/{spc["proposed"]} draft tokens accepted'
+          f'{"" if ar is None else f", rate {ar:.3f}"})')
+    print(f'spec_accept_rate '
+          f'{snap.get("serve.spec_accept_rate", {}).get("value")}')
     print(f'compile events   '
           f'{snap.get("compile.traces", {}).get("value")}')
     print(f'host spans       {len(obs.TRACER)}')
