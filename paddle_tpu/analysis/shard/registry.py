@@ -500,6 +500,56 @@ def _build_serving_spec_step():
     )
 
 
+def _build_serving_kv_export():
+    """The disagg migration gather (export half): one request's pages
+    collected contiguous from the head-sharded pool, output pinned
+    REPLICATED for the host download — the replication pin over the
+    sharded gather IS the migration's wire cost, so the all-gather
+    census here is exactly the per-export collective bill."""
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._kv_export, '__wrapped__', srv._kv_export)
+
+    def kv_export(pages, btabs, st):
+        return body(pages, btabs, st, ctx_bucket=16)
+
+    rep = f['rep']
+    return Suite(
+        fn=kv_export,
+        args=(f['pages'], _sds((1, 8), 'int32'), _sds((1,), 'int32')),
+        mesh=f['mesh'],
+        in_shardings=(f['pages_sh'], rep, rep),
+    )
+
+
+def _build_serving_kv_import():
+    """The import half: a replicated host-uploaded blob scattered into
+    the head-sharded destination pool through the block-table rows. A
+    replicated->sharded write is a local slice per device — the
+    declared budget is EMPTY, and any collective appearing here is a
+    resharded pool (the regression this suite pins)."""
+    from paddle_tpu.inference import serving as srv
+
+    f = _serving_fixture()
+    body = getattr(srv._kv_import, '__wrapped__', srv._kv_import)
+    Cx = 16
+
+    def kv_import(pages, blob, pflat, sflat):
+        return body(pages, blob, pflat, sflat, ctx_bucket=Cx)
+
+    ent = (_sds((1, Cx, 8, 8), 'float32'),
+           _sds((1, Cx, 8, 8), 'float32'))
+    rep = f['rep']
+    return Suite(
+        fn=kv_import,
+        args=(f['pages'], [ent, ent], _sds((Cx,), 'int32'),
+              _sds((Cx,), 'int32')),
+        mesh=f['mesh'],
+        in_shardings=(f['pages_sh'], rep, rep, rep),
+    )
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -587,6 +637,18 @@ ENTRIES = (
           budget={'all-reduce': {'count': 17, 'bytes': 29 * KB},
                   'all-gather': {'count': 15, 'bytes': 30 * KB},
                   'collective-permute': {'count': 8, 'bytes': KB}}),
+    # KV-cache migration (disaggregated serving, ISSUE 16): the export
+    # gather's replication pins are its entire wire cost — one
+    # all-gather per pool field (2 layers x k,v = 4 at the fixture),
+    # bytes = the migrated rows themselves. The import scatter is a
+    # replicated-blob -> sharded-pool write: a LOCAL slice per device,
+    # so its budget is {} — any collective surfacing there means the
+    # destination pool resharded (exactly what would silently multiply
+    # migration cost by the mesh degree on a real pod).
+    Entry('serving/kv_export_tp', _SRV, _build_serving_kv_export,
+          budget={'all-gather': {'count': 4, 'bytes': 20 * KB}}),
+    Entry('serving/kv_import_tp', _SRV, _build_serving_kv_import,
+          budget={}),
 )
 
 

@@ -49,6 +49,12 @@ class Geometry:
                        k + 1))
       serve_spec_window spec, ctx (a pure speculative window, no
                        admissions this step)
+      serve_export     ctx (the KV-migration gather behind
+                       `export_kv`: `ctx` buckets the exported
+                       kv length — bucket(context_len - 1))
+      serve_import     ctx (the KV-migration scatter behind
+                       `import_kv`, same `ctx` bucketing — a decode
+                       pool warms these instead of admission kinds)
       train_step       input_shapes, input_dtypes, label_shapes,
                        label_dtypes (shape entries are tuples/lists of int)
     """
@@ -174,6 +180,10 @@ def _registry_key(engine, g):
     if g.kind == 'serve_spec_window':
         return engine.registry_key('serve_spec_window', p['spec'],
                                    p['ctx'])
+    if g.kind == 'serve_export':
+        return engine.registry_key('serve_export', p['ctx'])
+    if g.kind == 'serve_import':
+        return engine.registry_key('serve_import', p['ctx'])
     if g.kind == 'train_step':
         return engine.registry_key(p['input_shapes'][0],
                                    p['input_dtypes'][0])
@@ -238,7 +248,7 @@ def for_decode_engine(engine, prompt_lens, batch_sizes=(1,),
 
 def for_serving_engine(engine, prompt_lens=None,
                        include_standalone_prefill=True,
-                       max_new_tokens=None):
+                       max_new_tokens=None, migration=False):
     """Geometries a ServingEngine dispatches: one fused admit+decode
     step per admission bucket, the pure decode window, (when
     `include_standalone_prefill`) the standalone prefill each bucket
@@ -259,7 +269,26 @@ def for_serving_engine(engine, prompt_lens=None,
     or prefix-hit-continuation admission can dispatch (chunk widths
     cap at bucket(prefill_chunk); with prefix caching alone the width
     is the unshared suffix, at most max_context_len - block_size
-    since a hit is at least one full page)."""
+    since a hit is at least one full page).
+
+    Disaggregated roles (engine.phase_role) reshape the set:
+
+      'decode'   — an import-fed decode pool dispatches NO admission
+                   kinds at all: only the `serve_import` scatter, the
+                   one-token continuation chunk that recomputes the
+                   boundary position, and the pure decode window.
+                   `prompt_lens` then declares the CONTEXT lengths at
+                   import (prompt + tokens generated on the prefill
+                   side). Assumes no preemption re-admissions — size
+                   the pool for the declared workload.
+      'prefill'  — the monolithic set plus the `serve_export` gather
+                   per reachable handoff context bucket (the request
+                   hands off holding 1..decode_window tokens).
+      'monolithic' (default) — unchanged; pass `migration=True` to
+                   additionally enumerate export+import at the
+                   declared buckets (a monolithic engine exercising
+                   round-trip migration, e.g. the bit-equality
+                   tests)."""
     W = engine.decode_window
     if prompt_lens is None:
         prompt_lens = range(1, engine.max_context_len + 1)
@@ -267,6 +296,10 @@ def for_serving_engine(engine, prompt_lens=None,
     chunk = getattr(engine, 'prefill_chunk', None)
     prefix = bool(getattr(engine, 'prefix_cache', False))
     spec = getattr(engine, 'spec_window', None)
+    role = getattr(engine, 'phase_role', 'monolithic')
+    if role == 'decode':
+        return _for_decode_pool(engine, prompt_lens, W, spec,
+                                max_new_tokens)
     mono_lens = (prompt_lens if chunk is None
                  else [L for L in prompt_lens if L <= chunk])
     buckets = []
@@ -351,6 +384,73 @@ def for_serving_engine(engine, prompt_lens=None,
             for sb in ladder
             if cb < sb or (chunk is not None and max_end > chunk
                            and cb == sb == cb_max))
+    if role == 'prefill' or migration:
+        # the handoff export: a prefill-role request hands off holding
+        # g in 1..W generated tokens, so the exported kv length is
+        # L + g - 1 — one serve_export per reachable bucket. The
+        # migration=True monolithic variant covers the same range (an
+        # export mid-decode reaches higher contexts; declare them via
+        # prompt_lens).
+        cxs = []
+        for L in prompt_lens:
+            for g in range(1, W + 1):
+                n = L + g - 1
+                if n < 1 or n + 1 > engine.max_context_len:
+                    continue
+                c = bucket_length(n, engine.buckets)
+                if c not in cxs:
+                    cxs.append(c)
+        entries.extend(Geometry('serve_export', ctx=c) for c in cxs)
+        if migration:
+            entries.extend(Geometry('serve_import', ctx=c) for c in cxs)
+    return GeometrySet(entries)
+
+
+def _for_decode_pool(engine, context_lens, W, spec, max_new_tokens):
+    """The decode-role set: import scatter + one-token continuation
+    chunk per import-context bucket, plus the pure window (speculative
+    engines: the spec window over its reachable verify ladder). No
+    admission kinds — an import-fed pool never dispatches them, and
+    enumerating them would stamp dead executables into the artifact
+    (the no-extra half of the exactness contract)."""
+    cb1 = bucket_length(1, engine.buckets)
+    entries = []
+    sbs, cxs = [], []
+    for L in context_lens:
+        if L < 2:
+            continue               # an import carries kv_len >= 1
+        sb = bucket_length(L, engine.buckets)
+        if sb not in sbs:
+            sbs.append(sb)
+        c = bucket_length(L - 1, engine.buckets)
+        if c not in cxs:
+            cxs.append(c)
+    entries.extend(Geometry('serve_import', ctx=c) for c in cxs)
+    entries.extend(
+        Geometry('serve_chunk_step', window=W, chunk=cb1, bucket=sb)
+        for sb in sbs)
+    if spec is None:
+        entries.append(Geometry('serve_window', window=W))
+    else:
+        # the verify ladder over live decode contexts, exactly the
+        # monolithic spec derivation with import contexts as the floor
+        k = int(spec)
+        mnts = (max_new_tokens if isinstance(max_new_tokens,
+                                             (list, tuple))
+                else [max_new_tokens])
+        budget = max(engine.max_new_tokens if m is None else int(m)
+                     for m in mnts)
+        lens = [L for L in context_lens if L >= 2]
+        if lens:
+            m_lo = min(lens)
+            m_hi = min(max(lens) + budget, engine.max_context_len) - 1
+            ladder, v = [], m_lo + k + 1
+            while v <= m_hi + k + 1:
+                b = bucket_length(v, engine.buckets)
+                ladder.append(b)
+                v = b + 1
+            entries.extend(Geometry('serve_spec_window', spec=k, ctx=c)
+                           for c in ladder)
     return GeometrySet(entries)
 
 

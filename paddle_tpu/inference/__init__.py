@@ -293,6 +293,17 @@ from .serving import (  # noqa: E402
     ServingEngine,
 )
 
+# Disaggregated prefill/decode serving: a prefill-only engine that
+# hands each request off at first token, the pair front that ferries
+# int8 KV blobs between pools, and the process-boundary wire format;
+# see disagg.py / docs/serving.md#disaggregated-serving.
+from .disagg import (  # noqa: E402
+    DisaggPair,
+    PrefillEngine,
+    pack_kv_blob,
+    unpack_kv_blob,
+)
+
 
 def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
                                mixed_params_file, mixed_precision=None,

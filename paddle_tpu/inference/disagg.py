@@ -1,0 +1,324 @@
+"""Disaggregated prefill/decode serving (docs/serving.md#disaggregated-
+serving).
+
+Chunked prefill stops one long prompt from monopolizing the engine,
+but prefill and decode still SHARE one compute budget — a long-prompt
+flood inflates decode p99 ITL because every scheduler step that runs a
+chunk runs it ahead of the decode window in the same fused dispatch
+(the interference DistServe quantifies, and that Mooncake/Splitwise
+remove by running the two phases on separate pools). This module is
+that split over the existing engine:
+
+    prefill = PrefillEngine(model, tp=1, prefill_chunk=64, ...)
+    decode  = ServingEngine(model, tp=1, phase_role='decode', ...)
+    pair    = DisaggPair(prefill, decode)
+    rid = pair.submit(prompt)
+    pair.run()
+    out = pair.result(rid)       # bit-equal to one monolithic engine
+
+  - `PrefillEngine` is a ServingEngine (phase_role='prefill',
+    decode_window=1) that only admits/chunks: the step a request's
+    prefill completes also commits its FIRST token (the fused
+    chunk+window dispatch), and the post-step handoff sweep exports
+    its KV (`export_kv` — int8 pages + per-row scales ship
+    bit-identical at ~half the bf16 bytes) and retires it locally as
+    'migrated'. A draining prefill engine refuses new submissions
+    (the inherited `submit` guard) while its sweep keeps completing
+    in-flight handoffs.
+  - `DisaggPair` routes submissions to the prefill pool, ferries each
+    handoff blob into the decode pool (`import_kv` — retried while
+    the pool is momentarily full, failed permanently only when the
+    decode pool is idle-empty and still cannot fit it), and streams
+    results from whichever pool finished the request (eos at the
+    first token finishes ON the prefill engine).
+  - `pack_kv_blob` / `unpack_kv_blob` flatten a blob to one
+    self-describing byte string (JSON header + raw array bytes — no
+    pickle), so a migration survives a process/host boundary the same
+    way a snapshot does; the wire schema is `snapshot()`'s.
+
+Bit-equality contract: a greedy stream served by the pair is
+token-for-token the monolithic engine's (bf16 AND int8 pools) — the
+export ships KV rows [0, context_len - 1) and the importer recomputes
+the boundary position through the continuation-chunk machinery, so
+both the migrated pages and the first decode logits are bit-identical
+(bench.py's gate_serve_disagg pins it, with zero post-warmup compiles
+on either pool).
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from ..observability import metrics as _obs
+from .serving import OutOfBlocks, QueueFull, ServingEngine
+
+__all__ = ['PrefillEngine', 'DisaggPair', 'pack_kv_blob',
+           'unpack_kv_blob']
+
+_MAGIC = b'PTKV'
+
+
+def pack_kv_blob(blob):
+    """Flatten an `export_kv` blob into one byte string: a 4-byte
+    magic, a length-prefixed JSON header (the blob minus its arrays,
+    plus each array's group/layer/field/shape/dtype), then the raw
+    array bytes in header order. No pickle — the wire format is
+    inspectable and survives any same-endianness process boundary."""
+    meta = {k: v for k, v in blob.items()
+            if k not in ('layers', 'draft_layers')}
+    specs, arrays = [], []
+    for group in ('layers', 'draft_layers'):
+        for li, lay in enumerate(blob.get(group) or []):
+            for field in sorted(lay):
+                a = np.ascontiguousarray(lay[field])
+                specs.append({'group': group, 'layer': li,
+                              'field': field, 'shape': list(a.shape),
+                              'dtype': str(a.dtype)})
+                arrays.append(a)
+    head = json.dumps({'magic': 'paddle_tpu.kv_migration',
+                       'version': 1, 'meta': meta,
+                       'arrays': specs}).encode('utf-8')
+    out = [_MAGIC, struct.pack('<I', len(head)), head]
+    out.extend(a.tobytes() for a in arrays)
+    return b''.join(out)
+
+
+def unpack_kv_blob(data):
+    """Inverse of `pack_kv_blob`: bytes -> an `import_kv`-ready blob
+    dict (arrays reconstructed zero-copy off the buffer)."""
+    if data[:4] != _MAGIC:
+        raise ValueError('not a packed KV migration blob (bad magic)')
+    (hlen,) = struct.unpack_from('<I', data, 4)
+    head = json.loads(data[8:8 + hlen].decode('utf-8'))
+    if head.get('magic') != 'paddle_tpu.kv_migration':
+        raise ValueError(
+            f"not a packed KV migration blob: {head.get('magic')!r}")
+    if head.get('version') != 1:
+        raise ValueError(
+            f"unsupported packed-blob version {head.get('version')!r}")
+    blob = dict(head['meta'])
+    off = 8 + hlen
+    for spec in head['arrays']:
+        # jax registers bfloat16 & friends as numpy dtypes, so
+        # np.dtype round-trips every pool dtype by name
+        dt = np.dtype(spec['dtype']) if spec['dtype'] != 'bfloat16' \
+            else _bf16()
+        n = int(np.prod(spec['shape'])) * dt.itemsize
+        a = np.frombuffer(data, dtype=dt, count=int(np.prod(spec['shape'])),
+                          offset=off).reshape(spec['shape'])
+        off += n
+        group = blob.setdefault(spec['group'], [])
+        while len(group) <= spec['layer']:
+            group.append({})
+        group[spec['layer']][spec['field']] = a
+    for group in ('layers', 'draft_layers'):
+        blob.setdefault(group, None)
+    return blob
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+class PrefillEngine(ServingEngine):
+    """A ServingEngine that only admits/chunks: every request hands
+    off at its first committed token. decode_window defaults to 1 so
+    a handed-off request carries exactly one generated token (the one
+    the completing chunk's fused window produced) — the minimum that
+    pins the next-step logits for the importer to verify against.
+
+    Handoffs land in an internal list (`take_handoffs()`) or go
+    straight to `handoff_sink` when one is given. A handed-off request
+    leaves this engine's registries as state 'migrated' — `result()`
+    on the DECODE engine (or the DisaggPair front) owns the outcome.
+    """
+
+    def __init__(self, model, decode_window=1, handoff_sink=None, **kw):
+        kw.pop('phase_role', None)       # this class IS the role
+        super().__init__(model, decode_window=decode_window,
+                         phase_role='prefill', **kw)
+        self.handoff_sink = handoff_sink
+        self._handoffs: list = []
+
+    def step(self):
+        finished = super().step()
+        self._sweep_handoffs()
+        return finished
+
+    def _sweep_handoffs(self):
+        """Export + locally retire every slot whose prefill completed
+        and committed at least one token. Runs AFTER the fused step
+        (the commit loop already journaled the window), so the
+        exported blob carries the request's full trail through its
+        first token. Draining does not stop the sweep — a draining
+        prefill engine refuses new submissions but completes every
+        in-flight handoff."""
+        for slot, req in enumerate(self._slot_req):
+            if (req is None or self._pfill[slot] is not None
+                    or not req.generated):
+                continue
+            req.mark('handoff', tokens=len(req.generated))
+            blob = self.export_kv(req.rid)
+            self._clear_slot(slot)
+            self._live.pop(req.rid, None)
+            if req.deadline is not None:
+                self._deadlines_live -= 1
+            req.state = 'migrated'
+            self.migration_counts['handoffs'] += 1
+            if _obs.enabled():
+                _obs.inc('serve.handoffs')
+            if self.handoff_sink is not None:
+                self.handoff_sink(blob)
+            else:
+                self._handoffs.append(blob)
+        self._update_gauges()
+
+    def take_handoffs(self):
+        """Drain and return the accumulated handoff blobs (empty when
+        a `handoff_sink` consumes them at the sweep)."""
+        out, self._handoffs = self._handoffs, []
+        return out
+
+
+class DisaggPair:
+    """The front over one prefill pool + one decode pool: submissions
+    go to the prefill engine, handoff blobs ferry to the decode
+    engine, results stream from whichever engine finished the request.
+    Both engines must agree on the snapshot config (model structure +
+    sampling contract) and the pool quantization world — checked at
+    construction, so a mismatched pair fails fast instead of failing
+    bit-equality.
+
+    `step()` is one scheduler iteration across the pair: prefill
+    step -> handoff sweep -> import retries -> decode step. A blob the
+    decode pool cannot place yet (slots full, pool momentarily dry)
+    waits in `pending_handoffs` and retries next step; it fails
+    permanently only when the decode pool is EMPTY and still cannot
+    fit it (nothing will ever free up) — `result(rid)` then re-raises
+    the placement error.
+    """
+
+    def __init__(self, prefill, decode):
+        if getattr(prefill, 'phase_role', None) != 'prefill':
+            raise ValueError(
+                "DisaggPair needs a prefill-role engine first "
+                "(PrefillEngine, or ServingEngine(phase_role='prefill'))")
+        if getattr(decode, 'phase_role', None) != 'decode':
+            raise ValueError(
+                "DisaggPair needs a decode-role engine second "
+                "(ServingEngine(phase_role='decode'))")
+        pc, dc = prefill._snapshot_config(), decode._snapshot_config()
+        diff = sorted(k for k in pc if dc.get(k) != pc[k])
+        if diff:
+            raise ValueError(
+                f'prefill/decode engines disagree on {diff} — a pair '
+                f'must share the snapshot config for migrated streams '
+                f'to stay bit-equal')
+        if prefill.kv_cache_dtype != decode.kv_cache_dtype:
+            raise ValueError(
+                'prefill/decode engines disagree on kv_cache_dtype — '
+                'blobs do not cross quantization worlds')
+        if (prefill.draft is None) != (decode.draft is None):
+            raise ValueError(
+                'prefill/decode engines disagree on speculative '
+                'decoding (draft=...) — a blob without draft KV cannot '
+                'feed a speculative decode pool')
+        self.prefill = prefill
+        self.decode = decode
+        self._pending: list = []      # blobs awaiting decode-pool room
+        self._failed: dict = {}       # rid -> placement error
+
+    # -- the serving surface ------------------------------------------------
+
+    def submit(self, prompt, **kw):
+        return self.prefill.submit(prompt, **kw)
+
+    def step(self):
+        """One iteration across the pair; returns finished Requests
+        from both pools (prefill-finished = eos/budget at the very
+        first token — those never migrate)."""
+        finished = list(self.prefill.step())
+        self._pending.extend(self.prefill.take_handoffs())
+        self._flush_pending()
+        finished.extend(self.decode.step())
+        return finished
+
+    def _flush_pending(self):
+        still = []
+        for blob in self._pending:
+            rid = int(blob['request']['rid'])
+            try:
+                self.decode.import_kv(rid, blob)
+            except (QueueFull, OutOfBlocks) as e:
+                if (self.decode.in_flight() == 0
+                        and not len(self.decode.queue)):
+                    # nothing in the decode pool will ever free up —
+                    # retrying forever would wedge run(); surface the
+                    # placement error at result(rid)
+                    self._failed[rid] = e
+                else:
+                    still.append(blob)
+        self._pending = still
+
+    def run(self, max_steps=None):
+        """Step until both pools and the handoff queue drain."""
+        steps = 0
+        while (len(self.prefill.queue) or self.prefill.in_flight()
+               or self._pending or len(self.decode.queue)
+               or self.decode.in_flight()):
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    def serve(self, prompts, max_new_tokens=None):
+        """Submit + run + collect, preserving submission order (the
+        monolithic `serve()` convenience over the pair)."""
+        rids = [self.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        self.run()
+        return [self.result(rid) for rid in rids]
+
+    def result(self, rid):
+        """Terminal outcome from whichever pool owns it (decode first
+        — that is where migrated requests finish). An import that
+        failed permanently re-raises its placement error here."""
+        if rid in self._failed:
+            raise self._failed.pop(rid)
+        try:
+            return self.decode.result(rid)
+        except KeyError:
+            return self.prefill.result(rid)
+
+    def status(self, rid):
+        for blob in self._pending:
+            if int(blob['request']['rid']) == rid:
+                return 'migrating'
+        try:
+            return self.decode.status(rid)
+        except KeyError:
+            return self.prefill.status(rid)
+
+    def in_flight(self):
+        return (self.prefill.in_flight() + self.decode.in_flight()
+                + len(self._pending))
+
+    def stats(self):
+        return {'prefill': self.prefill.stats(),
+                'decode': self.decode.stats(),
+                'pending_handoffs': len(self._pending)}
+
+    def drain(self, on=True):
+        """Flip BOTH engines' drain flags (new submissions refused;
+        in-flight work — including pending handoffs — completes)."""
+        self.prefill.draining = bool(on)
+        self.decode.draining = bool(on)
+
+    def close(self):
+        self.prefill.close()
+        self.decode.close()

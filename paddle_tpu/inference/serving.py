@@ -1253,6 +1253,45 @@ def _draft_chunk(draft, dpages, dlogits, ids, chunk_len, start, btabs,
                        ctx_bucket=ctx_bucket)
 
 
+@functools.partial(jax.jit, static_argnames=('ctx_bucket',))
+def _kv_export(pages, btabs, st, *, ctx_bucket):
+    """Gather ONE request's committed KV prefix [0, st[0]) out of its
+    pages into contiguous per-layer rows (the `_serve_chunk_step`
+    gather path at K=1) — the device half of `export_kv`. No donation:
+    the source pool must survive the export (the request keeps serving
+    until its owner decides the handoff). Outputs pin REPLICATED: under
+    a tp mesh this is the all-gather that reassembles the kv-head
+    shards into one host-fetchable, degree-agnostic blob (the
+    migration shardlint suite budgets it exactly). Int8 pools gather
+    int8 bytes + per-row scales, so the blob reproduces the pool
+    bit-for-bit at half the bf16 bytes."""
+    _count_trace('serve_export')
+    tmp = _pool_gather(pages, btabs, st, ctx_bucket)
+    out = []
+    for t in tmp:
+        fs = [_pin(f) for f in t]
+        out.append(type(t)(*fs) if hasattr(t, '_fields') else tuple(fs))
+    return out
+
+
+@functools.partial(jax.jit, donate_argnames=('pages',),
+                   static_argnames=('ctx_bucket',))
+def _kv_import(pages, blob, pflat, sflat, *, ctx_bucket):
+    """Scatter an exported blob's contiguous rows into this pool's
+    pages at flat (page, slot) targets — the device half of
+    `import_kv`, riding the same `.at[...].set` write the chunk bodies
+    commit through. Rows the host masked (past the export length, or
+    covered by shared prefix pages) land on the reserved scratch page.
+    The replicated blob re-shards on write under a tp mesh (each shard
+    keeps its own kv-head rows — a slice, not a collective), so a
+    blob exported at one tp degree imports at any other."""
+    del ctx_bucket           # shapes carry it; static keys the registry
+    _count_trace('serve_import')
+    out = [_pool_scatter(pc, t, pflat, sflat)
+           for t, pc in zip(blob, pages)]
+    return _pin_pages(out)
+
+
 def _ceil_div(a, b):
     return -(-a // b)
 
@@ -1287,7 +1326,8 @@ class ServingEngine:
                  postmortem_dir=None, mesh=None, tp=None,
                  ops_port=None, ops_host='127.0.0.1', watchdog=None,
                  slo_rules=None, ts_interval_s=None,
-                 draft=None, num_draft_tokens=4, kv_cache_dtype=None):
+                 draft=None, num_draft_tokens=4, kv_cache_dtype=None,
+                 phase_role='monolithic'):
         params = inspect.signature(model.forward).parameters
         if 'block_tables' not in params:
             raise NotImplementedError(
@@ -1325,16 +1365,33 @@ class ServingEngine:
         # quantization is write-order independent — preemption
         # re-prefill, prefix sharing, CoW, and snapshot/restore all
         # reproduce bit-identical pages). None = the model's cache
-        # dtype (prior behavior, byte for byte).
+        # dtype (prior behavior, byte for byte). 'bfloat16' keeps the
+        # unquantized layout at 2-byte rows — the deployment baseline
+        # the int8 migration blob's ~half-bytes headline is measured
+        # against (gate_serve_disagg).
         if kv_cache_dtype is None:
             self.kv_cache_dtype = None
         else:
             kd = jnp.dtype(kv_cache_dtype)
-            if kd != jnp.int8:
+            if kd not in (jnp.int8, jnp.bfloat16):
                 raise ValueError(
-                    f"kv_cache_dtype must be None or 'int8', got "
-                    f'{kv_cache_dtype!r}')
+                    f"kv_cache_dtype must be None, 'int8', or "
+                    f"'bfloat16', got {kv_cache_dtype!r}")
             self.kv_cache_dtype = kd
+        # phase-disaggregated serving (docs/serving.md#disaggregated-
+        # serving): the role tags what this engine is FOR — 'prefill'
+        # pools admit/chunk and hand every request off at first token
+        # (disagg.PrefillEngine), 'decode' pools receive `import_kv`
+        # migrations and only decode. The role changes no dispatch
+        # semantics here; it keys the AOT geometry enumeration (a
+        # decode pool warms import scatters, not admission prefills),
+        # rides /statusz + /healthz, and lets a phase-aware router
+        # place by role. 'monolithic' is prior behavior bit-for-bit.
+        if phase_role not in ('monolithic', 'prefill', 'decode'):
+            raise ValueError(
+                f"phase_role must be 'monolithic', 'prefill', or "
+                f"'decode', got {phase_role!r}")
+        self.phase_role = phase_role
         if getattr(getattr(model, 'config', None), 'sliding_window',
                    None) is not None:
             raise NotImplementedError(
@@ -1616,6 +1673,13 @@ class ServingEngine:
         # like `counts` so accept-rate dashboards see no discontinuity
         # across a failover)
         self.spec_counts = {'windows': 0, 'proposed': 0, 'accepted': 0}
+        # host-truth KV-migration counters (stats()['migration'] even
+        # with telemetry off; snapshot()/restore() carries them like
+        # `counts`). bytes_* are blob payload bytes — what the int8
+        # half-the-bf16-bytes headline is measured over.
+        self.migration_counts = {'exported': 0, 'imported': 0,
+                                 'import_failed': 0, 'handoffs': 0,
+                                 'bytes_exported': 0, 'bytes_imported': 0}
         # telemetry hot-path caches: metric handles (refreshed when the
         # registry generation changes, i.e. after a reset) and the last
         # occupancy tuple (gauges re-set only when it moves) — keeps
@@ -1750,7 +1814,9 @@ class ServingEngine:
         geometry). Tags are the dispatch kinds step() uses:
         ('serve_step', W, Sb), ('serve_window', W),
         ('serve_prefill', Sb), ('serve_chunk_step', W, Cb, Sb),
-        ('serve_spec_step', k, Sb, Cx), ('serve_spec_window', k, Cx).
+        ('serve_spec_step', k, Sb, Cx), ('serve_spec_window', k, Cx),
+        plus the migration pair export_kv/import_kv dispatch:
+        ('serve_export', Cx), ('serve_import', Cx).
         The pool dtype (int8 vs the model's cache dtype) keys here, so
         a quantized and an unquantized engine over one model never
         collide. Exposed so aot.GeometrySet enumeration and the live
@@ -1792,6 +1858,7 @@ class ServingEngine:
                 'pfx_cow': R.gauge('pool.prefix_cow_pages'),
                 'pfx_shared_b': R.gauge('pool.prefix_shared_bytes'),
                 'pfx_cached_b': R.gauge('pool.prefix_cached_bytes'),
+                'migration_ms': R.histogram('serve.migration_ms'),
             }
             self._mgen = R.generation
             self._last_occ = None          # force a gauge refresh
@@ -1872,6 +1939,11 @@ class ServingEngine:
             'watchdog': (self._watchdog.verdict()
                          if self._watchdog is not None else None),
             'draining': self.draining,
+            # disaggregated serving: which phase this engine runs, and
+            # the host-truth migration record (export/import/handoff
+            # counts + blob bytes moved)
+            'phase_role': self.phase_role,
+            'migration': dict(self.migration_counts),
             'blocks': self.allocator.stats(),
             'geometry': {'kind': 'paged', 'max_slots': self.max_slots,
                          'block_size': self.block_size,
@@ -1932,7 +2004,7 @@ class ServingEngine:
         process-wide) to force real persisting compiles."""
         return (_paged_prefill, _serve_window, _serve_step,
                 _serve_chunk_step, _serve_spec_window, _serve_spec_step,
-                _draft_chunk)
+                _draft_chunk, _kv_export, _kv_import)
 
     def _warm_geometry(self, g, draft=None):
         """Drive ONE enumerated geometry through the SAME module-level
@@ -2031,12 +2103,15 @@ class ServingEngine:
                 forced = self._put(np.zeros((K,), bool))
                 scommon = dict(k=k, ctx_bucket=Cx,
                                eos_token_id=self.eos_token_id)
-                if self.prefill_chunk is not None or self.prefix_cache:
+                if (self.prefill_chunk is not None or self.prefix_cache
+                        or self.phase_role == 'decode'):
                     # chunk steps can commit window tokens past the
                     # draft; the catch-up `_draft_chunk` shapes a live
                     # spec step can then dispatch (hole bucket x THIS
                     # geometry's ctx bucket) must be warm too, or a
                     # warm-attached engine would compile mid-serve
+                    # (decode-role pools re-enter through the one-token
+                    # continuation chunk, which opens the same hole)
                     self._warm_draft_catchup(
                         Cx, z,
                         self._put(np.zeros(
@@ -2060,6 +2135,36 @@ class ServingEngine:
                         self._dpages, self._last_logits, z, forced,
                         dev['btab'], dev['ctx'], dev['live'], budget,
                         *sample_args, **scommon)
+            elif g.kind == 'serve_export':
+                # the migration gather at K=1: a zero start length
+                # reads only the scratch page, so warming is inert
+                # beyond the jit cache (no donation — pools untouched)
+                Cx = int(p['ctx'])
+                self._note('serve_export', Cx)
+                btabs1 = self._put(
+                    np.zeros((1, self.max_blocks_per_seq), np.int32))
+                st1 = self._put(np.zeros((1,), np.int32))
+                _kv_export(self._pages, btabs1, st1, ctx_bucket=Cx)
+                if self.draft is not None:
+                    # the live export ships the draft's pages too
+                    _kv_export(self._dpages, btabs1, st1, ctx_bucket=Cx)
+            elif g.kind == 'serve_import':
+                # the migration scatter: all-zero targets write only
+                # the reserved scratch page (donated pools come back
+                # re-assigned, nothing live is touched). The zero blob
+                # rides the SAME `_blob_device_entries` upload the live
+                # import uses, so the warmed avals are the live ones
+                # by construction.
+                Cx = int(p['ctx'])
+                self._note('serve_import', Cx)
+                zi = self._put(np.zeros((Cx,), np.int32))
+                ents = self._blob_device_entries(self._pages, Cx)
+                self._pages = _kv_import(self._pages, ents, zi, zi,
+                                         ctx_bucket=Cx)
+                if self.draft is not None:
+                    dents = self._blob_device_entries(self._dpages, Cx)
+                    self._dpages = _kv_import(self._dpages, dents, zi,
+                                              zi, ctx_bucket=Cx)
             else:
                 raise ValueError(
                     f'unknown serving geometry kind {g.kind!r}')
@@ -2606,6 +2711,59 @@ class ServingEngine:
                 'top_p': self.top_p, 'eos_token_id': self.eos_token_id,
                 'max_context_len': self.max_context_len}
 
+    def _request_record(self, req, now):
+        """One request as a JSON-serializable dict — the wire format
+        `snapshot()` carries per request AND the `request` section of
+        an `export_kv` migration blob (one schema, one versioning
+        story: a blob survives exactly the process boundaries a
+        snapshot does)."""
+        return {
+            'rid': req.rid, 'prompt': req.prompt.tolist(),
+            'generated': [int(t) for t in req.generated],
+            'max_new_tokens': req.max_new_tokens,
+            'priority': req.priority, 'seq': req.seq,
+            'state': req.state, 'reason': req.reason,
+            'error': repr(req.error) if req.error is not None else None,
+            'deadline_left_s': (req.deadline - now
+                                if req.deadline is not None else None),
+            'result': (req.result.tolist()
+                       if req.result is not None else None),
+            # per-request sampling params + the speculative carried
+            # next-token (schema-1 compatible additions): a
+            # restored sampled stream re-derives its stateless key
+            # chain from (seed, generated index), and a restored
+            # speculative stream resumes from exactly the verify's
+            # pending choice — both bit-equal to uninterrupted
+            'temperature': req.temperature, 'top_k': req.top_k,
+            'top_p': req.top_p, 'sample_seed': req.sample_seed,
+            'spec_next': req.spec_next,
+        }
+
+    def _rebuild_request(self, r, now):
+        """Rebuild one `_request_record` dict into a live Request —
+        the restore path's inverse, shared with `import_kv` (a
+        migrated request keeps its identity: rid, sampling params,
+        seed, generated prefix, remaining deadline, speculative carry
+        all survive the hop)."""
+        req = Request(r['rid'], r['prompt'], r['max_new_tokens'],
+                      r['priority'],
+                      temperature=r.get('temperature', self.temperature),
+                      top_k=r.get('top_k', self.top_k),
+                      top_p=r.get('top_p', self.top_p),
+                      sample_seed=r.get('sample_seed'))
+        sn = r.get('spec_next')
+        req.spec_next = int(sn) if sn is not None else None
+        req.generated = [int(t) for t in r['generated']]
+        req.seq = r['seq']
+        req.state = r['state']
+        req.reason = r['reason']
+        req.error = r['error']          # repr string post-restore
+        if r['result'] is not None:
+            req.result = np.asarray(r['result'], np.int32)
+        if r['deadline_left_s'] is not None:
+            req.deadline = now + max(float(r['deadline_left_s']), 0.0)
+        return req
+
     def snapshot(self):
         """JSON-serializable host state for crash recovery: every
         non-terminal request (queued / running / preempted — prompt,
@@ -2618,30 +2776,7 @@ class ServingEngine:
         AOT artifact, `restore()`, and finish every stream bit-equal
         to an uninterrupted greedy run (gate_resilience proves it)."""
         now = time.perf_counter()
-
-        def rec(req):
-            return {
-                'rid': req.rid, 'prompt': req.prompt.tolist(),
-                'generated': [int(t) for t in req.generated],
-                'max_new_tokens': req.max_new_tokens,
-                'priority': req.priority, 'seq': req.seq,
-                'state': req.state, 'reason': req.reason,
-                'error': repr(req.error) if req.error is not None else None,
-                'deadline_left_s': (req.deadline - now
-                                    if req.deadline is not None else None),
-                'result': (req.result.tolist()
-                           if req.result is not None else None),
-                # per-request sampling params + the speculative carried
-                # next-token (schema-1 compatible additions): a
-                # restored sampled stream re-derives its stateless key
-                # chain from (seed, generated index), and a restored
-                # speculative stream resumes from exactly the verify's
-                # pending choice — both bit-equal to uninterrupted
-                'temperature': req.temperature, 'top_k': req.top_k,
-                'top_p': req.top_p, 'sample_seed': req.sample_seed,
-                'spec_next': req.spec_next,
-            }
-
+        rec = functools.partial(self._request_record, now=now)
         live = ([rec(r) for r in self.queue]
                 + [rec(r) for r in self._slot_req if r is not None])
         terminal = [rec(r) for r in self._terminal.values()]
@@ -2673,6 +2808,7 @@ class ServingEngine:
             'counts': dict(self.counts),
             'prefix_counts': dict(self.prefix_counts),
             'spec_counts': dict(self.spec_counts),
+            'migration_counts': dict(self.migration_counts),
             'tokens_out': self._tokens_out,
             'serve_time': self._serve_time,
         }
@@ -2709,28 +2845,7 @@ class ServingEngine:
                 f'{ {k: cfg[k] for k in diff} }')
         now = time.perf_counter()
         max_seq = -1
-
-        def rebuild(r):
-            req = Request(r['rid'], r['prompt'], r['max_new_tokens'],
-                          r['priority'],
-                          temperature=r.get('temperature',
-                                            self.temperature),
-                          top_k=r.get('top_k', self.top_k),
-                          top_p=r.get('top_p', self.top_p),
-                          sample_seed=r.get('sample_seed'))
-            sn = r.get('spec_next')
-            req.spec_next = int(sn) if sn is not None else None
-            req.generated = [int(t) for t in r['generated']]
-            req.seq = r['seq']
-            req.state = r['state']
-            req.reason = r['reason']
-            req.error = r['error']          # repr string post-restore
-            if r['result'] is not None:
-                req.result = np.asarray(r['result'], np.int32)
-            if r['deadline_left_s'] is not None:
-                req.deadline = now + max(float(r['deadline_left_s']), 0.0)
-            return req
-
+        rebuild = functools.partial(self._rebuild_request, now=now)
         # validate EVERY request's fit before touching engine state: a
         # mid-loop raise would leave the standby half-restored (its
         # fresh-engine check then refuses a retry, and stepping it
@@ -2787,6 +2902,9 @@ class ServingEngine:
         for k, v in snap.get('spec_counts', {}).items():
             if k in self.spec_counts:
                 self.spec_counts[k] = int(v)
+        for k, v in snap.get('migration_counts', {}).items():
+            if k in self.migration_counts:
+                self.migration_counts[k] = int(v)
         self._tokens_out = int(snap.get('tokens_out', self._tokens_out))
         # without the matching serve-time, tokens_per_s would divide the
         # lifetime token total by the standby's near-zero wall time — a
@@ -2805,6 +2923,359 @@ class ServingEngine:
         return {'requests': len(snap['requests']),
                 'terminal': len(snap['terminal']),
                 'next_rid': self._rid}
+
+    # -- KV-cache migration (disaggregated prefill/decode serving) ---------
+
+    def _blob_device_entries(self, pages, Cx, layers=None):
+        """Device-resident per-layer scatter payloads for `_kv_import`,
+        padded to the `Cx` bucket and uploaded replicated — ONE
+        builder for the live import and the warmup dummy (layers=None
+        -> zeros), so the warmed avals are the live ones by
+        construction (the zero-mid-serve-compiles contract)."""
+        from ..models.generation import RowQuantKVCache
+
+        ents = []
+        for li, pc in enumerate(pages):
+            Hkv, D = int(pc.kp.shape[1]), int(pc.kp.shape[3])
+            lay = layers[li] if layers is not None else None
+
+            def up(field, shape, dtype):
+                buf = np.zeros(shape, dtype)
+                if lay is not None:
+                    src = np.asarray(lay[field])
+                    buf[0, :src.shape[0]] = src
+                return self._put(buf)
+
+            if hasattr(pc, 'ks'):
+                ents.append(RowQuantKVCache(
+                    up('k', (1, Cx, Hkv, D), np.int8),
+                    up('v', (1, Cx, Hkv, D), np.int8),
+                    up('ks', (1, Cx, Hkv), np.float32),
+                    up('vs', (1, Cx, Hkv), np.float32)))
+            else:
+                dt = pc.kp.dtype
+                ents.append((up('k', (1, Cx, Hkv, D), dt),
+                             up('v', (1, Cx, Hkv, D), dt)))
+        return ents
+
+    @staticmethod
+    def _blob_layer_bytes(blob):
+        """Total payload bytes of a blob's KV arrays (target + draft) —
+        the unit the bytes_exported/bytes_imported counters move in."""
+        n = 0
+        for group in ('layers', 'draft_layers'):
+            for lay in blob.get(group) or []:
+                n += sum(np.asarray(v).nbytes for v in lay.values())
+        return n
+
+    def export_kv(self, rid):
+        """Gather running request `rid`'s paged KV (and draft KV when
+        speculative) into one contiguous, process-portable migration
+        blob — the prefill half of disaggregated serving
+        (docs/serving.md#disaggregated-serving).
+
+        The blob is a JSON-shaped dict plus numpy arrays: schema (1,
+        shared with `snapshot()`), engine config, the full
+        `_request_record` (identity, sampling params, seed, generated
+        prefix, remaining deadline, speculative carry), per-layer
+        contiguous K/V rows for positions [0, context_len - 1), and
+        the request's flight-recorder trail. Int8 pools ship int8
+        bytes + per-row f32 scales — BIT-identical pages at ~half the
+        bf16 bytes. Position context_len - 1 is deliberately NOT
+        shipped: the importer recomputes it through the existing
+        continuation-chunk machinery, which also reproduces the next
+        token's logits — so the migrated greedy stream is bit-equal
+        to the source engine's own. Read-only: the request keeps
+        serving here until its owner retires it (PrefillEngine's
+        handoff sweep, or `cancel()`)."""
+        t0 = time.perf_counter()
+        req = self._live.get(rid)
+        if req is None or req.state != 'running':
+            state = req.state if req is not None else 'unknown/terminal'
+            raise KeyError(
+                f'export_kv needs a RUNNING request: rid {rid} is '
+                f'{state!r} (queued/preempted requests have no pages '
+                f'to export — snapshot() covers those)')
+        slot = next(s for s, q in enumerate(self._slot_req) if q is req)
+        if self._pfill[slot] is not None:
+            raise RuntimeError(
+                f'request {rid} is mid chunked prefill '
+                f'({self._pfill[slot]}/{req.context_len} context tokens '
+                f'in pages) — step until its prefill completes before '
+                f'exporting')
+        kvlen = req.context_len - 1
+        if kvlen < 1:
+            raise RuntimeError(
+                f'request {rid} has no committed KV to export '
+                f'(context_len {req.context_len})')
+        Cx = bucket_length(kvlen, self.buckets)
+        dkvlen = None
+        with self._use_mesh():
+            hit = self._note('serve_export', Cx)
+            t_dispatch = time.perf_counter()
+            btabs = self._put(self._btab[slot:slot + 1])
+            st = self._put(np.asarray([kvlen], np.int32))
+            out = _kv_export(self._pages, btabs, st, ctx_bucket=Cx)
+            dout = None
+            if self.draft is not None:
+                # the draft pool's coverage can trail the target's
+                # (window tokens the draft never saw) — ship what it
+                # has; the importer's catch-up machinery fills the rest
+                dkvlen = min(int(self._dctx[slot]), kvlen)
+                dst = self._put(np.asarray([dkvlen], np.int32))
+                dout = _kv_export(self._dpages, btabs, dst, ctx_bucket=Cx)
+            host = jax.device_get(out)
+            dhost = jax.device_get(dout) if dout is not None else None
+        t_commit = time.perf_counter()
+        if not hit:
+            _obs_trace.compile_event(
+                'compile:serve_export', key=('serve_export', Cx),
+                dur_s=t_commit - t_dispatch,
+                geometry=str(self._geometry()))
+            _journal.record('compile', dispatch='serve_export',
+                            key=str(('serve_export', Cx)),
+                            dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
+
+        def crop(tmp, n):
+            layers = []
+            for t in tmp:
+                if hasattr(t, 'ks'):
+                    layers.append({'k': np.asarray(t.kq[0, :n]),
+                                   'v': np.asarray(t.vq[0, :n]),
+                                   'ks': np.asarray(t.ks[0, :n]),
+                                   'vs': np.asarray(t.vs[0, :n])})
+                else:
+                    k, v = t
+                    layers.append({'k': np.asarray(k[0, :n]),
+                                   'v': np.asarray(v[0, :n])})
+            return layers
+
+        layers = crop(host, kvlen)
+        draft_layers = crop(dhost, dkvlen) if dhost is not None else None
+        nbytes = sum(v.nbytes for lay in layers for v in lay.values())
+        if draft_layers is not None:
+            nbytes += sum(v.nbytes for lay in draft_layers
+                          for v in lay.values())
+        # mark BEFORE snapshotting the trail, so the export event
+        # itself rides the blob to the destination engine
+        req.mark('kv_export', kv_len=kvlen, bytes=nbytes)
+        blob = {
+            'schema': 1,
+            'kind': 'kv_migration',
+            'config': self._snapshot_config(),
+            'kv_cache_dtype': (str(self.kv_cache_dtype)
+                               if self.kv_cache_dtype else None),
+            'block_size': self.block_size,
+            'kv_len': kvlen,
+            'request': self._request_record(req, time.perf_counter()),
+            'layers': layers,
+            'draft_kv_len': dkvlen,
+            'draft_layers': draft_layers,
+            'trail': (_journal.trail(rid)
+                      if _journal.journal_enabled() else []),
+        }
+        self.migration_counts['exported'] += 1
+        self.migration_counts['bytes_exported'] += nbytes
+        if _obs.enabled():
+            self._metrics()['migration_ms'].observe(
+                (time.perf_counter() - t0) * 1e3)
+            _obs.inc('serve.kv_exported')
+        return blob
+
+    def import_kv(self, rid, blob):
+        """Scatter an `export_kv` blob into THIS engine's pool and
+        resume request `rid` — the decode half of disaggregated
+        serving. The request re-enters as a one-token continuation
+        chunk: the import places KV rows [0, kv_len) through the
+        existing block-table machinery, then the next step's chunk
+        dispatch recomputes position kv_len (= context_len - 1), which
+        commits both that KV row and the first decode logits BIT-equal
+        to the source engine's own step — no new dispatch kind, and
+        the AOT-warmed chunk/import shapes cover it (zero mid-serve
+        compiles on a warm-attached decode pool).
+
+        Prefix-cache engines share full prompt pages below kv_len with
+        the allocator's hash index (refcounts balanced); the page
+        containing the recompute position stays private, so the import
+        path never needs a CoW copy. Placement is ATOMIC: any failure
+        — no free slot (QueueFull: retryable), a dry pool
+        (OutOfBlocks), schema/config/dtype mismatch (ValueError) —
+        rolls back every page and refcount taken and leaves the engine
+        exactly as before the call. Returns the slot index."""
+        t0 = time.perf_counter()
+        rid = int(rid)
+        if blob.get('schema') != 1 or blob.get('kind') != 'kv_migration':
+            raise ValueError(
+                f"unsupported KV blob (schema {blob.get('schema')!r}, "
+                f"kind {blob.get('kind')!r}): this engine reads "
+                f"kv_migration schema 1")
+        cfg = self._snapshot_config()
+        got_cfg = blob.get('config', {})
+        diff = sorted(k for k in cfg if got_cfg.get(k) != cfg[k])
+        if diff:
+            raise ValueError(
+                f'KV blob config mismatch on {diff}: blob '
+                f'{ {k: got_cfg.get(k) for k in diff} } vs engine '
+                f'{ {k: cfg[k] for k in diff} }')
+        want = (str(self.kv_cache_dtype) if self.kv_cache_dtype else None)
+        if blob.get('kv_cache_dtype') != want:
+            raise ValueError(
+                f"KV blob pool dtype {blob.get('kv_cache_dtype')!r} != "
+                f'engine pool dtype {want!r}: migrating across '
+                f'quantization worlds would break bit-equality — match '
+                f'kv_cache_dtype across the pair')
+        r = blob['request']
+        if int(r['rid']) != rid:
+            raise ValueError(f"blob carries rid {r['rid']}, not {rid}")
+        if rid in self._live or rid in self._terminal:
+            raise ValueError(
+                f'rid {rid} is already registered on this engine — a '
+                f'migrated request keeps its identity, so the '
+                f'destination must not have seen it')
+        if self.draft is not None and blob.get('draft_layers') is None:
+            raise ValueError(
+                'this engine is speculative but the blob carries no '
+                'draft KV: export from a speculative source (or run '
+                'the pair without a draft)')
+        kvlen = int(blob['kv_len'])
+        now = time.perf_counter()
+        req = self._rebuild_request(r, now)
+        if req.context_len != kvlen + 1:
+            raise ValueError(
+                f'corrupt KV blob: kv_len {kvlen} does not match the '
+                f'carried request (context_len {req.context_len}; the '
+                f'export contract is kv_len == context_len - 1)')
+        total = len(req.prompt) + req.max_new_tokens
+        if (total > self.max_context_len
+                or _ceil_div(total, self.block_size)
+                > self.allocator.usable):
+            raise ValueError(
+                f'imported request {rid} needs {total} context tokens — '
+                f'it cannot fit this engine (max_context_len '
+                f'{self.max_context_len}, {self.allocator.usable} '
+                f'usable pages)')
+        slot = next((s for s, q in enumerate(self._slot_req)
+                     if q is None), None)
+        if slot is None:
+            raise QueueFull(
+                f'no free slot for imported request {rid} '
+                f'({self.max_slots} in flight) — retry after a step')
+        a = self.allocator
+        bs = self.block_size
+        total_pages = _ceil_div(req.context_len, bs)
+        shared: list = []
+        if self.prefix_cache:
+            hit_pages = a.match_prefix(prompt_page_hashes(req.prompt, bs))
+            # share only pages FULLY below the recompute position: the
+            # page holding position kvlen gets WRITTEN by the
+            # continuation chunk, so it stays private — the import
+            # path never needs a CoW copy (and has none to roll back)
+            shared = hit_pages[:min(len(hit_pages), kvlen // bs)]
+        pages: list = []
+        try:
+            a.phase = 'import'
+            if shared:
+                a.share(shared)
+                pages.extend(shared)
+            pages.extend(a.alloc(total_pages - len(shared)))
+        except Exception:
+            # atomic failure: return the shares (refcounts balanced),
+            # free anything allocated, leave the pool untouched
+            if pages:
+                a.free(pages)
+            self.migration_counts['import_failed'] += 1
+            _journal.record('kv_import_failed', rid=rid, kv_len=kvlen)
+            raise
+        finally:
+            a.phase = None
+        Cx = bucket_length(kvlen, self.buckets)
+        dkvlen = None
+        if self.draft is not None:
+            dkvlen = min(int(blob.get('draft_kv_len') or 0), kvlen)
+        try:
+            with self._use_mesh():
+                reg_hit = self._note('serve_import', Cx)
+                t_dispatch = time.perf_counter()
+                pages_np = np.asarray(pages, np.int32)
+                i = np.arange(Cx)
+                blk = np.minimum(i // bs, len(pages) - 1)
+                # rows the pool must NOT take from the blob — past the
+                # export length, or covered by shared prefix pages —
+                # scatter onto the reserved scratch page instead
+                live_rows = (i < kvlen) & (i >= len(shared) * bs)
+                sflat = self._put((i % bs).astype(np.int32))
+                pflat = self._put(
+                    np.where(live_rows, pages_np[blk], 0)
+                    .astype(np.int32))
+                ents = self._blob_device_entries(self._pages, Cx,
+                                                 blob['layers'])
+                self._pages = _kv_import(self._pages, ents, pflat,
+                                         sflat, ctx_bucket=Cx)
+                if self.draft is not None:
+                    drows = (i < dkvlen) & (i >= len(shared) * bs)
+                    dpflat = self._put(
+                        np.where(drows, pages_np[blk], 0)
+                        .astype(np.int32))
+                    dents = self._blob_device_entries(
+                        self._dpages, Cx, blob['draft_layers'])
+                    self._dpages = _kv_import(self._dpages, dents,
+                                              dpflat, sflat,
+                                              ctx_bucket=Cx)
+        except Exception:
+            a.free(pages)
+            self.migration_counts['import_failed'] += 1
+            _journal.record('kv_import_failed', rid=rid, kv_len=kvlen)
+            raise
+        t_commit = time.perf_counter()
+        if not reg_hit:
+            _obs_trace.compile_event(
+                'compile:serve_import', key=('serve_import', Cx),
+                dur_s=t_commit - t_dispatch,
+                geometry=str(self._geometry()))
+            _journal.record('compile', dispatch='serve_import',
+                            key=str(('serve_import', Cx)),
+                            dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
+        # ONE trail follows the request across engines: re-register
+        # the source's events FIRST (the journal bumps its seq past
+        # them; a same-process pair shares the journal and injects
+        # nothing), so the marks below extend the trail in order
+        if blob.get('trail'):
+            _journal.JOURNAL.inject_trail(rid, blob['trail'])
+        self._live[rid] = req
+        if req.deadline is not None:
+            self._deadlines_live += 1
+        self._place(slot, req, pages)
+        # the import covers [0, kvlen); the continuation-chunk
+        # machinery recomputes position kvlen from the carried tokens
+        # on the next step (take=1 — its chunk bucket is warmed)
+        self._pfill[slot] = kvlen
+        self._cow_pending[slot] = None
+        self._dctx[slot] = dkvlen if dkvlen is not None else kvlen
+        if self.prefix_cache:
+            # the imported rows ARE completed prompt KV: index the
+            # full prompt pages now (shared ones stay with their first
+            # writer), so later imports/admissions of the same prefix
+            # hit — and count this import against the same hit/miss
+            # telemetry the admission path feeds
+            req.page_hashes = prompt_page_hashes(req.prompt, bs)
+            self._register_prefix_pages(slot, req, 0, kvlen)
+            if shared:
+                self.prefix_counts['hits'] += 1
+                self.prefix_counts['hit_tokens'] += len(shared) * bs
+            else:
+                self.prefix_counts['misses'] += 1
+        self._rid = max(self._rid, rid + 1)
+        nbytes = self._blob_layer_bytes(blob)
+        req.mark('kv_import', kv_len=kvlen, bytes=nbytes, slot=slot,
+                 shared_pages=len(shared))
+        self.migration_counts['imported'] += 1
+        self.migration_counts['bytes_imported'] += nbytes
+        if _obs.enabled():
+            self._metrics()['migration_ms'].observe(
+                (time.perf_counter() - t0) * 1e3)
+            _obs.inc('serve.kv_imported')
+        self._update_gauges()
+        return slot
 
     # -- the scheduler iteration -------------------------------------------
 

@@ -149,14 +149,16 @@ class OpsServer:
         """(status_code, payload) for /healthz. Drain wins over rule
         state: a draining replica must fall out of the router NOW even
         if every SLO is green."""
+        role = getattr(self.engine, 'phase_role', 'monolithic')
         if getattr(self.engine, 'draining', False):
-            return 503, {'status': 'draining'}
+            return 503, {'status': 'draining', 'phase_role': role}
         if self.watchdog is None:
-            return 200, {'status': 'ok', 'watchdog': False}
+            return 200, {'status': 'ok', 'watchdog': False,
+                         'phase_role': role}
         v = self.watchdog.verdict()
         if v['healthy']:
-            return 200, {'status': 'ok', **v}
-        return 503, {'status': 'breach', **v}
+            return 200, {'status': 'ok', 'phase_role': role, **v}
+        return 503, {'status': 'breach', 'phase_role': role, **v}
 
     def statusz(self):
         payload = {}
@@ -178,6 +180,8 @@ class OpsServer:
                                              for k, v in costs.items()}
             payload['draining'] = bool(getattr(self.engine, 'draining',
                                                False))
+            payload['phase_role'] = getattr(self.engine, 'phase_role',
+                                            'monolithic')
         if self.timeseries is not None:
             payload['timeseries'] = {
                 'interval_s': self.timeseries.interval_s,

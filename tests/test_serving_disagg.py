@@ -1,0 +1,503 @@
+"""Disaggregated prefill/decode serving (ISSUE 16).
+
+Contract pinned here:
+
+  - `export_kv` -> `import_kv` round trips are BIT-equal to the
+    monolithic engine's greedy streams — bf16/f32 and int8 pools
+    (pages AND per-row scales ship exactly), across pack/unpack
+    process boundaries, snapshot/restore on the decode pool,
+    prefix-shared (CoW) requests, speculative draft pools, and
+    tp∈{1,2} including cross-degree migration.
+  - `import_kv` into a tight pool fails ATOMICALLY: an injected
+    OutOfBlocks mid-placement rolls back every page and prefix-share
+    refcount taken, counts `import_failed`, and leaves the engine
+    serving.
+  - AOT geometry enumeration for the decode role == the keys the live
+    import-fed pool notes, EXACTLY; a warm-attached pair serves with
+    zero retraces and zero compile-cache misses on both pools.
+  - `/healthz` and `/statusz` report the engine's phase role; a
+    draining prefill engine refuses new admissions while completing
+    in-flight handoffs.
+  - int8 migration blobs cost (D+4)/(2*D) of the bf16 bytes — per-row
+    f32 scales are the only overhead over half.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.inference.disagg import (DisaggPair, PrefillEngine,
+                                         pack_kv_blob, unpack_kv_blob)
+from paddle_tpu.inference.engine import COMPILE_CACHE, total_traces
+from paddle_tpu.inference.serving import (OutOfBlocks, QueueFull,
+                                          ServingEngine)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.testing.faults import FaultInjector
+
+pytestmark = pytest.mark.tier1
+
+_CACHE = {}
+
+
+def _model(seed=0, **kw):
+    key = (seed, tuple(sorted(kw.items())))
+    if key not in _CACHE:
+        pt.seed(seed)
+        cfg = dict(vocab_size=96, hidden_size=64, layers=2, heads=4,
+                   kv_heads=2, max_pos=256)
+        cfg.update(kw)
+        _CACHE[key] = LlamaForCausalLM(llama_tiny(**cfg))
+    return _CACHE[key]
+
+
+def _prompts(n=3, lo=5, hi=14, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(3, 96, size=int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+KW = dict(max_slots=3, block_size=8, max_new_tokens=8,
+          eos_token_id=None, decode_window=2, max_context_len=64)
+
+
+def _mk(dt=None, role='monolithic', **kw):
+    base = dict(KW, kv_cache_dtype=dt, phase_role=role)
+    base.update(kw)
+    return ServingEngine(_model(), **base)
+
+
+def _same(a, b):
+    return (np.asarray(a).shape == np.asarray(b).shape
+            and (np.asarray(a) == np.asarray(b)).all())
+
+
+def _export_after_first_token(engine, prompt, **kw):
+    """Submit, step until >= 1 token committed, export — the canonical
+    migration point (what PrefillEngine's sweep does)."""
+    rid = engine.submit(prompt, **kw)
+    while True:
+        engine.step()
+        req = engine._live.get(rid)
+        assert req is not None, 'request finished before export'
+        if req.generated:
+            return rid, engine.export_kv(rid)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize('dt', [None, 'bfloat16', 'int8'])
+    def test_explicit_round_trip_bit_equal(self, dt):
+        ps = _prompts()
+        ref = _mk(dt).serve(ps)
+        src = _mk(dt)
+        dst = _mk(dt, role='decode')
+        rid, blob = _export_after_first_token(src, ps[0])
+        dst.import_kv(rid, unpack_kv_blob(pack_kv_blob(blob)))
+        while dst.in_flight():
+            dst.step()
+        assert _same(dst.result(rid), ref[0])
+        assert src.migration_counts['exported'] == 1
+        assert dst.migration_counts['imported'] == 1
+        assert dst.migration_counts['bytes_imported'] == \
+            src.migration_counts['bytes_exported'] > 0
+
+    def test_reimported_pages_and_scales_bit_identical(self):
+        """Re-exporting from the DESTINATION pool reproduces the
+        migrated rows byte-for-byte — int8 pages and per-row f32
+        scales scatter without requantization."""
+        src = _mk('int8')
+        dst = _mk('int8', role='decode')
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        dst.import_kv(rid, blob)
+        dst.step()     # continuation chunk: recompute + decode window
+        blob2 = dst.export_kv(rid)
+        n = blob['kv_len']
+        assert blob2['kv_len'] > n   # the destination kept decoding
+        for l1, l2 in zip(blob['layers'], blob2['layers']):
+            assert set(l1) == {'k', 'v', 'ks', 'vs'} == set(l2)
+            for f in l1:
+                assert (np.asarray(l1[f])
+                        == np.asarray(l2[f])[:n]).all(), f
+
+    def test_wire_format_survives_pack_unpack(self):
+        src = _mk('int8')
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        data = pack_kv_blob(blob)
+        assert isinstance(data, bytes) and data[:4] == b'PTKV'
+        blob2 = unpack_kv_blob(data)
+        assert blob2['schema'] == 1 and blob2['kind'] == 'kv_migration'
+        assert blob2['kv_len'] == blob['kv_len']
+        assert blob2['request'] == blob['request']
+        for l1, l2 in zip(blob['layers'], blob2['layers']):
+            for f in l1:
+                a1, a2 = np.asarray(l1[f]), np.asarray(l2[f])
+                assert a1.dtype == a2.dtype and (a1 == a2).all()
+        with pytest.raises(ValueError):
+            unpack_kv_blob(b'XXXX' + data[4:])
+
+    @pytest.mark.parametrize('dt', [None, 'int8'])
+    def test_round_trip_across_snapshot_restore(self, dt):
+        """Import, snapshot the decode pool mid-flight, restore on a
+        fresh standby, finish there: still bit-equal."""
+        ps = _prompts()
+        ref = _mk(dt).serve(ps)
+        src = _mk(dt)
+        dst = _mk(dt, role='decode')
+        rid, blob = _export_after_first_token(src, ps[0])
+        dst.import_kv(rid, blob)
+        dst.step()
+        snap = dst.snapshot()
+        standby = _mk(dt, role='decode')
+        standby.restore(snap)
+        assert standby.migration_counts['imported'] == 1
+        standby.run()
+        assert _same(standby.result(rid), ref[0])
+
+    def test_blob_validation(self):
+        src = _mk('int8')
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        # quantization worlds must match
+        with pytest.raises(ValueError, match='dtype'):
+            _mk(None, role='decode').import_kv(rid, blob)
+        # identity travels with the blob
+        with pytest.raises(ValueError, match='rid'):
+            _mk('int8', role='decode').import_kv(rid + 5, blob)
+        # schema is versioned
+        bad = dict(blob, schema=99)
+        with pytest.raises(ValueError, match='schema'):
+            _mk('int8', role='decode').import_kv(rid, bad)
+        # config must agree (the snapshot-config fields: sampling
+        # contract + max_context_len; pool geometry is free to differ)
+        other = ServingEngine(_model(), **dict(
+            KW, kv_cache_dtype='int8', phase_role='decode',
+            max_context_len=32))
+        with pytest.raises(ValueError, match='mismatch'):
+            other.import_kv(rid, blob)
+        # a speculative pool needs draft KV in the blob
+        spec = ServingEngine(_model(), draft=_model(1, layers=1),
+                             num_draft_tokens=2, **dict(
+                                 KW, kv_cache_dtype='int8',
+                                 phase_role='decode'))
+        with pytest.raises(ValueError, match='draft'):
+            spec.import_kv(rid, blob)
+        # after all the rejects, a clean import still works
+        dst = _mk('int8', role='decode')
+        dst.import_kv(rid, blob)
+        assert dst.in_flight() == 1
+        assert dst.allocator.in_use() > 0
+
+    def test_phase_role_validated(self):
+        with pytest.raises(ValueError, match='phase_role'):
+            _mk(role='sidecar')
+
+
+class TestDisaggPair:
+    @pytest.mark.parametrize('dt', [None, 'int8'])
+    def test_pair_bit_equal_vs_monolithic(self, dt):
+        ps = _prompts(4)
+        ref = _mk(dt).serve(ps)
+        pf = PrefillEngine(_model(), kv_cache_dtype=dt, **KW)
+        de = _mk(dt, role='decode')
+        pair = DisaggPair(pf, de)
+        got = pair.serve(ps)
+        assert all(_same(a, b) for a, b in zip(ref, got))
+        assert pf.migration_counts['handoffs'] == len(ps)
+        assert de.migration_counts['imported'] == len(ps)
+        assert pf.allocator.in_use() == 0
+        assert de.allocator.in_use() == 0
+
+    def test_pair_speculative_bit_equal(self):
+        ps = _prompts(3)
+        d = _model(1, layers=1)
+        skw = dict(KW, draft=d, num_draft_tokens=2,
+                   kv_cache_dtype='int8')
+        ref = ServingEngine(_model(), **skw).serve(ps)
+        pf = PrefillEngine(_model(), **skw)
+        de = ServingEngine(_model(), phase_role='decode', **skw)
+        got = DisaggPair(pf, de).serve(ps)
+        assert all(_same(a, b) for a, b in zip(ref, got))
+        assert de.spec_counts['windows'] > 0   # decode really ran spec
+
+    def test_prefix_shared_requests_migrate_and_balance(self):
+        """Source CoW/prefix machinery survives an export (read-only),
+        and the importing pool's own prefix index shares full prompt
+        pages below the recompute position — refcounts balance to
+        zero on BOTH engines once everything retires."""
+        rng = np.random.default_rng(5)
+        sys_p = rng.integers(3, 96, (16,)).astype(np.int32)
+        ps = [np.concatenate([sys_p, rng.integers(3, 96, (4,))
+                              .astype(np.int32)]) for _ in range(3)]
+        ref = _mk('int8', prefix_cache=True).serve(ps)
+        pf = PrefillEngine(_model(), kv_cache_dtype='int8',
+                           prefix_cache=True, **KW)
+        de = _mk('int8', role='decode', prefix_cache=True)
+        pair = DisaggPair(pf, de)
+        # sequential serves so the decode pool's prefix index is
+        # populated before the later imports arrive
+        got = [pair.serve([p])[0] for p in ps]
+        assert all(_same(a, b) for a, b in zip(ref, got))
+        assert de.prefix_counts['hits'] > 0
+        assert pf.allocator.in_use() == 0
+        assert de.allocator.in_use() == 0
+
+    def test_pair_validates_construction(self):
+        pf = PrefillEngine(_model(), **KW)
+        with pytest.raises(ValueError, match='decode-role'):
+            DisaggPair(pf, _mk())
+        with pytest.raises(ValueError, match='prefill-role'):
+            DisaggPair(_mk(), _mk(role='decode'))
+        with pytest.raises(ValueError, match='kv_cache_dtype'):
+            DisaggPair(pf, _mk('int8', role='decode'))
+
+    def test_pair_result_and_status_routing(self):
+        pf = PrefillEngine(_model(), **KW)
+        de = _mk(role='decode')
+        pair = DisaggPair(pf, de)
+        rid = pair.submit(_prompts()[0])
+        assert pair.status(rid) == 'queued'
+        pair.run()
+        assert pair.status(rid) == 'finished'
+        assert pair.result(rid) is not None
+        assert pair.in_flight() == 0
+
+
+class TestServingTp:
+    def test_tp2_pair_and_cross_degree_bit_equal(self):
+        def mk_m():
+            pt.seed(0)
+            return LlamaForCausalLM(llama_tiny(
+                vocab_size=96, hidden_size=64, layers=2, heads=4,
+                kv_heads=4))
+
+        m = mk_m()
+        ps = _prompts(3, seed=3)
+        for dt in (None, 'int8'):
+            ref = ServingEngine(m, kv_cache_dtype=dt, **KW).serve(ps)
+            # tp=2 prefill -> tp=2 decode
+            pf = PrefillEngine(m, tp=2, kv_cache_dtype=dt, **KW)
+            de = ServingEngine(m, tp=2, kv_cache_dtype=dt,
+                               phase_role='decode', **KW)
+            got = DisaggPair(pf, de).serve(ps)
+            assert all(_same(a, b) for a, b in zip(ref, got))
+            # cross-degree: tp=2 export -> tp=1 import, over the wire
+            src = ServingEngine(m, tp=2, kv_cache_dtype=dt, **KW)
+            rid, blob = _export_after_first_token(src, ps[0])
+            blob = unpack_kv_blob(pack_kv_blob(blob))
+            dst = ServingEngine(m, kv_cache_dtype=dt,
+                                phase_role='decode', **KW)
+            dst.import_kv(rid, blob)
+            while dst.in_flight():
+                dst.step()
+            assert _same(dst.result(rid), ref[0])
+
+
+class TestAtomicImport:
+    def test_injected_outofblocks_rolls_back_shares_and_pages(self):
+        dst = _mk('int8', role='decode', prefix_cache=True)
+        src = _mk('int8', prefix_cache=True)
+        p = _prompts(1, lo=17, hi=18, seed=9)[0]
+        # first migration populates the destination's prefix index
+        rid1, blob1 = _export_after_first_token(src, p)
+        dst.import_kv(rid1, blob1)
+        while dst.in_flight():
+            dst.step()
+        dst.result(rid1)
+        assert dst.allocator.in_use() == 0
+        # second request, same prompt -> the import takes prefix
+        # shares THEN allocates; the injected OutOfBlocks on that
+        # alloc must give every share back
+        src2 = _mk('int8', prefix_cache=True)
+        rid2, blob2 = _export_after_first_token(src2, p)
+        inj = FaultInjector(seed=0)
+        rule = inj.script('alloc', exc=OutOfBlocks('injected: pool dry'),
+                          after=0, times=1)
+        with inj:
+            with pytest.raises(OutOfBlocks):
+                dst.import_kv(rid2, blob2)
+        assert rule.fired == 1
+        assert dst.allocator.in_use() == 0
+        assert rid2 not in dst._live
+        assert dst.migration_counts['import_failed'] == 1
+        assert dst.migration_counts['imported'] == 1
+        # the engine is untouched: the same import now lands and
+        # finishes bit-equal
+        dst.import_kv(rid2, blob2)
+        while dst.in_flight():
+            dst.step()
+        ref = _mk('int8').serve([p])[0]
+        assert _same(dst.result(rid2), ref)
+        assert dst.allocator.in_use() == 0
+
+    def test_oversized_import_rejected_before_placement(self):
+        small = ServingEngine(_model(), **dict(
+            KW, phase_role='decode', num_blocks=3))
+        src = _mk()
+        rid, blob = _export_after_first_token(
+            src, _prompts(1, lo=12, hi=13)[0])
+        with pytest.raises(ValueError, match='cannot fit'):
+            small.import_kv(rid, blob)
+        assert small.allocator.in_use() == 0
+        assert small.in_flight() == 0
+
+
+class TestWarmGeometry:
+    def test_decode_role_enum_equals_live(self):
+        """for_serving_engine on a decode-role pool (prompt_lens = the
+        contexts requests IMPORT at) == exactly the keys the live
+        import-fed pool notes: imports, the one-token continuation
+        chunk per context bucket, and the shared decode window —
+        no admission kinds."""
+        m = _model(hidden_size=32, layers=1)
+        lens = [5, 9, 21]
+        # DIFFERENT max_slots on purpose: pool config rides in every
+        # registry key, so the prefill engine's own compiles (the
+        # export source) can't collide with the decode pool's keys —
+        # the live set below attributes cleanly per engine
+        pf = PrefillEngine(m, max_slots=3, block_size=4,
+                           max_new_tokens=4, max_context_len=44,
+                           eos_token_id=None)          # window=1
+        de = ServingEngine(m, phase_role='decode', max_slots=2,
+                           block_size=4, max_new_tokens=4,
+                           max_context_len=44, decode_window=2,
+                           eos_token_id=None)
+        gs = aot.for_serving_engine(de, prompt_lens=[L + 1 for L in lens])
+        kinds = sorted({g.kind for g in gs})
+        assert kinds == ['serve_chunk_step', 'serve_import',
+                         'serve_window']
+        enum = set(gs.registry_keys(de))
+        enum_pf = set(aot.for_serving_engine(pf, prompt_lens=lens)
+                      .registry_keys(pf))
+        assert not enum & enum_pf
+        before = set(COMPILE_CACHE.keys())
+        blobs = []
+        for L in lens:
+            rid = pf.submit(np.arange(3, 3 + L, dtype=np.int32) % 90 + 3)
+            pf.run()
+            (blob,) = pf.take_handoffs()
+            blobs.append((rid, blob))
+        # solo import drains through pure windows; the remaining two
+        # land staggered so chunk steps overlap live decode rows
+        de.import_kv(*blobs[0])
+        de.run()
+        de.import_kv(*blobs[1])
+        de.step()
+        de.import_kv(*blobs[2])
+        de.run()
+        live = {k for k in COMPILE_CACHE.keys()
+                if k not in before} - enum_pf
+        assert live == enum, (
+            f'missing={sorted(map(str, enum - live))[:4]} '
+            f'extra={sorted(map(str, live - enum))[:4]}')
+
+    def test_prefill_role_enum_covers_live(self):
+        """The prefill role keeps the full monolithic enumeration
+        (admission kinds + the window its first-token decode can run)
+        plus serve_export per reachable handoff bucket; the live
+        sweep's keys are a subset, with every export key present."""
+        m = _model(hidden_size=32, layers=1)
+        kw = dict(max_slots=2, block_size=4, max_new_tokens=4,
+                  max_context_len=44, decode_window=1,
+                  eos_token_id=None)
+        lens = [5, 9, 21]
+        pf = PrefillEngine(m, **kw)
+        enum = set(aot.for_serving_engine(pf, prompt_lens=lens)
+                   .registry_keys(pf))
+        before = set(COMPILE_CACHE.keys())
+        for L in lens:
+            pf.submit(np.arange(2, 2 + L, dtype=np.int32) % 90 + 3)
+            pf.run()
+        assert pf.migration_counts['handoffs'] == len(lens)
+        live = {k for k in COMPILE_CACHE.keys() if k not in before}
+        assert live <= enum, sorted(map(str, live - enum))[:4]
+        exports = {k for k in enum if 'serve_export' in str(k)}
+        assert exports and exports <= live
+
+    def test_pair_zero_compiles_after_warm_attach(self):
+        ps = _prompts(3, seed=11)
+        lens = [len(p) for p in ps]
+        pf = PrefillEngine(_model(), kv_cache_dtype='int8', **KW)
+        de = _mk('int8', role='decode')
+        pf.warmup(geometries=aot.for_serving_engine(
+            pf, prompt_lens=lens))
+        # handoff contexts: L + g - 1 + 1 for g in 1..W committed
+        ctx = sorted({L + g for L in lens
+                      for g in range(1, KW['decode_window'] + 1)})
+        de.warmup(geometries=aot.for_serving_engine(
+            de, prompt_lens=ctx))
+        t0, m0 = total_traces(), COMPILE_CACHE.misses
+        got = DisaggPair(pf, de).serve(ps)
+        assert total_traces() - t0 == 0
+        assert COMPILE_CACHE.misses - m0 == 0
+        ref = _mk('int8').serve(ps)
+        assert all(_same(a, b) for a, b in zip(ref, got))
+
+
+class TestOpsSurface:
+    def test_health_and_statusz_report_phase_role(self):
+        from paddle_tpu.observability.httpd import start_ops_server
+
+        for role, eng in (('prefill', PrefillEngine(_model(), **KW)),
+                          ('decode', _mk(role='decode')),
+                          ('monolithic', _mk())):
+            srv = start_ops_server(eng)
+            try:
+                code, payload = srv.health()
+                assert code == 200 and payload['phase_role'] == role
+                assert srv.statusz()['phase_role'] == role
+                assert srv.statusz()['engine']['phase_role'] == role
+                eng.draining = True
+                code, payload = srv.health()
+                assert code == 503 and payload['phase_role'] == role
+            finally:
+                eng.draining = False
+                srv.close()
+
+    def test_stats_carry_migration_counters(self):
+        src = _mk()
+        dst = _mk(role='decode')
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        dst.import_kv(rid, blob)
+        s, d = src.stats(), dst.stats()
+        assert s['phase_role'] == 'monolithic'
+        assert d['phase_role'] == 'decode'
+        assert s['migration']['exported'] == 1
+        assert s['migration']['bytes_exported'] > 0
+        assert d['migration']['imported'] == 1
+
+    def test_draining_prefill_refuses_but_completes_handoffs(self):
+        pf = PrefillEngine(_model(), **KW)
+        de = _mk(role='decode')
+        pair = DisaggPair(pf, de)
+        ps = _prompts(3, seed=13)
+        rids = [pair.submit(p) for p in ps]
+        pair.step()         # admit (and possibly hand off) some
+        pair.drain(True)
+        with pytest.raises(QueueFull):
+            pair.submit(ps[0])
+        pair.run()          # in-flight handoffs still complete
+        assert pf.migration_counts['handoffs'] == len(ps)
+        ref = _mk().serve(ps)
+        for rid, want in zip(rids, ref):
+            assert _same(pair.result(rid), want)
+
+
+class TestMigrationBytes:
+    def test_int8_blob_bytes_vs_bf16(self):
+        """Per migrated row and kv head, int8 ships D bytes + a 4-byte
+        f32 scale (for k and v each) where bf16 ships 2*D — the blob
+        ratio is exactly (D + 4) / (2*D), i.e. half plus the scale
+        overhead (0.53 at a deployment D=64; 0.625 at this tiny
+        model's D=16)."""
+        p = _prompts(1, lo=20, hi=21, seed=21)[0]
+        D, Hkv, layers = 16, 2, 2
+        sizes = {}
+        for dt in ('bfloat16', 'int8'):
+            e = _mk(dt)
+            rid, blob = _export_after_first_token(e, p)
+            n = blob['kv_len']
+            per_layer = (n * Hkv * (D * 2 + 4 * 2) if dt == 'int8'
+                         else n * Hkv * D * 2 * 2)
+            assert e._blob_layer_bytes(blob) == per_layer * layers
+            sizes[dt] = (e._blob_layer_bytes(blob), n)
+        assert sizes['int8'][1] == sizes['bfloat16'][1]
+        r = sizes['int8'][0] / sizes['bfloat16'][0]
+        assert abs(r - (D + 4) / (2 * D)) < 1e-9

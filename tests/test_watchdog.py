@@ -484,7 +484,8 @@ class TestOpsServer:
             assert code == 200 and 'serve_tokens 5' in body
             code, body = _get(srv.url('/healthz'))
             assert code == 200
-            assert body == {'status': 'ok', 'watchdog': False}
+            assert body == {'status': 'ok', 'watchdog': False,
+                            'phase_role': 'monolithic'}
             code, body = _get(srv.url('/slo'))
             assert code == 404
             code, body = _get(srv.url('/statusz'))
@@ -527,7 +528,8 @@ class TestOpsServer:
         srv = start_ops_server(Eng())
         try:
             code, body = _get(srv.url('/healthz'))
-            assert code == 503 and body == {'status': 'draining'}
+            assert code == 503 and body == {'status': 'draining',
+                                            'phase_role': 'monolithic'}
             code, body = _get(srv.url('/statusz'))
             assert code == 200 and body['draining'] is True
         finally:
@@ -664,7 +666,8 @@ class TestServingIntegration:
         try:
             srv.drain()
             code, body = _get(srv.ops_server.url('/healthz'))
-            assert code == 503 and body == {'status': 'draining'}
+            assert code == 503 and body == {'status': 'draining',
+                                            'phase_role': 'monolithic'}
             with pytest.raises(QueueFull):
                 srv.submit(_p(3), 4)
             assert srv.counts['rejected'] == 1
