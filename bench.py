@@ -192,6 +192,24 @@ def _shardlint_gate(timeout_s=240):
     return clean, detail, payload.get('comm')
 
 
+def _hlolint_gate(timeout_s=420):
+    """Static compiled-artifact gate: hlolint must report zero NEW
+    error-severity violations over the serving/AOT suite registry vs
+    the committed baseline — a dropped donation alias, an HBM-budget
+    bust, a host transfer inside a serve dispatch, a collective census
+    that disagrees with shardlint's declaration, or a changed retrace
+    fingerprint fails the bench run at the XLA-artifact level while
+    the tunnel is down. Compiles ~30 programs, hence the longer
+    timeout. Returns (clean, detail, artifacts): artifacts is the
+    per-program {peak_bytes, fingerprint, aliased, census} map stamped
+    into the bench detail blob, or None."""
+    clean, detail, payload = _analysis_gate(['--hlo'],
+                                            timeout_s=timeout_s)
+    if clean:
+        detail += f' ({payload.get("suppressed", 0)} suppressed)'
+    return clean, detail, payload.get('artifacts')
+
+
 _TRAIN_GATE_SRC = r'''
 import json
 import jax
@@ -2030,6 +2048,8 @@ def main():
     print(f'# mosaiclint gate: {mosaiclint_detail}', flush=True)
     shardlint_clean, shardlint_detail, shardlint_comm = _shardlint_gate()
     print(f'# shardlint gate: {shardlint_detail}', flush=True)
+    hlolint_clean, hlolint_detail, hlolint_artifacts = _hlolint_gate()
+    print(f'# hlolint gate: {hlolint_detail}', flush=True)
     train_gate_clean, train_gate_detail = _train_engine_gate()
     print(f'# train engine gate: {train_gate_detail}', flush=True)
     serving_gate_clean, serving_gate_detail, serving_gate_payload = (
@@ -2063,6 +2083,7 @@ def main():
     static_gate_failed = (tracelint_clean is False
                           or mosaiclint_clean is False
                           or shardlint_clean is False
+                          or hlolint_clean is False
                           or train_gate_clean is False
                           or serving_gate_clean is False
                           or obs_gate_clean is False
@@ -2086,6 +2107,9 @@ def main():
             det['gate_shardlint_clean'] = shardlint_clean
             det['shardlint'] = shardlint_detail
             det['shardlint_comm'] = shardlint_comm
+            det['gate_hlolint_clean'] = hlolint_clean
+            det['hlolint'] = hlolint_detail
+            det['hlolint_artifacts'] = hlolint_artifacts
             det['gate_train_retrace_zero'] = train_gate_clean
             det['train_gate'] = train_gate_detail
             # the CPU-pinned serving gate is the round's continuous-
@@ -2924,6 +2948,17 @@ def main():
             # communication regressions show in the bench history
             # before they burn a real pod
             'shardlint_comm': shardlint_comm,
+            # static compiled-artifact gate (hlolint): False also fails
+            # the run — a dropped donation alias, an HBM-budget bust, a
+            # host transfer in a serve dispatch, or a retrace-
+            # fingerprint change is a regression the compiled XLA
+            # artifact proves before the chip sees it
+            'gate_hlolint_clean': hlolint_clean,
+            'hlolint': hlolint_detail,
+            # per-program artifact evidence (peak bytes, alias counts,
+            # collective census, fingerprints): memory and retrace
+            # regressions show in the bench history before they OOM
+            'hlolint_artifacts': hlolint_artifacts,
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,

@@ -23,11 +23,17 @@ the jaxpr/BlockSpec level over the registered pallas kernels.  The
 THIRD lives in `analysis.shard` (shardlint, docs/shardlint.md):
 SL001–SL006 prove the distributed layer's sharding and communication
 budgets by compiling registered suites under a virtual 8-device mesh.
-Neither is imported here — both need jax, and plain tracelint must
-stay importable without it.  Reach them via
-`paddle_tpu.analysis.mosaic` / `paddle_tpu.analysis.shard`,
-`python -m paddle_tpu.analysis --mosaic|--shard`, or the `mosaiclint`
-/ `shardlint` console scripts.
+The FOURTH lives in `analysis.hlo` (hlolint, docs/analysis.md):
+HL001–HL006 read the fully *compiled* XLA artifacts of every serve
+dispatch and AOT warmup geometry — donation actually aliased, no
+dtype widening, peak HBM vs declared budgets, zero host transfers,
+collective census cross-checked against shardlint, and retrace
+fingerprints against a committed baseline.  None of the three is
+imported here — they need jax, and plain tracelint must stay
+importable without it.  Reach them via `paddle_tpu.analysis.mosaic` /
+`.shard` / `.hlo`, `python -m paddle_tpu.analysis
+--mosaic|--shard|--hlo` (`--all` runs every family with one combined
+rc), or the `mosaiclint` / `shardlint` / `hlolint` console scripts.
 """
 from .engine import (
     Violation,
@@ -42,8 +48,9 @@ from .engine import (
     format_text,
     format_json,
 )
-from .config import (MosaiclintConfig, ShardlintConfig, TracelintConfig,
-                     load_config, load_mosaic_config, load_shard_config)
+from .config import (HlolintConfig, MosaiclintConfig, ShardlintConfig,
+                     TracelintConfig, load_config, load_hlo_config,
+                     load_mosaic_config, load_shard_config)
 from .rules import all_rules, get_rule
 
 __all__ = [
@@ -52,6 +59,8 @@ __all__ = [
     'load_baseline', 'write_baseline', 'filter_new',
     'format_text', 'format_json',
     'TracelintConfig', 'MosaiclintConfig', 'ShardlintConfig',
+    'HlolintConfig',
     'load_config', 'load_mosaic_config', 'load_shard_config',
+    'load_hlo_config',
     'all_rules', 'get_rule',
 ]

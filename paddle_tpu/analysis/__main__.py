@@ -1,27 +1,36 @@
-"""tracelint / mosaiclint / shardlint CLI.
+"""tracelint / mosaiclint / shardlint / hlolint CLI.
 
     python -m paddle_tpu.analysis [paths...]        # tracelint (AST)
     python -m paddle_tpu.analysis --mosaic [paths]  # mosaiclint (jaxpr)
     python -m paddle_tpu.analysis --shard [paths]   # shardlint (GSPMD)
+    python -m paddle_tpu.analysis --hlo [paths]     # hlolint (XLA HLO)
+    python -m paddle_tpu.analysis --all             # all four families
     tracelint paddle_tpu/                           # console script
     mosaiclint                                      # console script
     shardlint                                       # console script
+    hlolint                                         # console script
     tracelint --write-baseline                      # accept current debt
+    hlolint --write-fingerprints                    # re-baseline HL006
     shardlint --list-rules
 
-`--mosaic` and `--shard` are mutually exclusive — one invocation runs
-exactly one analyzer family (tools/lint_all.py runs all three).
+`--mosaic` / `--shard` / `--hlo` are mutually exclusive — one
+invocation runs exactly one analyzer family; `--all` runs the four
+families in sequence with ONE shared JSON report schema and a single
+combined exit code (the entrypoint CI and bench.py call —
+tools/lint_gate.sh wraps it with the env pins).
 
 Exit codes: 0 clean (modulo baseline/suppressions), 1 new
 ERROR-severity violations (warnings print but never gate — they exist
-to be confirmed on chip, not to block it), 2 usage/IO error.  Config
-comes from `[tool.tracelint]` / `[tool.mosaiclint]` / `[tool.shardlint]`
-in pyproject.toml at `--root` (default: cwd); CLI flags win over
-config.  mosaiclint traces the kernel registry and shardlint compiles
-the sharding registry with jax, so pin `JAX_PLATFORMS=cpu` where
-touching an accelerator backend is unwanted (bench.py's gates do);
-shardlint additionally forces the 8-virtual-device flag itself when
-the backend has not initialised yet.
+to be confirmed on chip, not to block it), 2 usage/IO error.  `--all`
+combines: 1 if any family gated, else 2 if any family errored, else
+0.  Config comes from `[tool.tracelint]` / `[tool.mosaiclint]` /
+`[tool.shardlint]` / `[tool.hlolint]` in pyproject.toml at `--root`
+(default: cwd); CLI flags win over config.  mosaiclint traces the
+kernel registry with jax, and shardlint/hlolint compile their
+registries, so pin `JAX_PLATFORMS=cpu` where touching an accelerator
+backend is unwanted (bench.py's gates do); shardlint and hlolint
+additionally force the 8-virtual-device flag themselves when the
+backend has not initialised yet.
 """
 from __future__ import annotations
 
@@ -29,7 +38,8 @@ import argparse
 import os
 import sys
 
-from .config import load_config, load_mosaic_config, load_shard_config
+from .config import (load_config, load_hlo_config, load_mosaic_config,
+                     load_shard_config)
 from .engine import (filter_new, format_json, format_text, lint_paths,
                      load_baseline, write_baseline)
 from .rules import all_rules
@@ -55,6 +65,17 @@ def _build_parser():
     p.add_argument('--shard', action='store_true',
                    help='run shardlint (SL rules over the distributed '
                         'sharding registry) instead of tracelint')
+    p.add_argument('--hlo', action='store_true',
+                   help='run hlolint (HL rules over compiled XLA '
+                        'artifacts of the serving/AOT registry) '
+                        'instead of tracelint')
+    p.add_argument('--all', action='store_true',
+                   help='run all four analyzer families with one '
+                        'combined report and exit code')
+    p.add_argument('--write-fingerprints', action='store_true',
+                   help='(hlolint) compile every suite and write the '
+                        'HL006 retrace-fingerprint baseline, then '
+                        'exit 0')
     p.add_argument('--root', default=None,
                    help='project root holding pyproject.toml and the '
                         'baseline (default: cwd)')
@@ -74,7 +95,8 @@ def _build_parser():
 
 def _family(args):
     return ('mosaiclint' if args.mosaic
-            else 'shardlint' if args.shard else 'tracelint')
+            else 'shardlint' if args.shard
+            else 'hlolint' if args.hlo else 'tracelint')
 
 
 def _finish(args, violations, baseline_path, baselined_filter=True,
@@ -211,14 +233,122 @@ def _main_shard(args, root):
                           entries_for, lint_and_report, 'comm')
 
 
+def _main_hlo(args, root):
+    # imported here: hlolint needs jax (it compiles the registry, and
+    # its xcheck suites need the virtual mesh), plain tracelint must not
+    from .hlo import (ensure_virtual_devices, fingerprint_report,
+                      lint_and_report, write_fingerprints)
+    from .hlo.registry import entries_for
+    from .hlo.rules import all_rules as all_hl_rules
+
+    # set the virtual-device flag BEFORE anything touches the backend;
+    # refuse with a recipe (rc 2) when a too-small backend already won
+    if not ensure_virtual_devices():
+        import jax
+
+        print(f'hlolint: needs 8 devices, found {jax.device_count()} '
+              f'(backend initialised first?) — run with XLA_FLAGS='
+              f'--xla_force_host_platform_device_count=8 '
+              f'JAX_PLATFORMS=cpu', file=sys.stderr)
+        return 2
+
+    cfg = load_hlo_config(root)
+    fp_path = cfg.fingerprints
+    if not os.path.isabs(fp_path):
+        fp_path = os.path.join(root, fp_path)
+    if args.write_fingerprints:
+        try:
+            entries = entries_for(args.paths or cfg.paths or None,
+                                  root=root)
+            fps = fingerprint_report(entries, root=root)
+        except Exception as e:  # noqa: BLE001 - a broken suite must not
+            # be silently baselined around
+            print(f'hlolint: --write-fingerprints failed: '
+                  f'{type(e).__name__}: {e}', file=sys.stderr)
+            return 2
+        write_fingerprints(fps, fp_path)
+        print(f'hlolint: wrote {len(fps)} fingerprint(s) across '
+              f'{len(entries)} suite(s) to {fp_path}')
+        return 0
+
+    def lint_fn(entries, rules=None, root=None):
+        return lint_and_report(entries, rules=rules, root=root,
+                               fingerprint_path=fp_path)
+
+    return _registry_main(args, root, 'hlolint', cfg, all_hl_rules,
+                          entries_for, lint_fn, 'artifacts')
+
+
+def _main_all(args, root):
+    """The unified runner: every family in sequence, one report.
+
+    JSON schema: {"schema": 1, "rc": combined, "families": [{"family",
+    "rc", <that family's own JSON report>}...]}; text mode prints each
+    family's text report under a header plus a summary table.
+    Combined rc: 1 if any family found new errors, else 2 if any
+    family failed outright, else 0 — so one exit code gates CI."""
+    import contextlib
+    import io
+    import json
+
+    if (args.write_baseline or args.write_fingerprints or args.baseline
+            or args.select or args.paths):
+        print('tracelint: --all runs every family with its own config;'
+              ' per-family flags (paths/--select/--baseline/--write-*)'
+              ' need a single-family invocation', file=sys.stderr)
+        return 2
+
+    flags = ['--root', root, '--format', 'json']
+    if args.no_baseline:
+        flags.append('--no-baseline')
+    rows, combined = [], []
+    for family, flag in (('tracelint', None), ('mosaiclint', '--mosaic'),
+                         ('shardlint', '--shard'), ('hlolint', '--hlo')):
+        buf, err = io.StringIO(), io.StringIO()
+        with contextlib.redirect_stdout(buf), \
+                contextlib.redirect_stderr(err):
+            try:
+                rc = main(([flag] if flag else []) + flags)
+            except SystemExit as e:  # argparse or a deep sys.exit
+                rc = int(e.code or 0)
+        try:
+            report = json.loads(buf.getvalue())
+        except ValueError:
+            report = {'error': (err.getvalue() or buf.getvalue()).strip()}
+        rows.append({'family': family, 'rc': rc, **report})
+        combined.append(rc)
+    rc = (1 if any(c == 1 for c in combined)
+          else 2 if any(c not in (0, 1) for c in combined) else 0)
+    if args.format == 'json':
+        print(json.dumps({'schema': 1, 'rc': rc, 'families': rows},
+                         indent=2))
+    else:
+        for row in rows:
+            status = ('clean' if row['rc'] == 0
+                      else 'GATE' if row['rc'] == 1 else 'ERROR')
+            n_new = len(row.get('violations', []))
+            print(f"{row['family']:<12} rc={row['rc']} [{status}] "
+                  f"new={n_new} baselined={row.get('baselined', 0)} "
+                  f"suppressed={row.get('suppressed', 0)}"
+                  + (f" error={row['error']}" if 'error' in row else ''))
+            for v in row.get('violations', []):
+                print(f"  {v['path']}:{v['line']}: {v['rule']} "
+                      f"[{v['severity']}] {v['message']}")
+    return rc
+
+
 def main(argv=None):
     args = _build_parser().parse_args(argv)
-    if args.mosaic and args.shard:
+    picked = [f for f, on in (('--mosaic', args.mosaic),
+                              ('--shard', args.shard),
+                              ('--hlo', args.hlo),
+                              ('--all', args.all)) if on]
+    if len(picked) > 1:
         # one invocation = one analyzer family; last-flag-wins would
         # silently skip a whole family in CI
-        print('tracelint: --mosaic and --shard are mutually exclusive '
-              '— pick one analyzer per invocation (tools/lint_all.py '
-              'runs all three)', file=sys.stderr)
+        print(f'tracelint: {" and ".join(picked)} are mutually '
+              f'exclusive — pick one analyzer per invocation (--all '
+              f'runs all four)', file=sys.stderr)
         return 2
     if args.list_rules:
         if args.mosaic:
@@ -229,6 +359,10 @@ def main(argv=None):
             from .shard.rules import all_rules as all_sl_rules
 
             rules = all_sl_rules()
+        elif args.hlo:
+            from .hlo.rules import all_rules as all_hl_rules
+
+            rules = all_hl_rules()
         else:
             rules = all_rules()
         for rule in rules:
@@ -237,10 +371,14 @@ def main(argv=None):
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
+    if args.all:
+        return _main_all(args, root)
     if args.mosaic:
         return _main_mosaic(args, root)
     if args.shard:
         return _main_shard(args, root)
+    if args.hlo:
+        return _main_hlo(args, root)
     return _main_tracelint(args, root)
 
 
@@ -254,6 +392,12 @@ def shard_main(argv=None):
     """Entry point for the `shardlint` console script."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(['--shard'] + argv)
+
+
+def hlo_main(argv=None):
+    """Entry point for the `hlolint` console script."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(['--hlo'] + argv)
 
 
 if __name__ == '__main__':

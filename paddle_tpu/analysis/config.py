@@ -1,7 +1,8 @@
-"""`[tool.tracelint]` / `[tool.mosaiclint]` config from pyproject.toml.
+"""`[tool.tracelint]` / `[tool.mosaiclint]` / `[tool.shardlint]` /
+`[tool.hlolint]` config from pyproject.toml.
 
 Python 3.10 has no stdlib tomllib and the repo pins no TOML package, so
-this reads the two tables the analyzers need with a deliberately tiny
+this reads the tables the analyzers need with a deliberately tiny
 parser: `key = "string"` and `key = ["a", "b", ...]` entries (lists may
 span lines) inside one `[tool.<name>]` section. That subset is the
 whole config surface; anything fancier belongs in CLI flags.
@@ -36,6 +37,16 @@ class ShardlintConfig:
     # entries by anchor file under paddle_tpu/distributed/
     paths: list = dataclasses.field(default_factory=list)
     baseline: str = 'tools/shardlint_baseline.json'
+    select: list = dataclasses.field(default_factory=list)  # empty = all
+
+
+@dataclasses.dataclass
+class HlolintConfig:
+    # same registry-filter semantics as mosaiclint/shardlint: paths
+    # select suite entries by anchor file
+    paths: list = dataclasses.field(default_factory=list)
+    baseline: str = 'tools/hlolint_baseline.json'
+    fingerprints: str = 'tools/hlolint_fingerprints.json'
     select: list = dataclasses.field(default_factory=list)  # empty = all
 
 
@@ -134,6 +145,21 @@ def load_shard_config(root=None):
         cfg.paths = list(table['paths'])
     if 'baseline' in table:
         cfg.baseline = table['baseline']
+    if 'select' in table:
+        cfg.select = list(table['select'])
+    return cfg
+
+
+def load_hlo_config(root=None):
+    """Hlolint config from the [tool.hlolint] table."""
+    cfg = HlolintConfig()
+    table = _load_table(root, 'hlolint')
+    if 'paths' in table:
+        cfg.paths = list(table['paths'])
+    if 'baseline' in table:
+        cfg.baseline = table['baseline']
+    if 'fingerprints' in table:
+        cfg.fingerprints = table['fingerprints']
     if 'select' in table:
         cfg.select = list(table['select'])
     return cfg

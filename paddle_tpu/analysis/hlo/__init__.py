@@ -1,0 +1,25 @@
+"""hlolint — compiled-artifact analysis (the fourth analyzer family).
+
+tracelint reads the AST, mosaiclint the jaxpr, shardlint the GSPMD
+partition; hlolint reads what XLA actually compiled: the HLO text,
+cost/memory analysis and lowered StableHLO of every registered serve
+dispatch and AOT warmup geometry, proving donation aliasing (HL001),
+dtype-width discipline (HL002), per-geometry HBM budgets (HL003),
+zero host transfers (HL004), the shardlint collective cross-check
+(HL005) and retrace-fingerprint stability (HL006).
+
+    python -m paddle_tpu.analysis.hlo          # == `hlolint`
+    hlolint --format json
+    hlolint --write-fingerprints               # re-baseline HL006
+
+jax imports stay lazy: `paddle_tpu.analysis` remains stdlib-only to
+import; the backend wakes only when a suite compiles.
+"""
+from .engine import (Entry, HloContext, HloRule, HloSuite, Program,  # noqa: F401
+                     ProgramArtifact, ensure_virtual_devices,
+                     find_converts, find_host_transfers,
+                     fingerprint_env, fingerprint_report,
+                     hlo_collective_census, lint_and_report,
+                     lint_entries, load_fingerprints, parse_alias_map,
+                     stablehlo_fingerprint, trace_entry,
+                     write_fingerprints)

@@ -1,0 +1,13 @@
+"""`python -m paddle_tpu.analysis.hlo` — the hlolint CLI.
+
+Thin alias for `python -m paddle_tpu.analysis --hlo` (one analyzer
+family per invocation; `--all` runs the four families together).
+"""
+from __future__ import annotations
+
+import sys
+
+from ..__main__ import hlo_main
+
+if __name__ == '__main__':
+    sys.exit(hlo_main())

@@ -1,0 +1,532 @@
+"""The hlolint engine: compile suites, read the artifact, run HL rules.
+
+tracelint proves source-level contracts with `ast`; mosaiclint proves
+Mosaic lowering legality at the jaxpr level; shardlint proves the
+GSPMD sharding contract on a virtual mesh. This engine closes the gap
+none of them can see: what XLA ACTUALLY COMPILED. Each registered
+suite is a list of `Program`s — the very jitted dispatches the serving
+scheduler executes (`ServingEngine._cost_specs` hands them over with
+the live model as an argument) or a shard-registry build replayed
+bit-identically — `.lower(*avals).compile()`d once, and the rules read
+four kinds of evidence out of that one artifact:
+
+  - the compiled HLO's `input_output_alias` header: every donated arg
+    XLA honored, counted against the suite's DECLARED donation
+    contract (`aot.geometry.donated_argnames`) — a silently-dropped
+    donation doubles KV pool memory on chip (HL001),
+  - the HLO instruction stream: `convert` widenings out of int8/int4
+    storage, any f64 landing anywhere, host round-trips (infeed /
+    outfeed / host callback custom-calls), and an INDEPENDENT
+    collective count cross-checked against shardlint's declared
+    budgets — two provers, one wire bill (HL002, HL004, HL005),
+  - the compiled memory analysis (argument + output + temp bytes):
+    peak device memory per AOT geometry against the suite's declared
+    HBM budget, so a geometry OOMs in CI instead of on a pod (HL003),
+  - the lowered StableHLO text, location-stripped and hashed: the
+    compilation-cache fingerprint per geometry. A changed fingerprint
+    for an unchanged geometry is a retrace regression — the committed
+    baseline in tools/hlolint_fingerprints.json pins it (HL006).
+
+Like its siblings: violations reuse tracelint's Violation/severity/
+baseline machinery keyed on the suite's anchor file, suppression lives
+in the registry with a MANDATORY reason, and a suite that fails to
+build or compile surfaces as HL000 — never as a silent pass. jax is
+imported lazily; importing `paddle_tpu.analysis` stays stdlib-only.
+Fingerprints are environment-keyed (jax/jaxlib/backend): a baseline
+recorded elsewhere skips HL006 with a note instead of paging on a
+toolchain bump.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import re
+
+from ..engine import Violation
+from ..shard.engine import Entry as _ShardEntry
+from ..shard.engine import _mesh_context, ensure_virtual_devices  # noqa: F401
+
+DEFAULT_FINGERPRINT_PATH = 'tools/hlolint_fingerprints.json'
+
+# Same kind vocabulary as shardlint's census — the two provers must
+# count the same ops to disagree meaningfully — but the parser below
+# is hlolint's own walk over the compiled text, not a shared helper.
+COLLECTIVE_KINDS = ('all-reduce', 'all-gather', 'reduce-scatter',
+                    'all-to-all', 'collective-permute',
+                    'collective-broadcast')
+
+# narrow storage dtypes whose widening to float compute HL002 polices
+NARROW_DTYPES = frozenset({'s4', 'u4', 's8', 'u8'})
+WIDE_FLOATS = frozenset({'f16', 'bf16', 'f32', 'f64'})
+
+_HLO_ITEMSIZE = {
+    'pred': 1, 's4': 1, 'u4': 1, 's8': 1, 'u8': 1,
+    's16': 2, 'u16': 2, 'f16': 2, 'bf16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8,
+    'f8e4m3fn': 1, 'f8e5m2': 1, 'f8e4m3b11fnuz': 1,
+    'c64': 8, 'c128': 16,
+}
+
+_DEF_RE = re.compile(r'^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(.*)$')
+_SHAPE_RE = re.compile(r'([a-z][a-z0-9]*)\[([0-9,]*)\]')
+_OP_RE = re.compile(r'\)?\s*([a-z][a-z0-9-]*)\(')
+_CONVERT_RE = re.compile(
+    r'=\s*([a-z][a-z0-9]*)\[[^\]]*\][^\s]*\s*convert\(\s*'
+    r'(?:([a-z][a-z0-9]*)\[[^\]]*\][^\s]*\s*)?%?([\w.-]+)')
+_CALLBACK_TARGET_HINTS = ('callback', 'python_cpu', 'py_cpu',
+                          'xla_ffi_python')
+
+
+# ---------------------------------------------------------------------------
+# Compiled-HLO evidence extraction
+# ---------------------------------------------------------------------------
+
+def parse_alias_map(hlo_text):
+    """[(output index tuple string, parameter number)] from the
+    module-level `input_output_alias={...}` header; [] when XLA
+    aliased nothing. One entry per donated INPUT LEAF XLA honored."""
+    start = hlo_text.find('input_output_alias={')
+    if start < 0:
+        return []
+    i = start + len('input_output_alias={')
+    depth = 1
+    j = i
+    while j < len(hlo_text) and depth:
+        if hlo_text[j] == '{':
+            depth += 1
+        elif hlo_text[j] == '}':
+            depth -= 1
+        j += 1
+    body = hlo_text[i:j - 1]
+    return [(m.group(1), int(m.group(2))) for m in re.finditer(
+        r'\{([0-9, ]*)\}:\s*\((\d+)', body)]
+
+
+def _result_bytes(head):
+    """Payload bytes of one instruction's result type (the text before
+    the op name; tuple results — async `-start` forms — sum their
+    elements)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(head):
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in _HLO_ITEMSIZE:
+            continue
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        total += n * _HLO_ITEMSIZE[dtype]
+    return total
+
+
+def hlo_collective_census(hlo_text):
+    """{kind: {'count': n, 'bytes': b}} — hlolint's OWN count of
+    collective call sites in the compiled module, written against the
+    instruction defs rather than shardlint's single line regex, so
+    HL005's cross-check pits two separately-derived numbers against
+    each other. Async `-start` halves count as their base kind,
+    `-done` halves are skipped (one logical site, two instructions)."""
+    census = {}
+    for line in hlo_text.splitlines():
+        d = _DEF_RE.match(line)
+        if not d:
+            continue
+        rest = d.group(2)
+        m = _OP_RE.search(rest)
+        if not m:
+            continue
+        op = m.group(1)
+        if op.endswith('-done'):
+            continue
+        if op.endswith('-start'):
+            op = op[:-len('-start')]
+        if op not in COLLECTIVE_KINDS:
+            continue
+        rec = census.setdefault(op, {'count': 0, 'bytes': 0})
+        rec['count'] += 1
+        # slice at the op, not at the first '(' — a tuple result type
+        # (async start) opens with '(' itself
+        rec['bytes'] += _result_bytes(rest[:m.start()])
+    return census
+
+
+def find_converts(hlo_text):
+    """[(to_dtype, from_dtype, operand_name)] for every `convert` in
+    the compiled module. The operand dtype comes from the inline type
+    when the printer emits one, else from a symbol table of every
+    instruction def — robust to both HLO text dialects."""
+    symbols = {}
+    for line in hlo_text.splitlines():
+        d = _DEF_RE.match(line)
+        if not d:
+            continue
+        m = _SHAPE_RE.search(d.group(2).split('(', 1)[0])
+        if m:
+            symbols[d.group(1)] = m.group(1)
+    out = []
+    for m in _CONVERT_RE.finditer(hlo_text):
+        to_dt, inline_from, operand = m.group(1), m.group(2), m.group(3)
+        from_dt = inline_from or symbols.get(operand)
+        if from_dt:
+            out.append((to_dt, from_dt, operand))
+    return out
+
+
+def find_host_transfers(hlo_text):
+    """[(op, detail)] for every host round-trip in the compiled
+    module: infeed/outfeed, host-to-device send/recv pairs, and the
+    custom-call targets jax lowers `io_callback`/`pure_callback`/
+    `debug.print` through. Inside a serve dispatch any of these is a
+    per-step host sync — the latency cliff TL002 polices at the AST
+    level and this proves at the artifact level."""
+    found = []
+    for line in hlo_text.splitlines():
+        d = _DEF_RE.match(line)
+        if not d:
+            continue
+        rest = d.group(2)
+        m = _OP_RE.search(rest)
+        if not m:
+            continue
+        op = m.group(1)
+        if op in ('infeed', 'outfeed', 'send', 'recv',
+                  'send-done', 'recv-done'):
+            found.append((op, d.group(1)))
+        elif op == 'custom-call':
+            tm = re.search(r'custom_call_target="([^"]*)"', rest)
+            target = tm.group(1) if tm else ''
+            if any(h in target.lower() for h in _CALLBACK_TARGET_HINTS):
+                found.append(('custom-call', target))
+    return found
+
+
+_LOC_RE = re.compile(r'\s*loc\((?:[^()"]|"[^"]*"|\([^()]*\))*\)')
+_LOC_LINE_RE = re.compile(r'^#loc.*$', re.MULTILINE)
+
+
+def stablehlo_fingerprint(stablehlo_text):
+    """sha256 of the lowered module with source locations stripped —
+    the compilation-cache identity of one geometry. Two lowerings of
+    the same (fn, avals, statics) hash equal; ANY change to the traced
+    program (shapes, dtype, op graph, donation) changes the hash.
+    Location info is dropped so a pure line-number shift in serving.py
+    does not masquerade as a retrace."""
+    text = _LOC_RE.sub('', stablehlo_text)
+    text = _LOC_LINE_RE.sub('', text)
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint baseline (tools/hlolint_fingerprints.json)
+# ---------------------------------------------------------------------------
+
+def fingerprint_env():
+    """The environment key fingerprints are only comparable within:
+    lowered text is stable for a pinned toolchain, not across jax
+    upgrades or backend swaps."""
+    import jax
+    import jaxlib
+
+    return {'jax': jax.__version__, 'jaxlib': jaxlib.__version__,
+            'backend': jax.default_backend()}
+
+
+def load_fingerprints(path):
+    """(env, {key: sha256}) from a baseline file; (None, {}) when the
+    file is absent (HL006 then warns per program instead of erroring)."""
+    if not path or not os.path.exists(path):
+        return None, {}
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    return data.get('env'), dict(data.get('fingerprints', {}))
+
+
+def write_fingerprints(fingerprints, path):
+    """Write the {key: sha256} map with the current environment key."""
+    payload = {
+        'comment': 'hlolint HL006 baseline: per-geometry sha256 of the '
+                   'location-stripped StableHLO. A changed hash for an '
+                   'unchanged geometry is a retrace regression. '
+                   'Regenerate with: hlolint --write-fingerprints '
+                   '(pinned to the env below; other envs skip HL006).',
+        'env': fingerprint_env(),
+        'fingerprints': dict(sorted(fingerprints.items())),
+    }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write('\n')
+
+
+# ---------------------------------------------------------------------------
+# Suite / Entry / context
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Program:
+    """One compilable dispatch: what `trace_entry` lowers and reads.
+
+    `fn` is either an ALREADY-JITTED function (the serving dispatches;
+    lowered directly so the artifact is the scheduler's own, donation
+    decorators included) or a plain callable (wrapped in `jax.jit`
+    here with `in_shardings`/`out_shardings`/`donate_argnums` — the
+    shard-registry replay path). `args` are (pytrees of)
+    ShapeDtypeStructs, `kwargs` the static keywords. `donate` DECLARES
+    the donated top-level positional args — HL001 compares the flat
+    leaf count under those args against the aliases XLA emitted."""
+
+    label: str
+    fn: object
+    args: tuple
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    donate: tuple = ()
+    in_shardings: object = None
+    out_shardings: object = None
+
+
+@dataclasses.dataclass
+class HloSuite:
+    """What an Entry's `build()` returns: the programs to compile and
+    the (optional) mesh they compile under."""
+
+    programs: list
+    mesh: object = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry(_ShardEntry):
+    """One registered compiled-artifact suite (anchor resolution and
+    the SL fields ride along from the shard Entry; `build()` returns
+    an `HloSuite`).
+
+    `hbm_budget` is the declared peak-device-memory budget in BYTES
+    for the suite's largest program (HL003; None on a registered
+    production suite is itself a violation — budgets are the point).
+    `shard_ref` names the shardlint registry entry whose declared
+    communication budget HL005 cross-checks this suite's own census
+    against. `dequant_ok` permits int8->float converts (the declared
+    per-row-scale dequant path of quantized pools); f64 is never
+    permitted."""
+
+    hbm_budget: object = None
+    shard_ref: object = None
+    dequant_ok: bool = False
+
+
+@dataclasses.dataclass
+class ProgramArtifact:
+    """Everything the HL rules read from one compiled program."""
+
+    label: str
+    expected_donated: int        # flat leaves under declared donate args
+    donated_args: tuple          # declared top-level positions
+    alias_entries: list          # parse_alias_map output
+    census: dict                 # hlo_collective_census output
+    converts: list               # find_converts output
+    host_transfers: list         # find_host_transfers output
+    memory: dict                 # costs.analyze(compiled)['memory']
+    fingerprint: str             # stablehlo_fingerprint output
+    has_f64: bool
+
+    def peak_bytes(self):
+        m = self.memory or {}
+        return int(m.get('argument_bytes') or 0) \
+            + int(m.get('output_bytes') or 0) \
+            + int(m.get('temp_bytes') or 0)
+
+
+@dataclasses.dataclass
+class HloContext:
+    """What an HloRule sees for one compiled suite."""
+
+    entry: Entry
+    suite: HloSuite
+    programs: list               # [ProgramArtifact]
+    baseline_env: object         # env dict of the fingerprint file
+    baseline_fps: dict           # {entry::label: sha256}
+    env_match: bool              # current env == baseline env
+    path: str
+    line: int
+
+
+class HloRule:
+    """Base class mirroring ShardRule over a compiled HloContext."""
+
+    id = 'HL000'
+    name = 'abstract'
+    severity = 'error'
+    description = ''
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def violation(self, ctx, message, severity=None):
+        return Violation(
+            path=ctx.path,
+            line=ctx.line,
+            col=0,
+            rule=self.id,
+            severity=severity or self.severity,
+            message=f'[{ctx.entry.name}] {message}',
+        )
+
+
+# ---------------------------------------------------------------------------
+# Tracing (lower + compile, once per program)
+# ---------------------------------------------------------------------------
+
+def _flat_leaves(tree):
+    import jax
+
+    return len(jax.tree.leaves(tree))
+
+
+def compile_program(prog, mesh=None):
+    """ProgramArtifact for one program. Already-jitted fns lower as
+    themselves (their own donation/static config); plain fns get the
+    analysis jit wrapper."""
+    import jax
+
+    from paddle_tpu.observability import costs
+
+    fn = prog.fn
+    if not hasattr(fn, 'lower'):
+        jit_kwargs = {}
+        if prog.in_shardings is not None:
+            jit_kwargs['in_shardings'] = prog.in_shardings
+        if prog.out_shardings is not None:
+            jit_kwargs['out_shardings'] = prog.out_shardings
+        if prog.donate:
+            jit_kwargs['donate_argnums'] = tuple(prog.donate)
+        # tracelint: disable=TL001 - one-shot analysis compile: the jit
+        # exists only to .lower().compile() this program once for its
+        # artifact; nothing ever executes it
+        fn = jax.jit(fn, **jit_kwargs)
+    ctx = _mesh_context(mesh) if mesh is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        lowered = fn.lower(*prog.args, **prog.kwargs)
+        compiled = lowered.compile()
+    stablehlo = lowered.as_text()
+    hlo = compiled.as_text()
+    try:
+        memory = costs.analyze(compiled).get('memory') or {}
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        memory = {}
+    expected = sum(_flat_leaves(prog.args[i]) for i in prog.donate)
+    return ProgramArtifact(
+        label=prog.label,
+        expected_donated=expected,
+        donated_args=tuple(prog.donate),
+        alias_entries=parse_alias_map(hlo),
+        census=hlo_collective_census(hlo),
+        converts=find_converts(hlo),
+        host_transfers=find_host_transfers(hlo),
+        memory=memory,
+        fingerprint=stablehlo_fingerprint(stablehlo),
+        has_f64='f64[' in hlo,
+    )
+
+
+def trace_entry(entry, root=None, baseline=None):
+    """HloContext for one entry. Any build/compile failure propagates —
+    lint_and_report turns it into an HL000 violation. `baseline` is
+    the (env, fingerprints) pair from `load_fingerprints`."""
+    path, line = entry.resolve_anchor(root=root)
+    suite = entry.build()
+    if not isinstance(suite, HloSuite):
+        raise TypeError(
+            f'{entry.name}: build() must return a hlo.engine.HloSuite, '
+            f'got {type(suite).__name__}')
+    artifacts = [compile_program(p, mesh=suite.mesh)
+                 for p in suite.programs]
+    env, fps = baseline if baseline is not None else (None, {})
+    env_match = env is not None and env == fingerprint_env()
+    return HloContext(
+        entry=entry, suite=suite, programs=artifacts,
+        baseline_env=env, baseline_fps=fps, env_match=env_match,
+        path=path, line=line)
+
+
+# ---------------------------------------------------------------------------
+# Lint loop
+# ---------------------------------------------------------------------------
+
+def lint_and_report(entries, rules=None, root=None,
+                    fingerprint_path=None):
+    """Run every rule over every entry, compiling each suite ONCE.
+
+    Returns (violations, suppressed, artifacts): `suppressed` pairs
+    each registry-suppressed Violation with its reason (empty reasons
+    raise), and `artifacts` maps entry name -> {program label:
+    {peak_bytes, fingerprint, aliased, donated, census}} (None when
+    the suite failed to compile) — the blob bench.py stamps as
+    `hlolint_artifacts`."""
+    if rules is None:
+        from .rules import all_rules
+
+        rules = all_rules()
+    if fingerprint_path is None:
+        fingerprint_path = os.path.join(
+            root or os.getcwd(), DEFAULT_FINGERPRINT_PATH)
+    baseline = load_fingerprints(fingerprint_path)
+    violations, suppressed, detail = [], [], {}
+    for entry in entries:
+        for rule_id, reason in entry.suppress.items():
+            if not (isinstance(reason, str) and reason.strip()):
+                raise ValueError(
+                    f'{entry.name}: suppression of {rule_id} must carry '
+                    f'a non-empty reason')
+        try:
+            ctx = trace_entry(entry, root=root, baseline=baseline)
+        except Exception as e:  # noqa: BLE001 - any failure is a finding
+            detail[entry.name] = None
+            path, line = '<registry>', 1
+            try:
+                path, line = entry.resolve_anchor(root=root)
+            except Exception:  # noqa: BLE001
+                pass
+            violations.append(Violation(
+                path=path, line=line, col=0, rule='HL000',
+                severity='error',
+                message=f'[{entry.name}] suite failed to build/compile: '
+                        f'{type(e).__name__}: {e}'))
+            continue
+        detail[entry.name] = {
+            a.label: {
+                'peak_bytes': a.peak_bytes(),
+                'fingerprint': a.fingerprint,
+                'aliased': len(a.alias_entries),
+                'donated': a.expected_donated,
+                'census': a.census,
+            } for a in ctx.programs
+        }
+        for rule in rules:
+            for v in rule.check(ctx):
+                if v.rule in entry.suppress:
+                    suppressed.append((v, entry.suppress[v.rule]))
+                else:
+                    violations.append(v)
+    return sorted(violations), suppressed, detail
+
+
+def lint_entries(entries, rules=None, root=None):
+    """(violations, suppressed) — see lint_and_report."""
+    violations, suppressed, _ = lint_and_report(entries, rules=rules,
+                                                root=root)
+    return violations, suppressed
+
+
+def fingerprint_report(entries, root=None):
+    """{entry::label: sha256} over every program of every entry,
+    compiling each suite once and PROPAGATING failures (a baseline
+    written around a broken suite would hide HL000 forever)."""
+    out = {}
+    for entry in entries:
+        ctx = trace_entry(entry, root=root)
+        for a in ctx.programs:
+            out[f'{entry.name}::{a.label}'] = a.fingerprint
+    return out

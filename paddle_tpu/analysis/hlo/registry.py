@@ -1,0 +1,313 @@
+"""The hlolint suite registry.
+
+Two suite families, both compiled to real XLA artifacts on CPU:
+
+  - `serving/*` and `aot/*`: a tiny single-device ServingEngine per
+    deployment shape (plain admit+decode, chunked prefill + prefix
+    cache, speculative verify over an int8 pool, monolithic KV
+    migration, and the PR 16 disagg roles — an import-fed decode pool
+    and an exporting prefill pool). Each suite enumerates its role's
+    AOT warmup geometries with `aot.geometry.for_serving_engine` and
+    compiles the EXACT jitted dispatches the scheduler executes via
+    `ServingEngine._cost_specs` — donation decorators, static config
+    and live model included — so HL001's alias proof, HL003's memory
+    bill and HL006's retrace fingerprint are the served executables',
+    not a re-derivation's. The declared donation contract comes from
+    `aot.geometry.donated_argnames` (the single source of truth the
+    dispatch decorators in inference/serving.py implement).
+  - `xcheck/*`: the shardlint registry's own TP-sharded serving
+    builders replayed bit-identically on the virtual 8-device mesh,
+    with `shard_ref` naming the shardlint entry whose declared
+    communication budget HL005 cross-checks hlolint's independent
+    census against — two provers, one wire bill.
+
+Shapes are tiny (2-layer 32-wide llama, 2 slots, 8..32 buckets): every
+suite pays a real CPU compile, and the properties the rules check —
+alias presence, convert structure, host transfers, collective counts,
+trace identity — are invariant to scaling the dims; only the absolute
+byte numbers shrink, and the hbm budgets are declared at the suite's
+own shapes (~1.6x the measured peak, keeping the 75% warn band clear
+of layout jitter between jax versions while a doubled temp still
+pages).
+
+To add a suite: write a `_build_*` returning an `HloSuite`, append an
+`Entry` with a unique `family/variant` name, run `hlolint --format
+json` once to measure peak_bytes, declare the budget, and re-baseline
+fingerprints with `hlolint --write-fingerprints`. If a rule fires and
+the code is RIGHT, suppress with a reason that will survive review.
+tests/test_hlolint.py's meta-test lints every entry; the bench gate
+fails the run on new violations.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+from .engine import Entry, HloSuite, Program
+
+KB = 1024
+MB = 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Serving-engine fixtures (single device)
+# ---------------------------------------------------------------------------
+
+# one engine-kwargs base shared by every single-device suite: 2 slots,
+# 4-token pages, an 8..32 bucket ladder — the smallest config that
+# still exercises multi-page block tables and bucketed admission
+_KW = dict(max_slots=2, block_size=4, max_new_tokens=4, decode_window=2,
+           max_context_len=32, buckets=(8, 16, 32), eos_token_id=2)
+
+
+@functools.lru_cache(maxsize=None)
+def _model(layers=2):
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    pt.seed(layers)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=64, hidden_size=32, layers=layers, heads=2,
+        kv_heads=2, intermediate_size=64, max_pos=64))
+
+
+def _donate_positions(fn, kind):
+    """Positional indices of the kind's declared donated argnames in
+    the dispatch's signature — jit strips nothing, so the jitted fn's
+    `__wrapped__` signature order IS the call order _cost_specs uses."""
+    from paddle_tpu.aot.geometry import donated_argnames
+
+    names = donated_argnames(kind)
+    if not names:
+        return ()
+    sig = inspect.signature(getattr(fn, '__wrapped__', fn))
+    pos = {p.name: i for i, p in enumerate(sig.parameters.values())
+           if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)}
+    return tuple(pos[n] for n in names)
+
+
+def _engine_suite(engine, gset):
+    """One Program per enumerated geometry, straight out of the
+    engine's own `_cost_specs` (the served executables, avals and
+    statics included), with the declared donation contract attached."""
+    progs = []
+    for g in gset:
+        for fn, args, statics in engine._cost_specs(g):
+            progs.append(Program(
+                label=g.label(), fn=fn, args=tuple(args),
+                kwargs=dict(statics),
+                donate=_donate_positions(fn, g.kind)))
+    return HloSuite(programs=progs)
+
+
+def _build_serving_admit_decode():
+    """The plain continuous-batching deployment: fused admit+decode
+    step, the pure window, the standalone multi-bucket prefill."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), **_KW)
+    return _engine_suite(eng, for_serving_engine(eng, prompt_lens=[4]))
+
+
+def _build_serving_chunk():
+    """Chunked prefill + prefix cache: the monolithic buckets clamp to
+    lengths <= prefill_chunk and the (chunk, context) continuation
+    pairs cover the long-prompt admissions."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), prefill_chunk=4, prefix_cache=True,
+                        **_KW)
+    return _engine_suite(eng,
+                         for_serving_engine(eng, prompt_lens=[4, 12]))
+
+
+def _build_serving_spec_verify():
+    """Speculative decoding over an int8 per-row-quantized pool: the
+    fused propose/verify step and window across the verify ladder —
+    the dequant converts here are the DECLARED path (dequant_ok)."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), draft=_model(1), num_draft_tokens=2,
+                        kv_cache_dtype='int8', **_KW)
+    return _engine_suite(eng, for_serving_engine(eng, prompt_lens=[4]))
+
+
+def _build_serving_kv_migration():
+    """Monolithic round-trip migration over an int8 pool: the PR 16
+    export gather (deliberately donation-free — the source pool must
+    survive) and import scatter (pool donated) at the reachable
+    handoff buckets."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), kv_cache_dtype='int8', **_KW)
+    return _engine_suite(eng, for_serving_engine(
+        eng, prompt_lens=[4], include_standalone_prefill=False,
+        migration=True))
+
+
+def _build_aot_decode_pool():
+    """The import-fed decode role: serve_import scatter, the one-token
+    boundary continuation chunk, the pure window — and NOTHING else
+    (an admission kind here would be a dead executable)."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), phase_role='decode', **_KW)
+    return _engine_suite(eng, for_serving_engine(eng, prompt_lens=[6]))
+
+
+def _build_aot_prefill_pool():
+    """The exporting prefill role: the monolithic admission set plus
+    the serve_export gather per reachable handoff context bucket."""
+    from paddle_tpu.aot.geometry import for_serving_engine
+    from paddle_tpu.inference.serving import ServingEngine
+
+    eng = ServingEngine(_model(), phase_role='prefill', **_KW)
+    return _engine_suite(eng, for_serving_engine(eng, prompt_lens=[4]))
+
+
+# ---------------------------------------------------------------------------
+# xcheck: the shardlint serving builders, replayed bit-identically
+# ---------------------------------------------------------------------------
+
+def _xcheck(shard_build, label):
+    """Wrap one shard-registry builder into an HloSuite: same fn, same
+    avals, same shardings, same mesh — the compiled artifact HL005
+    censuses is the one shardlint budgeted, reached through hlolint's
+    own parser."""
+
+    def build():
+        s = shard_build()
+        fn = s.fn
+        if s.kwargs:
+            inner, kw = fn, dict(s.kwargs)
+            fn = lambda *a: inner(*a, **kw)  # noqa: E731
+        prog = Program(label=label, fn=fn, args=tuple(s.args),
+                       in_shardings=s.in_shardings,
+                       out_shardings=s.out_shardings)
+        return HloSuite(programs=[prog], mesh=s.mesh)
+
+    return build
+
+
+def _xcheck_step():
+    from ..shard.registry import _build_serving_serve_step
+
+    return _xcheck(_build_serving_serve_step, 'serve_step_tp')()
+
+
+def _xcheck_window():
+    from ..shard.registry import _build_serving_serve_window
+
+    return _xcheck(_build_serving_serve_window, 'serve_window_tp')()
+
+
+def _xcheck_chunk():
+    from ..shard.registry import _build_serving_chunk_step
+
+    return _xcheck(_build_serving_chunk_step, 'serve_chunk_step_tp')()
+
+
+def _xcheck_spec():
+    from ..shard.registry import _build_serving_spec_step
+
+    return _xcheck(_build_serving_spec_step, 'serve_spec_step_tp')()
+
+
+def _xcheck_export():
+    from ..shard.registry import _build_serving_kv_export
+
+    return _xcheck(_build_serving_kv_export, 'kv_export_tp')()
+
+
+def _xcheck_import():
+    from ..shard.registry import _build_serving_kv_import
+
+    return _xcheck(_build_serving_kv_import, 'kv_import_tp')()
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+_SRV = 'paddle_tpu.inference.serving:ServingEngine'
+_GEO = 'paddle_tpu.aot.geometry:for_serving_engine'
+
+ENTRIES = (
+    # single-device serving deployments: budgets measured at the tiny
+    # fixture shapes (`hlolint --format json` -> peak_bytes, largest
+    # program of each suite), declared at ~1.6x
+    Entry('serving/admit_decode', _SRV, _build_serving_admit_decode,
+          hbm_budget=320 * KB),           # measured peak ~189 KB
+    Entry('serving/chunk', _SRV, _build_serving_chunk,
+          hbm_budget=320 * KB),           # measured peak ~198 KB
+    Entry('serving/spec_verify', _SRV, _build_serving_spec_verify,
+          hbm_budget=384 * KB,            # measured peak ~212 KB
+          dequant_ok=True),
+    Entry('serving/kv_migration', _SRV, _build_serving_kv_migration,
+          hbm_budget=256 * KB,            # measured peak ~144 KB
+          dequant_ok=True),
+    # role-aware AOT geometry sets (PR 16 disagg): the decode pool's
+    # import scatter and the prefill pool's export gather are the
+    # programs a real pod OOMs or double-buffers on first
+    Entry('aot/decode_pool', _GEO, _build_aot_decode_pool,
+          hbm_budget=320 * KB),           # measured peak ~190 KB
+    Entry('aot/prefill_pool', _GEO, _build_aot_prefill_pool,
+          hbm_budget=320 * KB),           # measured peak ~189 KB
+    # shardlint cross-checks on the virtual 8-device mesh: HL005 holds
+    # hlolint's independent census against the budget the NAMED
+    # shardlint entry declares — exact call-site agreement required
+    Entry('xcheck/serve_step_tp', _SRV, _xcheck_step,
+          hbm_budget=256 * KB,            # measured peak ~144 KB
+          shard_ref='serving/serve_step_tp'),
+    Entry('xcheck/serve_window_tp', _SRV, _xcheck_window,
+          hbm_budget=192 * KB,            # measured peak ~100 KB
+          shard_ref='serving/serve_window_tp'),
+    Entry('xcheck/serve_chunk_step_tp', _SRV, _xcheck_chunk,
+          hbm_budget=224 * KB,            # measured peak ~123 KB
+          shard_ref='serving/serve_chunk_step_tp'),
+    Entry('xcheck/serve_spec_step_tp', _SRV, _xcheck_spec,
+          hbm_budget=288 * KB,            # measured peak ~163 KB
+          shard_ref='serving/serve_spec_step_tp'),
+    Entry('xcheck/kv_export_tp', _SRV, _xcheck_export,
+          hbm_budget=64 * KB,             # measured peak ~37 KB
+          shard_ref='serving/kv_export_tp'),
+    Entry('xcheck/kv_import_tp', _SRV, _xcheck_import,
+          hbm_budget=96 * KB,             # measured peak ~51 KB
+          shard_ref='serving/kv_import_tp'),
+)
+
+
+def all_entries():
+    """Every registered compiled-artifact suite, in registry order."""
+    return list(ENTRIES)
+
+
+def entries_for(paths=None, root=None):
+    """Entries whose anchor file falls under one of `paths` (root-
+    relative prefixes); all of them when `paths` is falsy."""
+    entries = all_entries()
+    if not paths:
+        return entries
+    import os
+
+    root = root or os.getcwd()
+    norm = []
+    for p in paths:
+        if os.path.isabs(p):
+            try:
+                p = os.path.relpath(p, root)
+            except ValueError:
+                pass
+        norm.append(os.path.normpath(p).replace(os.sep, '/'))
+    out = []
+    for e in entries:
+        path, _ = e.resolve_anchor(root=root)
+        if any(path == p or path.startswith(p.rstrip('/') + '/')
+               for p in norm):
+            out.append(e)
+    return out

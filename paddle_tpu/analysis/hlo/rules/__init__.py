@@ -1,0 +1,41 @@
+"""hlolint rule registry (same pattern as shardlint's).
+
+Rules self-register via `@register`; importing this package pulls in
+every `hl*.py` module.  `all_rules()` returns fresh instances sorted
+by id, `get_rule('HL001')` one of them.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: adds an HloRule subclass to the registry."""
+    if cls.id in _REGISTRY:
+        raise ValueError(f'duplicate rule id {cls.id}')
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules(select=None):
+    """Instances of every registered rule (or the `select` subset),
+    sorted by id."""
+    ids = sorted(_REGISTRY)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise KeyError(f'unknown rule id(s): {sorted(unknown)}')
+        ids = sorted(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]()
+
+
+from . import hl001_donation_aliased    # noqa: E402,F401
+from . import hl002_dtype_upcast        # noqa: E402,F401
+from . import hl003_memory_budget       # noqa: E402,F401
+from . import hl004_host_transfer       # noqa: E402,F401
+from . import hl005_collective_xcheck   # noqa: E402,F401
+from . import hl006_fingerprint         # noqa: E402,F401

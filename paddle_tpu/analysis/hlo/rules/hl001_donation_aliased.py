@@ -1,0 +1,56 @@
+"""HL001 — every declared donated arg is actually aliased by XLA.
+
+`donate_argnames` is a REQUEST, not a guarantee: XLA only aliases a
+donated input to an output of identical shape/dtype/layout, and when
+it can't (a dtype drift, a reshaped return, a dropped output), jax
+silently falls back to copying. For the serving dispatches that means
+the KV pool — by far the largest live buffer — exists TWICE for the
+duration of every step: the un-aliased donation is exactly a 2x pool
+memory regression that no test observes on CPU and every pod OOMs on.
+
+The suite declares its donation contract (top-level donated args,
+sourced from `aot.geometry.donated_argnames`); the engine counts the
+flat input leaves under those args and parses the aliases XLA emitted
+into the compiled module's `input_output_alias` header. Fewer aliases
+than declared leaves = dropped donation = error. Aliases present with
+NO declared donation are flagged too (an undeclared in-place update is
+a correctness trap for a caller that reuses the input), at warning
+severity.
+"""
+from __future__ import annotations
+
+from ..engine import HloRule
+from . import register
+
+
+@register
+class DonationAliased(HloRule):
+    id = 'HL001'
+    name = 'donation-aliased'
+    severity = 'error'
+    description = ('every declared donated argument must appear in the '
+                   "compiled module's input_output_alias header — a "
+                   'silently-dropped donation doubles KV pool memory '
+                   'on chip.')
+
+    def check(self, ctx):
+        for a in ctx.programs:
+            aliased = len(a.alias_entries)
+            if a.expected_donated and aliased < a.expected_donated:
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: donation dropped — {a.expected_donated}'
+                    f' donated input leaf/leaves declared (args '
+                    f'{list(a.donated_args)}) but XLA aliased only '
+                    f'{aliased}; the un-aliased donated buffer(s) are '
+                    f'copied, not reused — for a KV pool that is a 2x '
+                    f'device-memory regression')
+            elif not a.expected_donated and aliased:
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: {aliased} input/output alias(es) '
+                    f'emitted but the suite declares NO donation — an '
+                    f'undeclared in-place update; declare it in '
+                    f'aot.geometry.DONATED_ARGNAMES or drop the '
+                    f'donate_argnames',
+                    severity='warning')

@@ -1,0 +1,59 @@
+"""HL002 — no unintended dtype upcasts in the compiled module.
+
+The int8 KV path's whole point is halving pool bytes AND attention
+bandwidth; a stray `convert(s8 -> f32)` upstream of the matmul silently
+restores full-width compute while the config still claims int8 — the
+bench numbers lie and the chip pays bf16 bandwidth. And nothing in the
+serving stack has any business in f64: one numpy scalar leaking into a
+traced expression flips a whole reduction to double precision, which
+TPUs emulate at a catastrophic rate.
+
+Evidence is the compiled HLO instruction stream:
+
+  - ANY `f64[...]` anywhere in the module is an error (no exceptions:
+    the serving stack declares no double-precision path),
+  - a `convert` from a narrow storage dtype (s4/u4/s8/u8) to a float
+    is an error UNLESS the suite sets `dequant_ok=True` — the declared
+    per-row-scale dequant of quantized pools (RowQuantKVCache widens
+    int8 pages against f32 scales by design; a suite serving a plain
+    bf16/f32 pool must never see one).
+"""
+from __future__ import annotations
+
+from ..engine import NARROW_DTYPES, WIDE_FLOATS, HloRule
+from . import register
+
+
+@register
+class DtypeUpcast(HloRule):
+    id = 'HL002'
+    name = 'dtype-upcast'
+    severity = 'error'
+    description = ('compiled modules must not widen int8/int4 storage '
+                   'to float compute outside the declared dequant path '
+                   '(dequant_ok suites), and must never touch f64.')
+
+    def check(self, ctx):
+        for a in ctx.programs:
+            if a.has_f64:
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: f64 appears in the compiled module — '
+                    f'a double-precision leak (likely a python float / '
+                    f'numpy scalar in a traced expression); TPUs '
+                    f'emulate f64 at a catastrophic rate')
+            if ctx.entry.dequant_ok:
+                continue
+            widenings = sorted({
+                (frm, to) for to, frm, _ in a.converts
+                if frm in NARROW_DTYPES and to in WIDE_FLOATS})
+            for frm, to in widenings:
+                n = sum(1 for t, f, _ in a.converts
+                        if f == frm and t == to)
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: {n} convert({frm} -> {to}) site(s) — '
+                    f'narrow storage widened to float compute in a '
+                    f'suite that declares no dequant path; the int8 '
+                    f'bandwidth saving is silently gone (set '
+                    f'dequant_ok=True only for per-row-scale pools)')

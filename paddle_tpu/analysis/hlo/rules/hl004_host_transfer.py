@@ -1,0 +1,49 @@
+"""HL004 — zero host transfers inside compiled serve dispatches.
+
+A serving step is a device-resident loop: the scheduler uploads its
+tiny control vectors once, dispatches, and only ever downloads the
+committed tokens. An `io_callback`/`pure_callback`/`debug.print` that
+sneaks INTO a dispatch compiles to a host round-trip per step —
+infeed/outfeed or a host-callback custom-call in the artifact — and
+the decode latency floor jumps from microseconds to the PCIe/host
+stack's milliseconds. tracelint's TL002 polices the obvious AST forms
+(`.item()`, `np.asarray` on traced values); this rule proves the
+property where it matters, on the compiled module, catching every
+route the AST pass cannot see (a library helper, a debug print left
+inside a jitted body, a checkify leak).
+
+Any infeed / outfeed / host send/recv / callback custom-call in a
+registered suite's compiled module is an error. There is no suppress-
+by-default carve-out: a dispatch that legitimately needs the host
+must say so in the registry with a reason that survives review.
+"""
+from __future__ import annotations
+
+from ..engine import HloRule
+from . import register
+
+
+@register
+class HostTransfer(HloRule):
+    id = 'HL004'
+    name = 'host-transfer'
+    severity = 'error'
+    description = ('compiled serve dispatches must contain no host '
+                   'round-trips (infeed/outfeed/host-callback '
+                   'custom-calls) — one is a per-step latency cliff.')
+
+    def check(self, ctx):
+        for a in ctx.programs:
+            if not a.host_transfers:
+                continue
+            kinds = {}
+            for op, detail in a.host_transfers:
+                kinds.setdefault(op, []).append(detail)
+            parts = '; '.join(
+                f'{len(v)}x {k} ({v[0]})' for k, v in sorted(kinds.items()))
+            yield self.violation(
+                ctx,
+                f'{a.label}: host transfer(s) inside the compiled '
+                f'dispatch: {parts} — every step pays a host '
+                f'round-trip; hoist the callback out of the jitted '
+                f'body')

@@ -1,0 +1,116 @@
+"""HL005 — hlolint's collective census vs shardlint's declared budget.
+
+shardlint proves the wire bill one way: its own census over its own
+compile, checked against the budget each suite declares (SL002). A
+bug in EITHER the census parser or the registry plumbing would let a
+regression through while both sides nod. So the suites that matter
+most — the TP-sharded serving dispatches — are compiled a second
+time here, from the shard registry's own builders, and counted by
+hlolint's independently-written parser; this rule then holds that
+second count against the budget the SHARDLINT registry declares:
+
+  - a kind the budget does not declare: error (the two provers see
+    different programs — or a resharding appeared),
+  - call-site count != the declared count: error. Unlike SL002 (which
+    tolerates under-count with a warning), the cross-check demands
+    EXACT agreement: shardlint's serving budgets are documented as
+    exact call-site counts, so any drift means one prover is wrong,
+  - payload bytes over the declared ceiling: error.
+
+`shard_ref` names the shardlint registry entry to compare against; a
+dangling ref is an error (the cross-check silently not running is the
+failure mode this rule exists to close).
+"""
+from __future__ import annotations
+
+from ..engine import HloRule
+from . import register
+
+
+def _norm(budget):
+    out = {}
+    for kind, v in (budget or {}).items():
+        if isinstance(v, dict):
+            out[kind] = {'count': int(v.get('count', 0)),
+                         'bytes': v.get('bytes')}
+        else:
+            out[kind] = {'count': int(v), 'bytes': None}
+    return out
+
+
+def _kb(n):
+    return n / 1024
+
+
+@register
+class CollectiveXcheck(HloRule):
+    id = 'HL005'
+    name = 'collective-xcheck'
+    severity = 'error'
+    description = ("the compiled module's collective census (hlolint's "
+                   'own parser) must agree EXACTLY with the shardlint '
+                   "registry's declared communication budget for the "
+                   'referenced suite — two independent provers, one '
+                   'wire bill.')
+
+    def check(self, ctx):
+        ref = ctx.entry.shard_ref
+        if ref is None:
+            return
+        from ...shard.registry import all_entries
+
+        declared = None
+        for e in all_entries():
+            if e.name == ref:
+                declared = e.budget
+                break
+        else:
+            yield self.violation(
+                ctx,
+                f'shard_ref {ref!r} names no shardlint registry entry '
+                f'— the cross-check is silently not running; fix the '
+                f'ref or drop it')
+            return
+        if declared is None:
+            yield self.violation(
+                ctx,
+                f'shardlint entry {ref!r} declares no budget '
+                f'(budget=None) — nothing to cross-check against')
+            return
+        declared = _norm(declared)
+        for a in ctx.programs:
+            census = a.census or {}
+            for kind, rec in sorted(census.items()):
+                want = declared.get(kind)
+                if want is None:
+                    yield self.violation(
+                        ctx,
+                        f'{a.label}: {rec["count"]} {kind} site(s) in '
+                        f'the compiled module but shardlint budget '
+                        f'{ref!r} declares none — the provers see '
+                        f'different programs, or a resharding appeared')
+                    continue
+                if rec['count'] != want['count']:
+                    yield self.violation(
+                        ctx,
+                        f'{a.label}: {kind} count mismatch — hlolint '
+                        f'counts {rec["count"]} site(s), shardlint '
+                        f'budget {ref!r} declares {want["count"]}; the '
+                        f'cross-check demands exact agreement (one '
+                        f'prover is wrong)')
+                if (want['bytes'] is not None
+                        and rec['bytes'] > want['bytes']):
+                    yield self.violation(
+                        ctx,
+                        f'{a.label}: {kind} payload '
+                        f'{_kb(rec["bytes"]):.1f} KB/device over the '
+                        f'{_kb(want["bytes"]):.1f} KB ceiling shardlint '
+                        f'budget {ref!r} declares')
+            for kind, want in sorted(declared.items()):
+                if want['count'] > 0 and kind not in census:
+                    yield self.violation(
+                        ctx,
+                        f'{a.label}: shardlint budget {ref!r} declares '
+                        f'{want["count"]} {kind} site(s) but the '
+                        f'compiled module has none — exact-agreement '
+                        f'drift (one prover is wrong)')

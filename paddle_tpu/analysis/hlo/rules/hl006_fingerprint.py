@@ -1,0 +1,82 @@
+"""HL006 — compilation-cache fingerprint baseline per geometry.
+
+The serving SLO depends on zero steady-state retraces: every dispatch
+shape is warmed AOT and the CompileCache counters prove nothing new
+compiles at serve time. But a retrace REGRESSION — a refactor that
+changes the traced program for an unchanged geometry (a static kwarg
+becoming dynamic, a weak-type flip, an accidental closure over a
+python scalar) — invalidates every warmed executable at once, and the
+first production step after deploy pays the full compile. Nothing
+catches that today until the latency graph does.
+
+The engine hashes each program's location-stripped StableHLO (the
+compilation-cache identity jax keys on, minus source positions so
+line-number churn is invisible) and this rule compares against the
+committed baseline in tools/hlolint_fingerprints.json:
+
+  - hash differs from baseline: error — the geometry's traced program
+    changed. If the change is INTENDED (a real dispatch improvement),
+    re-baseline with `hlolint --write-fingerprints` in the same
+    commit; CI then documents exactly when every retrace was bought,
+  - program missing from the baseline: warning — a new geometry;
+    baseline it,
+  - baseline recorded under a different jax/jaxlib/backend: the rule
+    skips entirely (lowered text is only stable within a pinned
+    toolchain; cross-env comparison would page on every upgrade).
+"""
+from __future__ import annotations
+
+from ..engine import HloRule
+from . import register
+
+
+@register
+class FingerprintBaseline(HloRule):
+    id = 'HL006'
+    name = 'retrace-fingerprint'
+    severity = 'error'
+    description = ('the location-stripped StableHLO hash of every '
+                   'program must match the committed fingerprint '
+                   'baseline — a changed hash for an unchanged '
+                   'geometry is a retrace regression.')
+
+    def check(self, ctx):
+        if ctx.baseline_env is None:
+            yield self.violation(
+                ctx,
+                'no fingerprint baseline found — record one with '
+                '`hlolint --write-fingerprints` so retrace regressions '
+                'gate in CI',
+                severity='warning')
+            return
+        if not ctx.env_match:
+            # lowered text is env-keyed; silently skipping would hide
+            # a stale baseline forever, so say so — but only advisory
+            yield self.violation(
+                ctx,
+                f'fingerprint baseline was recorded under '
+                f'{ctx.baseline_env} — different from this environment;'
+                f' HL006 skipped (re-record with --write-fingerprints '
+                f'on the pinned toolchain)',
+                severity='warning')
+            return
+        for a in ctx.programs:
+            key = f'{ctx.entry.name}::{a.label}'
+            want = ctx.baseline_fps.get(key)
+            if want is None:
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: no baseline fingerprint for this '
+                    f'program — new geometry; record it with '
+                    f'--write-fingerprints',
+                    severity='warning')
+            elif want != a.fingerprint:
+                yield self.violation(
+                    ctx,
+                    f'{a.label}: traced program changed for an '
+                    f'unchanged geometry (fingerprint '
+                    f'{a.fingerprint[:12]} != baseline {want[:12]}) — '
+                    f'a retrace regression: every warmed executable of '
+                    f'this geometry is invalidated. If intended, '
+                    f're-baseline with --write-fingerprints in the '
+                    f'same commit')

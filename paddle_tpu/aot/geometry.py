@@ -471,6 +471,42 @@ def for_train_engine(engine, batch_shape, batch_dtype='int32',
         label_dtypes=tuple(str(d) for d in label_dtypes))])
 
 
+# ---------------------------------------------------------------------------
+# Donation contract per serve kind
+# ---------------------------------------------------------------------------
+
+# Which ARGUMENT NAMES each serve-dispatch kind donates to jit — the
+# single source of truth shared by the dispatch decorators in
+# inference/serving.py and the hlolint HL001 prover (which counts the
+# `input_output_alias` entries XLA actually emitted against the flat
+# leaves of these args). serve_export deliberately donates NOTHING:
+# the source pool must survive the export (the request keeps serving
+# until its owner retires it).
+DONATED_ARGNAMES = {
+    'serve_step': ('pages', 'last_logits'),
+    'serve_window': ('pages', 'last_logits'),
+    'serve_prefill': ('pages', 'last_logits'),
+    'serve_chunk_step': ('pages', 'last_logits'),
+    'serve_spec_step': ('pages', 'dpages', 'last_logits'),
+    'serve_spec_window': ('pages', 'dpages', 'last_logits'),
+    'serve_export': (),
+    'serve_import': ('pages',),
+}
+
+
+def donated_argnames(kind):
+    """Declared donated argument names for a serve-dispatch geometry
+    kind. Raises on unknown kinds so a new dispatch cannot silently
+    ship with an undeclared (and therefore unproven) donation
+    contract."""
+    try:
+        return DONATED_ARGNAMES[kind]
+    except KeyError:
+        raise ValueError(
+            f'no declared donation contract for geometry kind {kind!r}'
+            f' — add it to aot.geometry.DONATED_ARGNAMES') from None
+
+
 def for_engine(engine, **workload):
     """Dispatch on engine type (the `aot.build` entry point)."""
     from ..inference.engine import DecodeEngine
@@ -489,4 +525,5 @@ def for_engine(engine, **workload):
 
 
 __all__ = ['Geometry', 'GeometrySet', 'for_engine', 'for_decode_engine',
-           'for_serving_engine', 'for_train_engine']
+           'for_serving_engine', 'for_train_engine',
+           'DONATED_ARGNAMES', 'donated_argnames']

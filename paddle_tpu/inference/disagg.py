@@ -87,24 +87,59 @@ def pack_kv_blob(blob):
 
 def unpack_kv_blob(data):
     """Inverse of `pack_kv_blob`: bytes -> an `import_kv`-ready blob
-    dict (arrays reconstructed zero-copy off the buffer)."""
+    dict (arrays reconstructed zero-copy off the buffer).
+
+    The whole layout is validated UP FRONT — preamble length, magic,
+    header bounds, parseable header, and the byte-exact payload length
+    the array specs imply — so a blob truncated or padded in transit
+    fails here with the defect named, before `import_kv` sees it (and
+    long before anything touches a block table)."""
+    if len(data) < 8:
+        raise ValueError(
+            f'truncated KV migration blob: {len(data)} byte(s), need '
+            f'at least 8 for the magic + header length')
     if data[:4] != _MAGIC:
         raise ValueError('not a packed KV migration blob (bad magic)')
     (hlen,) = struct.unpack_from('<I', data, 4)
-    head = json.loads(data[8:8 + hlen].decode('utf-8'))
+    if 8 + hlen > len(data):
+        raise ValueError(
+            f'truncated KV migration blob: header claims {hlen} '
+            f'byte(s) but only {len(data) - 8} follow the preamble')
+    try:
+        head = json.loads(data[8:8 + hlen].decode('utf-8'))
+    except ValueError as e:
+        raise ValueError(
+            f'corrupt KV migration blob header: {e}') from None
     if head.get('magic') != 'paddle_tpu.kv_migration':
         raise ValueError(
             f"not a packed KV migration blob: {head.get('magic')!r}")
     if head.get('version') != 1:
         raise ValueError(
             f"unsupported packed-blob version {head.get('version')!r}")
-    blob = dict(head['meta'])
-    off = 8 + hlen
-    for spec in head['arrays']:
+    specs = head.get('arrays')
+    if not isinstance(specs, list) or not isinstance(head.get('meta'),
+                                                     dict):
+        raise ValueError(
+            'corrupt KV migration blob header: missing meta/arrays')
+
+    def spec_dtype(spec):
         # jax registers bfloat16 & friends as numpy dtypes, so
         # np.dtype round-trips every pool dtype by name
-        dt = np.dtype(spec['dtype']) if spec['dtype'] != 'bfloat16' \
+        return np.dtype(spec['dtype']) if spec['dtype'] != 'bfloat16' \
             else _bf16()
+
+    need = sum(int(np.prod(s['shape'])) * spec_dtype(s).itemsize
+               for s in specs)
+    if len(data) != 8 + hlen + need:
+        raise ValueError(
+            f'KV migration blob payload length mismatch: the header '
+            f'specs need {need} byte(s), the buffer carries '
+            f'{len(data) - 8 - hlen} — truncated or corrupted in '
+            f'transit')
+    blob = dict(head['meta'])
+    off = 8 + hlen
+    for spec in specs:
+        dt = spec_dtype(spec)
         n = int(np.prod(spec['shape'])) * dt.itemsize
         a = np.frombuffer(data, dtype=dt, count=int(np.prod(spec['shape'])),
                           offset=off).reshape(spec['shape'])

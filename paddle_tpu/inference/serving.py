@@ -2357,9 +2357,28 @@ class ServingEngine:
                     fbool, btab, vec, live, vec) + samp,
                    dict(k=int(p['spec']), ctx_bucket=int(p['ctx']),
                         eos_token_id=self.eos_token_id))
+        elif g.kind == 'serve_export':
+            btabs1 = jax.ShapeDtypeStruct((1, self.max_blocks_per_seq),
+                                          jnp.int32)
+            st = jax.ShapeDtypeStruct((1,), jnp.int32)
+            yield (_kv_export, (pages, btabs1, st),
+                   dict(ctx_bucket=int(p['ctx'])))
+        elif g.kind == 'serve_import':
+            Cx = int(p['ctx'])
+            blob = sds(self._blob_aval_entries(Cx))
+            pflat = jax.ShapeDtypeStruct((Cx,), jnp.int32)
+            yield (_kv_import, (pages, blob, pflat, pflat),
+                   dict(ctx_bucket=Cx))
         else:
             raise NotImplementedError(
                 f'no cost specs for geometry kind {g.kind!r}')
+
+    def _blob_aval_entries(self, Cx):
+        """Zero-filled `_blob_device_entries` payload at the `Cx`
+        bucket — the aval source for `serve_export`/`serve_import`
+        cost/lint specs, so the analyzed scatter is shape-identical to
+        the live `import_kv` dispatch by construction."""
+        return self._blob_device_entries(self._pages, Cx)
 
     def _geometry_cost_tag(self, g):
         """The dispatch tag `step()` keys its registry notes with, for
@@ -2958,6 +2977,44 @@ class ServingEngine:
                              up('v', (1, Cx, Hkv, D), dt)))
         return ents
 
+    def _check_blob_layers(self, name, layers, pages, n):
+        """Structural validation of one blob KV group against THIS
+        engine's pool before any allocator/block-table/pool mutation:
+        layer count, field set, per-field dtype and row shape must be
+        exactly what `_blob_device_entries` will scatter. A truncated
+        or tampered blob fails here with the defect named — never
+        mid-scatter with a broadcast error after pages were taken (the
+        no-partial-scatter half of the atomic-placement contract)."""
+        if not isinstance(layers, (list, tuple)) or len(layers) != len(pages):
+            got = len(layers) if isinstance(layers, (list, tuple)) else \
+                type(layers).__name__
+            raise ValueError(
+                f'corrupt KV blob: {name} carries {got} layer(s), this '
+                f'engine scatters into {len(pages)}')
+        for li, (lay, pc) in enumerate(zip(layers, pages)):
+            Hkv, D = int(pc.kp.shape[1]), int(pc.kp.shape[3])
+            if hasattr(pc, 'ks'):
+                want = {'k': ((n, Hkv, D), np.dtype(np.int8)),
+                        'v': ((n, Hkv, D), np.dtype(np.int8)),
+                        'ks': ((n, Hkv), np.dtype(np.float32)),
+                        'vs': ((n, Hkv), np.dtype(np.float32))}
+            else:
+                dt = np.dtype(pc.kp.dtype)
+                want = {'k': ((n, Hkv, D), dt), 'v': ((n, Hkv, D), dt)}
+            if not isinstance(lay, dict) or set(lay) != set(want):
+                got = sorted(lay) if isinstance(lay, dict) else \
+                    type(lay).__name__
+                raise ValueError(
+                    f'corrupt KV blob: {name}[{li}] fields {got} != '
+                    f'expected {sorted(want)} for this pool')
+            for field, (shape, dt) in want.items():
+                a = np.asarray(lay[field])
+                if tuple(a.shape) != shape or a.dtype != dt:
+                    raise ValueError(
+                        f'corrupt KV blob: {name}[{li}].{field} is '
+                        f'{a.dtype}{tuple(a.shape)}, this pool scatters '
+                        f'{dt}{shape}')
+
     @staticmethod
     def _blob_layer_bytes(blob):
         """Total payload bytes of a blob's KV arrays (target + draft) —
@@ -3154,6 +3211,16 @@ class ServingEngine:
                 f'it cannot fit this engine (max_context_len '
                 f'{self.max_context_len}, {self.allocator.usable} '
                 f'usable pages)')
+        # structural check of every KV array BEFORE any allocator,
+        # block-table, or pool mutation: a truncated/tampered blob
+        # must leave the engine exactly as it found it
+        self._check_blob_layers('layers', blob.get('layers'),
+                                self._pages, kvlen)
+        if self.draft is not None:
+            self._check_blob_layers('draft_layers',
+                                    blob.get('draft_layers'),
+                                    self._dpages,
+                                    int(blob.get('draft_kv_len') or 0))
         slot = next((s for s, q in enumerate(self._slot_req)
                      if q is None), None)
         if slot is None:

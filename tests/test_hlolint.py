@@ -1,0 +1,556 @@
+"""hlolint (paddle_tpu.analysis.hlo) tier-1 tests.
+
+Every rule HL001-HL006 gets at least one negative case (a small
+fixture suite that must trigger it) and one clean case; plus the
+compiled-artifact parsers over synthetic HLO text, the HL005
+cross-check agreement over EVERY hlolint suite that names a shardlint
+entry (the two-independent-provers contract), the fingerprint baseline
+round-trip, the registry shape meta-tests, and the CLI/unified-runner
+exit-code contract.
+
+Everything compiles tiny programs on the virtual 8-device CPU mesh
+from conftest; the full-registry sweeps (a real `--hlo` CLI run and
+the whole-registry lint) are `slow`-marked — the bench gate and the
+committed baselines already pin those end to end.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis.hlo import (Entry, HloContext, HloSuite, Program,
+                                     ProgramArtifact, fingerprint_env,
+                                     fingerprint_report, find_converts,
+                                     find_host_transfers,
+                                     hlo_collective_census, lint_and_report,
+                                     parse_alias_map, stablehlo_fingerprint,
+                                     write_fingerprints)
+from paddle_tpu.analysis.hlo.rules import all_rules, get_rule
+
+pytestmark = pytest.mark.tier1
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SDS = jax.ShapeDtypeStruct
+NO_FPS = os.path.join(os.path.sep, 'nonexistent', 'fingerprints.json')
+
+# any real module:attr works as a fixture anchor; violations just need
+# a path to point at
+ANCHOR = 'paddle_tpu.inference.serving:ServingEngine'
+
+MB = 1024 * 1024
+
+
+def entry_of(build, name='fixture/suite', hbm_budget=512 * MB, **kw):
+    return Entry(name, ANCHOR, build, hbm_budget=hbm_budget, **kw)
+
+
+def lint_one(build, rules=None, fingerprint_path=NO_FPS, **kw):
+    vs, _, _ = lint_and_report([entry_of(build, **kw)], rules=rules,
+                               root=REPO, fingerprint_path=fingerprint_path)
+    return vs
+
+
+def hits(build, rule, **kw):
+    return [v for v in lint_one(build, **kw) if v.rule == rule]
+
+
+def suite_of(*programs):
+    def build():
+        return HloSuite(list(programs))
+
+    return build
+
+
+def artifact(label='p', expected_donated=0, donated_args=(),
+             alias_entries=(), census=None, converts=(),
+             host_transfers=(), memory=None, fingerprint='0' * 64,
+             has_f64=False):
+    return ProgramArtifact(
+        label=label, expected_donated=expected_donated,
+        donated_args=tuple(donated_args),
+        alias_entries=list(alias_entries), census=census or {},
+        converts=list(converts), host_transfers=list(host_transfers),
+        memory=memory if memory is not None else {'argument_bytes': 0},
+        fingerprint=fingerprint, has_f64=has_f64)
+
+
+def ctx_of(*artifacts, entry=None, **entry_kw):
+    e = entry or entry_of(lambda: None, **entry_kw)
+    return HloContext(entry=e, suite=HloSuite([]),
+                      programs=list(artifacts), baseline_env=None,
+                      baseline_fps={}, env_match=False,
+                      path='paddle_tpu/inference/serving.py', line=1)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-artifact parsers (synthetic HLO text)
+# ---------------------------------------------------------------------------
+
+class TestParsers:
+    def test_alias_map_header(self):
+        text = ('HloModule jit_f, input_output_alias={ {0}: (0, {}, '
+                'may-alias), {1}: (2, {}, may-alias) }, '
+                'entry_computation_layout=...\n%x = f32[] parameter(0)\n')
+        assert parse_alias_map(text) == [('0', 0), ('1', 2)]
+        assert parse_alias_map('HloModule jit_f\n') == []
+
+    def test_collective_census_counts_sites_and_bytes(self):
+        text = '\n'.join([
+            '  %ar = f32[8,16]{1,0} all-reduce(%a), to_apply=%add',
+            '  ROOT %ar2 = f32[8]{0} all-reduce(%b), to_apply=%add',
+            '  %ag-start = (f32[4]{0}, f32[8]{0}) all-gather-start(%c)',
+            '  %ag-done = f32[8]{0} all-gather-done(%ag-start)',
+            '  %cp = s32[2]{0} collective-permute(%d)',
+            '  %not-a-def all-reduce',
+        ])
+        census = hlo_collective_census(text)
+        assert census['all-reduce'] == {'count': 2,
+                                        'bytes': 8 * 16 * 4 + 8 * 4}
+        # -start counts once as its base kind, -done is skipped
+        assert census['all-gather'] == {'count': 1, 'bytes': 16 + 32}
+        assert census['collective-permute'] == {'count': 1, 'bytes': 8}
+
+    def test_find_converts_symbol_table_and_inline(self):
+        text = '\n'.join([
+            '  %p0 = s8[8]{0} parameter(0)',
+            '  %widen = f32[8]{0} convert(%p0)',
+            '  %inline = bf16[4]{0} convert(s8[4]{0} %p1)',
+        ])
+        got = find_converts(text)
+        assert ('f32', 's8', 'p0') in got
+        assert ('bf16', 's8', 'p1') in got
+
+    def test_find_host_transfers(self):
+        text = '\n'.join([
+            '  %of = token[] outfeed(%x, %tok)',
+            '  %cb = f32[4]{0} custom-call(%y), '
+            'custom_call_target="xla_ffi_python_cpu_callback"',
+            '  %ok = f32[4]{0} custom-call(%z), '
+            'custom_call_target="Sharding"',
+        ])
+        got = find_host_transfers(text)
+        assert ('outfeed', 'of') in got
+        assert any(op == 'custom-call' and 'callback' in d
+                   for op, d in got)
+        assert not any('Sharding' in d for _, d in got)
+
+    def test_fingerprint_ignores_locations_not_programs(self):
+        a = ('module @jit_f {\n  %0 = stablehlo.add %a, %b loc("x.py":1)'
+             '\n}\n#loc = loc("x.py":1:0)\n')
+        b = ('module @jit_f {\n  %0 = stablehlo.add %a, %b loc("y.py":99)'
+             '\n}\n#loc = loc("zzz.py":7:3)\n')
+        c = a.replace('add', 'subtract')
+        assert stablehlo_fingerprint(a) == stablehlo_fingerprint(b)
+        assert stablehlo_fingerprint(a) != stablehlo_fingerprint(c)
+
+
+# ---------------------------------------------------------------------------
+# HL001 — donation actually aliased
+# ---------------------------------------------------------------------------
+
+class TestHL001:
+    def test_negative_unaliasable_donation_errors(self):
+        """The canonical dropped donation: the donated input has no
+        same-shape output to alias into, so XLA copies — exactly the
+        2x-pool regression HL001 exists to catch."""
+        def f(x, y):
+            return (x * y).sum()
+
+        build = suite_of(Program('drop', f,
+                                 (SDS((8, 8), jnp.float32),
+                                  SDS((8, 8), jnp.float32)),
+                                 donate=(0,)))
+        vs = hits(build, 'HL001')
+        assert vs and vs[0].severity == 'error'
+        assert 'donation dropped' in vs[0].message
+
+    def test_clean_honored_donation(self):
+        def f(x, y):
+            return x + y
+
+        build = suite_of(Program('ok', f,
+                                 (SDS((8, 8), jnp.float32),
+                                  SDS((8, 8), jnp.float32)),
+                                 donate=(0,)))
+        assert not hits(build, 'HL001')
+
+    def test_undeclared_alias_warns(self):
+        """A jitted fn that donates while the suite declares nothing:
+        an in-place update the caller does not know about."""
+        # tracelint: disable=TL001 - fixture under test
+        jitted = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+        build = suite_of(Program('sneak', jitted,
+                                 (SDS((8,), jnp.float32),)))
+        vs = hits(build, 'HL001')
+        assert vs and vs[0].severity == 'warning'
+        assert 'declares NO donation' in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# HL002 — dtype upcasts
+# ---------------------------------------------------------------------------
+
+class TestHL002:
+    def test_negative_narrow_widening_without_dequant_ok(self):
+        def f(x):
+            return x.astype(jnp.float32) * 2.0
+
+        build = suite_of(Program('widen', f, (SDS((8, 8), jnp.int8),)))
+        vs = hits(build, 'HL002')
+        assert vs and vs[0].severity == 'error'
+        assert 'convert(s8 -> f32)' in vs[0].message
+
+    def test_dequant_ok_permits_the_declared_path(self):
+        def f(x):
+            return x.astype(jnp.float32) * 2.0
+
+        build = suite_of(Program('widen', f, (SDS((8, 8), jnp.int8),)))
+        assert not hits(build, 'HL002', dequant_ok=True)
+
+    def test_f64_always_errors_even_with_dequant_ok(self):
+        rule = get_rule('HL002')
+        ctx = ctx_of(artifact(has_f64=True), dequant_ok=True)
+        vs = list(rule.check(ctx))
+        assert vs and 'f64' in vs[0].message
+        assert vs[0].severity == 'error'
+
+    def test_clean_float_pool(self):
+        def f(x):
+            return x * 2.0
+
+        build = suite_of(Program('ok', f, (SDS((8, 8), jnp.float32),)))
+        assert not hits(build, 'HL002')
+
+
+# ---------------------------------------------------------------------------
+# HL003 — HBM budget
+# ---------------------------------------------------------------------------
+
+class TestHL003:
+    def test_negative_over_budget_geometry(self):
+        def f(x):
+            return x @ x
+
+        build = suite_of(Program('big', f, (SDS((64, 64), jnp.float32),)))
+        vs = hits(build, 'HL003', hbm_budget=128)
+        assert vs and vs[0].severity == 'error'
+        assert 'exceeds' in vs[0].message
+
+    def test_negative_missing_budget(self):
+        def f(x):
+            return x * 2.0
+
+        build = suite_of(Program('ok', f, (SDS((8,), jnp.float32),)))
+        vs = hits(build, 'HL003', hbm_budget=None)
+        assert vs and 'no hbm_budget declared' in vs[0].message
+
+    def test_warn_band_inside_top_quarter(self):
+        rule = get_rule('HL003')
+        a = artifact(memory={'argument_bytes': 60, 'output_bytes': 20,
+                             'temp_bytes': 0})
+        ctx = ctx_of(a, hbm_budget=100)     # peak 80 >= 75% of 100
+        vs = list(rule.check(ctx))
+        assert vs and vs[0].severity == 'warning'
+        assert 'headroom' in vs[0].message
+
+    def test_missing_memory_analysis_warns(self):
+        rule = get_rule('HL003')
+        ctx = ctx_of(artifact(memory={}), hbm_budget=100)
+        vs = list(rule.check(ctx))
+        assert vs and vs[0].severity == 'warning'
+        assert 'unavailable' in vs[0].message
+
+    def test_clean_within_budget(self):
+        def f(x):
+            return x * 2.0
+
+        build = suite_of(Program('ok', f, (SDS((8,), jnp.float32),)))
+        assert not hits(build, 'HL003')
+
+
+# ---------------------------------------------------------------------------
+# HL004 — host transfers
+# ---------------------------------------------------------------------------
+
+class TestHL004:
+    def test_negative_injected_host_callback(self):
+        """A pure_callback smuggled into a dispatch compiles to a host
+        round-trip custom-call — the per-step latency cliff."""
+        def f(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a) * 2,
+                jax.ShapeDtypeStruct((4,), jnp.float32), x)
+            return y + 1.0
+
+        build = suite_of(Program('cb', f, (SDS((4,), jnp.float32),)))
+        vs = hits(build, 'HL004')
+        assert vs and vs[0].severity == 'error'
+        assert 'host transfer' in vs[0].message
+
+    def test_clean_device_resident_dispatch(self):
+        def f(x):
+            return jnp.tanh(x) * 2.0
+
+        build = suite_of(Program('ok', f, (SDS((4,), jnp.float32),)))
+        assert not hits(build, 'HL004')
+
+
+# ---------------------------------------------------------------------------
+# HL005 — collective census vs shardlint budget
+# ---------------------------------------------------------------------------
+
+class TestHL005:
+    def test_agreement_on_every_shared_suite(self):
+        """THE cross-check: every hlolint suite that names a shardlint
+        entry compiles clean under HL005 — hlolint's independent count
+        of the compiled module agrees EXACTLY with the budget the
+        shardlint registry declares. Two provers, one wire bill."""
+        from paddle_tpu.analysis.hlo.registry import all_entries
+
+        shared = [e for e in all_entries() if e.shard_ref is not None]
+        assert len(shared) >= 6        # the xcheck family is registered
+        vs, _, _ = lint_and_report(
+            shared, rules=[get_rule('HL005')], root=REPO,
+            fingerprint_path=NO_FPS)
+        assert vs == [], '\n'.join(v.render() for v in vs)
+
+    def test_dangling_ref_errors(self):
+        rule = get_rule('HL005')
+        ctx = ctx_of(artifact(), shard_ref='serving/no_such_suite')
+        vs = list(rule.check(ctx))
+        assert vs and 'names no shardlint registry entry' in vs[0].message
+
+    def test_undeclared_kind_errors(self):
+        # kv_import_tp declares budget={} — any collective in the
+        # compiled module is drift
+        rule = get_rule('HL005')
+        ctx = ctx_of(
+            artifact(census={'all-reduce': {'count': 3, 'bytes': 64}}),
+            shard_ref='serving/kv_import_tp')
+        vs = list(rule.check(ctx))
+        assert vs and 'declares none' in vs[0].message
+
+    def test_count_drift_errors_exactly(self):
+        # serve_step_tp declares all-reduce sites; an empty census
+        # means one prover is wrong — exact agreement, both directions
+        rule = get_rule('HL005')
+        ctx = ctx_of(artifact(census={}),
+                     shard_ref='serving/serve_step_tp')
+        vs = list(rule.check(ctx))
+        assert vs and any('has none' in v.message for v in vs)
+
+    def test_no_ref_no_check(self):
+        rule = get_rule('HL005')
+        assert list(rule.check(ctx_of(artifact(
+            census={'all-reduce': {'count': 99, 'bytes': 1}})))) == []
+
+
+# ---------------------------------------------------------------------------
+# HL006 — retrace fingerprints
+# ---------------------------------------------------------------------------
+
+def _fp_build():
+    def f(x):
+        return jnp.tanh(x) + 1.0
+
+    return HloSuite([Program('p', f, (SDS((8,), jnp.float32),))])
+
+
+class TestHL006:
+    def test_no_baseline_warns(self):
+        vs = hits(_fp_build, 'HL006')
+        assert vs and vs[0].severity == 'warning'
+        assert 'no fingerprint baseline' in vs[0].message
+
+    def test_mismatch_is_retrace_regression_error(self, tmp_path):
+        e = entry_of(_fp_build, name='fx/fp')
+        fps = fingerprint_report([e], root=REPO)
+        assert fps
+        path = str(tmp_path / 'fp.json')
+        write_fingerprints({k: '0' * 64 for k in fps}, path)
+        vs, _, _ = lint_and_report([e], root=REPO, fingerprint_path=path)
+        bad = [v for v in vs if v.rule == 'HL006']
+        assert bad and bad[0].severity == 'error'
+        assert 'retrace regression' in bad[0].message
+
+    def test_matching_baseline_is_clean_and_stable(self, tmp_path):
+        e = entry_of(_fp_build, name='fx/fp')
+        fps = fingerprint_report([e], root=REPO)
+        # deterministic within a pinned env: two independent lowerings
+        # hash identically
+        assert fps == fingerprint_report([e], root=REPO)
+        path = str(tmp_path / 'fp.json')
+        write_fingerprints(fps, path)
+        vs, _, _ = lint_and_report([e], root=REPO, fingerprint_path=path)
+        assert [v for v in vs if v.rule == 'HL006'] == []
+
+    def test_env_mismatch_skips_with_advisory(self, tmp_path):
+        e = entry_of(_fp_build, name='fx/fp')
+        path = str(tmp_path / 'fp.json')
+        with open(path, 'w') as f:
+            json.dump({'env': {'jax': '0.0.0', 'jaxlib': '0.0.0',
+                               'backend': 'other'},
+                       'fingerprints': {}}, f)
+        vs, _, _ = lint_and_report([e], root=REPO, fingerprint_path=path)
+        adv = [v for v in vs if v.rule == 'HL006']
+        assert adv and adv[0].severity == 'warning'
+        assert 'skipped' in adv[0].message
+
+    def test_committed_baseline_matches_this_env(self):
+        """The committed fingerprint file was recorded under THIS
+        toolchain (else HL006 is silently advisory everywhere)."""
+        path = os.path.join(REPO, 'tools', 'hlolint_fingerprints.json')
+        with open(path) as f:
+            data = json.load(f)
+        assert data['env'] == fingerprint_env()
+        assert len(data['fingerprints']) >= 24
+
+
+# ---------------------------------------------------------------------------
+# Engine seams
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_build_failure_is_hl000(self):
+        def build():
+            raise RuntimeError('boom')
+
+        vs = lint_one(build)
+        assert vs and vs[0].rule == 'HL000'
+        assert 'boom' in vs[0].message
+
+    def test_reasonless_suppression_rejected(self):
+        def f(x):
+            return x * 2.0
+
+        build = suite_of(Program('ok', f, (SDS((8,), jnp.float32),)))
+        with pytest.raises(ValueError, match='reason'):
+            lint_one(build, suppress={'HL003': ''})
+
+    def test_suppression_with_reason_silences(self):
+        def f(x):
+            return x.astype(jnp.float32) * 2.0
+
+        build = suite_of(Program('widen', f, (SDS((8,), jnp.int8),)))
+        e = entry_of(build, suppress={
+            'HL002': 'fixture: the widening is the point'})
+        vs, sup, _ = lint_and_report([e], root=REPO,
+                                     fingerprint_path=NO_FPS)
+        assert not [v for v in vs if v.rule == 'HL002']
+        assert any(v.rule == 'HL002' for v, _ in sup)
+
+    def test_artifact_detail_stamped_for_bench(self):
+        def f(x):
+            return x + 1.0
+
+        build = suite_of(Program('p', f, (SDS((8,), jnp.float32),)))
+        _, _, detail = lint_and_report([entry_of(build, name='fx/d')],
+                                       root=REPO, fingerprint_path=NO_FPS)
+        rec = detail['fx/d']['p']
+        assert set(rec) == {'peak_bytes', 'fingerprint', 'aliased',
+                            'donated', 'census'}
+        assert rec['peak_bytes'] > 0 and len(rec['fingerprint']) == 64
+
+
+# ---------------------------------------------------------------------------
+# Registry shape + CLI contract
+# ---------------------------------------------------------------------------
+
+class TestMeta:
+    def test_rule_ids_and_severities(self):
+        rules = all_rules()
+        assert [r.id for r in rules] == [f'HL00{i}' for i in
+                                         range(1, 7)]
+        for r in rules:
+            assert r.severity in ('error', 'warning')
+            assert r.description
+
+    def test_registry_budgets_and_refs_declared(self):
+        from paddle_tpu.analysis.hlo.registry import all_entries
+
+        entries = all_entries()
+        names = {e.name for e in entries}
+        assert len(names) == len(entries) >= 12
+        for e in entries:
+            assert e.hbm_budget is not None, e.name
+            if e.name.startswith('xcheck/'):
+                assert e.shard_ref, e.name
+        # the serve-dispatch, migration, and AOT-geometry families the
+        # tentpole promises are all registered
+        for want in ('serving/admit_decode', 'serving/spec_verify',
+                     'serving/kv_migration', 'aot/decode_pool',
+                     'aot/prefill_pool', 'xcheck/serve_step_tp'):
+            assert want in names, want
+
+    def test_baseline_file_is_committed_and_empty(self):
+        path = os.path.join(REPO, 'tools', 'hlolint_baseline.json')
+        with open(path) as f:
+            data = json.load(f)
+        assert data['counts'] == {}          # zero tolerated debt
+
+    @pytest.mark.slow
+    def test_all_registered_suites_statically_clean(self):
+        """Every suite in the registry lints clean against the
+        committed fingerprint baseline (the full sweep the CLI and the
+        bench gate run; slow: ~30 compiles)."""
+        from paddle_tpu.analysis.hlo.registry import all_entries
+
+        vs, sup, _ = lint_and_report(all_entries(), root=REPO)
+        assert vs == [], '\n'.join(v.render() for v in vs)
+        for v, reason in sup:
+            assert reason.strip(), v.render()
+
+
+class TestCLI:
+    def test_hlo_main_list_rules(self, capsys):
+        from paddle_tpu.analysis.__main__ import hlo_main
+
+        assert hlo_main(['--list-rules']) == 0
+        out = capsys.readouterr().out
+        for rid in ('HL001', 'HL002', 'HL003', 'HL004', 'HL005',
+                    'HL006'):
+            assert rid in out
+
+    def test_family_flags_mutually_exclusive(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main(['--hlo', '--shard', '--root', REPO]) == 2
+        assert 'mutually exclusive' in capsys.readouterr().err
+
+    def test_all_rejects_family_flags(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main(['--all', '--hlo', '--root', REPO]) == 2
+
+    def test_exit_two_on_unknown_rule(self):
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main(['--hlo', '--root', REPO, '--select', 'HL999']) == 2
+
+    def test_path_filter_selects_anchor_file(self):
+        from paddle_tpu.analysis.hlo.registry import entries_for
+
+        entries = entries_for(['paddle_tpu/aot/geometry.py'], root=REPO)
+        assert {e.name for e in entries} == {'aot/decode_pool',
+                                             'aot/prefill_pool'}
+
+    @pytest.mark.slow
+    def test_exit_zero_on_repo(self):
+        """The acceptance run: `--hlo` over the full registry is green
+        against the committed baselines (slow: compiles everything in
+        a subprocess)."""
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        proc = subprocess.run(
+            [sys.executable, '-m', 'paddle_tpu.analysis', '--hlo',
+             '--root', REPO, '--format', 'json'],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=420)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload['new'] == 0
+        assert len(payload['artifacts']) >= 12   # stamped for bench.py

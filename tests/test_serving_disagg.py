@@ -20,7 +20,14 @@ Contract pinned here:
     in-flight handoffs.
   - int8 migration blobs cost (D+4)/(2*D) of the bf16 bytes — per-row
     f32 scales are the only overhead over half.
+  - a truncated/tampered PTKV byte string fails in `unpack_kv_blob`
+    with the defect named, and a structurally wrong blob dict fails in
+    `import_kv` BEFORE any allocator/block-table/pool mutation — no
+    partial scatter, ever (ISSUE 17).
 """
+import json
+import struct
+
 import numpy as np
 import pytest
 
@@ -337,6 +344,97 @@ class TestAtomicImport:
             small.import_kv(rid, blob)
         assert small.allocator.in_use() == 0
         assert small.in_flight() == 0
+
+
+class TestCorruptBlob:
+    """A damaged migration blob must fail with the defect named and
+    the engine untouched — wire-level damage in `unpack_kv_blob`,
+    dict-level damage in `import_kv`'s pre-mutation structural check.
+    """
+
+    def _packed(self):
+        src = _mk('int8')
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        return rid, blob, pack_kv_blob(blob)
+
+    def test_truncated_wire_blob_rejected(self):
+        _, _, data = self._packed()
+        (hlen,) = struct.unpack_from('<I', data, 4)
+        # shorter than the preamble
+        with pytest.raises(ValueError, match='truncated'):
+            unpack_kv_blob(b'')
+        with pytest.raises(ValueError, match='truncated'):
+            unpack_kv_blob(data[:6])
+        # header cut mid-JSON
+        with pytest.raises(ValueError, match='truncated'):
+            unpack_kv_blob(data[:8 + hlen // 2])
+        # payload cut: intact header, half the array bytes
+        cut = 8 + hlen + (len(data) - 8 - hlen) // 2
+        with pytest.raises(ValueError, match='length mismatch'):
+            unpack_kv_blob(data[:cut])
+        # trailing garbage is corruption too — the specs' byte count
+        # must match the buffer EXACTLY
+        with pytest.raises(ValueError, match='length mismatch'):
+            unpack_kv_blob(data + b'\x00' * 7)
+
+    def test_version_and_header_corruption_rejected(self):
+        _, _, data = self._packed()
+        (hlen,) = struct.unpack_from('<I', data, 4)
+        head = json.loads(data[8:8 + hlen].decode('utf-8'))
+        payload = data[8 + hlen:]
+
+        def repack(h):
+            enc = json.dumps(h).encode('utf-8')
+            return b'PTKV' + struct.pack('<I', len(enc)) + enc + payload
+
+        with pytest.raises(ValueError, match='version'):
+            unpack_kv_blob(repack(dict(head, version=99)))
+        with pytest.raises(ValueError, match='magic|blob'):
+            unpack_kv_blob(repack(dict(head, magic='something.else')))
+        # unparseable header bytes
+        with pytest.raises(ValueError, match='corrupt'):
+            unpack_kv_blob(data[:8] + b'\xff' * hlen + payload)
+        # parseable header missing its sections
+        with pytest.raises(ValueError, match='meta/arrays'):
+            unpack_kv_blob(repack({'magic': head['magic'], 'version': 1}))
+
+    def test_structural_mismatch_rejected_before_any_mutation(self):
+        rid, blob, data = self._packed()
+        base = unpack_kv_blob(data)
+        dst = _mk('int8', role='decode')
+
+        def tampered(**lay0_kw):
+            lay0 = dict(base['layers'][0], **lay0_kw)
+            for f in list(lay0_kw):
+                if lay0[f] is None:
+                    lay0.pop(f)
+            return dict(base, layers=[lay0] + list(base['layers'][1:]))
+
+        # wrong layer count
+        with pytest.raises(ValueError, match='layer'):
+            dst.import_kv(rid, dict(base, layers=base['layers'][:1]))
+        # missing field (scales lost en route)
+        with pytest.raises(ValueError, match='fields'):
+            dst.import_kv(rid, tampered(ks=None))
+        # wrong row count (a silently short scatter payload)
+        short_k = np.asarray(base['layers'][0]['k'])[:-1]
+        with pytest.raises(ValueError, match='scatters'):
+            dst.import_kv(rid, tampered(k=short_k))
+        # wrong dtype (pages dequantized somewhere en route)
+        wide_k = np.asarray(base['layers'][0]['k'], np.float32)
+        with pytest.raises(ValueError, match='scatters'):
+            dst.import_kv(rid, tampered(k=wide_k))
+        # every reject left the engine EXACTLY as before: no pages, no
+        # slot, no registration, no import_failed accounting surprise
+        assert dst.allocator.in_use() == 0
+        assert dst.in_flight() == 0
+        assert rid not in dst._live and rid not in dst._terminal
+        # and the intact blob still lands and finishes bit-equal
+        dst.import_kv(rid, base)
+        while dst.in_flight():
+            dst.step()
+        ref = _mk('int8').serve(_prompts())[0]
+        assert _same(dst.result(rid), ref)
 
 
 class TestWarmGeometry:

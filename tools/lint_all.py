@@ -1,18 +1,21 @@
 """Run every static analyzer family — tracelint + mosaiclint +
-shardlint — with one combined exit code.
+shardlint + hlolint — with one combined exit code.
 
     python tools/lint_all.py [--root DIR] [--format text|json]
 
-Per family it prints the NEW/baselined/suppressed counts and the rc in
-one summary table; the combined rc is:
+Thin wrapper over the unified runner (`python -m paddle_tpu.analysis
+--all`), kept for muscle memory and for the backend guard below: the
+unified runner shares one JSON schema ({'schema', 'rc', 'families'})
+and one combined rc across all four families:
 
     0  every family clean (modulo baselines/suppressions)
     1  any family found new error-severity violations
     2  no family violated but at least one could not run (no jax
        backend, registry failed to load, usage error)
 
-mosaiclint traces the kernel registry and shardlint compiles the
-distributed registry, so a usable jax backend is required — pin
+mosaiclint traces the kernel registry, shardlint compiles the
+distributed registry, and hlolint compiles the serving/AOT suite
+registry, so a usable jax backend is required — pin
 `JAX_PLATFORMS=cpu` to keep the flaky TPU tunnel out of the loop
 (the rc-2 guard below refuses cleanly when no backend initialises,
 mirroring tools/mosaic_check.py).  Importable anywhere; only main()
@@ -21,9 +24,6 @@ touches the backend.
 from __future__ import annotations
 
 import argparse
-import contextlib
-import io
-import json
 import os
 import sys
 
@@ -34,16 +34,11 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-FAMILIES = (
-    ('tracelint', []),
-    ('mosaiclint', ['--mosaic']),
-    ('shardlint', ['--shard']),
-)
-
 
 def _backend_ok():
-    """True when jax can initialise SOME backend (shardlint forces the
-    virtual-device flag itself; this only guards total absence)."""
+    """True when jax can initialise SOME backend (shardlint/hlolint
+    force the virtual-device flag themselves; this only guards total
+    absence)."""
     try:
         from paddle_tpu.analysis.shard import ensure_virtual_devices
 
@@ -55,24 +50,12 @@ def _backend_ok():
         return False
 
 
-def run_family(name, flags, root, fmt='json'):
-    """(rc, payload) for one analyzer family, output captured."""
-    from paddle_tpu.analysis.__main__ import main as analysis_main
-
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
-        rc = analysis_main([*flags, '--root', root, '--format', 'json'])
-    try:
-        payload = json.loads(buf.getvalue())
-    except ValueError:
-        payload = {}
-    return rc, payload
-
-
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='lint_all',
-        description='tracelint + mosaiclint + shardlint, combined rc')
+        description='tracelint + mosaiclint + shardlint + hlolint, '
+                    'combined rc (delegates to '
+                    '`python -m paddle_tpu.analysis --all`)')
     ap.add_argument('--root', default=_ROOT,
                     help='project root (default: the repo this script '
                          'lives in)')
@@ -81,67 +64,14 @@ def main(argv=None):
 
     if not _backend_ok():
         print('lint_all: no jax backend reachable (mosaiclint/'
-              'shardlint trace with jax) — run with JAX_PLATFORMS=cpu',
-              file=sys.stderr)
+              'shardlint/hlolint trace with jax) — run with '
+              'JAX_PLATFORMS=cpu', file=sys.stderr)
         return 2
 
-    rows = []
-    for name, flags in FAMILIES:
-        rc, payload = run_family(name, flags, args.root)
-        row = {
-            'family': name,
-            'rc': rc,
-            'new': payload.get('new'),
-            'baselined': payload.get('baselined'),
-            'suppressed': payload.get('suppressed'),
-            'violations': payload.get('violations', []),
-        }
-        if name == 'shardlint':
-            # surface WHAT the shardlint leg covered: suite count per
-            # registry family (mp_layers, ring, ..., serving — the
-            # TP-sharded ServingEngine dispatches), so a registry
-            # entry silently dropping out is visible in this summary
-            # instead of only as a quieter census
-            try:
-                from paddle_tpu.analysis.shard.registry import \
-                    all_entries
+    from paddle_tpu.analysis.__main__ import main as analysis_main
 
-                fams: dict = {}
-                for e in all_entries():
-                    fam = e.name.split('/', 1)[0]
-                    fams[fam] = fams.get(fam, 0) + 1
-                row['suites'] = fams
-            except Exception:  # noqa: BLE001 - summary only
-                row['suites'] = None
-        rows.append(row)
-
-    combined = (1 if any(r['rc'] == 1 for r in rows)
-                else 2 if any(r['rc'] not in (0, 1) for r in rows)
-                else 0)
-
-    if args.format == 'json':
-        print(json.dumps({'combined_rc': combined, 'families': rows},
-                         indent=2))
-        return combined
-
-    print(f'{"family":<12} {"rc":>3} {"new":>5} {"baselined":>10} '
-          f'{"suppressed":>11}')
-    for r in rows:
-        def fmt(v):
-            return '?' if v is None else str(v)
-
-        print(f'{r["family"]:<12} {fmt(r["rc"]):>3} {fmt(r["new"]):>5} '
-              f'{fmt(r["baselined"]):>10} {fmt(r["suppressed"]):>11}')
-        if r.get('suites'):
-            per = ' '.join(f'{k}({n})'
-                           for k, n in sorted(r['suites'].items()))
-            print(f'    suites: {per}')
-        for v in r['violations']:
-            print(f'    {v["path"]}:{v["line"]}: {v["rule"]} '
-                  f'[{v["severity"]}] {v["message"]}')
-    verdict = {0: 'clean', 1: 'NEW VIOLATIONS', 2: 'DID NOT RUN'}[combined]
-    print(f'lint_all: {verdict} (rc {combined})')
-    return combined
+    return analysis_main(
+        ['--all', '--root', args.root, '--format', args.format])
 
 
 if __name__ == '__main__':
