@@ -210,6 +210,24 @@ def _hlolint_gate(timeout_s=420):
     return clean, detail, payload.get('artifacts')
 
 
+def gate_statelint(timeout_s=420):
+    """Static engine-state coverage gate: statelint must report zero
+    NEW error-severity violations over the stateful engine classes vs
+    the committed (zero) baseline — an unclassified mutable attribute,
+    state a wire silently dropped, an asymmetric snapshot/restore
+    pair, a compile-geometry knob missing from the AOT refusal set, or
+    an unlocked mutation of a thread-shared structure fails the bench
+    run while the tunnel is down. Builds tiny CPU engines for the live
+    wire schemas, hence the longer timeout. Returns (clean, detail,
+    state): state is the per-class classification census stamped into
+    the bench detail blob, or None."""
+    clean, detail, payload = _analysis_gate(['--state'],
+                                            timeout_s=timeout_s)
+    if clean:
+        detail += f' ({payload.get("suppressed", 0)} suppressed)'
+    return clean, detail, payload.get('state')
+
+
 _TRAIN_GATE_SRC = r'''
 import json
 import jax
@@ -2050,6 +2068,8 @@ def main():
     print(f'# shardlint gate: {shardlint_detail}', flush=True)
     hlolint_clean, hlolint_detail, hlolint_artifacts = _hlolint_gate()
     print(f'# hlolint gate: {hlolint_detail}', flush=True)
+    statelint_clean, statelint_detail, statelint_state = gate_statelint()
+    print(f'# statelint gate: {statelint_detail}', flush=True)
     train_gate_clean, train_gate_detail = _train_engine_gate()
     print(f'# train engine gate: {train_gate_detail}', flush=True)
     serving_gate_clean, serving_gate_detail, serving_gate_payload = (
@@ -2084,6 +2104,7 @@ def main():
                           or mosaiclint_clean is False
                           or shardlint_clean is False
                           or hlolint_clean is False
+                          or statelint_clean is False
                           or train_gate_clean is False
                           or serving_gate_clean is False
                           or obs_gate_clean is False
@@ -2110,6 +2131,9 @@ def main():
             det['gate_hlolint_clean'] = hlolint_clean
             det['hlolint'] = hlolint_detail
             det['hlolint_artifacts'] = hlolint_artifacts
+            det['gate_statelint_clean'] = statelint_clean
+            det['statelint'] = statelint_detail
+            det['statelint_state'] = statelint_state
             det['gate_train_retrace_zero'] = train_gate_clean
             det['train_gate'] = train_gate_detail
             # the CPU-pinned serving gate is the round's continuous-
@@ -2959,6 +2983,17 @@ def main():
             # collective census, fingerprints): memory and retrace
             # regressions show in the bench history before they OOM
             'hlolint_artifacts': hlolint_artifacts,
+            # static engine-state coverage gate (statelint): False also
+            # fails the run — an unclassified mutable attribute, a wire
+            # that dropped declared state, an asymmetric snapshot/
+            # restore pair, or a refusal-set hole is a resilience
+            # regression provable on CPU before a failover hits it
+            'gate_statelint_clean': statelint_clean,
+            'statelint': statelint_detail,
+            # per-class classification census (persisted / derived /
+            # device / ephemeral counts per engine class): coverage
+            # drift shows in the bench history
+            'statelint_state': statelint_state,
             'decode_cache_len': dec_cache,
             'hbm_peak_gb': hbm_peak_gb,
             'host_rss_gb': host_rss_gb,

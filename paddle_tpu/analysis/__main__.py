@@ -1,22 +1,24 @@
-"""tracelint / mosaiclint / shardlint / hlolint CLI.
+"""tracelint / mosaiclint / shardlint / hlolint / statelint CLI.
 
     python -m paddle_tpu.analysis [paths...]        # tracelint (AST)
     python -m paddle_tpu.analysis --mosaic [paths]  # mosaiclint (jaxpr)
     python -m paddle_tpu.analysis --shard [paths]   # shardlint (GSPMD)
     python -m paddle_tpu.analysis --hlo [paths]     # hlolint (XLA HLO)
-    python -m paddle_tpu.analysis --all             # all four families
+    python -m paddle_tpu.analysis --state [paths]   # statelint (engine state)
+    python -m paddle_tpu.analysis --all             # all five families
     tracelint paddle_tpu/                           # console script
     mosaiclint                                      # console script
     shardlint                                       # console script
     hlolint                                         # console script
+    statelint                                       # console script
     tracelint --write-baseline                      # accept current debt
     hlolint --write-fingerprints                    # re-baseline HL006
     shardlint --list-rules
 
-`--mosaic` / `--shard` / `--hlo` are mutually exclusive — one
-invocation runs exactly one analyzer family; `--all` runs the four
-families in sequence with ONE shared JSON report schema and a single
-combined exit code (the entrypoint CI and bench.py call —
+`--mosaic` / `--shard` / `--hlo` / `--state` are mutually exclusive —
+one invocation runs exactly one analyzer family; `--all` runs the
+five families in sequence with ONE shared JSON report schema and a
+single combined exit code (the entrypoint CI and bench.py call —
 tools/lint_gate.sh wraps it with the env pins).
 
 Exit codes: 0 clean (modulo baseline/suppressions), 1 new
@@ -24,13 +26,14 @@ ERROR-severity violations (warnings print but never gate — they exist
 to be confirmed on chip, not to block it), 2 usage/IO error.  `--all`
 combines: 1 if any family gated, else 2 if any family errored, else
 0.  Config comes from `[tool.tracelint]` / `[tool.mosaiclint]` /
-`[tool.shardlint]` / `[tool.hlolint]` in pyproject.toml at `--root`
-(default: cwd); CLI flags win over config.  mosaiclint traces the
-kernel registry with jax, and shardlint/hlolint compile their
-registries, so pin `JAX_PLATFORMS=cpu` where touching an accelerator
-backend is unwanted (bench.py's gates do); shardlint and hlolint
-additionally force the 8-virtual-device flag themselves when the
-backend has not initialised yet.
+`[tool.shardlint]` / `[tool.hlolint]` / `[tool.statelint]` in
+pyproject.toml at `--root` (default: cwd); CLI flags win over config.
+mosaiclint traces the kernel registry with jax, shardlint/hlolint
+compile their registries, and statelint builds tiny CPU engines for
+its live wire schemas, so pin `JAX_PLATFORMS=cpu` where touching an
+accelerator backend is unwanted (bench.py's gates do); shardlint and
+hlolint additionally force the 8-virtual-device flag themselves when
+the backend has not initialised yet.
 """
 from __future__ import annotations
 
@@ -39,7 +42,7 @@ import os
 import sys
 
 from .config import (load_config, load_hlo_config, load_mosaic_config,
-                     load_shard_config)
+                     load_shard_config, load_state_config)
 from .engine import (filter_new, format_json, format_text, lint_paths,
                      load_baseline, write_baseline)
 from .rules import all_rules
@@ -69,8 +72,13 @@ def _build_parser():
                    help='run hlolint (HL rules over compiled XLA '
                         'artifacts of the serving/AOT registry) '
                         'instead of tracelint')
+    p.add_argument('--state', action='store_true',
+                   help='run statelint (ST rules over the stateful '
+                        'engine classes: snapshot/restore, KV '
+                        'migration, and AOT-refusal coverage of every '
+                        'mutable attribute) instead of tracelint')
     p.add_argument('--all', action='store_true',
-                   help='run all four analyzer families with one '
+                   help='run all five analyzer families with one '
                         'combined report and exit code')
     p.add_argument('--write-fingerprints', action='store_true',
                    help='(hlolint) compile every suite and write the '
@@ -96,7 +104,8 @@ def _build_parser():
 def _family(args):
     return ('mosaiclint' if args.mosaic
             else 'shardlint' if args.shard
-            else 'hlolint' if args.hlo else 'tracelint')
+            else 'hlolint' if args.hlo
+            else 'statelint' if args.state else 'tracelint')
 
 
 def _finish(args, violations, baseline_path, baselined_filter=True,
@@ -279,6 +288,19 @@ def _main_hlo(args, root):
                           entries_for, lint_fn, 'artifacts')
 
 
+def _main_state(args, root):
+    # imported here: statelint's live wire-schema extraction needs jax
+    # (it instantiates tiny CPU engines), plain tracelint must not;
+    # the registry/rules imports themselves stay stdlib-only
+    from .state import lint_and_report
+    from .state.registry import entries_for
+    from .state.rules import all_rules as all_st_rules
+
+    return _registry_main(args, root, 'statelint',
+                          load_state_config(root), all_st_rules,
+                          entries_for, lint_and_report, 'state')
+
+
 def _main_all(args, root):
     """The unified runner: every family in sequence, one report.
 
@@ -303,7 +325,8 @@ def _main_all(args, root):
         flags.append('--no-baseline')
     rows, combined = [], []
     for family, flag in (('tracelint', None), ('mosaiclint', '--mosaic'),
-                         ('shardlint', '--shard'), ('hlolint', '--hlo')):
+                         ('shardlint', '--shard'), ('hlolint', '--hlo'),
+                         ('statelint', '--state')):
         buf, err = io.StringIO(), io.StringIO()
         with contextlib.redirect_stdout(buf), \
                 contextlib.redirect_stderr(err):
@@ -342,13 +365,14 @@ def main(argv=None):
     picked = [f for f, on in (('--mosaic', args.mosaic),
                               ('--shard', args.shard),
                               ('--hlo', args.hlo),
+                              ('--state', args.state),
                               ('--all', args.all)) if on]
     if len(picked) > 1:
         # one invocation = one analyzer family; last-flag-wins would
         # silently skip a whole family in CI
         print(f'tracelint: {" and ".join(picked)} are mutually '
               f'exclusive — pick one analyzer per invocation (--all '
-              f'runs all four)', file=sys.stderr)
+              f'runs all five)', file=sys.stderr)
         return 2
     if args.list_rules:
         if args.mosaic:
@@ -363,6 +387,10 @@ def main(argv=None):
             from .hlo.rules import all_rules as all_hl_rules
 
             rules = all_hl_rules()
+        elif args.state:
+            from .state.rules import all_rules as all_st_rules
+
+            rules = all_st_rules()
         else:
             rules = all_rules()
         for rule in rules:
@@ -379,6 +407,8 @@ def main(argv=None):
         return _main_shard(args, root)
     if args.hlo:
         return _main_hlo(args, root)
+    if args.state:
+        return _main_state(args, root)
     return _main_tracelint(args, root)
 
 
@@ -398,6 +428,12 @@ def hlo_main(argv=None):
     """Entry point for the `hlolint` console script."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(['--hlo'] + argv)
+
+
+def state_main(argv=None):
+    """Entry point for the `statelint` console script."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(['--state'] + argv)
 
 
 if __name__ == '__main__':

@@ -1,5 +1,5 @@
 """`[tool.tracelint]` / `[tool.mosaiclint]` / `[tool.shardlint]` /
-`[tool.hlolint]` config from pyproject.toml.
+`[tool.hlolint]` / `[tool.statelint]` config from pyproject.toml.
 
 Python 3.10 has no stdlib tomllib and the repo pins no TOML package, so
 this reads the tables the analyzers need with a deliberately tiny
@@ -47,6 +47,15 @@ class HlolintConfig:
     paths: list = dataclasses.field(default_factory=list)
     baseline: str = 'tools/hlolint_baseline.json'
     fingerprints: str = 'tools/hlolint_fingerprints.json'
+    select: list = dataclasses.field(default_factory=list)  # empty = all
+
+
+@dataclasses.dataclass
+class StatelintConfig:
+    # same registry-filter semantics as its siblings: paths select
+    # class declarations by their source file
+    paths: list = dataclasses.field(default_factory=list)
+    baseline: str = 'tools/statelint_baseline.json'
     select: list = dataclasses.field(default_factory=list)  # empty = all
 
 
@@ -160,6 +169,19 @@ def load_hlo_config(root=None):
         cfg.baseline = table['baseline']
     if 'fingerprints' in table:
         cfg.fingerprints = table['fingerprints']
+    if 'select' in table:
+        cfg.select = list(table['select'])
+    return cfg
+
+
+def load_state_config(root=None):
+    """Statelint config from the [tool.statelint] table."""
+    cfg = StatelintConfig()
+    table = _load_table(root, 'statelint')
+    if 'paths' in table:
+        cfg.paths = list(table['paths'])
+    if 'baseline' in table:
+        cfg.baseline = table['baseline']
     if 'select' in table:
         cfg.select = list(table['select'])
     return cfg

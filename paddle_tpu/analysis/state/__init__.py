@@ -1,0 +1,28 @@
+"""statelint — engine-state coverage analysis (the fifth analyzer
+family).
+
+tracelint reads the AST, mosaiclint the jaxpr, shardlint the GSPMD
+partition, hlolint the compiled artifact; statelint reads the
+runtime's MUTABLE HOST STATE: every `self.X = ...` site of the
+stateful engine classes, each classified by the registry (persisted /
+derived-rebuilt / device-rederived / ephemeral-with-reason) and
+proven against the LIVE wire dicts — snapshot()/restore(), the KV
+migration blob, the AOT refusal sets. ST001 is the ratchet (no
+unclassified mutable state), ST002/ST003 the live diff (no silently
+dropped state, no dead wire keys), ST004 writer/reader symmetry,
+ST005 config-identity coverage of the refusal sets, ST006 lock
+discipline on thread-shared structures.
+
+    python -m paddle_tpu.analysis.state        # == `statelint`
+    statelint --format json
+
+jax imports stay lazy: `paddle_tpu.analysis` remains stdlib-only to
+import; the backend wakes only when live.py builds its tiny engines.
+"""
+from .engine import (Attr, ClassDecl, RoundTrip, StateContext,  # noqa: F401
+                     StateRule, derived, device, ephemeral,
+                     lint_and_report, lint_entries, persisted,
+                     roundtrip_io, scan_attrs, scan_loads,
+                     scan_mutations, trace_decl)
+from .registry import (DECLS, WIRE_EXTENDS, WIRE_STRUCTURAL,  # noqa: F401
+                       entries_for)

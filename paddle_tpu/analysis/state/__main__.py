@@ -1,0 +1,13 @@
+"""`python -m paddle_tpu.analysis.state` — the statelint CLI.
+
+Thin alias for `python -m paddle_tpu.analysis --state` (one analyzer
+family per invocation; `--all` runs the five families together).
+"""
+from __future__ import annotations
+
+import sys
+
+from ..__main__ import state_main
+
+if __name__ == '__main__':
+    sys.exit(state_main())

@@ -1,0 +1,579 @@
+"""The statelint engine: AST attribute scan + live wire schemas.
+
+tracelint proves source-level serving contracts, mosaiclint Mosaic
+lowering legality, shardlint the GSPMD sharding contract, hlolint the
+compiled artifact. This engine closes the remaining gap: whether the
+runtime's MUTABLE HOST STATE is completely covered by the wire formats
+that claim to carry it. PR 8 added snapshot/restore, and PRs 12-16
+each had to remember BY HAND that trails, watchdog state, `spec_next`,
+sampling params, and migration counters "ride snapshot" — review
+hardening repeatedly caught misses (lifetime counters, tokens_out,
+breach indices). Every one of those is statically checkable, because
+the paper's framework ambition makes the engine's entire runtime state
+host-side Python:
+
+  - an AST walk enumerates every `self.X = ...` / `self.X += ...`
+    site of each registered class — the ground truth of what state
+    EXISTS (ST001 forces a classification for all of it),
+  - the per-class registry (registry.py) declares what each attribute
+    IS: `persisted` (names the wire + key it rides), `derived-rebuilt`
+    (host bookkeeping restore reconstructs), `device-rederived`
+    (device buffers re-prefill/AOT-attach recreate), or `ephemeral`
+    with a MANDATORY reason (sockets, absolute clocks, perf windows),
+  - tiny CPU engines are instantiated and their ACTUAL dicts read
+    (live.py) — snapshot(), the per-request record, the export_kv
+    blob, aot_config(), _snapshot_config(), the watchdog state — so a
+    `persisted` claim is proven against the real wire, not against
+    what the registry wishes it were (ST002/ST003),
+  - reader/writer symmetry of each snapshot()/restore() -style pair
+    is proven from the AST (ST004), config-identity fields against
+    the refusal sets (ST005), and lock discipline on thread-shared
+    structures via lexical with-context analysis (ST006 — the PR-14
+    "dictionary changed size" scrape-race class).
+
+Like its siblings: violations reuse tracelint's Violation/severity/
+baseline machinery keyed on the class's source file, suppression lives
+in the registry with a MANDATORY reason, and a live-schema extraction
+that fails to build surfaces as ST000 — never as a silent pass. jax
+is imported lazily (only by live.py); importing the package and
+running the pure-AST rules stays stdlib-only.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from ..engine import Violation
+
+KINDS = ('persisted', 'derived-rebuilt', 'device-rederived', 'ephemeral')
+
+# container methods that mutate in place — what ST006 counts as a
+# mutation site alongside rebinds and subscript stores/deletes
+MUTATORS = frozenset({
+    'add', 'append', 'appendleft', 'clear', 'discard', 'extend',
+    'insert', 'pop', 'popitem', 'popleft', 'remove', 'setdefault',
+    'sort', 'update',
+})
+
+
+# ---------------------------------------------------------------------------
+# Registry vocabulary (the declarations registry.py is written in)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Attr:
+    """One attribute's classification.
+
+    `claims` is ((wire, key), ...): the wire dict(s) this attribute's
+    state rides and the key it rides under — checked against the LIVE
+    schemas by ST002, and what marks a wire key as documented for
+    ST003 (claims are legal on any kind: a derived attribute may still
+    claim the wire key that carries its config identity, e.g. the
+    allocator claiming aot_config's 'num_blocks'). `reason` is
+    MANDATORY for 'ephemeral' (an empty one is a registry
+    misconfiguration — rc 2, never a silent pass)."""
+
+    kind: str
+    claims: tuple = ()
+    reason: str = ''
+
+
+def persisted(*claims, note=''):
+    """Attr for state a wire format carries: persisted(('snapshot',
+    'counts'), ('blob', 'kv_cache_dtype'), ...)."""
+    return Attr('persisted', tuple(tuple(c) for c in claims), note)
+
+
+def derived(note='', claims=()):
+    """Attr for host bookkeeping restore() rebuilds from persisted
+    state (slot tables, heaps, refcounts, block tables)."""
+    return Attr('derived-rebuilt', tuple(tuple(c) for c in claims), note)
+
+
+def device(note='', claims=()):
+    """Attr for device-resident buffers that re-prefill / AOT attach
+    recreate (pools, logits, dummy slots)."""
+    return Attr('device-rederived', tuple(tuple(c) for c in claims),
+                note)
+
+
+def ephemeral(reason):
+    """Attr for state that DELIBERATELY dies with the process —
+    sockets, absolute clock stamps, perf windows, test harness hooks.
+    The reason is the declaration: it must say why losing this is
+    correct."""
+    return Attr('ephemeral', (), reason)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundTrip:
+    """One writer/reader wire pair ST004 proves symmetric.
+
+    `marker` names a key identifying the writer's wire dict literal
+    (e.g. 'schema' for snapshot(), 'rid' for _request_record) so
+    incidental dict literals in the same function are ignored. With
+    marker=None — the subclass-override style, where the writer
+    mutates super()'s dict instead of building one — writes are
+    collected from string-constant subscript stores and every dict
+    literal in the writer."""
+
+    writer: str
+    reader: str
+    param: str
+    marker: str = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassDecl:
+    """One registered stateful class: where it lives, what each of its
+    mutable attributes is, and which wire contracts it owns."""
+
+    name: str                    # e.g. 'inference.serving.ServingEngine'
+    path: str                    # repo-relative source path
+    cls: str                     # class name in that file
+    attrs: dict                  # attr -> Attr
+    inherit: str = None          # parent decl name (attrs merge under ours)
+    config_identity: dict = dataclasses.field(default_factory=dict)
+    # ^ attr -> ((wire, key), ...): fields that change trace geometry /
+    #   pool layout and therefore must sit in the refusal sets (ST005)
+    geometry_methods: tuple = ()  # methods whose self.X loads are
+    #   config-identity EVIDENCE (every load must be declared)
+    roundtrips: tuple = ()       # RoundTrip pairs (ST004)
+    roundtrip_ok: dict = dataclasses.field(default_factory=dict)
+    # ^ wire key -> reason: declared asymmetries (e.g. informational
+    #   fields the reader deliberately ignores)
+    owns_wires: tuple = ()       # wires whose ST003 dead-key check
+    #   this decl reports (exactly one owner per wire)
+    locks: dict = dataclasses.field(default_factory=dict)
+    # ^ guarded attr -> lock attr name (ST006)
+    lock_free: dict = dataclasses.field(default_factory=dict)
+    # ^ method name (or '*') -> reason mutations there run unlocked
+    suppress: dict = dataclasses.field(default_factory=dict)
+
+    def resolve(self, root=None):
+        """(absolute source path, repo-relative path)."""
+        rel = self.path
+        absolute = rel if os.path.isabs(rel) \
+            else os.path.join(root or os.getcwd(), rel)
+        return absolute, rel
+
+
+# ---------------------------------------------------------------------------
+# AST extraction
+# ---------------------------------------------------------------------------
+
+def _find_class(tree, cls):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return node
+    return None
+
+
+def _self_attr(node):
+    """X when `node` is the expression `self.X`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+def _walk_methods(cls_node):
+    """Yield (method_name, statement) for every statement in the class
+    body, with nested functions attributed to their enclosing method
+    (a closure over self still mutates the instance)."""
+    for item in cls_node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(item):
+                yield item.name, sub
+
+
+def scan_attrs(cls_node):
+    """{attr: [(line, col, method)]} over every `self.X` ASSIGNMENT
+    target in the class body: Assign (incl. tuple targets), AugAssign,
+    AnnAssign, plus `for self.X in ...` and `with ... as self.X` — the
+    complete inventory of instance state this class creates."""
+    out = {}
+
+    def hit(node, method):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Store):
+            out.setdefault(attr, []).append(
+                (node.lineno, node.col_offset, method))
+
+    def targets_of(stmt):
+        if isinstance(stmt, ast.Assign):
+            return stmt.targets
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.target]
+        if isinstance(stmt, ast.For):
+            return [stmt.target]
+        return []
+
+    for method, stmt in _walk_methods(cls_node):
+        for t in targets_of(stmt):
+            for node in ast.walk(t):
+                hit(node, method)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    for node in ast.walk(item.optional_vars):
+                        hit(node, method)
+    for sites in out.values():
+        sites.sort()
+    return out
+
+
+def scan_loads(cls_node, methods):
+    """{attr} of every `self.X` LOAD inside the named methods — the
+    config-identity evidence ST005 reads out of `_geometry()` and
+    friends."""
+    out = set()
+    for item in cls_node.body:
+        if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and item.name in methods):
+            for node in ast.walk(item):
+                attr = _self_attr(node)
+                if attr is not None and isinstance(node.ctx, ast.Load):
+                    out.add(attr)
+    return out
+
+
+def scan_mutations(cls_node, guarded):
+    """[(attr, line, method, held_locks)] for every mutation site of a
+    guarded attr: rebinds (`self.X = / +=`), subscript stores and
+    deletes (`self.X[k] = / del self.X[k]`), and in-place mutator
+    calls (`self.X.append(...)`). `held_locks` is the frozenset of
+    self.<lock> attributes whose `with` blocks lexically enclose the
+    site — what ST006 compares against the declared lock."""
+    sites = []
+
+    def visit(node, method, locks):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            held = set(locks)
+            for item in node.items:
+                lock = _self_attr(item.context_expr)
+                if lock is not None:
+                    held.add(lock)
+            for child in ast.iter_child_nodes(node):
+                visit(child, method, frozenset(held))
+            return
+        tgts = []
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [node.target]
+        elif isinstance(node, ast.Delete):
+            tgts = node.targets
+        for t in tgts:
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            if attr in guarded:
+                sites.append((attr, t.lineno, method, locks))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in MUTATORS):
+                attr = _self_attr(f.value)
+                if attr in guarded:
+                    sites.append((attr, node.lineno, method, locks))
+        for child in ast.iter_child_nodes(node):
+            visit(child, method, locks)
+
+    for item in cls_node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in item.body:
+                visit(stmt, item.name, frozenset())
+    return sites
+
+
+def _method(cls_node, name):
+    for item in cls_node.body:
+        if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and item.name == name):
+            return item
+    return None
+
+
+def roundtrip_io(cls_node, rt):
+    """(writes, required_reads, optional_reads) for one RoundTrip —
+    all sets of string keys, or None when either method is missing
+    (the caller turns that into a violation, not a silent pass).
+
+    Writes: string keys of the writer's wire dict literal(s) —
+    identified by `rt.marker` when given, every dict literal plus
+    string-constant subscript stores when marker is None (the
+    subclass-override style). Reads: `param['k']` subscripts are
+    REQUIRED (a missing key raises at restore time), `param.get('k')`
+    calls are OPTIONAL (back-compat defaults)."""
+    writer = _method(cls_node, rt.writer)
+    reader = _method(cls_node, rt.reader)
+    if writer is None or reader is None:
+        return None
+
+    writes = set()
+    for node in ast.walk(writer):
+        if isinstance(node, ast.Dict):
+            keys = [k.value for k in node.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if rt.marker is None or rt.marker in keys:
+                writes.update(keys)
+        if rt.marker is None and isinstance(node, (ast.Assign,
+                                                   ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in tgts:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    writes.add(t.slice.value)
+
+    required, optional = set(), set()
+    for node in ast.walk(reader):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == rt.param
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            required.add(node.slice.value)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'get'
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == rt.param
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            optional.add(node.args[0].value)
+    return writes, required, optional
+
+
+# ---------------------------------------------------------------------------
+# Context + rule base
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StateContext:
+    """Everything the ST rules read for one registered class."""
+
+    decl: ClassDecl
+    path: str                 # repo-relative source path (anchor)
+    line: int                 # ClassDef line
+    attrs: dict               # scanned {attr: [(line, col, method)]}
+    merged: dict              # decl.attrs with inherited attrs underneath
+    mutations: list           # scan_mutations over decl.locks keys
+    geometry_loads: set       # scan_loads over decl.geometry_methods
+    roundtrips: list          # [(RoundTrip, io-or-None)]
+    schemas: dict             # wire -> set(keys); None when live failed
+    structural: dict          # wire -> {key: note} (registry structural)
+    claimed: dict             # wire -> set(keys) claimed by ANY decl
+
+
+class StateRule:
+    """Base class mirroring its siblings over a StateContext."""
+
+    id = 'ST000'
+    name = 'abstract'
+    severity = 'error'
+    description = ''
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def violation(self, ctx, message, line=None, severity=None):
+        return Violation(
+            path=ctx.path,
+            line=line if line is not None else ctx.line,
+            col=0,
+            rule=self.id,
+            severity=severity or self.severity,
+            message=f'[{ctx.decl.name}] {message}',
+        )
+
+
+# ---------------------------------------------------------------------------
+# Lint loop
+# ---------------------------------------------------------------------------
+
+def _validate(decls):
+    """Registry misconfigurations raise ValueError (rc 2 at the CLI —
+    a broken declaration must never read as a clean run)."""
+    by_name = {}
+    for decl in decls:
+        if decl.name in by_name:
+            raise ValueError(f'duplicate class declaration {decl.name}')
+        by_name[decl.name] = decl
+        for attr, a in decl.attrs.items():
+            if a.kind not in KINDS:
+                raise ValueError(
+                    f'{decl.name}.{attr}: unknown kind {a.kind!r} '
+                    f'(one of {KINDS})')
+            if a.kind == 'ephemeral' and not (isinstance(a.reason, str)
+                                              and a.reason.strip()):
+                raise ValueError(
+                    f'{decl.name}.{attr}: ephemeral needs a non-empty '
+                    f'reason — say why losing this state is correct')
+            if a.kind == 'persisted' and not a.claims:
+                raise ValueError(
+                    f'{decl.name}.{attr}: persisted needs at least one '
+                    f'(wire, key) claim')
+        for table, what in ((decl.suppress, 'suppression'),
+                            (decl.lock_free, 'lock-free declaration'),
+                            (decl.roundtrip_ok, 'round-trip exemption')):
+            for key, reason in table.items():
+                if not (isinstance(reason, str) and reason.strip()):
+                    raise ValueError(
+                        f'{decl.name}: {what} of {key!r} must carry a '
+                        f'non-empty reason')
+    for decl in decls:
+        if decl.inherit is not None and decl.inherit not in by_name:
+            raise ValueError(
+                f'{decl.name}: inherit={decl.inherit!r} is not a '
+                f'declared class')
+    return by_name
+
+
+def _merged_attrs(decl, by_name):
+    merged = {}
+    seen = set()
+    cur = decl
+    chain = []
+    while cur is not None:
+        if cur.name in seen:
+            raise ValueError(f'inheritance cycle at {cur.name}')
+        seen.add(cur.name)
+        chain.append(cur)
+        cur = by_name.get(cur.inherit) if cur.inherit else None
+    for d in reversed(chain):        # parent first, child overrides
+        merged.update(d.attrs)
+    return merged
+
+
+def _claims_map(decls, structural):
+    """wire -> set(keys) claimed by any declaration (attr claims of
+    every kind, config-identity claims, plus the registry's structural
+    keys) — ST003's 'documented' set."""
+    claimed = {wire: set(keys) for wire, keys in structural.items()}
+    for decl in decls:
+        for a in decl.attrs.values():
+            for wire, key in a.claims:
+                claimed.setdefault(wire, set()).add(key)
+        for pairs in decl.config_identity.values():
+            for wire, key in pairs:
+                claimed.setdefault(wire, set()).add(key)
+    return claimed
+
+
+def trace_decl(decl, by_name, tree_cache, schemas, structural, claimed,
+               root=None):
+    """StateContext for one declaration. Parse/lookup failures
+    propagate — lint_and_report turns them into ST000 violations."""
+    absolute, rel = decl.resolve(root=root)
+    tree = tree_cache.get(absolute)
+    if tree is None:
+        with open(absolute, encoding='utf-8') as f:
+            tree = ast.parse(f.read(), filename=absolute)
+        tree_cache[absolute] = tree
+    cls_node = _find_class(tree, decl.cls)
+    if cls_node is None:
+        raise LookupError(f'class {decl.cls} not found in {rel}')
+    return StateContext(
+        decl=decl,
+        path=rel,
+        line=cls_node.lineno,
+        attrs=scan_attrs(cls_node),
+        merged=_merged_attrs(decl, by_name),
+        mutations=(scan_mutations(cls_node, set(decl.locks))
+                   if decl.locks else []),
+        geometry_loads=(scan_loads(cls_node, decl.geometry_methods)
+                        if decl.geometry_methods else set()),
+        roundtrips=[(rt, roundtrip_io(cls_node, rt))
+                    for rt in decl.roundtrips],
+        schemas=schemas,
+        structural=structural,
+        claimed=claimed,
+    )
+
+
+def lint_and_report(entries, rules=None, root=None, schemas=None):
+    """Run every ST rule over every declared class, extracting the
+    live wire schemas ONCE.
+
+    Returns (violations, suppressed, detail): `suppressed` pairs each
+    registry-suppressed Violation with its reason, and `detail` is the
+    per-class coverage census bench.py stamps — {'live': bool,
+    'classes': {name: {kind: count, ...}}, 'wires': {wire: n_keys}}.
+    `schemas` injects pre-extracted wire schemas (tests); by default
+    live.live_schemas() builds tiny CPU engines, and a failure there
+    is an ST000 ERROR on the registry (never a silent pass) with the
+    pure-AST rules still running."""
+    from .registry import WIRE_STRUCTURAL
+
+    if rules is None:
+        from .rules import all_rules
+
+        rules = all_rules()
+    entries = list(entries)
+    by_name = _validate(entries)
+    structural = {w: dict(keys) for w, keys in WIRE_STRUCTURAL.items()}
+    claimed = _claims_map(entries, structural)
+
+    violations, suppressed = [], []
+    if schemas is None:
+        from . import live
+
+        try:
+            schemas = live.live_schemas()
+        except Exception as e:  # noqa: BLE001 - any failure is a finding
+            schemas = None
+            violations.append(Violation(
+                path='paddle_tpu/analysis/state/registry.py', line=1,
+                col=0, rule='ST000', severity='error',
+                message=f'live schema extraction failed — ST002/ST003/'
+                        f'ST005 did not run: {type(e).__name__}: {e}'))
+    if schemas is not None:
+        schemas = {w: set(keys) for w, keys in schemas.items()}
+
+    detail = {'live': schemas is not None, 'classes': {},
+              'wires': ({w: len(k) for w, k in sorted(schemas.items())}
+                        if schemas is not None else None)}
+    tree_cache = {}
+    for decl in entries:
+        try:
+            ctx = trace_decl(decl, by_name, tree_cache, schemas,
+                             structural, claimed, root=root)
+        except Exception as e:  # noqa: BLE001 - any failure is a finding
+            detail['classes'][decl.name] = None
+            violations.append(Violation(
+                path=decl.path, line=1, col=0, rule='ST000',
+                severity='error',
+                message=f'[{decl.name}] declaration failed to resolve: '
+                        f'{type(e).__name__}: {e}'))
+            continue
+        census = {'attrs': len(ctx.attrs), 'unclassified': 0}
+        for kind in KINDS:
+            census[kind] = 0
+        for attr in ctx.attrs:
+            a = ctx.merged.get(attr)
+            if a is None:
+                census['unclassified'] += 1
+            else:
+                census[a.kind] += 1
+        detail['classes'][decl.name] = census
+        for rule in rules:
+            for v in rule.check(ctx):
+                if v.rule in decl.suppress:
+                    suppressed.append((v, decl.suppress[v.rule]))
+                else:
+                    violations.append(v)
+    return sorted(violations), suppressed, detail
+
+
+def lint_entries(entries, rules=None, root=None, schemas=None):
+    """(violations, suppressed) — see lint_and_report."""
+    violations, suppressed, _ = lint_and_report(
+        entries, rules=rules, root=root, schemas=schemas)
+    return violations, suppressed

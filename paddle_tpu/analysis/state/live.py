@@ -1,0 +1,82 @@
+"""Live wire-schema extraction for statelint.
+
+The registry's `persisted` claims are proven against the ACTUAL wire
+dicts, not against what the registry wishes they were: tiny CPU
+engines are instantiated here and their snapshot()/record/blob/
+aot_config dicts read directly. A claim that names a key the real
+wire stopped carrying is an ST002 error the moment the wire changes —
+the declaration cannot drift from the implementation, because the
+implementation is consulted every run.
+
+Everything runs on CPU (the bench gate launches this under
+JAX_PLATFORMS=cpu in a subprocess, like hlolint's artifact builds)
+with the same tiny-llama geometry the tier-1 serving tests use. jax
+is imported lazily so `import paddle_tpu.analysis.state` stays
+stdlib-only for the pure-AST rules.
+"""
+from __future__ import annotations
+
+# the tiny geometry the tier-1 serving tests use — small enough that
+# one prefill + one window step compiles in seconds on CPU
+_ENGINE_KW = dict(max_slots=3, block_size=8, max_new_tokens=8,
+                  eos_token_id=None, decode_window=2,
+                  max_context_len=64)
+
+
+def _tiny_model():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=96, hidden_size=64, layers=2, heads=4, kv_heads=2,
+        max_pos=256))
+
+
+def live_schemas():
+    """{wire: sorted list of top-level keys} for every wire format the
+    registry claims against — read from real objects. Raises on ANY
+    failure (the engine turns that into an ST000 error; a build
+    failure must never read as a clean run)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.disagg import DisaggPair, PrefillEngine
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.observability.watchdog import (Watchdog,
+                                                   default_serving_rules)
+    from paddle_tpu.training.engine import TrainEngine
+
+    pt.seed(0)
+    model = _tiny_model()
+    wires = {}
+
+    eng = ServingEngine(model, **_ENGINE_KW)
+    try:
+        rid = eng.submit(np.arange(1, 9, dtype=np.int32))
+        eng.step()                       # prefill + first committed token
+        snap = eng.snapshot()
+        wires['snapshot'] = sorted(snap)
+        wires['request'] = sorted(snap['requests'][0])
+        wires['snapshot_config'] = sorted(eng._snapshot_config())
+        wires['aot_config'] = sorted(eng.aot_config())
+        wires['blob'] = sorted(eng.export_kv(rid))
+    finally:
+        eng.close()
+
+    wd = Watchdog(default_serving_rules())
+    wires['watchdog'] = sorted(wd.snapshot_state())
+
+    # the disagg wires: snapshot keys exist on fresh engines — no
+    # traffic needed, construction alone proves the dict shapes
+    pre = PrefillEngine(model, **_ENGINE_KW)
+    dec = ServingEngine(model, phase_role='decode', **_ENGINE_KW)
+    try:
+        pair = DisaggPair(pre, dec)
+        wires['prefill_snapshot'] = sorted(pre.snapshot())
+        wires['pair_snapshot'] = sorted(pair.snapshot())
+    finally:
+        pre.close()
+        dec.close()
+
+    tr = TrainEngine(_tiny_model())
+    wires['train_aot_config'] = sorted(tr.aot_config())
+    return wires

@@ -1,0 +1,41 @@
+"""statelint rule registry (same pattern as its four siblings').
+
+Rules self-register via `@register`; importing this package pulls in
+every `st*.py` module.  `all_rules()` returns fresh instances sorted
+by id, `get_rule('ST001')` one of them.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: adds a StateRule subclass to the registry."""
+    if cls.id in _REGISTRY:
+        raise ValueError(f'duplicate rule id {cls.id}')
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules(select=None):
+    """Instances of every registered rule (or the `select` subset),
+    sorted by id."""
+    ids = sorted(_REGISTRY)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise KeyError(f'unknown rule id(s): {sorted(unknown)}')
+        ids = sorted(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]()
+
+
+from . import st001_unclassified          # noqa: E402,F401
+from . import st002_dropped_state         # noqa: E402,F401
+from . import st003_unclaimed_key         # noqa: E402,F401
+from . import st004_asymmetric_roundtrip  # noqa: E402,F401
+from . import st005_config_identity       # noqa: E402,F401
+from . import st006_unlocked_mutation     # noqa: E402,F401

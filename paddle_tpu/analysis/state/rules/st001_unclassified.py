@@ -1,0 +1,58 @@
+"""ST001 — every mutable attribute must carry a classification.
+
+This is statelint's ratchet, the state-coverage analogue of
+tracelint's TL001: the AST scan is ground truth for what instance
+state EXISTS (`self.X = ...` anywhere in the class), and an attribute
+the registry does not classify is an attribute nobody has answered
+the snapshot question for. PR 8-16 each lost at least one review
+round to exactly this — `_tokens_out`, the drain flag, breach
+indices, `spec_next` — all mutable state that silently sat outside
+snapshot()/restore() until a human noticed. With the ratchet, adding
+`self._new_counter = 0` to the ServingEngine FAILS the lint until its
+author declares what it is: persisted (and on which wire), rebuilt,
+device-rederived, or ephemeral WITH the reason losing it is correct.
+
+The inverse drift is flagged too, at warning severity: a declared
+attribute the class no longer assigns is a stale declaration — dead
+registry weight that misdocuments the class.
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class Unclassified(StateRule):
+    id = 'ST001'
+    name = 'unclassified-attribute'
+    severity = 'error'
+    description = ('every scanned `self.X = ...` attribute must be '
+                   'classified in the registry (persisted / '
+                   'derived-rebuilt / device-rederived / ephemeral '
+                   'with reason); declared-but-never-assigned '
+                   'attributes warn as stale.')
+
+    def check(self, ctx):
+        for attr in sorted(ctx.attrs):
+            if attr in ctx.merged:
+                continue
+            line, _col, method = ctx.attrs[attr][0]
+            yield self.violation(
+                ctx,
+                f'mutable attribute self.{attr} (first assigned in '
+                f'{method}(), line {line}) has no classification — '
+                f'declare it in analysis/state/registry.py: persisted '
+                f'(naming the wire+key it rides), derived-rebuilt, '
+                f'device-rederived, or ephemeral with the reason '
+                f'losing it across snapshot/restore is correct',
+                line=line)
+        for attr in sorted(ctx.decl.attrs):
+            if attr not in ctx.attrs:
+                yield self.violation(
+                    ctx,
+                    f'declared attribute self.{attr} is never assigned '
+                    f'in class {ctx.decl.cls} — stale declaration; '
+                    f'drop it from the registry (or move it to the '
+                    f'class that owns it)',
+                    severity='warning')

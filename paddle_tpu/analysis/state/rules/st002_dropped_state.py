@@ -1,0 +1,56 @@
+"""ST002 — every wire claim must hold on the LIVE wire.
+
+A `persisted` declaration is a promise: "this attribute's state rides
+snapshot key 'counts'" (or a blob field, or a refusal-set entry). The
+promise is only worth anything if it is re-checked against the actual
+dict the running code builds — otherwise the registry drifts the
+first time someone renames a snapshot key or drops a field from
+aot_config, and statelint degrades into documentation. So live.py
+instantiates tiny CPU engines, reads the real snapshot()/record/blob/
+aot_config dicts, and this rule diffs every claim against them: a
+claim naming a key the wire does not carry is an ERROR — either the
+wire silently dropped state (the PR-16 hardening class: lifetime
+counters missing from snapshot) or the registry is wrong, and both
+need a human.
+
+Claims are checked on the declaring class only (inherited attributes
+are the parent declaration's problem — one claim, one report).
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class DroppedState(StateRule):
+    id = 'ST002'
+    name = 'dropped-state'
+    severity = 'error'
+    description = ('a registry claim names (wire, key); the key must '
+                   'exist on the live wire dict — a missing key means '
+                   'the wire silently dropped declared state (or the '
+                   'registry drifted).')
+
+    def check(self, ctx):
+        if ctx.schemas is None:
+            return  # ST000 already reported the live failure
+        for attr in sorted(ctx.decl.attrs):
+            a = ctx.decl.attrs[attr]
+            for wire, key in a.claims:
+                keys = ctx.schemas.get(wire)
+                if keys is None:
+                    yield self.violation(
+                        ctx,
+                        f'self.{attr} claims unknown wire {wire!r} '
+                        f'(live wires: '
+                        f'{sorted(ctx.schemas)}) — fix the claim or '
+                        f'teach analysis/state/live.py the new wire')
+                elif key not in keys:
+                    yield self.violation(
+                        ctx,
+                        f'self.{attr} is declared {a.kind} riding '
+                        f'{wire}[{key!r}], but the live {wire} dict '
+                        f'has no such key — the wire dropped this '
+                        f'state (a restored/attached replica silently '
+                        f'loses it), or the claim is stale')

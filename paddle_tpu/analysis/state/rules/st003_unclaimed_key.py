@@ -1,0 +1,62 @@
+"""ST003 — every live wire key must be claimed by something.
+
+The inverse of ST002, at warning severity: a key the real snapshot()
+(or blob, or refusal set) carries that NO declaration claims — not an
+attribute claim, not a config-identity entry, not a registry
+structural key — is a dead or orphaned field. Either the state it
+once carried moved (and the writer kept emitting it, bloating every
+snapshot), or a new field landed without a registry entry (so nothing
+will notice when it later breaks). Warning, not error: an extra wire
+key loses no state — but it is exactly how wire formats rot.
+
+Reported once per wire by the wire's OWNING declaration (the class
+whose method builds the dict), so a dead snapshot key does not repeat
+across the fourteen registered classes. Subclass wires fold their
+base wire's claims in first (WIRE_EXTENDS): PrefillEngine.snapshot()
+legitimately carries every base-snapshot key.
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class UnclaimedKey(StateRule):
+    id = 'ST003'
+    name = 'unclaimed-wire-key'
+    severity = 'warning'
+    description = ('a key on a live wire dict that no declaration '
+                   'claims (attribute, config-identity, or structural) '
+                   'is a dead field — dropped state nobody will miss, '
+                   'or a new field nobody registered.')
+
+    def check(self, ctx):
+        if ctx.schemas is None:
+            return  # ST000 already reported the live failure
+        from ..registry import WIRE_EXTENDS
+
+        for wire in ctx.decl.owns_wires:
+            keys = ctx.schemas.get(wire)
+            if keys is None:
+                yield self.violation(
+                    ctx,
+                    f'declaration owns wire {wire!r} but live '
+                    f'extraction produced no such wire (live wires: '
+                    f'{sorted(ctx.schemas)}) — teach '
+                    f'analysis/state/live.py to build it',
+                    severity='error')
+                continue
+            claimed = set(ctx.claimed.get(wire, ()))
+            base = WIRE_EXTENDS.get(wire)
+            while base is not None:
+                claimed |= set(ctx.claimed.get(base, ()))
+                base = WIRE_EXTENDS.get(base)
+            for key in sorted(set(keys) - claimed):
+                yield self.violation(
+                    ctx,
+                    f'live {wire} dict carries key {key!r} that no '
+                    f'declaration claims — dead field, or new state '
+                    f'missing its registry entry (claim it from the '
+                    f'attribute that backs it, or add it to '
+                    f'WIRE_STRUCTURAL with a note)')

@@ -1,0 +1,78 @@
+"""ST004 — writer/reader wire pairs must be symmetric.
+
+snapshot() and restore() are one contract split across two functions,
+and nothing but discipline keeps them agreeing: a key snapshot()
+writes that restore() never reads is state that rides every wire and
+silently dies on arrival (the write side of the PR-16 class), and a
+key restore() REQUIRES (bare `snap['k']` subscript) that snapshot()
+never writes is a restore that crashes on every genuine snapshot —
+both invisible until a failover actually happens.
+
+The engine extracts both halves from the AST: writer keys from the
+wire dict literal (identified by its marker key, or — subclass-
+override style — from string-subscript stores onto super()'s dict),
+reader keys split into REQUIRED (`param['k']`, raises when absent)
+and OPTIONAL (`param.get('k')`, the schema-1-compatible back-compat
+idiom). Errors:
+
+  - required read of a never-written key (restore crashes on real
+    snapshots),
+  - written key never read, required or optional (dead freight —
+    unless the registry declares the asymmetry in `roundtrip_ok`
+    with a reason, e.g. the blob's informational `block_size`).
+
+An optional read of a never-written key is legal BY DESIGN: that is
+exactly what reading an older snapshot's missing key looks like.
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class AsymmetricRoundtrip(StateRule):
+    id = 'ST004'
+    name = 'asymmetric-roundtrip'
+    severity = 'error'
+    description = ('each declared writer/reader pair (snapshot/restore, '
+                   'export_kv/import_kv, record/rebuild) must agree on '
+                   'its keys: required-read-never-written and '
+                   'written-never-read are both errors unless declared '
+                   'in roundtrip_ok with a reason.')
+
+    def check(self, ctx):
+        for rt, io in ctx.roundtrips:
+            pair = f'{rt.writer}()/{rt.reader}()'
+            if io is None:
+                yield self.violation(
+                    ctx,
+                    f'declared round-trip {pair} — method not found in '
+                    f'class {ctx.decl.cls}; fix the RoundTrip '
+                    f'declaration')
+                continue
+            writes, required, optional = io
+            if not writes:
+                yield self.violation(
+                    ctx,
+                    f'{pair}: no writer keys found '
+                    f'(marker={rt.marker!r}) — the wire dict literal '
+                    f'moved; fix the RoundTrip marker')
+                continue
+            for key in sorted(required - writes):
+                yield self.violation(
+                    ctx,
+                    f"{pair}: {rt.reader}() REQUIRES {rt.param}"
+                    f"[{key!r}] but {rt.writer}() never writes that "
+                    f'key — restore crashes on every genuine '
+                    f'{rt.writer}() dict')
+            for key in sorted(writes - required - optional):
+                if key in ctx.decl.roundtrip_ok:
+                    continue
+                yield self.violation(
+                    ctx,
+                    f'{pair}: {rt.writer}() writes key {key!r} that '
+                    f'{rt.reader}() never reads — state rides the '
+                    f'wire and silently dies on arrival; read it, '
+                    f'stop writing it, or declare the asymmetry in '
+                    f'roundtrip_ok with a reason')

@@ -1,0 +1,78 @@
+"""ST005 — config-identity fields must sit in the refusal sets.
+
+An AOT artifact's executables close over the engine's compile
+geometry; attaching one across a geometry change must REFUSE
+(ArtifactMismatch names the field), and the refusal set is
+aot_config() — a dict someone has to remember to extend every time a
+new knob becomes compilation-relevant. The repo's history is the
+argument for automating it: speculative decoding added num_draft_
+tokens/draft_struct, int8 pools added kv_cache_dtype, tensor
+parallelism added tp, SPMD training added mesh — each one a review
+catch, because forgetting it does not fail; it ATTACHES, then
+miscompiles or silently serves with the wrong geometry.
+
+Evidence is read from the engine itself: `_geometry()` and
+`_sampling_key()` are the tuples compiled executables are keyed by,
+so every `self.X` the AST finds LOADED there is by construction
+compilation-relevant. The registry must map each such attribute to
+the refusal-set key(s) carrying its identity (config_identity), and
+each mapped key must exist on the live refusal wire. Two failure
+modes, both errors:
+
+  - a geometry-method load with no config_identity entry (a new knob
+    entered the dispatch key without entering the refusal contract),
+  - a config_identity claim naming a key the live aot_config /
+    snapshot_config no longer carries (the refusal set dropped it).
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class ConfigIdentity(StateRule):
+    id = 'ST005'
+    name = 'config-identity'
+    severity = 'error'
+    description = ('every attribute loaded in the geometry/sampling-key '
+                   'methods must map (via config_identity) to live '
+                   'refusal-set keys — a knob that keys compiled '
+                   'executables but is absent from aot_config attaches '
+                   'across geometry changes instead of refusing.')
+
+    def check(self, ctx):
+        decl = ctx.decl
+        if not decl.geometry_methods:
+            return
+        for attr in sorted(ctx.geometry_loads):
+            if attr in decl.config_identity:
+                continue
+            yield self.violation(
+                ctx,
+                f'self.{attr} is loaded in '
+                f'{"/".join(decl.geometry_methods)} — it keys compiled '
+                f'executables — but has no config_identity entry: map '
+                f'it to the aot_config/_snapshot_config key(s) that '
+                f'carry its identity, or the artifact refusal check '
+                f'cannot see it change')
+        if ctx.schemas is None:
+            return  # ST000 already reported the live failure
+        for attr in sorted(decl.config_identity):
+            for wire, key in decl.config_identity[attr]:
+                keys = ctx.schemas.get(wire)
+                if keys is None:
+                    yield self.violation(
+                        ctx,
+                        f'config_identity of self.{attr} names unknown '
+                        f'wire {wire!r} (live wires: '
+                        f'{sorted(ctx.schemas)})')
+                elif key not in keys:
+                    yield self.violation(
+                        ctx,
+                        f'config_identity: self.{attr} rides '
+                        f'{wire}[{key!r}], but the live {wire} dict '
+                        f'has no such key — the refusal set dropped a '
+                        f'compilation-relevant field; an artifact '
+                        f'built under a different {attr} now ATTACHES '
+                        f'instead of refusing')

@@ -1,0 +1,62 @@
+"""ST006 — thread-shared structures mutate only under their lock.
+
+The PR-14 scrape-race class: the ops server's metrics scrape iterates
+the registry on its own thread while the scheduler commits samples —
+"RuntimeError: dictionary changed size during iteration", seen maybe
+once per thousand scrapes and never in a unit test. The fix was a
+lock; this rule is what keeps the lock HELD as the code grows.
+
+The registry declares, per class, which attributes are thread-shared
+and which lock guards each (`locks={'_metrics': '_lock'}`). The
+engine then finds every mutation site of a guarded attribute —
+rebinds (`self.x =`/`+=`), subscript stores and deletes, and in-place
+mutator calls (append/update/pop/...) — and records which
+`with self.<lock>:` blocks lexically enclose it. A mutation outside
+its declared lock is an error, with two declared escapes (both
+carrying mandatory reasons, both visible in the registry diff):
+
+  - `__init__` is exempt (no second thread can hold a reference
+    during construction),
+  - `lock_free={'method': reason}` exempts a named method — e.g. a
+    helper only ever called from under the lock, where the lexical
+    analysis cannot see the caller's `with` (marked explicitly so a
+    NEW unlocked caller is a reviewable registry change, not a silent
+    race).
+"""
+from __future__ import annotations
+
+from ..engine import StateRule
+from . import register
+
+
+@register
+class UnlockedMutation(StateRule):
+    id = 'ST006'
+    name = 'unlocked-mutation'
+    severity = 'error'
+    description = ('declared thread-shared attributes (registry locks=) '
+                   'may only be mutated inside `with self.<lock>:` — '
+                   'outside __init__ and declared lock_free methods, an '
+                   'unlocked mutation is the scrape-race class.')
+
+    def check(self, ctx):
+        decl = ctx.decl
+        for attr, line, method, held in ctx.mutations:
+            if method == '__init__':
+                continue
+            if '*' in decl.lock_free or method in decl.lock_free:
+                continue
+            lock = decl.locks[attr]
+            if lock in held:
+                continue
+            yield self.violation(
+                ctx,
+                f'self.{attr} is declared thread-shared (guarded by '
+                f'self.{lock}) but {method}() line {line} mutates it '
+                f'outside any `with self.{lock}:` block'
+                + (f' (locks held: '
+                   f'{", ".join("self." + h for h in sorted(held))})'
+                   if held else '')
+                + ' — the scrape-race class: hold the lock, or declare '
+                  'the method in lock_free with the reason it is safe',
+                line=line)

@@ -1,0 +1,34 @@
+"""The ONE place the snapshot / KV-migration wire versions live.
+
+`ServingEngine.snapshot()` / `restore()`, `export_kv` / `import_kv`,
+and `pack_kv_blob` / `unpack_kv_blob` used to each carry their own
+literal `1` — four writers and four readers that had to drift together
+by reviewer discipline. They all import from here now, and statelint
+(analysis/state) reads the same constants for its ST003/ST004 wire
+checks, so a version bump is one edit that every producer, consumer,
+and prover sees at once.
+
+Bumping a version is a WIRE change: old snapshots/blobs refuse to load
+by design (the readers name the version they got vs the one they
+read). Schema-1-compatible additions — new optional keys read with
+`.get()` defaults, like 'draining' or a watchdog's 'last_window_idx' —
+do NOT bump these; that forward-compatibility contract is what keeps a
+rolling fleet upgrade from stranding every in-flight snapshot.
+"""
+from __future__ import annotations
+
+# ServingEngine.snapshot()/restore() top-level schema, ALSO the schema
+# of an export_kv blob dict (one versioning story: a blob survives
+# exactly the process boundaries a snapshot does), a Watchdog's
+# snapshot_state(), and a DisaggPair's composed pair snapshot.
+SNAPSHOT_SCHEMA = 1
+
+# the 'kind' tag distinguishing a KV-migration blob dict from a full
+# engine snapshot (both carry SNAPSHOT_SCHEMA)
+KV_BLOB_KIND = 'kv_migration'
+
+# pack_kv_blob / unpack_kv_blob byte framing: 4-byte preamble magic,
+# JSON header magic string, and the header's own version field
+PTKV_MAGIC = b'PTKV'
+PTKV_HEADER_MAGIC = 'paddle_tpu.kv_migration'
+PTKV_VERSION = 1

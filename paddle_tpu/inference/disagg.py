@@ -46,18 +46,21 @@ on either pool).
 """
 from __future__ import annotations
 
+import base64
 import json
 import struct
 
 import numpy as np
 
 from ..observability import metrics as _obs
+from ._schema import (PTKV_HEADER_MAGIC, PTKV_MAGIC, PTKV_VERSION,
+                      SNAPSHOT_SCHEMA)
 from .serving import OutOfBlocks, QueueFull, ServingEngine
 
 __all__ = ['PrefillEngine', 'DisaggPair', 'pack_kv_blob',
            'unpack_kv_blob']
 
-_MAGIC = b'PTKV'
+_MAGIC = PTKV_MAGIC
 
 
 def pack_kv_blob(blob):
@@ -77,8 +80,8 @@ def pack_kv_blob(blob):
                               'field': field, 'shape': list(a.shape),
                               'dtype': str(a.dtype)})
                 arrays.append(a)
-    head = json.dumps({'magic': 'paddle_tpu.kv_migration',
-                       'version': 1, 'meta': meta,
+    head = json.dumps({'magic': PTKV_HEADER_MAGIC,
+                       'version': PTKV_VERSION, 'meta': meta,
                        'arrays': specs}).encode('utf-8')
     out = [_MAGIC, struct.pack('<I', len(head)), head]
     out.extend(a.tobytes() for a in arrays)
@@ -110,12 +113,13 @@ def unpack_kv_blob(data):
     except ValueError as e:
         raise ValueError(
             f'corrupt KV migration blob header: {e}') from None
-    if head.get('magic') != 'paddle_tpu.kv_migration':
+    if head.get('magic') != PTKV_HEADER_MAGIC:
         raise ValueError(
             f"not a packed KV migration blob: {head.get('magic')!r}")
-    if head.get('version') != 1:
+    if head.get('version') != PTKV_VERSION:
         raise ValueError(
-            f"unsupported packed-blob version {head.get('version')!r}")
+            f"unsupported packed-blob version {head.get('version')!r} "
+            f'(this reader unpacks version {PTKV_VERSION})')
     specs = head.get('arrays')
     if not isinstance(specs, list) or not isinstance(head.get('meta'),
                                                      dict):
@@ -217,6 +221,33 @@ class PrefillEngine(ServingEngine):
         a `handoff_sink` consumes them at the sweep)."""
         out, self._handoffs = self._handoffs, []
         return out
+
+    def snapshot(self):
+        """The base snapshot plus the completed-but-unferried handoff
+        blobs. A handed-off request has already LEFT this engine's
+        registries (retired as 'migrated' at the sweep) — its exported
+        blob sitting in `_handoffs` is the only record it exists, so a
+        snapshot without it would silently drop the stream on a crash
+        between sweep and ferry. Blobs ride packed + base64 so the
+        snapshot stays one JSON-able dict (schema-1 compatible: the
+        key is absent only from pre-handoff snapshots, and the base
+        restore ignores keys it does not read)."""
+        snap = super().snapshot()
+        snap['handoffs'] = [
+            base64.b64encode(pack_kv_blob(b)).decode('ascii')
+            for b in self._handoffs]
+        return snap
+
+    def restore(self, snap):
+        """Base restore, then re-materialize the unferried handoff
+        blobs — `take_handoffs()` (or the DisaggPair ferry) picks them
+        up exactly where the crashed engine left them."""
+        report = super().restore(snap)
+        for packed in snap.get('handoffs') or []:
+            self._handoffs.append(
+                unpack_kv_blob(base64.b64decode(packed)))
+        report['handoffs'] = len(snap.get('handoffs') or [])
+        return report
 
 
 class DisaggPair:
@@ -353,6 +384,57 @@ class DisaggPair:
         in-flight work — including pending handoffs — completes)."""
         self.prefill.draining = bool(on)
         self.decode.draining = bool(on)
+
+    # -- crash-safe warm restart across the pair ---------------------------
+
+    def snapshot(self):
+        """Both pools' snapshots plus the ferry state BETWEEN them:
+        blobs awaiting decode-pool room (packed + base64, like the
+        prefill engine's own unferried handoffs) and the permanently
+        failed placements. Without the ferry section, a crash between
+        handoff and import silently drops every in-transit stream —
+        neither pool's snapshot knows it exists."""
+        return {
+            'schema': SNAPSHOT_SCHEMA,
+            'prefill': self.prefill.snapshot(),
+            'decode': self.decode.snapshot(),
+            'pending': [
+                base64.b64encode(pack_kv_blob(b)).decode('ascii')
+                for b in self._pending],
+            'failed': {str(rid): repr(e)
+                       for rid, e in self._failed.items()},
+        }
+
+    def restore(self, snap):
+        """Load a pair `snapshot()` into a FRESH pair (both engines
+        fresh — the per-engine restores enforce it). In-transit blobs
+        resume ferrying on the next step; failed placements re-raise
+        at `result(rid)` (as RuntimeError carrying the original
+        error's repr — the exception OBJECT does not cross a process
+        boundary). Raises ValueError naming missing keys or any
+        per-engine config mismatch. Returns a report dict."""
+        if snap.get('schema') != SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"unsupported pair snapshot schema "
+                f"{snap.get('schema')!r} (this pair reads schema "
+                f'{SNAPSHOT_SCHEMA})')
+        missing = sorted(k for k in ('prefill', 'decode')
+                         if k not in snap)
+        if missing:
+            raise ValueError(
+                f'pair snapshot missing required key(s) {missing}: '
+                f'not a DisaggPair.snapshot() dict')
+        report = {'prefill': self.prefill.restore(snap['prefill']),
+                  'decode': self.decode.restore(snap['decode'])}
+        self._pending = [
+            unpack_kv_blob(base64.b64decode(packed))
+            for packed in snap.get('pending') or []]
+        self._failed = {int(rid): RuntimeError(msg)
+                        for rid, msg in (snap.get('failed')
+                                         or {}).items()}
+        report['pending'] = len(self._pending)
+        report['failed'] = len(self._failed)
+        return report
 
     def close(self):
         self.prefill.close()

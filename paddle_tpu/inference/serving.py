@@ -126,6 +126,7 @@ from ..observability import tracing as _obs_trace
 from ..observability import watchdog as _obs_wd
 from ..observability.httpd import start_ops_server as _start_ops_server
 from ..testing import faults as _faults
+from ._schema import KV_BLOB_KIND, SNAPSHOT_SCHEMA
 from .engine import (COMPILE_CACHE, DEFAULT_BUCKETS, _count_trace,
                      bucket_length, total_traces, trace_counts)
 
@@ -2812,7 +2813,7 @@ class ServingEngine:
         _journal.record('snapshot', requests=len(live),
                         terminal=len(terminal))
         return {
-            'schema': 1,
+            'schema': SNAPSHOT_SCHEMA,
             'config': self._snapshot_config(),
             'requests': live,
             'terminal': terminal,
@@ -2830,6 +2831,11 @@ class ServingEngine:
             'migration_counts': dict(self.migration_counts),
             'tokens_out': self._tokens_out,
             'serve_time': self._serve_time,
+            # the drain flag rides too (schema-1 compatible): a
+            # standby resurrected from a draining primary's snapshot
+            # must keep refusing submissions, or the router's drain
+            # decision silently un-happens on failover
+            'draining': self.draining,
         }
 
     def restore(self, snap):
@@ -2850,10 +2856,21 @@ class ServingEngine:
                 'queued, in flight, or unretrieved, or has already '
                 'served traffic (its lifetime counters would be '
                 'silently overwritten)')
-        if snap.get('schema') != 1:
+        if snap.get('schema') != SNAPSHOT_SCHEMA:
             raise ValueError(
                 f"unsupported snapshot schema {snap.get('schema')!r} "
-                f'(this engine reads schema 1)')
+                f'(this engine reads schema {SNAPSHOT_SCHEMA})')
+        # name every missing required key at once, before any state is
+        # touched — "KeyError: 'terminal'" from the middle of the loop
+        # below names a symptom, not the defect (a truncated or
+        # hand-built snapshot)
+        missing = sorted(k for k in ('requests', 'terminal')
+                         if k not in snap)
+        if missing:
+            raise ValueError(
+                f'snapshot missing required key(s) {missing}: not a '
+                f'ServingEngine.snapshot() dict (or truncated in '
+                f'transit)')
         cfg = self._snapshot_config()
         got = snap.get('config', {})
         diff = sorted(k for k in cfg if got.get(k) != cfg[k])
@@ -2929,6 +2946,12 @@ class ServingEngine:
         # lifetime token total by the standby's near-zero wall time — a
         # phantom throughput spike on every failover
         self._serve_time = float(snap.get('serve_time', self._serve_time))
+        # a draining primary's standby keeps refusing submissions (the
+        # router decided to drain the REPLICA, not the process); older
+        # snapshots without the key restore un-drained
+        if snap.get('draining', False):
+            self.draining = True
+            _obs.set_gauge('serve.draining', 1.0)
         # older snapshots carry an 'rng' key from the pre-PR-15 shared
         # sampling stream; per-request stateless keys made it
         # meaningless, so it is accepted and ignored
@@ -3117,8 +3140,8 @@ class ServingEngine:
         # itself rides the blob to the destination engine
         req.mark('kv_export', kv_len=kvlen, bytes=nbytes)
         blob = {
-            'schema': 1,
-            'kind': 'kv_migration',
+            'schema': SNAPSHOT_SCHEMA,
+            'kind': KV_BLOB_KIND,
             'config': self._snapshot_config(),
             'kv_cache_dtype': (str(self.kv_cache_dtype)
                                if self.kv_cache_dtype else None),
@@ -3161,11 +3184,21 @@ class ServingEngine:
         exactly as before the call. Returns the slot index."""
         t0 = time.perf_counter()
         rid = int(rid)
-        if blob.get('schema') != 1 or blob.get('kind') != 'kv_migration':
+        if (blob.get('schema') != SNAPSHOT_SCHEMA
+                or blob.get('kind') != KV_BLOB_KIND):
             raise ValueError(
                 f"unsupported KV blob (schema {blob.get('schema')!r}, "
                 f"kind {blob.get('kind')!r}): this engine reads "
-                f"kv_migration schema 1")
+                f"{KV_BLOB_KIND} schema {SNAPSHOT_SCHEMA}")
+        # name every missing required key at once — a blob without its
+        # request record or KV payload fails here with the defect
+        # named, not as a KeyError from the placement machinery
+        missing = sorted(k for k in ('request', 'kv_len', 'layers')
+                         if k not in blob)
+        if missing:
+            raise ValueError(
+                f'KV blob missing required key(s) {missing}: not an '
+                f'export_kv blob (or stripped in transit)')
         cfg = self._snapshot_config()
         got_cfg = blob.get('config', {})
         diff = sorted(k for k in cfg if got_cfg.get(k) != cfg[k])

@@ -330,7 +330,11 @@ class Watchdog:
                 'rules': {n: dict(st) for n, st in self._state.items()},
                 'windows_evaluated': self.windows_evaluated,
                 'breaches_total': self.breaches_total,
-                'recoveries_total': self.recoveries_total}
+                'recoveries_total': self.recoveries_total,
+                # rides too (schema-1 compatible addition) so a
+                # restored standby's verdict() reports the primary's
+                # last evaluated window instead of a fresh -1
+                'last_window_idx': self.last_window_idx}
 
     def load_state(self, snap):
         """Adopt a `snapshot_state()`. Rules are matched BY NAME:
@@ -354,6 +358,8 @@ class Watchdog:
         self.windows_evaluated = int(snap.get('windows_evaluated', 0))
         self.breaches_total = int(snap.get('breaches_total', 0))
         self.recoveries_total = int(snap.get('recoveries_total', 0))
+        lw = snap.get('last_window_idx')   # absent pre-PR-18 snapshots
+        self.last_window_idx = int(lw) if lw is not None else None
         return adopted
 
 

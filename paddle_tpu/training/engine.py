@@ -460,6 +460,13 @@ class TrainEngine:
             'accum_steps': self.accum_steps,
             'scaler_cfg': (list(self._scaler_cfg)
                            if self._scaler_cfg is not None else None),
+            # the mesh geometry is compilation-relevant: a dp=8
+            # engine's fused step is an 8-shard SPMD program a
+            # mesh-less engine can never look up — attaching across
+            # mesh shapes must refuse (ArtifactMismatch names this
+            # field)
+            'mesh': (dict(self.mesh.shape)
+                     if self.mesh is not None else None),
         }
 
     def _aot_jitted_fns(self):

@@ -912,6 +912,47 @@ class TestSnapshotRestore:
         left = fresh._live[rid].deadline - time.perf_counter()
         assert 0 < left <= 300.0
 
+    def test_draining_flag_survives_restore(self):
+        """A standby resurrected from a draining primary's snapshot
+        keeps refusing submissions — restoring to accepting would
+        re-open the drained endpoint behind the router's back."""
+        srv = ServingEngine(_model(), **self._kw())
+        rid = srv.submit(_prompt(140, 6), 4)
+        srv.drain()
+        snap = json.loads(json.dumps(srv.snapshot()))
+        assert snap['draining'] is True
+        fresh = ServingEngine(_model(), **self._kw())
+        fresh.restore(snap)
+        assert fresh.draining
+        with pytest.raises(QueueFull, match='draining'):
+            fresh.submit(_prompt(141, 6), 4)
+        fresh.run()                          # in-flight work completes
+        assert fresh.result(rid) is not None
+        # and a non-draining snapshot restores to accepting
+        srv2 = ServingEngine(_model(), **self._kw())
+        srv2.submit(_prompt(142, 6), 4)
+        fresh2 = ServingEngine(_model(), **self._kw())
+        fresh2.restore(srv2.snapshot())
+        assert not fresh2.draining
+        fresh2.submit(_prompt(143, 6), 4)    # no QueueFull
+
+    def test_restore_names_every_missing_key(self):
+        """A truncated/hand-built snapshot fails with the missing keys
+        NAMED, all at once, before any state is touched — not with a
+        bare KeyError from the middle of the rebuild loop."""
+        srv = ServingEngine(_model(), **self._kw())
+        srv.submit(_prompt(144, 6), 4)
+        snap = srv.snapshot()
+        bad = {k: v for k, v in snap.items()
+               if k not in ('requests', 'terminal')}
+        fresh = ServingEngine(_model(), **self._kw())
+        with pytest.raises(ValueError,
+                           match=r"\['requests', 'terminal'\]"):
+            fresh.restore(bad)
+        # nothing was touched: the engine is still fresh enough to
+        # accept the intact snapshot
+        fresh.restore(snap)
+
 
 class TestAllocatorUnderInjection:
     def test_double_free_still_raises_under_injection(self):

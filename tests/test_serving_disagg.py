@@ -599,3 +599,92 @@ class TestMigrationBytes:
         assert sizes['int8'][1] == sizes['bfloat16'][1]
         r = sizes['int8'][0] / sizes['bfloat16'][0]
         assert abs(r - (D + 4) / (2 * D)) < 1e-9
+
+
+class TestDisaggSnapshot:
+    def test_unferried_handoffs_survive_prefill_snapshot(self):
+        """A handed-off request has already LEFT the prefill engine's
+        registries — the blob parked in `_handoffs` is the only record
+        it exists. Snapshot it, restore on a standby, ferry from
+        THERE: still bit-equal."""
+        ps = _prompts(2, seed=31)
+        ref = _mk().serve(ps)
+        pf = PrefillEngine(_model(), **KW)
+        rids = [pf.submit(p) for p in ps]
+        for _ in range(64):
+            pf.step()
+            if pf.migration_counts['handoffs'] == len(ps):
+                break
+        assert len(pf._handoffs) == len(ps)   # parked, never taken
+        snap = json.loads(json.dumps(pf.snapshot()))  # wire round-trip
+        assert len(snap['handoffs']) == len(ps)
+        standby = PrefillEngine(_model(), **KW)
+        rep = standby.restore(snap)
+        assert rep['handoffs'] == len(ps)
+        de = _mk(role='decode')
+        for blob in standby.take_handoffs():
+            de.import_kv(int(blob['request']['rid']), blob)
+        de.run()
+        for rid, want in zip(rids, ref):
+            assert _same(de.result(rid), want)
+
+    def test_pair_snapshot_with_in_transit_blob_restores_bit_equal(self):
+        """Crash between handoff and import: the ferry section of the
+        pair snapshot is the ONLY record the in-transit stream exists.
+        A restored pair resumes ferrying and finishes bit-equal."""
+        ps = _prompts(3, seed=32)
+        ref = _mk().serve(ps)
+        pf = PrefillEngine(_model(), **KW)
+        de = _mk(role='decode', max_slots=1)  # force blobs to wait
+        pair = DisaggPair(pf, de)
+        rids = [pair.submit(p) for p in ps]
+        for _ in range(64):
+            pair.step()
+            if pair._pending:
+                break
+        assert pair._pending                  # a real in-transit cut
+        snap = json.loads(json.dumps(pair.snapshot()))
+        assert snap['pending']
+        fresh = DisaggPair(PrefillEngine(_model(), **KW),
+                           _mk(role='decode', max_slots=1))
+        rep = fresh.restore(snap)
+        assert rep['pending'] == len(snap['pending'])
+        fresh.run()
+        for rid, want in zip(rids, ref):
+            assert _same(fresh.result(rid), want)
+
+    def test_pair_restore_names_missing_keys_and_replays_failures(self):
+        donor = DisaggPair(PrefillEngine(_model(), **KW),
+                           _mk(role='decode'))
+        snap = donor.snapshot()
+        bad = {k: v for k, v in snap.items()
+               if k not in ('prefill', 'decode')}
+        fresh = DisaggPair(PrefillEngine(_model(), **KW),
+                           _mk(role='decode'))
+        with pytest.raises(ValueError,
+                           match=r"\['decode', 'prefill'\]"):
+            fresh.restore(bad)
+        # permanently failed placements survive the failover and still
+        # re-raise at result() — as RuntimeError carrying the original
+        # error's repr (the exception object does not cross a process
+        # boundary)
+        snap['failed'] = {'7': "OutOfBlocks('no room')"}
+        fresh.restore(snap)
+        with pytest.raises(RuntimeError, match='OutOfBlocks'):
+            fresh.result(7)
+
+    def test_import_kv_names_missing_blob_keys(self):
+        """A structurally wrong blob dict fails with the missing keys
+        NAMED, before any allocator/pool mutation — not with a bare
+        KeyError mid-scatter."""
+        src = _mk()
+        rid, blob = _export_after_first_token(src, _prompts()[0])
+        bad = {k: v for k, v in blob.items()
+               if k not in ('request', 'kv_len')}
+        dst = _mk(role='decode')
+        with pytest.raises(ValueError,
+                           match=r"\['kv_len', 'request'\]"):
+            dst.import_kv(rid, bad)
+        assert dst.allocator.in_use() == 0    # nothing was touched
+        dst.import_kv(rid, blob)              # intact blob still lands
+        assert dst.in_flight() == 1

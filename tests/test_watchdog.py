@@ -407,6 +407,29 @@ class TestWatchdog:
         with pytest.raises(ValueError):
             dog2.load_state({'schema': 99})
 
+    def test_last_window_idx_rides_snapshot(self):
+        """A restored standby's verdict() reports the primary's last
+        evaluated window index, not a fresh None — and a schema-1
+        snapshot from before the field existed still loads."""
+        t = WindowedTimeseries(interval_s=1.0)
+        t.maybe_commit(now=0.0)
+        dog = self._dog()
+        self._drive(dog, t, 1.0, 2)
+        self._drive(dog, t, 2.0, 2)
+        assert dog.last_window_idx is not None
+        snap = json.loads(json.dumps(dog.snapshot_state()))
+        assert snap['last_window_idx'] == dog.last_window_idx
+        dog2 = self._dog()
+        dog2.load_state(snap)
+        assert dog2.last_window_idx == dog.last_window_idx
+        assert (dog2.verdict()['last_window_idx']
+                == dog.last_window_idx)
+        # back-compat: the field is a schema-1-compatible addition
+        old = {k: v for k, v in snap.items() if k != 'last_window_idx'}
+        dog3 = self._dog()
+        dog3.load_state(old)
+        assert dog3.last_window_idx is None
+
     def test_recovery_after_restored_state_clamps_duration(self):
         """A standby adopting the primary's breach carries the
         PRIMARY's window index; recovering on the standby's fresh ring

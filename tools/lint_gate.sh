@@ -1,15 +1,15 @@
 #!/usr/bin/env bash
 # Static-analysis gate: every analyzer family — tracelint, mosaiclint,
-# shardlint, hlolint — in one shot with baseline-diff semantics (fail
-# ONLY on NEW violations; everything in the committed tools/*_baseline
-# files is tolerated until ratcheted out).
+# shardlint, hlolint, statelint — in one shot with baseline-diff
+# semantics (fail ONLY on NEW violations; everything in the committed
+# tools/*_baseline files is tolerated until ratcheted out).
 #
 # This is the shell entry point for CI and pre-push hooks; bench.py's
-# per-family gates (_tracelint_gate .. _hlolint_gate) run the same
+# per-family gates (_tracelint_gate .. gate_statelint) run the same
 # unified runner in-process per family so each family's evidence lands
 # in the bench detail blob separately.
 #
-#   tools/lint_gate.sh            # all four families, combined rc
+#   tools/lint_gate.sh            # all five families, combined rc
 #   tools/lint_gate.sh --format json
 #
 # rc 0: every family clean (modulo baselines/suppressions)
@@ -17,9 +17,10 @@
 # rc 2: a family could not run (no jax backend, registry import error)
 #
 # The analyzers must never wake a flaky TPU tunnel: pin the CPU
-# backend, and pre-set the virtual 8-device flag shardlint/hlolint
-# need so the mesh suites compile even when something imported jax
-# before the runner's own guard could.
+# backend (statelint's live wire-schema engines included), and pre-set
+# the virtual 8-device flag shardlint/hlolint need so the mesh suites
+# compile even when something imported jax before the runner's own
+# guard could.
 set -u
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"

@@ -254,6 +254,22 @@ def main(argv=None):
     print(f'serve.tok_s      '
           f'{snap.get("serve.tok_s", {}).get("value")}')
 
+    # the statelint coverage census (pure-AST: rules=[] skips the live
+    # wire build) — how much engine state exists and how it is
+    # classified; `statelint` proves the claims, this line surfaces
+    # the coverage shape next to the telemetry it protects
+    from paddle_tpu.analysis.state import DECLS, lint_and_report
+    _, _, st_census = lint_and_report(DECLS, rules=[], root=_ROOT,
+                                      schemas={})
+    classes = [c for c in st_census['classes'].values() if c]
+    print(f'statelint census {len(classes)} classes, '
+          f'{sum(c["attrs"] for c in classes)} mutable attrs '
+          f'({sum(c["persisted"] for c in classes)} persisted / '
+          f'{sum(c["derived-rebuilt"] for c in classes)} rebuilt / '
+          f'{sum(c["device-rederived"] for c in classes)} device / '
+          f'{sum(c["ephemeral"] for c in classes)} ephemeral, '
+          f'{sum(c["unclassified"] for c in classes)} unclassified)')
+
     # the SLO watchdog verdict + per-rule states, and one scrape of
     # the live ops endpoint to prove the SERVED verdict matches
     verdict = srv._watchdog.verdict()
