@@ -2007,6 +2007,248 @@ def _serve_disagg_gate(timeout_s=600):
         f"{payload.get('byte_ratio')}"), payload
 
 
+_FLEET_SIM_GATE_SRC = r'''
+import json, os, tempfile
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import aot
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference.engine import total_traces
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.fleet import Fleet
+from paddle_tpu.observability import REGISTRY
+from paddle_tpu.testing.faults import FaultInjector
+
+pt.seed(0)
+model = LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                    layers=2))
+KW = dict(max_slots=4, num_blocks=64, block_size=8, max_context_len=64,
+          max_new_tokens=12, decode_window=4)
+
+def factory(**kw):
+    return ServingEngine(model, **KW, **kw)
+
+work = tempfile.mkdtemp(prefix='paddle_tpu_fleet_gate_')
+ART = os.path.join(work, 'artifact')
+builder = ServingEngine(model, **KW)
+aot.build(builder, ART)
+builder.close()
+
+# one seeded workload stream: (prompt, max_new_tokens) pairs; every
+# fleet stream is checked bit-equal against a plain single engine
+rng = np.random.default_rng(0)
+N_CAL, N_SCALE, N_STEADY, N_SPIKE = 12, 48, 12, 36
+TOTAL = N_CAL + N_SCALE + N_STEADY + N_SPIKE
+prompts = [rng.integers(3, 96, (int(rng.integers(4, 12)),)).astype(
+    np.int32) for _ in range(TOTAL)]
+mnts = [int(rng.integers(6, 13)) for _ in range(TOTAL)]
+
+ref = ServingEngine(model, **KW)
+expect = []
+for p, m in zip(prompts, mnts):
+    r = ref.submit(p, max_new_tokens=m)
+    while ref.in_flight() or len(ref.queue):
+        ref.step()
+    expect.append(np.asarray(ref.result(r)))
+ref.close()
+
+fleet = Fleet(factory, artifact=ART,
+              postmortem_dir=os.path.join(work, 'pm'))
+fleet.scale_to(1)
+mark = total_traces()
+cm = REGISTRY.get('compile.cache_misses')
+cm0 = cm.value if cm is not None else 0
+parity = True
+cursor = 0
+
+def run_batch(n):
+    """Submit n requests from the stream, run the fleet dry, check
+    parity; returns (tokens_generated, sim_seconds) for throughput."""
+    global cursor, parity
+    t0, rids = fleet.sim_time_s, []
+    for i in range(cursor, cursor + n):
+        rids.append(fleet.submit(prompts[i], max_new_tokens=mnts[i]))
+    fleet.run(max_steps=2000)
+    toks = 0
+    for i, r in zip(range(cursor, cursor + n), rids):
+        out = np.asarray(fleet.result(r))
+        toks += len(out) - len(prompts[i])
+        parity = parity and np.array_equal(out, expect[i])
+    cursor += n
+    return toks, fleet.sim_time_s - t0
+
+# -- sim-clock throughput: the same batch-per-replica load at n=1 and
+# n=4 — replicas are parallel hosts on the sim clock, so the fleet
+# figure must scale (the gate floor is 2x at 4 replicas)
+toks1, dt1 = run_batch(N_CAL)
+tok_s_single = toks1 / max(dt1, 1e-9)
+fleet.scale_to(4)
+toks4, dt4 = run_batch(N_SCALE)
+tok_s_fleet = toks4 / max(dt4, 1e-9)
+scale_ratio = tok_s_fleet / max(tok_s_single, 1e-9)
+
+# -- the autoscaling flood: Poisson arrivals per fleet round, steady
+# at n=1 then a traffic spike (scale up mid-flood), one rolling
+# restart and one replica kill DURING the spike, then drain
+fleet.scale_to(1)
+arrivals = rng.poisson(0.45, 400).tolist()      # steady draw stream
+spike_arrivals = rng.poisson(3.0, 400).tolist()
+steady_rids, spike_rids, submitted = [], [], 0
+rid_of = {}
+
+def arrive(n, bucket):
+    global submitted, cursor
+    for _ in range(n):
+        if submitted >= N_STEADY + N_SPIKE:
+            return
+        i = cursor
+        r = fleet.submit(prompts[i], max_new_tokens=mnts[i])
+        bucket.append(r)
+        rid_of[r] = i
+        cursor += 1
+        submitted += 1
+
+round_i = 0
+while submitted < N_STEADY:
+    arrive(arrivals[round_i], steady_rids)
+    fleet.step()
+    round_i += 1
+    if round_i > 500:
+        break
+
+fleet.scale_to(4)                  # spike: scale up UNDER load — the
+#   steady tail is still in flight when the three fresh replicas warm
+restarted = killed = False
+spike_round = 0
+while submitted < N_STEADY + N_SPIKE or fleet.in_flight() \
+        or fleet.queue_depth():
+    arrive(spike_arrivals[spike_round], spike_rids)
+    if not restarted and submitted >= N_STEADY + 8:
+        fleet.restart(next(iter(fleet.replicas)))  # rolling restart
+        restarted = True
+    if not killed and submitted >= N_STEADY + 20:
+        victim = next(iter(fleet.replicas))
+        with FaultInjector(seed=0) as inj:         # replica kill
+            inj.script('replica_step',
+                       when=lambda c: c['replica'] == victim)
+            fleet.step()
+        killed = True
+    else:
+        fleet.step()
+    spike_round += 1
+    if spike_round > 800:
+        break
+
+for bucket in (steady_rids, spike_rids):
+    for r in bucket:
+        out = np.asarray(fleet.result(r))
+        i = rid_of[r]
+        parity = parity and np.array_equal(out, expect[i])
+
+def p99(rids):
+    vals = sorted(fleet._ttft[r] for r in rids if r in fleet._ttft)
+    if not vals:
+        return None
+    k = min(len(vals) - 1, max(0, int(round(0.99 * len(vals) + 0.5)) - 1))
+    return vals[k] * 1e3
+
+steady_p99, spike_p99 = p99(steady_rids), p99(spike_rids)
+cm = REGISTRY.get('compile.cache_misses')
+print(json.dumps({
+    'parity': bool(parity),
+    'retraces': int(total_traces() - mark),
+    'cache_misses': int((cm.value if cm is not None else 0) - cm0),
+    'leak': int(sum(e.allocator.in_use()
+                    for e in fleet.replicas.values())),
+    'tok_s_single_sim': round(tok_s_single, 2),
+    'tok_s_fleet4_sim': round(tok_s_fleet, 2),
+    'scale_ratio': round(scale_ratio, 4),
+    'ttft_p99_ms_steady': steady_p99,
+    'ttft_p99_ms_spike': spike_p99,
+    'spike_factor': (round(spike_p99 / max(steady_p99, 1e-9), 4)
+                     if steady_p99 and spike_p99 else None),
+    'migrations': int(fleet.counts['migrations']),
+    'resurrections': int(fleet.counts['resurrections']),
+    'restarts': int(fleet.counts['restarts']),
+    'routed': int(fleet.counts['routed']),
+    'route_shares': {k: round(v, 4)
+                     for k, v in fleet.route_shares().items()},
+    'replicas': len(fleet.replicas)}))
+fleet.close()
+'''
+
+# the spike-phase p99 TTFT budget: sim-time multiple of the
+# steady-state p99 the flood may reach while the fleet absorbs a 6x
+# arrival-rate spike WITH a rolling restart and a replica kill in the
+# middle of it (queueing + migration re-prefill, not a stall)
+_FLEET_SPIKE_TTFT_FACTOR = 4.0
+
+
+def _fleet_sim_gate(timeout_s=600):
+    """Replica-fleet autoscaling gate, CPU-pinned like the other
+    dynamic gates. One subprocess proves the fleet contract end to
+    end on the simulated deployment clock (replicas are parallel
+    hosts — sim time advances by the MAX per-replica wall per round):
+
+      (a) every routed stream — through scale-up, scale-down
+          migration, a rolling restart, and a replica kill — finishes
+          BIT-EQUAL to a plain single engine;
+      (b) elasticity is zero-compile: after the first replica warms
+          from the shared AOT artifact, scale_to(4), the restart
+          replacement, and the resurrection standby add ZERO traces
+          and ZERO compile-cache misses;
+      (c) sim-clock throughput at 4 replicas >= 2x one replica on the
+          same per-replica load;
+      (d) the 6x Poisson arrival spike (absorbed by scaling 1->4
+          mid-flood) keeps spike-phase p99 TTFT within
+          _FLEET_SPIKE_TTFT_FACTOR of steady-state;
+      (e) the lifecycle actually happened: migrations > 0, exactly
+          one resurrection, one restart, zero leaked pages.
+
+    A ratio-only miss (scale_ratio or spike_factor, with (a)/(b)/(e)
+    clean) gets ONE subprocess retry — wall-clock noise moves the sim
+    clock's per-round max, a real regression fails both runs. Returns
+    (clean, detail, payload); clean is None when the gate could not
+    run (never poses as a pass)."""
+    payload, err = _gate_subprocess(_FLEET_SIM_GATE_SRC, timeout_s)
+    if payload is None:
+        return None, err, {}
+
+    def _functional(p):
+        return (p.get('parity') is True
+                and p.get('retraces') == 0
+                and p.get('cache_misses') == 0
+                and p.get('leak') == 0
+                and p.get('migrations', 0) > 0
+                and p.get('resurrections') == 1
+                and p.get('restarts') == 1)
+
+    def _ratios_ok(p):
+        return (p.get('scale_ratio') is not None
+                and p.get('scale_ratio') >= 2.0
+                and p.get('spike_factor') is not None
+                and p.get('spike_factor') <= _FLEET_SPIKE_TTFT_FACTOR)
+
+    if _functional(payload) and not _ratios_ok(payload):
+        retry, _ = _gate_subprocess(_FLEET_SIM_GATE_SRC, timeout_s)
+        if (retry is not None and _functional(retry)
+                and _ratios_ok(retry)):
+            payload = retry
+    clean = bool(_functional(payload) and _ratios_ok(payload))
+    return clean, (
+        f"fleet sim tok/s {payload.get('tok_s_fleet4_sim')} at 4 "
+        f"replicas vs {payload.get('tok_s_single_sim')} at 1 (ratio "
+        f"{payload.get('scale_ratio')}), spike p99 TTFT "
+        f"{payload.get('ttft_p99_ms_spike')}ms vs steady "
+        f"{payload.get('ttft_p99_ms_steady')}ms (factor "
+        f"{payload.get('spike_factor')}, budget "
+        f"{_FLEET_SPIKE_TTFT_FACTOR}), parity={payload.get('parity')}, "
+        f"{payload.get('retraces')} retrace(s), "
+        f"{payload.get('migrations')} migration(s), "
+        f"{payload.get('resurrections')} resurrection(s), "
+        f"{payload.get('routed')} routed"), payload
+
+
 def _train_engine_gate(timeout_s=240):
     """Dynamic training-contract gate, CPU-pinned like the lint gates:
     a tiny TrainEngine run must show ZERO steady-state retraces and a
@@ -2100,6 +2342,9 @@ def main():
     disagg_gate_clean, disagg_gate_detail, disagg_gate_payload = (
         _serve_disagg_gate())
     print(f'# serve disagg gate: {disagg_gate_detail}', flush=True)
+    fleet_gate_clean, fleet_gate_detail, fleet_gate_payload = (
+        _fleet_sim_gate())
+    print(f'# fleet sim gate: {fleet_gate_detail}', flush=True)
     static_gate_failed = (tracelint_clean is False
                           or mosaiclint_clean is False
                           or shardlint_clean is False
@@ -2115,7 +2360,8 @@ def main():
                           or spec_gate_clean is False
                           or flight_gate_clean is False
                           or wd_gate_clean is False
-                          or disagg_gate_clean is False)
+                          or disagg_gate_clean is False
+                          or fleet_gate_clean is False)
     if not _accelerator_reachable():
         stashed = _stashed_tpu_line()
         if stashed is not None:
@@ -2283,6 +2529,29 @@ def main():
                 'migration_ms_p99')
             det['serve_migration_byte_ratio'] = disagg_gate_payload.get(
                 'byte_ratio')
+            # replica-fleet autoscaling gate (CPU subprocess proof):
+            # bit-equal streams through scale/restart/kill, zero
+            # compiles after the first replica warms, sim-clock
+            # throughput >= 2x at 4 replicas, spike p99 TTFT within
+            # budget, zero leaked pages — stamped like the other
+            # serving gates (new keys this round: null-only backfill
+            # by construction)
+            det['gate_fleet_sim'] = fleet_gate_clean
+            det['fleet_sim_gate'] = fleet_gate_detail
+            det['fleet_scale_ratio'] = fleet_gate_payload.get(
+                'scale_ratio')
+            det['fleet_tok_s_single_sim'] = fleet_gate_payload.get(
+                'tok_s_single_sim')
+            det['fleet_tok_s_4x_sim'] = fleet_gate_payload.get(
+                'tok_s_fleet4_sim')
+            det['fleet_ttft_p99_ms_spike'] = fleet_gate_payload.get(
+                'ttft_p99_ms_spike')
+            det['fleet_spike_ttft_factor'] = fleet_gate_payload.get(
+                'spike_factor')
+            det['fleet_migrations'] = fleet_gate_payload.get(
+                'migrations')
+            det['fleet_resurrections'] = fleet_gate_payload.get(
+                'resurrections')
             # backfill the unsuffixed gates ONLY when the stashed TPU
             # artifact predates them (or its serving bench was
             # time-boxed away) — a real TPU-measured value must never
@@ -2916,6 +3185,27 @@ def main():
                 'migration_ms_p99'),
             'serve_migration_byte_ratio': disagg_gate_payload.get(
                 'byte_ratio'),
+            # replica-fleet autoscaling gate (CPU subprocess proof):
+            # bit-equal streams through scale-up/scale-down migration,
+            # a rolling restart, and a replica kill+resurrection; zero
+            # compiles after the first replica warms off the shared
+            # AOT artifact; sim-clock throughput >= 2x at 4 replicas;
+            # spike-phase p99 TTFT within its declared factor of
+            # steady-state; zero leaked pages
+            'gate_fleet_sim': fleet_gate_clean,
+            'fleet_sim_gate': fleet_gate_detail,
+            'fleet_scale_ratio': fleet_gate_payload.get('scale_ratio'),
+            'fleet_tok_s_single_sim': fleet_gate_payload.get(
+                'tok_s_single_sim'),
+            'fleet_tok_s_4x_sim': fleet_gate_payload.get(
+                'tok_s_fleet4_sim'),
+            'fleet_ttft_p99_ms_spike': fleet_gate_payload.get(
+                'ttft_p99_ms_spike'),
+            'fleet_spike_ttft_factor': fleet_gate_payload.get(
+                'spike_factor'),
+            'fleet_migrations': fleet_gate_payload.get('migrations'),
+            'fleet_resurrections': fleet_gate_payload.get(
+                'resurrections'),
             # measured-path gate is TPU-only (like the int8/kv8 gates:
             # the CPU smoke config's dispatch overhead swamps the
             # step-count win by construction); the CPU-provable version

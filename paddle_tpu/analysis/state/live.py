@@ -73,6 +73,13 @@ def live_schemas():
         pair = DisaggPair(pre, dec)
         wires['prefill_snapshot'] = sorted(pre.snapshot())
         wires['pair_snapshot'] = sorted(pair.snapshot())
+        # the fleet wire needs only an adopted replica — construction
+        # alone proves the dict shape, like the pair wires above
+        from paddle_tpu.inference.fleet import Fleet
+
+        fl = Fleet()
+        fl.add('replica0', dec)
+        wires['fleet_snapshot'] = sorted(fl.snapshot())
     finally:
         pre.close()
         dec.close()

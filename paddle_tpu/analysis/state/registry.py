@@ -26,6 +26,8 @@ Wire names used in claims (extracted live by live.py):
   watchdog         Watchdog.snapshot_state()
   prefill_snapshot PrefillEngine.snapshot() (extends 'snapshot')
   pair_snapshot    DisaggPair.snapshot()
+  fleet_snapshot   Fleet.snapshot() — per-replica engine snapshots
+                   plus the fleet's routing table and sim clock
 """
 from __future__ import annotations
 
@@ -63,6 +65,9 @@ WIRE_STRUCTURAL = {
     },
     'pair_snapshot': {
         'schema': 'wire version stamp (inference._schema)',
+    },
+    'fleet_snapshot': {
+        'schema': 'wire version stamp (fleet.FLEET_SNAPSHOT_SCHEMA)',
     },
 }
 
@@ -243,6 +248,17 @@ _SERVING = ClassDecl(
             'constructor role config; a standby is built WITH its '
             'role — carrying it would let a snapshot silently flip '
             "an engine's role"),
+        '_registry': ephemeral(
+            'which MetricsRegistry the serve.*/pool.* series land in '
+            '(a fleet replica gets a private one); scrape-time state, '
+            'and the durable counters ride the snapshot counts wires'),
+        '_jr': ephemeral(
+            'which flight-recorder Journal request trails land in; '
+            'the trails themselves ride the snapshot trails key'),
+        '_rid_start': ephemeral(
+            "the replica's rid-stride origin — construction config "
+            "(the fleet rebuilds it from the fleet_snapshot replica "
+            "index), used only by restore()'s fresh-engine check"),
     },
 )
 
@@ -332,6 +348,10 @@ _REQUEST = ClassDecl(
             'deadline_left_s instead'),
         'admit_seq': ephemeral(
             'admission stamp re-issued by the restoring engine'),
+        'journal': ephemeral(
+            "which flight recorder mark() writes to (the owning "
+            "engine's private journal, or the process one); the "
+            'events themselves ride the snapshot trails key'),
     },
 )
 
@@ -377,6 +397,10 @@ _ALLOCATOR = ClassDecl(
         'high_water': ephemeral('pool-lifetime stat'),
         'prefix_evictions': ephemeral('pool-lifetime stat'),
         'prefix_shares': ephemeral('pool-lifetime stat'),
+        'journal': ephemeral(
+            'which flight recorder pool events land in (set by a '
+            'private-registry engine); pool state itself is derived '
+            'by re-placement'),
     },
 )
 
@@ -410,6 +434,13 @@ _WATCHDOG = ClassDecl(
         'postmortem_min_interval_s': ephemeral('host knob'),
         '_last_postmortem_t': ephemeral('absolute clock stamp for '
                                         'postmortem rate-limiting'),
+        'registry': ephemeral(
+            'which MetricsRegistry the watchdog.* series land in (a '
+            'private-registry replica scopes them); breach totals '
+            'ride the watchdog wire'),
+        'journal': ephemeral(
+            'which Journal slo_breach/slo_recovered events land in; '
+            'durable breach state rides the watchdog wire'),
     },
 )
 
@@ -454,6 +485,8 @@ _TIMESERIES = ClassDecl(
         'max_windows': ephemeral('observability window config'),
         'derive': ephemeral('derivation callables; host config'),
         'registry': ephemeral('host registry reference'),
+        'journal': ephemeral('host journal reference (whose overflow '
+                             'count rides the windows)'),
         '_lock': ephemeral('the lock object itself'),
         '_ring': ephemeral('perf windows restart with the process; '
                            'durable breach totals ride the watchdog '
@@ -539,6 +572,92 @@ _FAULTS = ClassDecl(
 )
 
 
+_FLEET = ClassDecl(
+    name='inference.fleet.Fleet',
+    path='paddle_tpu/inference/fleet.py',
+    cls='Fleet',
+    owns_wires=('fleet_snapshot',),
+    roundtrips=(RoundTrip('snapshot', 'restore', 'snap',
+                          marker='schema'),),
+    attrs={
+        'replicas': persisted(
+            ('fleet_snapshot', 'replicas'),
+            note="every replica's full engine snapshot nests here, "
+                 'keyed by name'),
+        '_index': persisted(
+            ('fleet_snapshot', 'replicas'),
+            note="each replica's rid-stride index rides inside its "
+                 'replicas entry; restore() rebuilds rid_start from '
+                 'index * rid_stride'),
+        '_next_index': persisted(('fleet_snapshot', 'next_index')),
+        '_where': persisted(
+            ('fleet_snapshot', 'where'),
+            note='the rid -> replica routing table; without it a '
+                 "restored fleet could not answer result(rid)"),
+        'counts': persisted(('fleet_snapshot', 'counts')),
+        'sim_time_s': persisted(
+            ('fleet_snapshot', 'sim_time_s'),
+            note='the autoscaling-simulation clock continues across a '
+                 'fleet restore, like the engine serve_time'),
+        'factory': ephemeral('host callable that builds replicas; '
+                             're-bound at construction'),
+        'router': ephemeral('pure placement policy object; stateless '
+                            'between decisions'),
+        'artifact': ephemeral('host path knob (the shared AOT '
+                              'artifact dir replicas warm from)'),
+        'rid_stride': ephemeral(
+            'host knob; both sides of a fleet restore must agree — '
+            'the wire carries each replica index, rid_start is '
+            'index * stride'),
+        'postmortem_dir': ephemeral('host path knob'),
+        'name_prefix': ephemeral('host naming knob'),
+        '_round': ephemeral('fleet step-round counter; durable sim '
+                            'continuity rides sim_time_s'),
+        '_submit_t': ephemeral(
+            'sim-clock first-token staging for in-flight rids; a '
+            'restored fleet re-measures TTFT from restore onward'),
+        '_ttft': ephemeral('recorded sim TTFTs; reporting state, '
+                           'bounded and re-accumulated per process'),
+        'max_ttft_records': ephemeral('retention knob'),
+        '_routed_by': ephemeral(
+            'per-replica route census behind the route_share gauges; '
+            'the durable total rides the fleet_snapshot counts'),
+    },
+)
+
+
+_ROUTER = ClassDecl(
+    name='inference.fleet.Router',
+    path='paddle_tpu/inference/fleet.py',
+    cls='Router',
+    attrs={
+        'max_pressure': ephemeral('pure routing-policy knob; no '
+                                  'placement state survives a decision'),
+    },
+)
+
+
+_SIGNALS = ClassDecl(
+    name='inference.fleet.ReplicaSignals',
+    path='paddle_tpu/inference/fleet.py',
+    cls='ReplicaSignals',
+    attrs={
+        # a signals object is one point-in-time scrape — every field
+        # is recomputed per routing decision, nothing survives
+        'name': ephemeral('scrape identity'),
+        'role': ephemeral('point-in-time scrape value'),
+        'healthy': ephemeral('point-in-time scrape value'),
+        'draining': ephemeral('point-in-time scrape value'),
+        'breaching': ephemeral('point-in-time scrape value'),
+        'queue_depth': ephemeral('point-in-time scrape value'),
+        'in_flight': ephemeral('point-in-time scrape value'),
+        'pool_pressure': ephemeral('point-in-time scrape value'),
+        'tok_s': ephemeral('point-in-time scrape value'),
+        'err_rate': ephemeral('point-in-time scrape value'),
+    },
+)
+
+
 _TRAIN = ClassDecl(
     name='training.engine.TrainEngine',
     path='paddle_tpu/training/engine.py',
@@ -602,7 +721,7 @@ _TRAIN = ClassDecl(
 DECLS = (
     _SERVING, _PREFILL, _PAIR, _REQUEST, _QUEUE, _ALLOCATOR,
     _WATCHDOG, _SLORULE, _TIMESERIES, _METRICS, _JOURNAL,
-    _FAULTRULE, _FAULTS, _TRAIN,
+    _FAULTRULE, _FAULTS, _FLEET, _ROUTER, _SIGNALS, _TRAIN,
 )
 
 

@@ -1,0 +1,738 @@
+"""The replica fleet — load-aware routing and ms-scale elasticity over
+N serving replicas (ROADMAP item 1, docs/serving.md#fleet).
+
+Every per-replica piece already exists: windowed rate gauges and a
+drain-aware `/healthz` (PR 14), one shared AOT artifact with
+zero-compile `warm_attach` (PR 7), bit-equal `snapshot()`/`restore()`
+migration (PR 8/16/18), crash postmortem bundles (PR 12), and
+phase-role placement (PR 16). This module is the composition: a
+`Fleet` fronts N engine-like replicas (plain `ServingEngine`s,
+tp-sharded ones, or `DisaggPair`s) behind ONE submission surface, and
+a `Router` — pure policy, no engine references — decides placement
+per request off live `ReplicaSignals`:
+
+  - load        queue depth + in-flight (least-loaded first),
+  - pressure    watermark-relative pool pressure,
+  - health      drain state and the watchdog verdict (a healthz-503 or
+                an active SLO breach stops routing there NOW),
+  - phase role  bare prefill/decode engines never take fresh
+                submissions (a `DisaggPair` routes internally),
+  - rates       the PR-14 windowed `serve.tok_s` / `serve.err_rate`
+                gauges, scraped in-process from each replica's PRIVATE
+                registry (`ReplicaSignals.from_engine`) or over HTTP
+                from its `/metrics`+`/healthz` endpoint
+                (`ReplicaSignals.from_http`, the cross-process path).
+
+Elasticity is the headline. `scale_to(n)` grows the fleet by building
+replicas from the factory and warm-attaching each to ONE shared AOT
+artifact — zero compiles after the first replica warms, so capacity
+follows traffic at millisecond scale. Scale-down drains the victim,
+snapshots it, and scatters its requests across the survivors via
+`ServingEngine.adopt_request` (the restore contract per request:
+re-prefill resumes every stream bit-equal). `restart(name)` is the
+rolling-restart recipe fleet-level: spin the replacement FIRST, then
+migrate, then close. A replica whose `step()` raises (the PR-8 worker
+death, or the `replica_step` fault seam) is resurrected: its
+auto-dumped postmortem bundle is read back and its snapshot restored
+onto a fresh zero-compile standby — requests ride through the crash.
+
+The autoscaling clock: replicas in one process share one core, so
+wall-clock fleet throughput cannot exceed one replica's. The fleet
+therefore keeps a SIMULATED clock — each `step()` round steps every
+replica once and advances `sim_time_s` by the MAX per-replica wall
+time, i.e. replicas are parallel hosts of the simulated deployment
+(exactly how a dp fleet behaves on real hardware). Sim-time feeds the
+TTFT percentiles and the scale-throughput ratio `gate_fleet_sim`
+asserts; every real-execution property (bit-equal streams, zero
+retraces, zero leaked pages) stays measured on real execution.
+
+Telemetry rides the existing stack, fleet-scoped into the PROCESS
+registry/journal (each replica's serve.*/pool.* series live in its
+private registry): counters `fleet.routed`, `fleet.migrations`,
+`fleet.resurrections`, `fleet.restarts`, per-replica
+`fleet.routed.<name>`; gauges `fleet.replicas`,
+`fleet.route_share.<name>`; histogram `fleet.ttft_sim_ms`; journal
+events `fleet_scale` / `fleet_migrate` / `fleet_resurrect` /
+`fleet_restart`.
+"""
+from __future__ import annotations
+
+import time
+
+from ..observability import journal as _journal
+from ..observability import metrics as _obs
+from ..testing import faults as _faults
+from .serving import QueueFull
+
+__all__ = ['ReplicaSignals', 'Router', 'Fleet', 'NoEligibleReplica',
+           'FLEET_SNAPSHOT_SCHEMA']
+
+# the fleet_snapshot wire format version (statelint wire claim):
+# {'schema', 'replicas': {name: {'index', 'snapshot'}}, 'where',
+#  'counts', 'sim_time_s', 'next_index'}
+FLEET_SNAPSHOT_SCHEMA = 1
+
+# roles a fresh submission may route to: a bare prefill/decode engine
+# is half of a pair — its pool either never decodes or never admits,
+# so placing new work there strands it
+_SUBMITTABLE_ROLES = ('monolithic', 'pair')
+
+
+class NoEligibleReplica(RuntimeError):
+    """Every replica is draining, breaching, full, or role-excluded."""
+
+
+def _pair_role(engine):
+    return ('pair' if hasattr(engine, 'prefill')
+            and hasattr(engine, 'decode') else 'monolithic')
+
+
+class ReplicaSignals:
+    """One replica's routing inputs as a pure value — the Router never
+    touches an engine, so policy is unit-testable from synthetic
+    signals alone (and the same decision runs off an HTTP scrape)."""
+
+    __slots__ = ('name', 'role', 'healthy', 'draining', 'breaching',
+                 'queue_depth', 'in_flight', 'pool_pressure', 'tok_s',
+                 'err_rate')
+
+    def __init__(self, name, *, role='monolithic', healthy=True,
+                 draining=False, breaching=False, queue_depth=0,
+                 in_flight=0, pool_pressure=0.0, tok_s=None,
+                 err_rate=0.0):
+        self.name = str(name)
+        self.role = role
+        self.healthy = bool(healthy)
+        self.draining = bool(draining)
+        self.breaching = bool(breaching)
+        self.queue_depth = int(queue_depth)
+        self.in_flight = int(in_flight)
+        self.pool_pressure = float(pool_pressure)
+        self.tok_s = tok_s
+        self.err_rate = float(err_rate)
+
+    @property
+    def load(self):
+        """Outstanding work: what least-loaded routing minimizes."""
+        return self.queue_depth + self.in_flight
+
+    def __repr__(self):
+        return (f'ReplicaSignals({self.name!r}, role={self.role!r}, '
+                f'healthy={self.healthy}, draining={self.draining}, '
+                f'breaching={self.breaching}, load={self.load}, '
+                f'pressure={self.pool_pressure:.2f}, '
+                f'tok_s={self.tok_s}, err_rate={self.err_rate:.3f})')
+
+    # -- scraping ----------------------------------------------------------
+
+    @classmethod
+    def from_engine(cls, name, engine):
+        """In-process scrape: host truth (queue/slots/allocator) plus
+        the replica's PRIVATE registry's windowed rate gauges and its
+        watchdog verdict — the same numbers `/metrics` and `/healthz`
+        would serve, without the HTTP round trip. Works for a
+        `DisaggPair` too (signals aggregate across both pools)."""
+        role = getattr(engine, 'phase_role', None) or _pair_role(engine)
+        if role == 'pair':
+            prefill, decode = engine.prefill, engine.decode
+            qd = (len(prefill.queue) + len(decode.queue)
+                  + len(engine._pending))
+            pressure = max(
+                prefill.allocator.utilization() / prefill.admit_watermark,
+                decode.allocator.utilization() / decode.admit_watermark)
+            draining = prefill.draining or decode.draining
+            parts = (prefill, decode)
+        else:
+            qd = len(engine.queue)
+            a = engine.allocator
+            pressure = a.utilization() / engine.admit_watermark
+            draining = engine.draining
+            parts = (engine,)
+        breaching, healthy = False, True
+        tok_s, err_rate = None, 0.0
+        for part in parts:
+            wd = getattr(part, '_watchdog', None)
+            if wd is not None and not wd.verdict()['healthy']:
+                breaching, healthy = True, False
+            reg = getattr(part, '_registry', None)
+            if reg is not None:
+                g = reg.get('serve.tok_s')
+                if g is not None:
+                    tok_s = (tok_s or 0.0) + g.value
+                g = reg.get('serve.err_rate')
+                if g is not None:
+                    err_rate = max(err_rate, g.value)
+        return cls(name, role=role, healthy=healthy and not draining,
+                   draining=draining, breaching=breaching,
+                   queue_depth=qd, in_flight=engine.in_flight(),
+                   pool_pressure=pressure, tok_s=tok_s,
+                   err_rate=err_rate)
+
+    @classmethod
+    def from_http(cls, name, base_url, timeout=2.0):
+        """Cross-process scrape off a replica's ops endpoint: verdict
+        from `/healthz` (a 503 — breach OR draining — is ineligible),
+        gauges from `/metrics` Prometheus text. Any transport error
+        reads as unhealthy: a replica that cannot answer its own
+        health check must not take traffic."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        base = base_url.rstrip('/')
+        try:
+            with urllib.request.urlopen(base + '/healthz',
+                                        timeout=timeout) as r:
+                hz, code = _json.loads(r.read()), r.status
+        except urllib.error.HTTPError as e:       # 503 carries a body
+            hz, code = _json.loads(e.read()), e.code
+        except Exception:  # noqa: BLE001 - unreachable = unhealthy
+            return cls(name, healthy=False, breaching=True)
+        try:
+            with urllib.request.urlopen(base + '/metrics',
+                                        timeout=timeout) as r:
+                gauges = _parse_prometheus(r.read().decode())
+        except Exception:  # noqa: BLE001
+            gauges = {}
+        draining = hz.get('status') == 'draining'
+        return cls(
+            name, role=hz.get('phase_role', 'monolithic'),
+            healthy=code == 200, draining=draining,
+            breaching=code != 200 and not draining,
+            queue_depth=int(gauges.get('serve_queue_depth', 0)),
+            in_flight=int(gauges.get('serve_in_flight', 0)),
+            pool_pressure=gauges.get('serve_pool_pressure', 0.0),
+            tok_s=gauges.get('serve_tok_s'),
+            err_rate=gauges.get('serve_err_rate', 0.0))
+
+
+def _parse_prometheus(text):
+    """name -> value for the plain (label-free) samples in a
+    Prometheus 0.0.4 text page — the gauges the router reads are all
+    label-free, so histogram series with `{le=...}` labels are simply
+    skipped."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#') or '{' in line:
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+class Router:
+    """Pure placement policy over `ReplicaSignals` — deterministic,
+    engine-free, unit-testable.
+
+    `eligible()` drops replicas that must not take fresh work:
+    draining, unhealthy/breaching, role-excluded (bare prefill/decode
+    halves), or at/over pool pressure `max_pressure`. `choose()` ranks
+    the eligible set least-loaded first, then lowest pool pressure,
+    then lowest windowed error rate, then HIGHEST windowed tok/s, and
+    finally by name — the total order that makes every tie-break
+    deterministic (gate parity depends on reproducible placement)."""
+
+    def __init__(self, max_pressure=None):
+        self.max_pressure = (None if max_pressure is None
+                             else float(max_pressure))
+
+    def eligible(self, signals):
+        out = []
+        for s in signals:
+            if s.draining or s.breaching or not s.healthy:
+                continue
+            if s.role not in _SUBMITTABLE_ROLES:
+                continue
+            if (self.max_pressure is not None
+                    and s.pool_pressure >= self.max_pressure):
+                continue
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _rank(s):
+        return (s.load, s.pool_pressure, s.err_rate,
+                -(s.tok_s if s.tok_s is not None else 0.0), s.name)
+
+    def choose(self, signals):
+        """The eligible replicas, best placement first (empty when
+        nothing is eligible — the caller decides whether that is
+        backpressure or an outage)."""
+        return sorted(self.eligible(signals), key=self._rank)
+
+
+class Fleet:
+    """N replicas behind one submission surface.
+
+    `factory(**kw)` builds ONE replica; the fleet calls it with
+    `metrics_registry=` (a fresh private `MetricsRegistry` — the
+    per-replica series isolation the router's signals need),
+    `rid_start=` (disjoint `rid_stride`-sized id spaces, so a request
+    keeps its rid across migration/resurrection hops), and
+    `postmortem_dir=` (where a killed replica's bundle lands). Pass
+    `artifact=` (a PR-7 AOT artifact dir) and every replica after the
+    first warms zero-compile; pre-built engines/pairs join via
+    `add()` and manage their own warmth.
+
+    The fleet steps its replicas round-robin per `step()` call and
+    advances the simulated deployment clock `sim_time_s` by the max
+    per-replica wall per round (see the module docstring). All
+    fleet-level counters/gauges land in the PROCESS registry."""
+
+    def __init__(self, factory=None, *, router=None, artifact=None,
+                 rid_stride=1 << 20, postmortem_dir=None,
+                 name_prefix='replica'):
+        self.factory = factory
+        self.router = router if router is not None else Router()
+        self.artifact = artifact
+        self.rid_stride = int(rid_stride)
+        if self.rid_stride < 1:
+            raise ValueError('rid_stride must be >= 1')
+        self.postmortem_dir = postmortem_dir
+        self.name_prefix = str(name_prefix)
+        self.replicas: dict = {}      # name -> engine-like, step order
+        self._index: dict = {}        # name -> rid-stride index
+        self._next_index = 0
+        self._where: dict = {}        # rid -> replica name
+        self._round = 0
+        self.sim_time_s = 0.0
+        # sim-time TTFT bookkeeping: rid -> submit sim-time while the
+        # first token is pending, then rid -> sim TTFT seconds
+        # (bounded — oldest evicted — so a long flood can't grow it)
+        self._submit_t: dict = {}
+        self._ttft: dict = {}
+        self.max_ttft_records = 4096
+        self.counts = {'routed': 0, 'migrations': 0, 'resurrections': 0,
+                       'restarts': 0}
+        self._routed_by: dict = {}    # name -> requests routed there
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _require_factory(self):
+        if self.factory is None:
+            raise RuntimeError(
+                'this Fleet has no factory — scale_to()/restart()/'
+                'resurrection need one to build replicas (pass '
+                'factory=, or add() pre-built replicas only)')
+
+    def _new_replica(self):
+        """Build + warm one replica from the factory on a fresh
+        private registry and a disjoint rid stride. With a shared
+        artifact the warm is `warm_attach` — zero compiles after the
+        first replica in the process warmed (the ms-scale elasticity
+        contract gate_fleet_sim pins)."""
+        self._require_factory()
+        idx = self._next_index
+        self._next_index += 1
+        name = f'{self.name_prefix}{idx}'
+        eng = self.factory(metrics_registry=_obs.MetricsRegistry(),
+                           rid_start=idx * self.rid_stride,
+                           postmortem_dir=self.postmortem_dir)
+        if self.artifact is not None:
+            eng.warmup(artifact=self.artifact)
+        self.replicas[name] = eng
+        self._index[name] = idx
+        self._set_replica_gauges()
+        return name
+
+    def add(self, name, engine, index=None):
+        """Adopt a pre-built replica (a tp-sharded engine, a
+        `DisaggPair`, anything engine-like). `index` reserves a rid
+        stride for bookkeeping symmetry; the caller owns the engine's
+        actual `rid_start` (and its warmth)."""
+        name = str(name)
+        if name in self.replicas:
+            raise ValueError(f'replica {name!r} already exists')
+        if index is None:
+            index = self._next_index
+        self._next_index = max(self._next_index, int(index) + 1)
+        self.replicas[name] = engine
+        self._index[name] = int(index)
+        self._set_replica_gauges()
+        return name
+
+    def scale_to(self, n):
+        """Grow or shrink to `n` replicas. Growth builds+warms from
+        the factory (zero-compile under a shared artifact); shrink
+        drains the youngest replicas and migrates their requests to
+        the survivors before closing them. Returns the replica-name
+        list after scaling."""
+        n = int(n)
+        if n < 1:
+            raise ValueError('a fleet keeps at least one replica')
+        before = len(self.replicas)
+        while len(self.replicas) < n:
+            self._new_replica()
+        while len(self.replicas) > n:
+            victim = next(reversed(self.replicas))
+            self._retire_replica(victim)
+        if len(self.replicas) != before:
+            _journal.record('fleet_scale', n_from=before,
+                            n_to=len(self.replicas))
+        return list(self.replicas)
+
+    def _retire_replica(self, name):
+        """Drain `name`, migrate everything it holds to survivors,
+        close it, and forget it."""
+        eng = self.replicas[name]
+        eng.drain(True)
+        self._migrate(name)
+        eng.close()
+        del self.replicas[name]
+        del self._index[name]
+        self._set_replica_gauges()
+
+    def _migrate(self, victim):
+        """Scatter every request the draining victim holds across the
+        surviving replicas via `adopt_request` — per request the
+        restore contract, so each migrated stream finishes bit-equal
+        to an uninterrupted run. Terminal-but-unretrieved records move
+        too: `result(rid)` answers on the survivor."""
+        eng = self.replicas[victim]
+        snap = eng.snapshot()
+        trails = snap.get('trails') or {}
+        moved = 0
+        for rec in list(snap['requests']) + list(snap['terminal']):
+            rid = int(rec['rid'])
+            if self._where.get(rid, victim) != victim:
+                continue               # already adopted elsewhere
+            target = self._pick_survivor(exclude=victim)
+            self.replicas[target].adopt_request(
+                rec, trail=trails.get(str(rid)))
+            if rid in self._where:
+                self._where[rid] = target
+            moved += 1
+        if moved:
+            self.counts['migrations'] += moved
+            _obs.inc('fleet.migrations', moved)
+        _journal.record('fleet_migrate', replica=victim, moved=moved)
+        return moved
+
+    def _pick_survivor(self, exclude):
+        # migration needs adopt_request on the target — a DisaggPair
+        # can serve fresh traffic but not splice a foreign record in
+        sigs = [s for s in self.signals()
+                if s.name != exclude
+                and hasattr(self.replicas[s.name], 'adopt_request')]
+        ranked = self.router.choose(sigs)
+        if not ranked:
+            raise NoEligibleReplica(
+                f'cannot migrate off {exclude!r}: no eligible surviving '
+                f'replica (scale up first, or undrain a survivor)')
+        return ranked[0].name
+
+    def restart(self, name):
+        """Rolling restart of one replica: spin the replacement FIRST
+        (zero-compile warm under the shared artifact), then drain +
+        migrate + close the old one — fleet capacity never dips below
+        N. Returns the replacement's name."""
+        if name not in self.replicas:
+            raise KeyError(f'unknown replica {name!r}')
+        self._require_factory()
+        fresh = self._new_replica()
+        self._retire_replica(name)
+        self.counts['restarts'] += 1
+        _obs.inc('fleet.restarts')
+        _journal.record('fleet_restart', replica=name, replacement=fresh)
+        return fresh
+
+    def _resurrect(self, name, error):
+        """A replica's step() raised — the worker-death path. Ensure
+        its postmortem bundle exists (step() already auto-dumped on a
+        real crash; the fault-seam path dumps here), read the bundle's
+        snapshot back, and restore it onto a fresh zero-compile
+        standby. The dead replica's requests — queued, preempted, AND
+        the running ones, re-entering as preempted — ride through the
+        crash; only the resurrection is observable (a `fleet_resurrect`
+        event and the counter)."""
+        from ..observability import postmortem as _postmortem
+
+        eng = self.replicas.pop(name)
+        self._index.pop(name, None)
+        if getattr(eng, 'last_postmortem', None) is None:
+            eng._auto_postmortem(error)
+        bundle_path = getattr(eng, 'last_postmortem', None)
+        if bundle_path is None:
+            raise RuntimeError(
+                f'replica {name!r} died ({error!r}) without a '
+                f'postmortem bundle — give the fleet (or the replica) '
+                f'a postmortem_dir so its requests can resurrect'
+            ) from error
+        snap = _postmortem.load_bundle(bundle_path)['snapshot']
+        standby = self._new_replica()
+        self.replicas[standby].restore(snap)
+        for rid, owner in list(self._where.items()):
+            if owner == name:
+                self._where[rid] = standby
+        try:
+            eng.close()
+        except Exception:  # noqa: BLE001 - it already crashed
+            pass
+        self.counts['resurrections'] += 1
+        _obs.inc('fleet.resurrections')
+        _journal.record('fleet_resurrect', replica=name,
+                        standby=standby, error=repr(error),
+                        bundle=bundle_path)
+        self._set_replica_gauges()
+        return standby
+
+    # -- the serving surface -----------------------------------------------
+
+    def signals(self):
+        """Live `ReplicaSignals` for every replica, in step order."""
+        return [ReplicaSignals.from_engine(name, eng)
+                for name, eng in self.replicas.items()]
+
+    def submit(self, prompt, **kw):
+        """Route one request: rank the eligible replicas and place on
+        the best one that accepts (a QueueFull there falls through to
+        the next — shedding is a per-replica verdict, the fleet's job
+        is to find room). Raises `NoEligibleReplica` when no replica
+        may take fresh work."""
+        ranked = self.router.choose(self.signals())
+        if not ranked:
+            raise NoEligibleReplica(
+                'no replica is eligible for new work (all draining, '
+                'breaching, or role-excluded)')
+        last_full = None
+        for s in ranked:
+            try:
+                rid = self.replicas[s.name].submit(prompt, **kw)
+            except QueueFull as e:
+                last_full = e
+                continue
+            self._where[rid] = s.name
+            self._submit_t[rid] = self.sim_time_s
+            self.counts['routed'] += 1
+            self._routed_by[s.name] = self._routed_by.get(s.name, 0) + 1
+            _obs.inc('fleet.routed')
+            _obs.inc(f'fleet.routed.{s.name}')
+            self._set_share_gauges()
+            return rid
+        raise last_full
+
+    def step(self):
+        """One fleet round: step every replica once (the `replica_step`
+        fault seam fires per replica first — a scripted kill looks
+        exactly like that replica's step() raising), resurrect any
+        replica that died, advance the sim clock by the round's max
+        per-replica wall, and settle sim-time TTFTs. Returns the
+        round's finished Requests across all replicas."""
+        finished = []
+        max_wall = 0.0
+        for name in list(self.replicas):
+            eng = self.replicas.get(name)
+            if eng is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                if _faults.ACTIVE is not None:
+                    _faults.fire('replica_step', replica=name,
+                                 step=self._round)
+                finished.extend(eng.step())
+            except Exception as e:  # noqa: BLE001 - any step() escape
+                #   is a worker death; the fleet's job is to resurrect
+                self._resurrect(name, e)
+                continue
+            max_wall = max(max_wall, time.perf_counter() - t0)
+        self._round += 1
+        self.sim_time_s += max_wall
+        self._settle_ttft()
+        return finished
+
+    def _settle_ttft(self):
+        """Move rids whose first token landed this round from the
+        pending map to the TTFT record (sim-time milliseconds, into
+        the `fleet.ttft_sim_ms` histogram and the bounded dict the
+        percentile report reads)."""
+        if not self._submit_t:
+            return
+        for rid in list(self._submit_t):
+            name = self._where.get(rid)
+            eng = self.replicas.get(name) if name is not None else None
+            if eng is None:
+                self._submit_t.pop(rid)
+                continue
+            req = self._req_of(eng, rid)
+            if req is None:            # terminal before we looked:
+                #   count submit->now (an upper bound, never an
+                #   undercount) so failed-fast requests don't vanish
+                ttft = self.sim_time_s - self._submit_t.pop(rid)
+            elif req.generated:
+                ttft = self.sim_time_s - self._submit_t.pop(rid)
+            else:
+                continue
+            self._ttft[rid] = ttft
+            _obs.observe('fleet.ttft_sim_ms', ttft * 1e3)
+            while len(self._ttft) > self.max_ttft_records:
+                self._ttft.pop(next(iter(self._ttft)))
+
+    @staticmethod
+    def _req_of(engine, rid):
+        if hasattr(engine, '_live'):
+            r = engine._live.get(rid)
+            if r is None:
+                r = engine._terminal.get(rid)
+            return r
+        # DisaggPair: the request lives in exactly one pool
+        return (Fleet._req_of(engine.prefill, rid)
+                or Fleet._req_of(engine.decode, rid))
+
+    def result(self, rid):
+        """Terminal outcome of `rid`, wherever it lives now (routing,
+        migration, and resurrection all keep `_where` current)."""
+        name = self._where.get(rid)
+        if name is None or name not in self.replicas:
+            raise KeyError(f'unknown rid {rid} (never routed here, or '
+                           f'already retrieved)')
+        out = self.replicas[name].result(rid)
+        self._where.pop(rid, None)
+        self._submit_t.pop(rid, None)
+        return out
+
+    def status(self, rid):
+        name = self._where.get(rid)
+        if name is None or name not in self.replicas:
+            raise KeyError(f'unknown rid {rid}')
+        return self.replicas[name].status(rid)
+
+    def drain(self, name, on=True):
+        """Flip one replica's drain flag (the router stops/resumes
+        routing there on the next signals() read)."""
+        self.replicas[name].drain(on)
+
+    def in_flight(self):
+        return sum(e.in_flight() for e in self.replicas.values())
+
+    def queue_depth(self):
+        return sum(s.queue_depth for s in self.signals())
+
+    def run(self, max_steps=None):
+        """Step until every replica is idle (or `max_steps`)."""
+        steps = 0
+        while any(e.in_flight() or s.queue_depth
+                  for e, s in zip(self.replicas.values(),
+                                  self.signals())):
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    # -- observability -----------------------------------------------------
+
+    def _set_replica_gauges(self):
+        _obs.set_gauge('fleet.replicas', len(self.replicas))
+
+    def _set_share_gauges(self):
+        total = self.counts['routed']
+        if not total:
+            return
+        for name, n in self._routed_by.items():
+            _obs.set_gauge(f'fleet.route_share.{name}', n / total)
+
+    def route_shares(self):
+        """name -> fraction of all routed requests placed there
+        (includes replicas that no longer exist — the shares are a
+        lifetime census, like the counters they derive from)."""
+        total = self.counts['routed']
+        return {name: n / total for name, n in self._routed_by.items()
+                } if total else {}
+
+    def ttft_percentiles(self, ps=(50, 95, 99)):
+        """Sim-time TTFT percentiles (milliseconds) over the recorded
+        requests — nearest-rank over the exact per-rid values, not the
+        histogram's bucket interpolation."""
+        vals = sorted(self._ttft.values())
+        if not vals:
+            return {f'p{p}': None for p in ps}
+        out = {}
+        for p in ps:
+            k = min(len(vals) - 1,
+                    max(0, int(round(p / 100 * len(vals) + 0.5)) - 1))
+            out[f'p{p}'] = vals[k] * 1e3
+        return out
+
+    def stats(self):
+        return {
+            'replicas': {name: eng.stats()
+                         for name, eng in self.replicas.items()},
+            'sim_time_s': self.sim_time_s,
+            'rounds': self._round,
+            'counts': dict(self.counts),
+            'route_shares': self.route_shares(),
+            'ttft_sim_ms': self.ttft_percentiles(),
+        }
+
+    # -- fleet snapshot (the fleet_snapshot wire) --------------------------
+
+    def snapshot(self):
+        """JSON-able fleet state: every replica's engine snapshot plus
+        the fleet's own routing table and clocks — enough for a fresh
+        `Fleet` over the same factory to `restore()` and finish every
+        stream bit-equal."""
+        return {
+            'schema': FLEET_SNAPSHOT_SCHEMA,
+            'replicas': {name: {'index': self._index[name],
+                                'snapshot': eng.snapshot()}
+                         for name, eng in self.replicas.items()},
+            'where': {str(rid): name
+                      for rid, name in self._where.items()},
+            'counts': dict(self.counts),
+            'sim_time_s': self.sim_time_s,
+            'next_index': self._next_index,
+        }
+
+    def restore(self, snap):
+        """Rebuild a `snapshot()` onto THIS fresh fleet (no replicas
+        yet): one factory-built replica per snapshot entry, each
+        engine-restored, the routing table and counters carried over."""
+        if self.replicas:
+            raise RuntimeError('restore() needs a fresh fleet — this '
+                               'one already has replicas')
+        if snap.get('schema') != FLEET_SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"unsupported fleet_snapshot schema "
+                f"{snap.get('schema')!r} (this fleet reads schema "
+                f'{FLEET_SNAPSHOT_SCHEMA})')
+        self._require_factory()
+        for name, ent in snap['replicas'].items():
+            idx = int(ent['index'])
+            eng = self.factory(
+                metrics_registry=_obs.MetricsRegistry(),
+                rid_start=idx * self.rid_stride,
+                postmortem_dir=self.postmortem_dir)
+            if self.artifact is not None:
+                eng.warmup(artifact=self.artifact)
+            eng.restore(ent['snapshot'])
+            self.replicas[name] = eng
+            self._index[name] = idx
+            self._next_index = max(self._next_index, idx + 1)
+        self._where = {int(rid): name
+                       for rid, name in snap.get('where', {}).items()}
+        for k, v in snap.get('counts', {}).items():
+            if k in self.counts:
+                self.counts[k] = int(v)
+        self.sim_time_s = float(snap.get('sim_time_s', 0.0))
+        self._next_index = max(self._next_index,
+                               int(snap.get('next_index', 0)))
+        self._set_replica_gauges()
+        return {'replicas': len(self.replicas),
+                'where': len(self._where)}
+
+    def close(self):
+        """Close every replica (idempotent)."""
+        for name in list(self.replicas):
+            try:
+                self.replicas[name].close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            self.replicas.pop(name, None)
+            self._index.pop(name, None)
+        self._set_replica_gauges()

@@ -249,6 +249,14 @@ class BlockAllocator:
         # around its call sites purely so fault scripts can target one
         # phase ("pool dries mid-decode but admission still works")
         self.phase = None
+        # which flight recorder the pool events land in — set by a
+        # private-registry engine so N in-process replicas' alloc/free
+        # trails never interleave (None = the process journal)
+        self.journal = None
+
+    def _record(self, kind, **fields):
+        (self.journal if self.journal is not None
+         else _journal.JOURNAL).record(kind, **fields)
 
     @property
     def usable(self):
@@ -307,15 +315,15 @@ class BlockAllocator:
                 self._unindex(p)
                 del self._cached[p]
                 self._free.append(p)
-                _journal.record('prefix_evict', page=p, phase=self.phase)
+                self._record('prefix_evict', page=p, phase=self.phase)
             self.prefix_evictions += harvest
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
         self.alloc_count += n
         self.high_water = max(self.high_water, len(self._ref))
-        _journal.record('alloc', n=n, phase=self.phase,
-                        free=len(self._free))
+        self._record('alloc', n=n, phase=self.phase,
+                     free=len(self._free))
         return pages
 
     def free(self, pages):
@@ -345,7 +353,7 @@ class BlockAllocator:
             else:
                 self._free.append(p)
         self.free_count += len(pages)
-        _journal.record('free', n=len(pages))
+        self._record('free', n=len(pages))
 
     # -- prefix index ------------------------------------------------------
 
@@ -380,7 +388,7 @@ class BlockAllocator:
                 self._ref[p] += 1
         self.prefix_shares += len(pages)
         self.high_water = max(self.high_water, len(self._ref))
-        _journal.record('share', n=len(pages))
+        self._record('share', n=len(pages))
         return pages
 
     def register_prefix(self, page, h):
@@ -417,7 +425,7 @@ class BlockAllocator:
         finally:
             self.phase = prev
         self.cow_count += 1
-        _journal.record('cow', src=page, new=new)
+        self._record('cow', src=page, new=new)
         return new
 
     def _unindex(self, page):
@@ -505,7 +513,7 @@ class Request:
                  'seq', 'state', 'admit_seq', 'times', 'enqueued_at',
                  'deadline', 'reason', 'error', 'result', 'page_hashes',
                  'temperature', 'top_k', 'top_p', 'sample_seed',
-                 'spec_next')
+                 'spec_next', 'journal')
 
     def __init__(self, rid, prompt, max_new_tokens, priority,
                  temperature=0.0, top_k=0, top_p=1.0, sample_seed=None):
@@ -529,6 +537,11 @@ class Request:
                                else rid)
         self.spec_next = None
         self.generated: list = []
+        # which flight recorder mark() writes to — the owning engine
+        # re-binds it to its own journal before the first mark, so a
+        # private-registry replica's request trails stay private
+        # (None = the process journal)
+        self.journal = None
         self.page_hashes = None  # full-prompt-page chain hashes, lazy
         self.seq = None          # arrival order, stamped by RequestQueue
         self.admit_seq = None    # last admission order (preemption ties)
@@ -555,7 +568,9 @@ class Request:
         if _obs.enabled():
             t = time.perf_counter() if t is None else t
             self.times.append((event, t))
-            _journal.record(event, rid=self.rid, t=t, **fields)
+            (self.journal if self.journal is not None
+             else _journal.JOURNAL).record(event, rid=self.rid, t=t,
+                                           **fields)
 
     def when(self, event):
         """First timestamp for `event`, or None."""
@@ -1328,8 +1343,34 @@ class ServingEngine:
                  ops_port=None, ops_host='127.0.0.1', watchdog=None,
                  slo_rules=None, ts_interval_s=None,
                  draft=None, num_draft_tokens=4, kv_cache_dtype=None,
-                 phase_role='monolithic'):
+                 phase_role='monolithic', metrics_registry=None,
+                 journal=None, rid_start=0):
         params = inspect.signature(model.forward).parameters
+        # telemetry scope (docs/observability.md#per-replica-scopes):
+        # metrics_registry gives this engine a PRIVATE MetricsRegistry
+        # — every serve.*/pool.* series, the windowed rate gauges, and
+        # the watchdog's health series land there instead of the
+        # process registry, so N in-process replicas (the fleet shape)
+        # never merge their series. A private registry implies a
+        # private flight-recorder journal too (request trails, pool
+        # events) unless `journal=` passes one explicitly. None/None =
+        # the process globals, prior behavior bit-identical.
+        self._registry = (metrics_registry if metrics_registry is not None
+                          else _obs.REGISTRY)
+        if journal is not None:
+            self._jr = journal
+        elif metrics_registry is not None:
+            self._jr = _journal.Journal()
+        else:
+            self._jr = _journal.JOURNAL
+        # rid_start offsets this engine's request-id space: fleet
+        # replicas take disjoint strides so a request keeps its rid
+        # across a drain-migration or kill-resurrection hop to another
+        # replica (rids are the join key for trails and results)
+        self._rid = int(rid_start)
+        if self._rid < 0:
+            raise ValueError(f'rid_start must be >= 0, got {rid_start}')
+        self._rid_start = self._rid
         if 'block_tables' not in params:
             raise NotImplementedError(
                 f'{type(model).__name__} lacks block_tables in its '
@@ -1495,6 +1536,8 @@ class ServingEngine:
             # actually exercise preemption
             num_blocks = self.max_slots * self.max_blocks_per_seq + 1
         self.allocator = BlockAllocator(num_blocks, self.block_size)
+        if self._jr is not _journal.JOURNAL:
+            self.allocator.journal = self._jr
         self.queue = RequestQueue()
         # admission control / load shedding (docs/serving.md#resilience):
         # max_queue bounds what submit() will hold (QueueFull past it —
@@ -1658,7 +1701,6 @@ class ServingEngine:
         self.counts = {'finished': 0, 'failed': 0, 'expired': 0,
                        'cancelled': 0, 'rejected': 0, 'shed': 0,
                        'admission_paused': 0}
-        self._rid = 0
         self._admit_seq = itertools.count()
         self.preemption_count = 0
         self._tokens_out = 0
@@ -1729,28 +1771,44 @@ class ServingEngine:
         self.draining = False
         self.ops_server = None
         self._watchdog = None
+        # a private registry forces the private ring too: the whole
+        # point of metrics_registry= is per-replica series, and the
+        # windowed rate gauges ARE series (they must derive from and
+        # publish into THIS replica's registry, not the process one)
+        private = self._registry is not _obs.REGISTRY
         want_ops = (ops_port is not None or watchdog is not None
-                    or slo_rules is not None or ts_interval_s is not None)
+                    or slo_rules is not None or ts_interval_s is not None
+                    or private)
         if want_ops:
             self._ts = _obs_ts.WindowedTimeseries(
                 interval_s=(1.0 if ts_interval_s is None
-                            else float(ts_interval_s)))
+                            else float(ts_interval_s)),
+                registry=self._registry if private else None,
+                journal=self._jr if private else None)
             if watchdog is not False:
                 if isinstance(watchdog, _obs_wd.Watchdog):
                     self._watchdog = watchdog
                     if self._watchdog.postmortem_engine is None:
                         self._watchdog.postmortem_engine = self
+                    if private and self._watchdog.registry is None:
+                        self._watchdog.registry = self._registry
+                    if private and self._watchdog.journal is None:
+                        self._watchdog.journal = self._jr
                 else:
                     rules = (slo_rules if slo_rules is not None
                              else _obs_wd.default_serving_rules(
                                  engine=self))
                     self._watchdog = _obs_wd.Watchdog(
-                        rules, postmortem_engine=self)
+                        rules, postmortem_engine=self,
+                        registry=self._registry if private else None,
+                        journal=self._jr if private else None)
         else:
             self._ts = _obs_ts.TIMESERIES
         if ops_port is not None:
-            self.ops_server = _start_ops_server(self, port=ops_port,
-                                                host=ops_host)
+            self.ops_server = _start_ops_server(
+                self, port=ops_port, host=ops_host,
+                registry=self._registry if private else None,
+                journal=self._jr if private else None)
         self._update_gauges()
 
     # -- bookkeeping -------------------------------------------------------
@@ -1834,11 +1892,31 @@ class ServingEngine:
         with the measured wall duration)."""
         return COMPILE_CACHE.note(self.registry_key(*tag))
 
+    # scoped-telemetry writers: a private-registry replica's counters/
+    # gauges land in ITS registry (the fleet's per-replica signals),
+    # a default engine hits the module conveniences byte-for-byte.
+    # compile.* stays global on purpose — engine.py's trace counters
+    # are process truth either way.
+    def _inc(self, name, n=1):
+        if self._registry is _obs.REGISTRY:
+            _obs.inc(name, n)
+        elif _obs.enabled():
+            self._registry.counter(name).inc(n)
+
+    def _set_gauge(self, name, v):
+        if self._registry is _obs.REGISTRY:
+            _obs.set_gauge(name, v)
+        elif _obs.enabled():
+            self._registry.gauge(name).set(v)
+
+    def _record(self, kind, **fields):
+        self._jr.record(kind, **fields)
+
     def _metrics(self):
         """Cached registry handles for the hot per-step records (the
         generation check makes a registry reset() safe: stale handles
         are re-resolved instead of written into orphaned objects)."""
-        R = _obs.REGISTRY
+        R = self._registry
         if self._mgen != R.generation:
             self._mx = {
                 'ttft': R.histogram('serve.ttft_ms'),
@@ -2459,7 +2537,7 @@ class ServingEngine:
             # the same typed backpressure signal a full queue gives,
             # counted under 'rejected' so the refusals are visible
             self.counts['rejected'] += 1
-            _obs.inc('serve.rejected')
+            self._inc('serve.rejected')
             raise QueueFull(
                 'engine draining: new submissions refused — route to '
                 'another replica (drain(False) reopens admission)')
@@ -2510,6 +2588,8 @@ class ServingEngine:
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       sample_seed=(self._rid if seed is None
                                    else int(seed)))
+        if self._jr is not _journal.JOURNAL:
+            req.journal = self._jr
         if victim is not None:
             self._shed(victim)
         self._rid += 1
@@ -2518,7 +2598,7 @@ class ServingEngine:
             self._deadlines_live += 1
         req.mark('arrival', prompt_len=plen, max_new_tokens=mnt,
                  priority=priority)
-        _obs.inc('serve.requests')
+        self._inc('serve.requests')
         self._live[req.rid] = req
         self.queue.push(req)
         return req.rid
@@ -2557,7 +2637,7 @@ class ServingEngine:
                     victim = cand
         if victim is None:
             self.counts['rejected'] += 1
-            _obs.inc('serve.rejected')
+            self._inc('serve.rejected')
             raise QueueFull(
                 f'queue full ({len(self.queue)}/{self.max_queue}), '
                 f'policy={self.shed_policy!r}: request rejected — back '
@@ -2575,7 +2655,7 @@ class ServingEngine:
                             f'arrival (queue full at {self.max_queue})',
                      count=False)
         self.counts['shed'] += 1
-        _obs.inc('serve.shed')
+        self._inc('serve.shed')
 
     def result(self, rid):
         """Terminal outcome of a request, handed over ONCE (removed
@@ -2648,8 +2728,8 @@ class ServingEngine:
         if on == self.draining:
             return
         self.draining = on
-        _journal.record('drain', on=on)
-        _obs.set_gauge('serve.draining', 1.0 if on else 0.0)
+        self._record('drain', on=on)
+        self._set_gauge('serve.draining', 1.0 if on else 0.0)
 
     def close(self):
         """Release the engine's external resources — today that is the
@@ -2771,6 +2851,8 @@ class ServingEngine:
                       top_k=r.get('top_k', self.top_k),
                       top_p=r.get('top_p', self.top_p),
                       sample_seed=r.get('sample_seed'))
+        if self._jr is not _journal.JOURNAL:
+            req.journal = self._jr
         sn = r.get('spec_next')
         req.spec_next = int(sn) if sn is not None else None
         req.generated = [int(t) for t in r['generated']]
@@ -2807,11 +2889,11 @@ class ServingEngine:
         trails = {}
         if _journal.journal_enabled():
             for r in live + terminal:
-                t = _journal.trail(r['rid'])
+                t = self._jr.trail(r['rid'])
                 if t:
                     trails[str(r['rid'])] = t
-        _journal.record('snapshot', requests=len(live),
-                        terminal=len(terminal))
+        self._record('snapshot', requests=len(live),
+                     terminal=len(terminal))
         return {
             'schema': SNAPSHOT_SCHEMA,
             'config': self._snapshot_config(),
@@ -2850,7 +2932,7 @@ class ServingEngine:
         that cannot fit THIS pool, RuntimeError when the engine is not
         fresh. Returns a report dict."""
         if (self.in_flight() or len(self.queue) or self._live
-                or self._terminal or self._rid):
+                or self._terminal or self._rid != self._rid_start):
             raise RuntimeError(
                 'restore() needs a fresh engine: this one has requests '
                 'queued, in flight, or unretrieved, or has already '
@@ -2902,9 +2984,9 @@ class ServingEngine:
         # a same-process hot standby shares the journal and injects
         # nothing (the trails are already there)
         for rid_s, evs in (snap.get('trails') or {}).items():
-            _journal.JOURNAL.inject_trail(int(rid_s), evs)
-        _journal.record('restore', requests=len(snap['requests']),
-                        terminal=len(snap['terminal']))
+            self._jr.inject_trail(int(rid_s), evs)
+        self._record('restore', requests=len(snap['requests']),
+                     terminal=len(snap['terminal']))
         for r in snap['requests']:
             req = rebuild(r)
             if req.state == 'running':
@@ -2951,7 +3033,7 @@ class ServingEngine:
         # snapshots without the key restore un-drained
         if snap.get('draining', False):
             self.draining = True
-            _obs.set_gauge('serve.draining', 1.0)
+            self._set_gauge('serve.draining', 1.0)
         # older snapshots carry an 'rng' key from the pre-PR-15 shared
         # sampling stream; per-request stateless keys made it
         # meaningless, so it is accepted and ignored
@@ -2965,6 +3047,61 @@ class ServingEngine:
         return {'requests': len(snap['requests']),
                 'terminal': len(snap['terminal']),
                 'next_rid': self._rid}
+
+    def adopt_request(self, record, trail=None):
+        """Adopt ONE migrated request into this RUNNING engine — the
+        fleet's scale-down path (docs/serving.md#fleet). `restore()`
+        rebuilds a whole snapshot onto a fresh standby; a drain-
+        migration instead scatters a victim replica's requests across
+        survivors that are mid-serve, so this takes a single
+        `_request_record` dict (+ its flight-recorder trail) and
+        splices it in: terminal records land in `_terminal` (result()
+        semantics unchanged — the rid answers on THIS replica now),
+        live ones re-enter as preempted via the queue (their pages
+        died with the victim; re-prefill reproduces the stream
+        bit-equal, exactly the restore contract). Queue-bound exempt,
+        like preemption requeues: migrated work was already admitted
+        once. Raises ValueError on a rid collision (live, or terminal
+        and unretrieved here) or a request this pool cannot fit —
+        before any state is touched."""
+        rid = int(record['rid'])
+        if rid in self._live or rid in self._terminal:
+            raise ValueError(
+                f'adopt_request: rid {rid} already exists on this '
+                f'engine — fleet rid_start strides must keep replica '
+                f'id spaces disjoint')
+        total = len(record['prompt']) + record['max_new_tokens']
+        if (total > self.max_context_len
+                or _ceil_div(total, self.block_size)
+                > self.allocator.usable):
+            raise ValueError(
+                f'adopt_request: rid {rid} needs {total} context '
+                f'tokens — it cannot fit this engine (max_context_len '
+                f'{self.max_context_len}, {self.allocator.usable} '
+                f'usable pages)')
+        if trail:
+            self._jr.inject_trail(rid, trail)
+        now = time.perf_counter()
+        req = self._rebuild_request(record, now=now)
+        if req.state in ('finished', 'failed', 'expired', 'cancelled'):
+            self._terminal[rid] = req
+            while len(self._terminal) > self.max_terminal:
+                self._terminal.pop(next(iter(self._terminal)))
+            return rid
+        if req.state == 'running':
+            req.state = 'preempted'
+        # fresh arrival seq on THIS engine: the victim's seq space can
+        # collide with the survivor's, and a heap tie on (priority,
+        # seq) would fall through to comparing Request objects
+        req.seq = None
+        req.mark('adopted', state=req.state,
+                 generated=len(req.generated))
+        self._live[rid] = req
+        if req.deadline is not None:
+            self._deadlines_live += 1
+        self.queue.push(req)
+        self._update_gauges()
+        return rid
 
     # -- KV-cache migration (disaggregated prefill/decode serving) ---------
 
@@ -3112,9 +3249,9 @@ class ServingEngine:
                 'compile:serve_export', key=('serve_export', Cx),
                 dur_s=t_commit - t_dispatch,
                 geometry=str(self._geometry()))
-            _journal.record('compile', dispatch='serve_export',
-                            key=str(('serve_export', Cx)),
-                            dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
+            self._record('compile', dispatch='serve_export',
+                         key=str(('serve_export', Cx)),
+                         dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
 
         def crop(tmp, n):
             layers = []
@@ -3151,7 +3288,7 @@ class ServingEngine:
             'layers': layers,
             'draft_kv_len': dkvlen,
             'draft_layers': draft_layers,
-            'trail': (_journal.trail(rid)
+            'trail': (self._jr.trail(rid)
                       if _journal.journal_enabled() else []),
         }
         self.migration_counts['exported'] += 1
@@ -3159,7 +3296,7 @@ class ServingEngine:
         if _obs.enabled():
             self._metrics()['migration_ms'].observe(
                 (time.perf_counter() - t0) * 1e3)
-            _obs.inc('serve.kv_exported')
+            self._inc('serve.kv_exported')
         return blob
 
     def import_kv(self, rid, blob):
@@ -3284,7 +3421,7 @@ class ServingEngine:
             if pages:
                 a.free(pages)
             self.migration_counts['import_failed'] += 1
-            _journal.record('kv_import_failed', rid=rid, kv_len=kvlen)
+            self._record('kv_import_failed', rid=rid, kv_len=kvlen)
             raise
         finally:
             a.phase = None
@@ -3324,7 +3461,7 @@ class ServingEngine:
         except Exception:
             a.free(pages)
             self.migration_counts['import_failed'] += 1
-            _journal.record('kv_import_failed', rid=rid, kv_len=kvlen)
+            self._record('kv_import_failed', rid=rid, kv_len=kvlen)
             raise
         t_commit = time.perf_counter()
         if not reg_hit:
@@ -3332,15 +3469,15 @@ class ServingEngine:
                 'compile:serve_import', key=('serve_import', Cx),
                 dur_s=t_commit - t_dispatch,
                 geometry=str(self._geometry()))
-            _journal.record('compile', dispatch='serve_import',
-                            key=str(('serve_import', Cx)),
-                            dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
+            self._record('compile', dispatch='serve_import',
+                         key=str(('serve_import', Cx)),
+                         dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
         # ONE trail follows the request across engines: re-register
         # the source's events FIRST (the journal bumps its seq past
         # them; a same-process pair shares the journal and injects
         # nothing), so the marks below extend the trail in order
         if blob.get('trail'):
-            _journal.JOURNAL.inject_trail(rid, blob['trail'])
+            self._jr.inject_trail(rid, blob['trail'])
         self._live[rid] = req
         if req.deadline is not None:
             self._deadlines_live += 1
@@ -3373,7 +3510,7 @@ class ServingEngine:
         if _obs.enabled():
             self._metrics()['migration_ms'].observe(
                 (time.perf_counter() - t0) * 1e3)
-            _obs.inc('serve.kv_imported')
+            self._inc('serve.kv_imported')
         self._update_gauges()
         return slot
 
@@ -3446,11 +3583,11 @@ class ServingEngine:
             out = os.path.join(
                 self.postmortem_dir,
                 f'postmortem-{os.getpid()}-{self._postmortem_seq}')
-            _journal.record('postmortem', error=repr(error))
+            self._record('postmortem', error=repr(error))
             _postmortem.dump_bundle(out, engine=self, error=error,
                                     reason='worker death in step()')
             self.last_postmortem = out
-            _obs.inc('serve.postmortems')
+            self._inc('serve.postmortems')
         except Exception:  # noqa: BLE001 - never mask the real crash
             pass
 
@@ -3695,7 +3832,7 @@ class ServingEngine:
                     *sample_args, ftok_d, forced_d, ctx_bucket=Sb,
                     **common)
             self.prefix_counts['chunk_steps'] += 1
-            _obs.inc('serve.chunk_steps')
+            self._inc('serve.chunk_steps')
             if self._cow_release:
                 # the dispatch carrying the CoW copies is issued: the
                 # pinned source pages may now be freed (any future
@@ -3751,7 +3888,7 @@ class ServingEngine:
                 f'compile:{dispatch_key[0]}', key=dispatch_key,
                 dur_s=t_commit - t_dispatch,
                 geometry=str(self._geometry()))
-            _journal.record(
+            self._record(
                 'compile', dispatch=dispatch_key[0],
                 key=str(dispatch_key),
                 dur_ms=round((t_commit - t_dispatch) * 1e3, 3))
@@ -3787,8 +3924,8 @@ class ServingEngine:
                 self.spec_counts['proposed'] += self.spec_window
                 self.spec_counts['accepted'] += max(0, take - 1)
                 if telemetry:
-                    _obs.inc('serve.spec_proposed', self.spec_window)
-                    _obs.inc('serve.spec_accepted', max(0, take - 1))
+                    self._inc('serve.spec_proposed', self.spec_window)
+                    self._inc('serve.spec_accepted', max(0, take - 1))
             else:
                 take = min(W, req.remaining)
                 committed = []
@@ -3830,7 +3967,7 @@ class ServingEngine:
                 if row_ms is not None:
                     mx['itl'].observe(row_ms, n=itl_n)
                 else:
-                    _obs.inc('serve.itl_skipped_compile', itl_n)
+                    self._inc('serve.itl_skipped_compile', itl_n)
                 req.mark('window', t_commit, n=len(committed),
                          total=len(req.generated))
             done = (req.remaining == 0
@@ -3867,15 +4004,15 @@ class ServingEngine:
                 fl = cost.get('flops')
                 if fl and wall > 0:
                     fps = fl / wall
-                    _obs.set_gauge('serve.model_flops_per_s', fps)
+                    self._set_gauge('serve.model_flops_per_s', fps)
                     mfu = (fps / self._peak_flops
                            if self._peak_flops else None)
                     if mfu is not None:
-                        _obs.set_gauge('serve.mfu_est', mfu)
+                        self._set_gauge('serve.mfu_est', mfu)
                     ba = cost.get('bytes_accessed')
                     if ba:
-                        _obs.set_gauge('serve.roofline_intensity',
-                                       fl / ba)
+                        self._set_gauge('serve.roofline_intensity',
+                                        fl / ba)
                     self._last_mfu = {
                         'tag': dispatch_key, 'flops': fl,
                         'bytes_accessed': ba,
@@ -4071,13 +4208,13 @@ class ServingEngine:
                     # Shared pages a hit would revive off the cached
                     # LRU count as pressure too.
                     self.counts['admission_paused'] += 1
-                    _obs.inc('serve.admission_paused')
+                    self._inc('serve.admission_paused')
                     if self._paused_head != req.rid:
                         # edge-triggered: one trail event per stall,
                         # not one per paused scheduler step
                         self._paused_head = req.rid
-                        _journal.record('admission_paused', rid=req.rid,
-                                        held_after=held_after)
+                        self._record('admission_paused', rid=req.rid,
+                                     held_after=held_after)
                     break
                 self.queue.pop()
                 got = []             # references to return on unwind
@@ -4139,15 +4276,15 @@ class ServingEngine:
                     if hit:
                         self.prefix_counts['hits'] += 1
                         self.prefix_counts['hit_tokens'] += start
-                        _obs.inc('serve.prefix_hits')
-                        _obs.inc('serve.prefix_hit_tokens', start)
+                        self._inc('serve.prefix_hits')
+                        self._inc('serve.prefix_hit_tokens', start)
                     elif not hit_skipped:
                         # a matched-but-unprofitable hit counts in
                         # NEITHER hits nor misses (hits_skipped above):
                         # hit rate = hits/(hits+misses) must read cache
                         # effectiveness, not the guard's declines
                         self.prefix_counts['misses'] += 1
-                        _obs.inc('serve.prefix_misses')
+                        self._inc('serve.prefix_misses')
                 chunked = (self.prefill_chunk is not None
                            and req.context_len - start > self.prefill_chunk)
                 if start > 0 or chunked:
@@ -4164,7 +4301,7 @@ class ServingEngine:
                     self._dctx[slot] = start
                     if chunked:
                         self.prefix_counts['chunked_admissions'] += 1
-                        _obs.inc('serve.chunked_admissions')
+                        self._inc('serve.chunked_admissions')
                 else:
                     placed.append((slot, req))
             _sp.args['admitted'] = admitted
@@ -4199,7 +4336,7 @@ class ServingEngine:
                                      # admission_paused edge trigger
         req.mark('admitted', slot=slot, pages=len(pages))
         if _obs.enabled():
-            _obs.inc('serve.admissions')
+            self._inc('serve.admissions')
             if req.enqueued_at is not None:
                 self._metrics()['qwait'].observe(
                     (time.perf_counter() - req.enqueued_at) * 1e3)
@@ -4410,7 +4547,7 @@ class ServingEngine:
         req.state = 'preempted'
         self.preemption_count += 1
         req.mark('preempted', generated=len(req.generated))
-        _obs.inc('serve.preemptions')
+        self._inc('serve.preemptions')
         self.queue.push(req)
 
     def _retire(self, req, state, reason=None, error=None, result=None,
@@ -4430,7 +4567,7 @@ class ServingEngine:
         req.mark(state, reason=reason, tokens=len(req.generated))
         if count:
             self.counts[state] += 1
-            _obs.inc(f'serve.{state}')
+            self._inc(f'serve.{state}')
         if self._live.pop(req.rid, None) is not None \
                 and req.deadline is not None:
             self._deadlines_live -= 1

@@ -55,9 +55,13 @@ class OpsServer:
 
     def __init__(self, engine=None, *, host='127.0.0.1', port=0,
                  registry=None, timeseries=None, watchdog=None,
-                 journal_tail=200, ts_tail=30):
+                 journal=None, journal_tail=200, ts_tail=30):
         self.engine = engine
         self.registry = registry if registry is not None else _metrics.REGISTRY
+        # whose flight recorder /statusz tails — a private-registry
+        # replica passes its private journal so N per-replica ops
+        # endpoints on one host never interleave each other's events
+        self.journal = journal if journal is not None else _journal.JOURNAL
         self.timeseries = (timeseries if timeseries is not None
                            else getattr(engine, '_ts', None))
         self.watchdog = (watchdog if watchdog is not None
@@ -188,7 +192,7 @@ class OpsServer:
                 'windows': self.timeseries.windows(self.ts_tail)}
         if self.watchdog is not None:
             payload['watchdog'] = self.watchdog.verdict()
-        payload['journal_tail'] = _journal.tail(self.journal_tail)
+        payload['journal_tail'] = self.journal.tail(self.journal_tail)
         return payload
 
 

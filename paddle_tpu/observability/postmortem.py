@@ -113,10 +113,18 @@ def dump_bundle(out_dir, engine=None, error=None, reason=None,
     def _json_to(name, payload):
         _write(name, lambda p: _dump_json(p, payload))
 
-    _json_to('metrics.json', _metrics.REGISTRY.snapshot())
+    # a private-registry replica's bundle carries ITS series and ITS
+    # flight recorder (the fleet's kill-resurrection reads them back);
+    # default engines keep dumping the process globals byte-for-byte
+    reg = getattr(engine, '_registry', None)
+    reg = reg if reg is not None else _metrics.REGISTRY
+    jr = getattr(engine, '_jr', None)
+    jr = jr if jr is not None else _journal.JOURNAL
+
+    _json_to('metrics.json', reg.snapshot())
     _write('host_trace.json', _tracing.TRACER.export)
     _write('journal.jsonl',
-           lambda p: _journal.JOURNAL.save(p, tail=JOURNAL_TAIL))
+           lambda p: jr.save(p, tail=JOURNAL_TAIL))
 
     census = None
     if engine is not None:
@@ -147,9 +155,9 @@ def dump_bundle(out_dir, engine=None, error=None, reason=None,
         'fingerprint': env_fingerprint(),
         'engine': census,
         'journal': {
-            'events': len(_journal.JOURNAL),
-            'dropped': _journal.JOURNAL.dropped,
-            'trails': len(_journal.JOURNAL.trails()),
+            'events': len(jr),
+            'dropped': jr.dropped,
+            'trails': len(jr.trails()),
         },
         'extra': extra,
         'files': sorted(written),

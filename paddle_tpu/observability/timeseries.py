@@ -109,7 +109,7 @@ class WindowedTimeseries:
     lock (uncontended — commits happen once per interval)."""
 
     def __init__(self, interval_s=1.0, max_windows=120, registry=None,
-                 derive=True):
+                 derive=True, journal=None):
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError('interval_s must be > 0')
@@ -117,6 +117,11 @@ class WindowedTimeseries:
         if self.max_windows < 1:
             raise ValueError('max_windows must be >= 1')
         self.registry = registry if registry is not None else _metrics.REGISTRY
+        # which journal's overflow count rides the windows as the
+        # `journal.dropped_events` pseudo-counter — a private-registry
+        # replica passes its private journal so its drop-rate windows
+        # never read another replica's ring
+        self.journal = journal if journal is not None else _journal.JOURNAL
         self.derive = bool(derive)
         self._ring: collections.deque = collections.deque(
             maxlen=self.max_windows)
@@ -146,7 +151,7 @@ class WindowedTimeseries:
             else:
                 self._edges[name] = m.edges
                 hists[name] = (tuple(m.counts), m.count, m.sum)
-        counters['journal.dropped_events'] = _journal.JOURNAL.dropped
+        counters['journal.dropped_events'] = self.journal.dropped
         return {'counters': counters, 'gauges': gauges, 'hists': hists}
 
     def _rebase(self, now):

@@ -174,7 +174,14 @@ class Watchdog:
 
     def __init__(self, rules, *, postmortem_engine=None,
                  postmortem_min_interval_s=300.0, on_breach=None,
-                 on_recover=None):
+                 on_recover=None, registry=None, journal=None):
+        # where the watchdog.* counters/gauges and the slo_breach /
+        # slo_recovered journal events land. None = the process
+        # globals (prior behavior); a private-registry replica passes
+        # its own scopes so N in-process replicas' health series never
+        # merge (the fleet routes off per-replica verdicts).
+        self.registry = registry
+        self.journal = journal
         self.rules = list(rules)
         names = [r.name for r in self.rules]
         if len(set(names)) != len(names):
@@ -238,23 +245,41 @@ class Watchdog:
                 if (st['state'] == 'breach'
                         and st['false_streak'] >= rule.clear_windows):
                     self._edge_recover(rule, st, window, value)
-        _metrics.inc('watchdog.evaluations')
+        self._inc('watchdog.evaluations')
         breaching = self.breaching()
-        _metrics.set_gauge('watchdog.healthy',
-                           0.0 if breaching else 1.0)
-        _metrics.set_gauge('watchdog.breaching_rules', len(breaching))
+        self._set_gauge('watchdog.healthy',
+                        0.0 if breaching else 1.0)
+        self._set_gauge('watchdog.breaching_rules', len(breaching))
         return edges
+
+    # -- scoped telemetry (private registry/journal when configured) -------
+
+    def _inc(self, name, n=1):
+        if self.registry is None:
+            _metrics.inc(name, n)
+        elif _metrics.enabled():
+            self.registry.counter(name).inc(n)
+
+    def _set_gauge(self, name, v):
+        if self.registry is None:
+            _metrics.set_gauge(name, v)
+        elif _metrics.enabled():
+            self.registry.gauge(name).set(v)
+
+    def _record(self, kind, **fields):
+        (self.journal if self.journal is not None
+         else _journal.JOURNAL).record(kind, **fields)
 
     def _edge_breach(self, rule, st, window, value):
         st['state'] = 'breach'
         st['breaches'] += 1
         st['breached_at_idx'] = window['idx']
         self.breaches_total += 1
-        _metrics.inc('watchdog.breaches')
-        _journal.record('slo_breach', rule=rule.name, expr=rule.expr,
-                        op=rule.op, threshold=rule.threshold,
-                        value=_num(value), windows=st['true_streak'],
-                        window_idx=window['idx'])
+        self._inc('watchdog.breaches')
+        self._record('slo_breach', rule=rule.name, expr=rule.expr,
+                     op=rule.op, threshold=rule.threshold,
+                     value=_num(value), windows=st['true_streak'],
+                     window_idx=window['idx'])
         if self.on_breach is not None:
             self.on_breach(rule, st)
         self._maybe_postmortem(rule, value)
@@ -263,7 +288,7 @@ class Watchdog:
         st['state'] = 'ok'
         st['recoveries'] += 1
         self.recoveries_total += 1
-        _metrics.inc('watchdog.recoveries')
+        self._inc('watchdog.recoveries')
         # clamped at 0: after a snapshot/restore failover the carried
         # breached_at_idx indexes the PRIMARY's ring while this ring
         # restarted at 0 — the true duration spans two rings and is
@@ -271,10 +296,10 @@ class Watchdog:
         since = st['breached_at_idx']
         breached = (max(0, window['idx'] - since)
                     if since is not None else None)
-        _journal.record('slo_recovered', rule=rule.name,
-                        value=_num(value),
-                        breached_windows=breached,
-                        window_idx=window['idx'])
+        self._record('slo_recovered', rule=rule.name,
+                     value=_num(value),
+                     breached_windows=breached,
+                     window_idx=window['idx'])
         if self.on_recover is not None:
             self.on_recover(rule, st)
 

@@ -52,6 +52,7 @@ Seam sites wired in-tree (callers pass site-specific context):
   | `dispatch`     | `ServingEngine.step`, per dispatch        | `kind` ('prefill'/'chunk'/'window'), `rids`/`bucket` |
   | `draft_dispatch` | `ServingEngine.step`, before each speculative propose/verify dispatch | `k`, `rids` (the live decoding requests riding the window) |
   | `shm_push`     | `io.dataloader._push_with_backoff`        | `worker_id`, `timeout` |
+  | `replica_step` | `Fleet.step`, before each replica's step() (a scripted exception kills that replica exactly as its own step() raising would — the fleet dumps its postmortem bundle and resurrects its requests on a standby) | `replica`, `step` |
 
 Every ctx also carries `site` and `call` (1-based per-site call count
 since install). What each seam DOES with a scripted exception is the

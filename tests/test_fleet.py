@@ -1,0 +1,404 @@
+"""Fleet layer (inference/fleet.py): load-aware routing + elasticity.
+
+Covers the tentpole properties:
+  - Router policy as a PURE unit: synthetic `ReplicaSignals` in,
+    placement out — least-loaded first, drain/breach/unhealthy
+    exclusion, phase-role affinity (bare prefill/decode halves never
+    take fresh work, pairs do), pressure ceiling, and a deterministic
+    name tie-break;
+  - per-replica telemetry scoping (`metrics_registry=`): N in-process
+    engines keep their serve.*/pool.* series and journal trails
+    apart, and the ephemeral-port ops endpoint (`ops_port=0`) reports
+    its real port and serves the PRIVATE registry;
+  - `adopt_request`: a drained replica's record splices into a
+    RUNNING survivor and finishes bit-equal to an uninterrupted run,
+    with rid-collision and fit refusals up front;
+  - fleet elasticity: scale up zero-compile from one shared AOT
+    artifact, scale down with drain-migration, kill-resurrection off
+    the postmortem bundle via the `replica_step` seam — greedy parity
+    and zero leaked pages throughout, plus the fleet_snapshot
+    roundtrip.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+pytestmark = pytest.mark.tier1
+
+from paddle_tpu import aot  # noqa: E402
+from paddle_tpu.inference.engine import total_traces  # noqa: E402
+from paddle_tpu.inference.fleet import (  # noqa: E402
+    Fleet,
+    NoEligibleReplica,
+    ReplicaSignals,
+    Router,
+)
+from paddle_tpu.inference.serving import ServingEngine  # noqa: E402
+from paddle_tpu.models.llama import (  # noqa: E402
+    LlamaForCausalLM,
+    llama_tiny,
+)
+from paddle_tpu.observability import journal as obs_journal  # noqa: E402
+from paddle_tpu.observability import metrics as obs_metrics  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+
+ENGINE_KW = dict(max_slots=3, num_blocks=48, block_size=8,
+                 max_context_len=64, max_new_tokens=10,
+                 decode_window=4)
+
+
+@functools.lru_cache(maxsize=None)
+def _model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(vocab_size=96, hidden_size=64,
+                                       layers=2))
+
+
+def _factory(**kw):
+    return ServingEngine(_model(), **ENGINE_KW, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def _artifact():
+    # ONE shared AOT artifact for every fleet test in this module —
+    # building it is the expensive part, and sharing it is exactly the
+    # fleet's own deployment model
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix='paddle_tpu_fleet_test_')
+    path = tmp + '/artifact'
+    eng = ServingEngine(_model(), **ENGINE_KW)
+    try:
+        aot.build(eng, path)
+    finally:
+        eng.close()
+    return path
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(3, 96, (n,)).astype(
+        np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Router policy — pure units, no engines constructed
+
+
+def _sig(name, **kw):
+    return ReplicaSignals(name, **kw)
+
+
+class TestRouterPolicy:
+    def test_least_loaded_wins(self):
+        r = Router()
+        got = r.choose([_sig('a', queue_depth=4, in_flight=2),
+                        _sig('b', queue_depth=1, in_flight=1),
+                        _sig('c', queue_depth=0, in_flight=3)])
+        assert [s.name for s in got] == ['b', 'c', 'a']
+
+    def test_draining_excluded(self):
+        r = Router()
+        got = r.choose([_sig('a', draining=True), _sig('b')])
+        assert [s.name for s in got] == ['b']
+
+    def test_breach_and_unhealthy_excluded(self):
+        r = Router()
+        got = r.choose([_sig('a', breaching=True),
+                        _sig('b', healthy=False),
+                        _sig('c')])
+        assert [s.name for s in got] == ['c']
+
+    def test_phase_role_affinity(self):
+        # bare prefill/decode halves never take fresh submissions; a
+        # DisaggPair routes internally, so 'pair' is placeable
+        r = Router()
+        got = r.choose([_sig('p', role='prefill'),
+                        _sig('d', role='decode'),
+                        _sig('pair', role='pair', queue_depth=9),
+                        _sig('mono', role='monolithic', queue_depth=1)])
+        assert [s.name for s in got] == ['mono', 'pair']
+
+    def test_pressure_ceiling(self):
+        r = Router(max_pressure=1.0)
+        got = r.choose([_sig('hot', pool_pressure=1.0),
+                        _sig('warm', pool_pressure=0.99)])
+        assert [s.name for s in got] == ['warm']
+
+    def test_tie_breaks_by_pressure_err_tok_then_name(self):
+        r = Router()
+        # equal load: lowest pressure wins
+        got = r.choose([_sig('a', pool_pressure=0.5),
+                        _sig('b', pool_pressure=0.2)])
+        assert got[0].name == 'b'
+        # equal load+pressure: lowest windowed error rate wins
+        got = r.choose([_sig('a', err_rate=0.2), _sig('b', err_rate=0.0)])
+        assert got[0].name == 'b'
+        # equal everything else: HIGHEST windowed tok/s wins
+        got = r.choose([_sig('a', tok_s=10.0), _sig('b', tok_s=90.0)])
+        assert got[0].name == 'b'
+        # full tie: deterministic name order
+        got = r.choose([_sig('z'), _sig('a'), _sig('m')])
+        assert [s.name for s in got] == ['a', 'm', 'z']
+
+    def test_empty_when_nothing_eligible(self):
+        r = Router()
+        assert r.choose([_sig('a', draining=True),
+                         _sig('b', breaching=True)]) == []
+
+
+# ---------------------------------------------------------------------------
+# Per-replica telemetry scoping (the metrics_registry= satellite)
+
+
+class TestPrivateRegistry:
+    def test_series_and_trails_stay_apart(self):
+        obs_metrics.set_enabled(True)
+        # earlier test files feed the PROCESS registry/journal — clear
+        # both so "the global scope stayed clean" is provable here
+        obs_metrics.REGISTRY.reset()
+        obs_journal.JOURNAL.clear()
+        ra, rb = obs_metrics.MetricsRegistry(), obs_metrics.MetricsRegistry()
+        a = _factory(metrics_registry=ra, rid_start=0)
+        b = _factory(metrics_registry=rb, rid_start=1 << 20)
+        try:
+            rid_a = a.submit(_prompt(1, 6), max_new_tokens=4)
+            rid_b = b.submit(_prompt(2, 6), max_new_tokens=4)
+            while a.in_flight() or len(a.queue):
+                a.step()
+            while b.in_flight() or len(b.queue):
+                b.step()
+            a.result(rid_a), b.result(rid_b)
+            assert ra.get('serve.requests').value == 1
+            assert rb.get('serve.requests').value == 1
+            # neither replica wrote the process registry's serve series
+            g = obs_metrics.REGISTRY.get('serve.requests')
+            assert g is None or g.value == 0
+            # private journals: each replica's trail is in ITS journal
+            assert a._jr is not b._jr
+            assert a._jr.trail(rid_a) and not a._jr.trail(rid_b)
+            assert b._jr.trail(rid_b) and not b._jr.trail(rid_a)
+            assert not obs_journal.JOURNAL.trail(rid_a)
+        finally:
+            a.close()
+            b.close()
+
+    def test_rid_start_strides_are_disjoint(self):
+        a = _factory(metrics_registry=obs_metrics.MetricsRegistry(),
+                     rid_start=5)
+        try:
+            assert a.submit(_prompt(3, 4)) == 5
+            assert a.submit(_prompt(4, 4)) == 6
+        finally:
+            a.close()
+        with pytest.raises(ValueError, match='rid_start'):
+            _factory(rid_start=-1)
+
+    def test_ephemeral_ops_port_serves_private_registry(self):
+        import json
+        import urllib.request
+
+        obs_metrics.set_enabled(True)
+        reg = obs_metrics.MetricsRegistry()
+        eng = _factory(metrics_registry=reg, ops_port=0)
+        try:
+            port = eng.ops_server.port
+            assert port > 0                  # OS-assigned, discoverable
+            rid = eng.submit(_prompt(5, 6), max_new_tokens=4)
+            while eng.in_flight() or len(eng.queue):
+                eng.step()
+            eng.result(rid)
+            base = f'http://127.0.0.1:{port}'
+            body = urllib.request.urlopen(base + '/metrics').read().decode()
+            assert 'serve_requests 1' in body
+            hz = json.loads(urllib.request.urlopen(base + '/healthz').read())
+            assert hz['status'] == 'ok'
+            # the cross-process scrape path reads the same numbers
+            sig = ReplicaSignals.from_http('r0', base)
+            assert sig.healthy and not sig.draining
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# adopt_request — the drain-migration splice
+
+
+class TestAdoptRequest:
+    def test_adopted_stream_is_bit_equal(self):
+        donor = _factory(metrics_registry=obs_metrics.MetricsRegistry(),
+                         rid_start=1 << 20)
+        taker = _factory(metrics_registry=obs_metrics.MetricsRegistry(),
+                         rid_start=0)
+        ref = _factory()
+        try:
+            p = _prompt(7, 9)
+            rid = donor.submit(p, max_new_tokens=8)
+            donor.step()                     # mid-flight: tokens exist
+            donor.drain(True)
+            snap = donor.snapshot()
+            rec = next(r for r in snap['requests'] if r['rid'] == rid)
+            # the taker is BUSY, not fresh — restore() would refuse
+            busy = taker.submit(_prompt(8, 5), max_new_tokens=4)
+            taker.adopt_request(rec,
+                                trail=snap['trails'].get(str(rid)))
+            while taker.in_flight() or len(taker.queue):
+                taker.step()
+            got = taker.result(rid)
+            r_ref = ref.submit(p, max_new_tokens=8)
+            while ref.in_flight() or len(ref.queue):
+                ref.step()
+            assert np.array_equal(got, ref.result(r_ref))
+            taker.result(busy)
+            assert taker.allocator.in_use() == 0
+        finally:
+            donor.close()
+            taker.close()
+            ref.close()
+
+    def test_rid_collision_and_fit_refused(self):
+        taker = _factory()
+        try:
+            rid = taker.submit(_prompt(9, 5), max_new_tokens=4)
+            with pytest.raises(ValueError, match='already exists'):
+                taker.adopt_request({'rid': rid, 'prompt': [1, 2],
+                                     'max_new_tokens': 4})
+            with pytest.raises(ValueError, match='cannot fit'):
+                taker.adopt_request({'rid': 999, 'prompt': [1] * 60,
+                                     'max_new_tokens': 60})
+        finally:
+            taker.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet elasticity — scale, migrate, resurrect, snapshot
+
+
+class TestFleet:
+    def _fleet(self, tmp_path):
+        return Fleet(_factory, artifact=_artifact(),
+                     postmortem_dir=str(tmp_path / 'pm'))
+
+    def test_scale_migrate_kill_parity(self, tmp_path):
+        prompts = [_prompt(100 + i, 5 + (i % 4)) for i in range(10)]
+        mnts = [6 + (i % 3) for i in range(10)]
+        ref = _factory()
+        try:
+            rr = [ref.submit(p, max_new_tokens=m)
+                  for p, m in zip(prompts, mnts)]
+            while ref.in_flight() or len(ref.queue):
+                ref.step()
+            expect = [ref.result(r) for r in rr]
+        finally:
+            ref.close()
+
+        fleet = self._fleet(tmp_path)
+        try:
+            fleet.scale_to(1)
+            mark = total_traces()
+            rids = [fleet.submit(p, max_new_tokens=m)
+                    for p, m in zip(prompts[:4], mnts[:4])]
+            fleet.step()
+            # scale up under load: zero compiles (shared AOT artifact)
+            fleet.scale_to(3)
+            assert total_traces() == mark
+            assert len(fleet.replicas) == 3
+            rids += [fleet.submit(p, max_new_tokens=m)
+                     for p, m in zip(prompts[4:8], mnts[4:8])]
+            fleet.step()
+            # kill one replica mid-flood: requests resurrect from its
+            # postmortem bundle onto a fresh zero-compile standby
+            victim = next(iter(fleet.replicas))
+            with faults.FaultInjector(seed=0) as inj:
+                inj.script('replica_step',
+                           when=lambda c: c['replica'] == victim)
+                fleet.step()
+            assert victim not in fleet.replicas
+            assert fleet.counts['resurrections'] == 1
+            assert total_traces() == mark
+            rids += [fleet.submit(p, max_new_tokens=m)
+                     for p, m in zip(prompts[8:], mnts[8:])]
+            # scale down under load: drain + migrate to survivors
+            fleet.scale_to(1)
+            assert len(fleet.replicas) == 1
+            assert fleet.counts['migrations'] > 0
+            assert total_traces() == mark
+            fleet.run(max_steps=300)
+            got = [fleet.result(r) for r in rids]
+            for g, e in zip(got, expect):
+                assert np.array_equal(g, e)
+            assert all(eng.allocator.in_use() == 0
+                       for eng in fleet.replicas.values())
+            assert fleet.counts['routed'] == 10
+            assert abs(sum(fleet.route_shares().values()) - 1.0) < 1e-9
+        finally:
+            fleet.close()
+
+    def test_rolling_restart_keeps_capacity(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        try:
+            fleet.scale_to(2)
+            mark = total_traces()
+            rid = fleet.submit(_prompt(200, 6), max_new_tokens=6)
+            fleet.step()
+            old = next(iter(fleet.replicas))
+            fresh = fleet.restart(old)
+            assert old not in fleet.replicas
+            assert fresh in fleet.replicas
+            assert len(fleet.replicas) == 2
+            assert total_traces() == mark
+            fleet.run(max_steps=200)
+            assert fleet.result(rid) is not None
+        finally:
+            fleet.close()
+
+    def test_fleet_snapshot_roundtrip(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        f2 = None
+        try:
+            fleet.scale_to(2)
+            rid = fleet.submit(_prompt(201, 7), max_new_tokens=8)
+            fleet.step()
+            snap = fleet.snapshot()
+            assert snap['schema'] == 1
+            f2 = self._fleet(tmp_path)
+            f2.restore(snap)
+            f2.run(max_steps=200)
+            fleet.run(max_steps=200)
+            assert np.array_equal(f2.result(rid), fleet.result(rid))
+        finally:
+            fleet.close()
+            if f2 is not None:
+                f2.close()
+
+    def test_no_eligible_replica_raises(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        try:
+            fleet.scale_to(1)
+            fleet.drain(next(iter(fleet.replicas)))
+            with pytest.raises(NoEligibleReplica):
+                fleet.submit(_prompt(202, 5))
+        finally:
+            fleet.close()
+
+    def test_signals_reflect_drain_and_load(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        try:
+            fleet.scale_to(2)
+            a, b = list(fleet.replicas)
+            rid = fleet.submit(_prompt(203, 5), max_new_tokens=4)
+            owner = fleet._where[rid]
+            sigs = {s.name: s for s in fleet.signals()}
+            assert sigs[owner].load == 1
+            fleet.drain(a)
+            sigs = {s.name: s for s in fleet.signals()}
+            assert sigs[a].draining and not sigs[b].draining
+            # the router now refuses a, so the next request lands on b
+            rid2 = fleet.submit(_prompt(204, 5), max_new_tokens=4)
+            assert fleet._where[rid2] == b
+            fleet.run(max_steps=200)
+            fleet.result(rid), fleet.result(rid2)
+        finally:
+            fleet.close()

@@ -55,6 +55,8 @@ WIRES = {
         'block_size', 'config', 'draft_kv_len', 'draft_layers', 'kind',
         'kv_cache_dtype', 'kv_len', 'layers', 'request', 'schema',
         'trail'],
+    'fleet_snapshot': ['counts', 'next_index', 'replicas', 'schema',
+                       'sim_time_s', 'where'],
     'pair_snapshot': ['decode', 'failed', 'pending', 'prefill',
                       'schema'],
     'prefill_snapshot': [
